@@ -1,0 +1,63 @@
+"""horovod_tpu: a TPU-native distributed training framework with the
+capabilities of Horovod (reference: JayjeetAtGithub/horovod), re-designed
+for XLA/ICI rather than ported from NCCL/MPI.
+
+Quick start (the reference's ``import horovod.torch as hvd`` idiom)::
+
+    import horovod_tpu as hvd
+    hvd.init()
+    out = hvd.allreduce(stacked, op=hvd.Sum)       # eager collective
+    # ... or call the same ops inside a jitted shard_map step.
+
+Layer map (vs SURVEY.md §1): the user API here is L5; collectives compile
+to XLA HLOs over the device mesh (replacing L2b/L1's NCCL/MPI data plane).
+"""
+
+from .version import __version__  # noqa: F401
+
+from .basics import (  # noqa: F401
+    config,
+    cross_rank,
+    cross_size,
+    global_axis_name,
+    global_mesh,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    process_count,
+    process_rank,
+    rank,
+    shutdown,
+    size,
+)
+from .exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HorovodTpuError,
+    HostsUpdatedInterrupt,
+    NotInitializedError,
+)
+from .ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    barrier,
+    broadcast,
+    grouped_allreduce,
+    grouped_reducescatter,
+    reducescatter,
+)
+from .process_sets import (  # noqa: F401
+    ProcessSet,
+    add_process_set,
+    get_process_set_ids,
+    global_process_set,
+    remove_process_set,
+)

@@ -1,0 +1,230 @@
+"""Lifecycle + world facts: the ``hvd.init()/rank()/size()`` surface.
+
+TPU-native re-design of the reference's ``horovod/common/basics.py``
+(``HorovodBasics``) and the C API it binds
+(``horovod/common/operations.cc — horovod_init/_rank/_size/...``).
+
+Key divergence from the reference, by design: JAX is a single-controller SPMD
+system — one Python process drives many devices, and collectives are
+*compiled into* the step function rather than enqueued to a background
+thread. So:
+
+- ``size()`` is the number of **devices** (one rank per chip, like Horovod's
+  one rank per GPU), not the number of processes.
+- Inside a compiled step (under ``shard_map`` over the hvd axis), ``rank()``
+  returns the per-device ``lax.axis_index`` — a traced value.
+- Outside compiled code, ``rank()`` returns the first local device's global
+  rank: it is 0 exactly on the process that should do rank-0-only work
+  (checkpointing, logging), which preserves the reference idiom
+  ``if hvd.rank() == 0: save(...)``.
+- For input pipelines, shard data by ``process_rank()/process_count()``
+  (each controller process feeds its local devices), the JAX-native
+  equivalent of the reference's per-rank data sharding.
+
+Multi-host initialization uses ``jax.distributed.initialize`` driven by the
+launcher's env (coordinator address from the rendezvous server), replacing
+the reference's MPI/Gloo bootstrap.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Sequence
+
+from .exceptions import NotInitializedError
+from .topology import Topology
+from .utils.env import RuntimeConfig
+from .utils.logging import get_logger
+
+_lock = threading.Lock()
+
+
+class _GlobalState:
+    """Singleton runtime state (analog of the reference's
+    ``HorovodGlobalState`` in ``horovod/common/global_state.h``), minus the
+    background thread: negotiation is compiled away in the JAX path, and the
+    native runtime (``horovod_tpu.runtime``) owns its own loop when used.
+    """
+
+    def __init__(self) -> None:
+        self.initialized = False
+        self.topology: Topology | None = None
+        self.config: RuntimeConfig | None = None
+        self.mesh = None  # global 1-D jax Mesh over all ranks, axis 'hvd'
+        self.axis_name = "hvd"
+
+    def require_init(self) -> "_GlobalState":
+        if not self.initialized:
+            raise NotInitializedError()
+        return self
+
+
+_state = _GlobalState()
+
+
+def _maybe_init_distributed(config: RuntimeConfig) -> None:
+    """Multi-host bootstrap over DCN via jax.distributed.
+
+    The launcher (``horovod_tpu.runner``) writes the coordinator address in
+    env; on managed TPU slices JAX can also discover it from metadata, in
+    which case this is a no-op.
+    """
+    import jax
+
+    coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
+    nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)
+    proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "-1") or -1)
+    if coord and nprocs > 1 and proc_id >= 0:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nprocs,
+            process_id=proc_id,
+        )
+
+
+def init(devices: Sequence[Any] | None = None) -> None:
+    """Initialize the framework: topology, global mesh, process sets.
+
+    Replaces the reference's ``InitializeHorovodOnce()`` — but where that
+    spawned a background negotiation thread, this derives the static world:
+    sorted device list (ICI order), the global 1-D mesh (axis ``'hvd'``)
+    that every collective and the DistributedOptimizer shard over, and the
+    global process set. Idempotent.
+    """
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    with _lock:
+        if _state.initialized:
+            return
+        config = RuntimeConfig.from_env()
+        _maybe_init_distributed(config)
+        topo = Topology(devices)
+        _state.topology = topo
+        _state.config = config
+        _state.mesh = Mesh(np.array(topo.devices), (_state.axis_name,))
+        _state.initialized = True
+
+        # Register the global process set (id 0) now that the world exists.
+        from . import process_sets
+
+        process_sets._reset(topo, _state.mesh)
+        get_logger().info(
+            "horovod_tpu initialized: %d rank(s), %d host(s), backend=%s",
+            topo.size,
+            topo.cross_size,
+            jax.default_backend(),
+        )
+
+
+def shutdown() -> None:
+    """Tear down world state (elastic re-init calls this before re-forming)."""
+    with _lock:
+        if not _state.initialized:
+            return
+        from . import process_sets
+        from .ops.executable_cache import global_cache
+
+        # Compiled executables are sharded over this epoch's mesh; a new
+        # world must not hit them (stale devices / reused process-set ids).
+        global_cache().clear()
+        process_sets._clear()
+        _state.initialized = False
+        _state.topology = None
+        _state.mesh = None
+        _state.config = None
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def in_axis_scope(axis_name: str) -> bool:
+    """True when called under shard_map/pmap with `axis_name` bound.
+
+    The single shared probe used by every dual-regime API (rank(),
+    local_rank(), the collective ops) to decide traced vs eager dispatch.
+    """
+    import jax
+
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except (NameError, KeyError, TypeError):
+        return False
+
+
+def _axis_index_or_none(axis_name: str):
+    """Per-device rank if called under a mapped axis, else None."""
+    import jax
+
+    if in_axis_scope(axis_name):
+        return jax.lax.axis_index(axis_name)
+    return None
+
+
+def rank(axis_name: str | None = None):
+    """Global rank. Traced (per-device) inside shard_map; else process view."""
+    st = _state.require_init()
+    idx = _axis_index_or_none(axis_name or st.axis_name)
+    if idx is not None:
+        return idx
+    return st.topology.rank
+
+
+def size() -> int:
+    """Total number of ranks (devices) in the world."""
+    return _state.require_init().topology.size
+
+
+def local_rank(axis_name: str | None = None):
+    st = _state.require_init()
+    idx = _axis_index_or_none(axis_name or st.axis_name)
+    if idx is not None:
+        import jax.numpy as jnp
+
+        # Table lookup: hosts are not contiguous in ICI rank order.
+        return jnp.asarray(st.topology.local_rank_table)[idx]
+    return st.topology.local_rank
+
+
+def local_size() -> int:
+    return _state.require_init().topology.local_size
+
+
+def cross_rank() -> int:
+    return _state.require_init().topology.cross_rank
+
+
+def cross_size() -> int:
+    return _state.require_init().topology.cross_size
+
+
+def process_rank() -> int:
+    """This controller process's index — shard input pipelines by this."""
+    return _state.require_init().topology.process_index
+
+
+def process_count() -> int:
+    return _state.require_init().topology.process_count
+
+
+def global_mesh():
+    """The global 1-D mesh (axis 'hvd') in canonical ICI rank order."""
+    return _state.require_init().mesh
+
+
+def global_axis_name() -> str:
+    return _state.axis_name
+
+
+def config() -> RuntimeConfig:
+    return _state.require_init().config
+
+
+def is_homogeneous() -> bool:
+    """True if every host has the same number of local ranks."""
+    topo = _state.require_init().topology
+    return topo.size == topo.local_size * topo.cross_size

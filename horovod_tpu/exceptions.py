@@ -1,0 +1,54 @@
+"""Exception types that drive elastic recovery.
+
+Parity with the reference's ``horovod/common/exceptions.py``: two exception
+types form the contract between the runtime and the elastic retry loop
+(``horovod_tpu.elastic.run``):
+
+- ``HorovodInternalError``: a collective or the control plane failed (a peer
+  died, a TPU VM was preempted mid-step). The elastic loop responds by
+  restoring the last committed state and re-initializing the world.
+- ``HostsUpdatedInterrupt``: the elastic driver notified us that hosts were
+  added/removed but nothing failed; in-memory state is still good, only a
+  re-rendezvous is needed.
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective operation fails mid-flight.
+
+    Catching this in the elastic ``run`` decorator triggers state restore +
+    full re-initialization (new rendezvous, new world).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised when the elastic driver reports a host-set change.
+
+    In-memory state survives; the elastic loop re-syncs and continues.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API that requires ``init()`` was called before initialization."""
+
+    def __init__(self, what: str = "horovod_tpu"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class StalledTensorError(HorovodTpuError):
+    """Raised/reported when a tensor was submitted on some ranks but not all.
+
+    The classic distributed deadlock: a conditional diverged across ranks so
+    rank A waits forever on a collective rank B will never enter. Mirrors the
+    reference's stall inspector report (``horovod/common/stall_inspector.cc``).
+    """

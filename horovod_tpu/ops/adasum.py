@@ -1,0 +1,66 @@
+"""Adasum: scaling-invariant gradient combination, as XLA ops.
+
+Re-implementation of the math in the reference's
+``horovod/common/ops/adasum/adasum.h`` (templated recursive vector-halving
+reduction), re-targeted to the compiled regime. The pairwise rule for two
+gradients a, b:
+
+    adasum(a, b) = (1 - a.b / (2 a.a)) a  +  (1 - a.b / (2 b.b)) b
+
+i.e. each side is shrunk by half its projection onto the other, which makes
+the combination invariant to per-worker learning-rate scaling (the point of
+Adasum). Reduction over N ranks applies the rule along a binary tree.
+
+Where the reference runs recursive halving over MPI point-to-points, the
+compiled form all_gathers the N contributions over ICI (one AllGather HLO)
+and evaluates the O(N) pairwise tree locally on every device — identical
+results on every rank, no host round-trips, and the tree is unrolled into
+straight-line XLA code. For the world sizes Horovod's Adasum targets
+(ranks-per-node to low hundreds) the gather-then-combine form trades a
+modest memory factor for zero latency chain; a ppermute ring variant is the
+planned optimization for very large N.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def adasum_pair(a, b):
+    """Combine two same-shaped gradient tensors by the Adasum rule."""
+    af = a.ravel().astype(jnp.float32)
+    bf = b.ravel().astype(jnp.float32)
+    dot = jnp.dot(af, bf)
+    asq = jnp.dot(af, af)
+    bsq = jnp.dot(bf, bf)
+    # Guard zero norms: adasum(0, b) == b, adasum(a, 0) == a.
+    a_scale = jnp.where(asq > 0, 1.0 - dot / (2.0 * jnp.maximum(asq, 1e-30)), 0.0)
+    b_scale = jnp.where(bsq > 0, 1.0 - dot / (2.0 * jnp.maximum(bsq, 1e-30)), 0.0)
+    out = a_scale * af + b_scale * bf
+    return out.reshape(a.shape).astype(a.dtype)
+
+
+def adasum_tree(stack):
+    """Reduce a stacked (N, ...) array of per-rank tensors pairwise.
+
+    N need not be a power of two: odd elements are carried to the next
+    round, matching the reference's handling of non-power-of-two worlds.
+    """
+    n = stack.shape[0]
+    parts = [stack[i] for i in range(n)]
+    while len(parts) > 1:
+        nxt = [
+            adasum_pair(parts[i], parts[i + 1])
+            for i in range(0, len(parts) - 1, 2)
+        ]
+        if len(parts) % 2 == 1:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def adasum_reduce(x, axis_name: str):
+    """Adasum-allreduce `x` across the named axis (traced regime)."""
+    stacked = lax.all_gather(x, axis_name, axis=0)
+    return adasum_tree(stacked)

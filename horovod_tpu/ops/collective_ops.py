@@ -1,0 +1,390 @@
+"""The collective op surface: allreduce / allgather / broadcast / alltoall /
+reducescatter (+ grouped variants, barrier).
+
+TPU-native re-design of the reference's op layer (``horovod/common/ops/*`` +
+the per-framework ``mpi_ops.py`` wrappers). The reference *invokes* library
+collectives (NCCL/MPI/Gloo) at runtime after negotiating readiness; here
+collectives are *compiled*: XLA HLO collectives (AllReduce, AllGather,
+AllToAll, ReduceScatter, CollectivePermute) over the ICI mesh. Two regimes,
+one API:
+
+**Traced regime** — called inside a compiled step (under ``shard_map`` over a
+process set's axis). The call lowers directly to the HLO collective; fusion
+with neighboring computation is XLA's job. This is the production path: the
+DistributedOptimizer's gradient allreduce compiles into the train step, and
+the negotiation/fusion machinery of the reference is replaced by trace-time
+bucketing (``horovod_tpu.ops.fusion``).
+
+**Eager regime** — called outside any trace, for reference-style scripting
+(`hvd.allreduce(np.array(...))`) and tests. Tensors use the
+*stacked-rank convention*: a value for a process set of size N is an array of
+shape ``(N, *tensor_shape)``, row r holding rank r's tensor (the
+single-controller representation of "each rank has a tensor"). The call is
+backed by a per-signature compiled executable
+(``horovod_tpu.ops.executable_cache``) sharded over the set's sub-mesh.
+
+Reduce op constants mirror ``horovod/common/common.h``'s ``ReduceOp``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .executable_cache import global_cache
+
+# -- Reduce ops (parity: horovod.torch.mpi_ops Average/Sum/Adasum/Min/Max) ---
+
+Average = "average"
+Sum = "sum"
+Min = "min"
+Max = "max"
+Product = "product"
+Adasum = "adasum"
+
+_VALID_OPS = (Average, Sum, Min, Max, Product, Adasum)
+
+
+def _resolve_process_set(process_set):
+    if process_set is None:
+        from ..process_sets import global_process_set
+
+        return global_process_set
+    return process_set
+
+
+def _in_axis_scope(axis_name: str) -> bool:
+    """True when called under shard_map/pmap with `axis_name` bound."""
+    from ..basics import in_axis_scope
+
+    return in_axis_scope(axis_name)
+
+
+def _axis_size(axis_name: str) -> int:
+    return lax.psum(1, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# Traced-regime implementations (inside shard_map) — pure lax.
+# ---------------------------------------------------------------------------
+
+
+def _allreduce_traced(x, op, axis_name, prescale_factor, postscale_factor):
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    if op == Sum:
+        out = lax.psum(x, axis_name)
+    elif op == Average:
+        out = lax.pmean(x, axis_name)
+    elif op == Min:
+        out = lax.pmin(x, axis_name)
+    elif op == Max:
+        out = lax.pmax(x, axis_name)
+    elif op == Product:
+        gathered = lax.all_gather(x, axis_name, axis=0)
+        out = jnp.prod(gathered, axis=0)
+    elif op == Adasum:
+        from .adasum import adasum_reduce
+
+        out = adasum_reduce(x, axis_name)
+    else:
+        raise ValueError(f"unknown reduce op {op!r}; expected one of {_VALID_OPS}")
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, dtype=out.dtype)
+    return out
+
+
+def _allgather_traced(x, axis_name):
+    # Horovod allgather concatenates along dim 0 (equal shapes on TPU: XLA
+    # requires static uniform shapes; the reference's ragged first dim is
+    # supported eagerly via padding in `allgather_object`).
+    return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+
+def _broadcast_traced(x, root_rank, axis_name):
+    # No broadcast HLO is exposed through lax; the idiomatic XLA form is a
+    # masked psum, which XLA lowers to a one-to-all on ICI.
+    idx = lax.axis_index(axis_name)
+    zero = jnp.zeros_like(x)
+    contrib = jnp.where(idx == root_rank, x, zero)
+    return lax.psum(contrib, axis_name)
+
+
+def _alltoall_traced(x, axis_name):
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=True)
+
+
+def _reducescatter_traced(x, op, axis_name, prescale_factor, postscale_factor):
+    if op not in (Sum, Average):
+        raise ValueError(f"reducescatter supports Sum/Average, got {op!r}")
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
+    out = lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+    scale = postscale_factor
+    if op == Average:
+        scale = scale / _axis_size(axis_name)
+    if scale != 1.0:
+        out = out * jnp.asarray(scale, dtype=out.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Eager-regime dispatch: stacked-rank arrays over the set's sub-mesh,
+# executed via the compiled-executable cache.
+# ---------------------------------------------------------------------------
+
+
+def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
+    ps = _resolve_process_set(process_set)
+    mesh = ps.mesh
+    axis = ps.axis_name
+    n = ps.size()
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != n:
+        raise ValueError(
+            f"eager {kind} expects the stacked-rank convention: leading axis "
+            f"of size {n} (= process set size); got shape {x.shape}. Inside "
+            f"a compiled step, call this op under shard_map over axis "
+            f"{axis!r} instead."
+        )
+    key = (kind, x.shape, str(x.dtype), ps.process_set_id, extra_key)
+
+    def build():
+        def shard_fn(v):
+            # Each shard is (1, *tensor_shape): strip the stacking axis so the
+            # op sees the rank's tensor, then restore it for re-stacking.
+            return traced_fn(v[0])[None]
+
+        fn = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=P(axis),
+            out_specs=P(axis),
+            check_vma=False,
+        )
+        return jax.jit(fn)
+
+    compiled = global_cache().get_or_build(key, build)
+    sharding = NamedSharding(mesh, P(axis))
+    x = jax.device_put(x, sharding)
+    return compiled(x)
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def _resolve_op(op, average):
+    # `average=` is the reference's deprecated bool form; keep it working.
+    if op is None:
+        if average is None:
+            return Average
+        return Average if average else Sum
+    if average is not None:
+        raise ValueError("specify either op= or average=, not both")
+    if op not in _VALID_OPS:
+        raise ValueError(f"unknown reduce op {op!r}; expected one of {_VALID_OPS}")
+    return op
+
+
+def allreduce(
+    tensor,
+    average: bool | None = None,
+    op: str | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set=None,
+    name: str | None = None,
+):
+    """Reduce `tensor` across the process set; every rank gets the result.
+
+    Parity: ``horovod.torch.mpi_ops.allreduce`` /
+    ``horovod/common/ops/*_operations.cc`` Allreduce classes. On TPU this is
+    one AllReduce HLO over the ICI ring of the set's sub-mesh.
+    """
+    del name  # names exist for the reference's negotiation; nothing to key here
+    op = _resolve_op(op, average)
+    ps = _resolve_process_set(process_set)
+    if _in_axis_scope(ps.axis_name):
+        return _allreduce_traced(
+            tensor, op, ps.axis_name, prescale_factor, postscale_factor
+        )
+    traced = functools.partial(
+        _allreduce_traced,
+        op=op,
+        axis_name=ps.axis_name,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+    return _eager_dispatch(
+        "allreduce", traced, tensor, ps, (op, prescale_factor, postscale_factor)
+    )
+
+
+def grouped_allreduce(
+    tensors: Sequence[Any],
+    average: bool | None = None,
+    op: str | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set=None,
+):
+    """Allreduce a list of tensors as one fused operation.
+
+    Parity: ``hvd.grouped_allreduce`` + the reference's ``GroupTable``
+    (``horovod/common/group_table.cc``). In the traced regime the fusion pass
+    packs the group into same-dtype buckets and emits one AllReduce per
+    bucket — the compiled equivalent of the reference's fusion buffer.
+    """
+    op = _resolve_op(op, average)
+    ps = _resolve_process_set(process_set)
+    if _in_axis_scope(ps.axis_name):
+        from .fusion import fused_allreduce
+
+        return fused_allreduce(
+            list(tensors),
+            op=op,
+            axis_name=ps.axis_name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+        )
+    return [
+        allreduce(
+            t,
+            op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=ps,
+        )
+        for t in tensors
+    ]
+
+
+def allgather(tensor, process_set=None, name: str | None = None):
+    """Concatenate each rank's tensor along axis 0 on every rank.
+
+    Parity: ``hvd.allgather``. XLA requires equal shapes per rank (static
+    shapes on TPU); the reference's ragged first dimension is handled at the
+    object layer (``allgather_object``) via pad+size-exchange.
+    """
+    del name
+    ps = _resolve_process_set(process_set)
+    if _in_axis_scope(ps.axis_name):
+        return _allgather_traced(tensor, ps.axis_name)
+
+    # Eager stacked form: (n, d0, ...) -> (n, n*d0, ...): every row holds the
+    # concatenation. all_gather(tiled) inside gives per-shard (n*d0, ...).
+    def traced(x):
+        return _allgather_traced(x, ps.axis_name)
+
+    return _eager_dispatch("allgather", traced, tensor, ps)
+
+
+def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None):
+    """Broadcast rank `root_rank`'s tensor to every rank in the set.
+
+    Parity: ``hvd.broadcast`` / ``BroadcastOp``; as in the reference,
+    `root_rank` is a **global** rank (which must belong to the set), not a
+    set-relative index. Compiled as a masked psum, which XLA turns into a
+    root-sourced transfer over ICI.
+    """
+    del name
+    ps = _resolve_process_set(process_set)
+    try:
+        relative_root = ps.ranks.index(root_rank)
+    except ValueError:
+        raise ValueError(
+            f"root_rank {root_rank} (a global rank) is not a member of "
+            f"process set {ps.ranks}"
+        ) from None
+    if _in_axis_scope(ps.axis_name):
+        return _broadcast_traced(tensor, relative_root, ps.axis_name)
+
+    def traced(x):
+        return _broadcast_traced(x, relative_root, ps.axis_name)
+
+    return _eager_dispatch("broadcast", traced, tensor, ps, (relative_root,))
+
+
+def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
+    """Scatter distinct chunks of `tensor` to every rank, gather received.
+
+    Parity: ``hvd.alltoall`` (the collective primitive MoE/expert-parallel
+    dispatch builds on). Equal splits compile to one AllToAll HLO — the
+    all-to-all rides ICI directly. Uneven `splits` are not supported in the
+    compiled path (XLA static shapes); pad to equal chunks.
+    """
+    del name
+    if splits is not None:
+        raise NotImplementedError(
+            "uneven alltoall splits require dynamic shapes, which cannot "
+            "compile on TPU; pad chunks to equal size (see "
+            "horovod_tpu.ops.fusion.pad_to_multiple)"
+        )
+    ps = _resolve_process_set(process_set)
+    if _in_axis_scope(ps.axis_name):
+        return _alltoall_traced(tensor, ps.axis_name)
+
+    def traced(x):
+        return _alltoall_traced(x, ps.axis_name)
+
+    return _eager_dispatch("alltoall", traced, tensor, ps)
+
+
+def reducescatter(
+    tensor,
+    op: str | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set=None,
+    name: str | None = None,
+):
+    """Reduce across ranks and scatter: rank r keeps slice r along axis 0.
+
+    Parity: ``hvd.reducescatter`` / ``ReducescatterOp``. One ReduceScatter
+    HLO; dim 0 must be divisible by the set size (static shapes).
+    """
+    del name
+    op = _resolve_op(op, None) if op is not None else Average
+    ps = _resolve_process_set(process_set)
+    if _in_axis_scope(ps.axis_name):
+        return _reducescatter_traced(
+            tensor, op, ps.axis_name, prescale_factor, postscale_factor
+        )
+
+    def traced(x):
+        return _reducescatter_traced(
+            x, op, ps.axis_name, prescale_factor, postscale_factor
+        )
+
+    return _eager_dispatch(
+        "reducescatter", traced, tensor, ps, (op, prescale_factor, postscale_factor)
+    )
+
+
+def grouped_reducescatter(tensors: Sequence[Any], op: str | None = None, **kw):
+    return [reducescatter(t, op=op, **kw) for t in tensors]
+
+
+def barrier(process_set=None) -> None:
+    """Block until every rank in the set reaches the barrier.
+
+    Parity: ``hvd.barrier``. Eagerly: a scalar psum over the sub-mesh,
+    blocked on. (In the compiled regime barriers are meaningless — XLA's
+    dataflow order is the synchronization.)
+    """
+    ps = _resolve_process_set(process_set)
+    token = jnp.ones((ps.size(),), dtype=jnp.int32)
+    out = _eager_dispatch(
+        "barrier",
+        lambda x: lax.psum(x, ps.axis_name),
+        token,
+        ps,
+    )
+    jax.block_until_ready(out)

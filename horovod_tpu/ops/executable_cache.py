@@ -1,0 +1,75 @@
+"""Compiled-executable cache for eager collectives.
+
+The TPU-native descendant of the reference's response cache
+(``horovod/common/response_cache.cc``): where Horovod caches *negotiated
+responses* keyed by tensor signature so steady-state steps skip the
+controller round-trip, an XLA system caches *compiled executables* keyed by
+the same signature — op type, shape, dtype, process set, scale factors. A
+cache hit dispatches a pre-compiled collective with zero negotiation or
+compilation; a miss costs one XLA compile (the analog of Horovod's slow
+negotiation path), so signatures are designed to repeat (static shapes,
+bucket-size quantization in the fusion pass).
+
+An LRU bound (``HOROVOD_CACHE_CAPACITY``) protects against signature churn
+from dynamic shapes, just as the reference's capacity bound does.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Hashable
+
+
+class ExecutableCache:
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._entries: "collections.OrderedDict[Hashable, Any]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return self._entries[key]
+        # Build outside the lock: XLA compiles can take seconds and must not
+        # serialize unrelated lookups. A racing duplicate build is benign.
+        value = build()
+        with self._lock:
+            self.misses += 1
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+        return value
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self.hits = 0
+            self.misses = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+_global_cache: ExecutableCache | None = None
+
+
+def global_cache() -> ExecutableCache:
+    global _global_cache
+    if _global_cache is None:
+        from ..basics import _state
+        from ..utils.env import get_int
+
+        if _state.initialized and _state.config is not None:
+            capacity = _state.config.cache_capacity
+        else:
+            capacity = get_int("HOROVOD_CACHE_CAPACITY", 1024)
+        _global_cache = ExecutableCache(capacity)
+    return _global_cache

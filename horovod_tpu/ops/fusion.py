@@ -1,0 +1,151 @@
+"""Trace-time tensor fusion: the compiled answer to Horovod's fusion buffer.
+
+The reference packs small tensors into a persistent 64 MiB scratch buffer at
+runtime (``horovod/common/fusion_buffer_manager.cc`` + the controller's
+``FuseResponses()``), because each NCCL launch has fixed latency. On TPU the
+same economics hold — each AllReduce HLO has fixed ICI latency — but the
+packing can happen **at trace time**: the gradient pytree is known when the
+step function is traced, so we statically group leaves into same-dtype
+buckets up to ``HOROVOD_FUSION_THRESHOLD`` bytes, emit one concat + one
+AllReduce + one split per bucket, and let XLA fuse the pack/unpack copies
+into neighboring ops (the role played by ``cuda_kernels.cu``'s batched
+memcpy kernels in the reference).
+
+This "static negotiation" is why no background controller thread exists in
+the JAX path: readiness ordering is a dataflow fact inside the compiled
+program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+from ..utils.env import get_int
+
+
+def fusion_threshold_bytes() -> int:
+    from ..basics import _state
+
+    if _state.initialized and _state.config is not None:
+        return _state.config.fusion_threshold_bytes
+    return get_int("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024)
+
+
+def bucket_leaves(
+    leaves: Sequence[Any], threshold_bytes: int | None = None
+) -> list[list[int]]:
+    """Group leaf indices into same-dtype buckets of <= threshold bytes.
+
+    Order-preserving greedy packing (mirrors the controller's first-fit
+    response fusion). A leaf larger than the threshold gets its own bucket.
+    threshold <= 0 disables fusion (one bucket per leaf).
+    """
+    if threshold_bytes is None:
+        threshold_bytes = fusion_threshold_bytes()
+    buckets: list[list[int]] = []
+    bucket_dtype = None
+    bucket_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nbytes = int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+        if (
+            threshold_bytes <= 0
+            or not buckets
+            or bucket_dtype != leaf.dtype
+            or bucket_bytes + nbytes > threshold_bytes
+        ):
+            buckets.append([i])
+            bucket_dtype = leaf.dtype
+            bucket_bytes = nbytes
+        else:
+            buckets[-1].append(i)
+            bucket_bytes += nbytes
+    return buckets
+
+
+def _reduce_bucket(flat, op, axis_name, prescale_factor, postscale_factor):
+    from .collective_ops import _allreduce_traced
+
+    return _allreduce_traced(flat, op, axis_name, prescale_factor, postscale_factor)
+
+
+def fused_allreduce(
+    tensors: Sequence[Any],
+    op,
+    axis_name: str,
+    threshold_bytes: int | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> list[Any]:
+    """Allreduce a list of tensors with static bucketing (traced regime)."""
+    tensors = [jnp.asarray(t) for t in tensors]
+    from .collective_ops import Adasum
+
+    if op == Adasum:
+        # Adasum's scale factors are whole-vector dot products — packing
+        # tensors into one buffer would couple per-layer factors (the
+        # reference computes them per tensor inside its fusion buffer too).
+        return [
+            _reduce_bucket(t, op, axis_name, prescale_factor, postscale_factor)
+            for t in tensors
+        ]
+    buckets = bucket_leaves(tensors, threshold_bytes)
+    out: list[Any] = [None] * len(tensors)
+    for bucket in buckets:
+        if len(bucket) == 1:
+            i = bucket[0]
+            out[i] = _reduce_bucket(
+                tensors[i], op, axis_name, prescale_factor, postscale_factor
+            )
+            continue
+        flats = [tensors[i].ravel() for i in bucket]
+        packed = jnp.concatenate(flats)
+        reduced = _reduce_bucket(
+            packed, op, axis_name, prescale_factor, postscale_factor
+        )
+        offset = 0
+        for i in bucket:
+            n = tensors[i].size
+            out[i] = reduced[offset : offset + n].reshape(tensors[i].shape)
+            offset += n
+    return out
+
+
+def fused_allreduce_pytree(
+    tree,
+    op,
+    axis_name: str,
+    threshold_bytes: int | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Allreduce every leaf of a pytree (the gradient pytree) with fusion."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    reduced = fused_allreduce(
+        leaves,
+        op,
+        axis_name,
+        threshold_bytes=threshold_bytes,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+    return jax.tree.unflatten(treedef, reduced)
+
+
+def pad_to_multiple(x, multiple: int, axis: int = 0):
+    """Zero-pad `x` along `axis` to a multiple of `multiple`.
+
+    Helper for alltoall/reducescatter whose dim-0 must divide evenly on TPU
+    (static shapes); returns (padded, original_size).
+    """
+    size = x.shape[axis]
+    remainder = size % multiple
+    if remainder == 0:
+        return x, size
+    pad = multiple - remainder
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths), size

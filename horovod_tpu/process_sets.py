@@ -1,0 +1,154 @@
+"""Process sets: named subsets of ranks with their own collectives.
+
+Re-design of the reference's ``horovod/common/process_set.cc`` /
+``process_sets.py`` (``ProcessSetTable``, ``add_process_set``) for the mesh
+world: a process set is a subset of device ranks, realized as
+
+- a **sub-mesh** (1-D ``jax.sharding.Mesh`` over exactly those devices, in
+  rank order) used by the eager collective wrappers, and
+- an **axis name** usable inside compiled steps: shard_map over
+  ``ps.mesh`` with axis ``ps.axis_name`` gives collectives scoped to the
+  set — the compiled analog of the reference's per-process-set
+  communicators (NCCL comm per set in ``nccl_operations.cc``).
+
+Where the reference negotiates set membership dynamically over its control
+plane, membership here is static per ``init()`` epoch (elastic re-init
+rebuilds the table), which is what lets XLA compile set-scoped collectives
+with fixed replica groups.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Sequence
+
+from .exceptions import HorovodTpuError
+
+_lock = threading.Lock()
+
+
+class ProcessSet:
+    """A subset of ranks. ``process_set_id`` 0 is the global set."""
+
+    def __init__(self, ranks: Sequence[int]):
+        self.ranks: list[int] = sorted(int(r) for r in ranks)
+        if len(set(self.ranks)) != len(self.ranks):
+            raise ValueError(f"duplicate ranks in process set: {ranks}")
+        self.process_set_id: int = -1  # assigned on add
+        self._mesh = None
+        self._topology = None
+
+    # -- wiring (called by the table) ---------------------------------------
+
+    def _initialize(self, process_set_id: int, topology, global_mesh) -> None:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        if self.ranks and (
+            self.ranks[0] < 0 or self.ranks[-1] >= topology.size
+        ):
+            raise ValueError(
+                f"process set ranks {self.ranks} out of range for world size "
+                f"{topology.size}"
+            )
+        self.process_set_id = process_set_id
+        self._topology = topology
+        if process_set_id == 0:
+            self._mesh = global_mesh
+        else:
+            devices = [topology.devices[r] for r in self.ranks]
+            self._mesh = Mesh(np.array(devices), (self.axis_name,))
+
+    # -- public surface ------------------------------------------------------
+
+    @property
+    def axis_name(self) -> str:
+        return "hvd" if self.process_set_id == 0 else f"hvd_ps{self.process_set_id}"
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            raise HorovodTpuError(
+                "process set not registered; call add_process_set() after init()"
+            )
+        return self._mesh
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank *within the set* (process-level view)."""
+        topo = self._topology
+        my_global = topo.rank
+        try:
+            return self.ranks.index(my_global)
+        except ValueError:
+            return -1
+
+    def included(self) -> bool:
+        return self.rank() >= 0
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+global_process_set = ProcessSet([])
+
+_table: dict[int, ProcessSet] = {}
+_next_id = 1
+
+
+def _reset(topology, global_mesh) -> None:
+    """(Re)build the table at init(): register the global set as id 0."""
+    global _next_id
+    with _lock:
+        _table.clear()
+        _next_id = 1
+        global_process_set.ranks = list(range(topology.size))
+        global_process_set._initialize(0, topology, global_mesh)
+        _table[0] = global_process_set
+
+
+def _clear() -> None:
+    with _lock:
+        _table.clear()
+        global_process_set._mesh = None
+        global_process_set.process_set_id = -1
+
+
+def add_process_set(process_set: ProcessSet | Sequence[int]) -> ProcessSet:
+    """Register a new process set from a list of global ranks."""
+    from . import basics
+
+    st = basics._state.require_init()
+    if not isinstance(process_set, ProcessSet):
+        process_set = ProcessSet(process_set)
+    global _next_id
+    with _lock:
+        for existing in _table.values():
+            if existing.ranks == process_set.ranks:
+                raise ValueError(
+                    f"a process set with ranks {process_set.ranks} already "
+                    f"exists: {existing}"
+                )
+        process_set._initialize(_next_id, st.topology, st.mesh)
+        _table[_next_id] = process_set
+        _next_id += 1
+    return process_set
+
+
+def remove_process_set(process_set: ProcessSet) -> bool:
+    if process_set.process_set_id <= 0:
+        return False  # cannot remove the global set (parity with reference)
+    with _lock:
+        removed = _table.pop(process_set.process_set_id, None)
+    if removed is not None:
+        process_set._mesh = None
+        process_set.process_set_id = -1
+        return True
+    return False
+
+
+def get_process_set_ids() -> list[int]:
+    with _lock:
+        return sorted(_table.keys())

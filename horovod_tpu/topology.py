@@ -1,0 +1,153 @@
+"""ICI-topology-aware rank assignment.
+
+The reference assigns ranks in host:slot order
+(``horovod/runner/common/util/hosts.py — get_host_assignments``). On TPU the
+equivalent must be topology-aware: ranks follow the ICI torus coordinates so
+that (a) neighboring ranks are ICI neighbors (ring collectives ride ICI links,
+not DCN) and (b) replica groups formed from contiguous rank ranges are
+ICI-contiguous sub-tori.
+
+This module sorts ``jax.devices()`` into that canonical order and derives the
+Horovod world facts (rank / local_rank / cross_rank) from it:
+
+- ``rank``        — index of a device in the canonical topology order.
+- ``local_rank``  — index among devices on the same host (process).
+- ``cross_rank``  — host index (DCN coordinate), matching the reference's
+                    cross-communicator used for hierarchical allreduce.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import numpy as np
+
+
+def _device_sort_key(device: Any):
+    """Sort key: (slice, ICI coords z-major, core) with host as tiebreak.
+
+    TPU devices expose ``coords`` (x, y, z on the ICI torus) and
+    ``slice_index`` for multi-slice jobs. CPU/other devices fall back to
+    ``(process_index, id)`` which preserves JAX's default stable order.
+    """
+    slice_index = getattr(device, "slice_index", 0) or 0
+    coords = getattr(device, "coords", None)
+    core = getattr(device, "core_on_chip", 0) or 0
+    if coords is not None:
+        # z-major ordering keeps x-neighbors adjacent in rank space; on a
+        # torus this makes [r, r+1] pairs ICI-linked along the minor axis.
+        x, y, z = (list(coords) + [0, 0, 0])[:3]
+        return (slice_index, z, y, x, core, device.process_index, device.id)
+    return (slice_index, device.process_index, device.id)
+
+
+def sorted_devices(devices: Sequence[Any] | None = None) -> list[Any]:
+    """All devices in canonical ICI-topology order (the rank order)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=_device_sort_key)
+
+
+class Topology:
+    """World facts derived from the device list.
+
+    One instance is built at ``init()`` and owned by ``basics``. It answers
+    every rank/size query and provides the canonical device ordering used to
+    build meshes (so mesh axis order == rank order == ICI order).
+    """
+
+    def __init__(self, devices: Sequence[Any] | None = None):
+        import jax
+
+        self.devices: list[Any] = sorted_devices(devices)
+        self.num_devices: int = len(self.devices)
+        self.process_index: int = jax.process_index()
+        self.process_count: int = jax.process_count()
+
+        # Host (process) grouping: local == same process in JAX's model,
+        # which on TPU VMs == same host.
+        self._local_devices = [
+            d for d in self.devices if d.process_index == self.process_index
+        ]
+        self._device_rank = {id(d): i for i, d in enumerate(self.devices)}
+
+        # Ranks grouped by process, in process order — the cross structure.
+        procs = sorted({d.process_index for d in self.devices})
+        self._proc_order = {p: i for i, p in enumerate(procs)}
+
+        # Per-rank local/cross index tables. The canonical ICI order does NOT
+        # group a host's chips contiguously (a host's 2x2 block interleaves
+        # with its torus neighbors), so local_rank(global_rank) must be a
+        # table lookup, not arithmetic.
+        seen_per_proc: dict[int, int] = {}
+        self.local_rank_table: list[int] = []
+        self.cross_rank_table: list[int] = []
+        for d in self.devices:
+            idx = seen_per_proc.get(d.process_index, 0)
+            self.local_rank_table.append(idx)
+            seen_per_proc[d.process_index] = idx + 1
+            self.cross_rank_table.append(self._proc_order[d.process_index])
+
+    # -- Horovod world facts -------------------------------------------------
+
+    def rank_of(self, device: Any) -> int:
+        return self._device_rank[id(device)]
+
+    @property
+    def local_devices(self) -> list[Any]:
+        return self._local_devices
+
+    @property
+    def size(self) -> int:
+        """Total ranks == total devices (one rank per chip, as in Horovod)."""
+        return self.num_devices
+
+    @property
+    def local_size(self) -> int:
+        return len(self._local_devices)
+
+    @property
+    def rank(self) -> int:
+        """The first local device's global rank (controller-process view).
+
+        In single-controller SPMD there is no single 'my rank'; per-device
+        rank comes from ``lax.axis_index`` inside the compiled step. This
+        process-level value exists so rank-0-only idioms (checkpointing,
+        logging) from reference-style scripts keep working: it is 0 exactly
+        on the process that owns the rank-0 device.
+        """
+        if not self._local_devices:
+            return 0
+        return self.rank_of(self._local_devices[0])
+
+    @property
+    def local_rank(self) -> int:
+        """Process-level view: 0 (the first local device's local index)."""
+        return 0
+
+    @property
+    def cross_rank(self) -> int:
+        return self._proc_order.get(self.process_index, 0)
+
+    @property
+    def cross_size(self) -> int:
+        return len(self._proc_order)
+
+    def device_coords(self, device: Any) -> tuple | None:
+        coords = getattr(device, "coords", None)
+        return tuple(coords) if coords is not None else None
+
+    def describe(self) -> str:
+        lines = [
+            f"world: {self.size} device rank(s) across "
+            f"{self.cross_size} host(s)"
+        ]
+        for i, d in enumerate(self.devices):
+            coords = self.device_coords(d)
+            lines.append(
+                f"  rank {i}: {d.platform}:{d.id} host={d.process_index}"
+                + (f" coords={coords}" if coords else "")
+            )
+        return "\n".join(lines)

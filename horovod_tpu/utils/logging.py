@@ -1,0 +1,43 @@
+"""Leveled logging, mirroring the reference's ``horovod/common/logging.cc``.
+
+``HOROVOD_LOG_LEVEL`` in {trace, debug, info, warning, error, fatal};
+``HOROVOD_LOG_TIMESTAMP`` / ``HOROVOD_LOG_HIDE_TIME`` control the prefix.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_LEVELS = {
+    "trace": 5,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+logging.addLevelName(5, "TRACE")
+
+_logger: logging.Logger | None = None
+
+
+def get_logger() -> logging.Logger:
+    global _logger
+    if _logger is None:
+        logger = logging.getLogger("horovod_tpu")
+        level = os.environ.get("HOROVOD_LOG_LEVEL", "warning").lower()
+        logger.setLevel(_LEVELS.get(level, logging.WARNING))
+        if not logger.handlers:
+            handler = logging.StreamHandler(sys.stderr)
+            if os.environ.get("HOROVOD_LOG_HIDE_TIME"):
+                fmt = "[%(levelname)s] %(message)s"
+            else:
+                fmt = "%(asctime)s [%(levelname)s] %(message)s"
+            handler.setFormatter(logging.Formatter(fmt))
+            logger.addHandler(handler)
+        logger.propagate = False
+        _logger = logger
+    return _logger

@@ -1,0 +1,42 @@
+"""Test harness: an 8-device virtual CPU mesh stands in for a TPU slice.
+
+The reference tests every distributed behavior with N processes on one
+machine (SURVEY.md §4 "localhost-as-cluster"); the single-controller analog
+is N virtual CPU devices in one process. Must configure JAX before any
+backend is initialized, so this runs at conftest import time.
+"""
+
+import os
+
+# Neutralize the axon TPU tunnel for tests (the sitecustomize in
+# PYTHONPATH force-selects the 'axon' platform when these are set).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_world():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    assert hvd.size() == 8, (
+        f"expected the 8-device virtual CPU mesh, got {hvd.size()} devices "
+        f"on backend {jax.default_backend()}"
+    )
+    yield
+
+
+@pytest.fixture()
+def hvd():
+    import horovod_tpu as hvd
+
+    return hvd

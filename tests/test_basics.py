@@ -1,0 +1,72 @@
+"""World facts + process sets, mirroring the reference's basics coverage
+(test/parallel/test_torch.py rank/size assertions, process-set registration).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def test_world_facts(hvd):
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8  # single controller process owns all 8
+    assert hvd.rank() == 0
+    assert hvd.cross_size() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.process_count() == 1
+    assert hvd.process_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_rank_is_traced_inside_shard_map(hvd):
+    mesh = hvd.global_mesh()
+
+    def step():
+        return hvd.rank().reshape(1)
+
+    f = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(), out_specs=P("hvd"))
+    )
+    np.testing.assert_array_equal(np.asarray(f()), np.arange(8))
+
+
+def test_global_mesh_axis(hvd):
+    mesh = hvd.global_mesh()
+    assert mesh.axis_names == ("hvd",)
+    assert mesh.devices.size == 8
+
+
+def test_process_set_registration(hvd):
+    ps = hvd.add_process_set([0, 2, 4, 6])
+    try:
+        assert ps.process_set_id > 0
+        assert ps.size() == 4
+        assert ps.mesh.devices.size == 4
+        assert ps.axis_name != hvd.global_process_set.axis_name
+        assert ps.process_set_id in hvd.get_process_set_ids()
+        with pytest.raises(ValueError):
+            hvd.add_process_set([0, 2, 4, 6])  # duplicate membership
+    finally:
+        assert hvd.remove_process_set(ps)
+    assert ps.process_set_id == -1
+
+
+def test_cannot_remove_global_set(hvd):
+    assert not hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_process_set_rank_validation(hvd):
+    with pytest.raises(ValueError):
+        hvd.add_process_set([0, 99])
+
+
+def test_uninitialized_error():
+    import horovod_tpu.basics as basics
+    from horovod_tpu.exceptions import NotInitializedError
+
+    st = basics._GlobalState()
+    with pytest.raises(NotInitializedError):
+        st.require_init()

@@ -1,0 +1,272 @@
+"""Collective numerics across ranks — the analog of the reference's
+``test/parallel/test_torch.py`` op tests: every op is checked against a
+local numpy reference computation (SURVEY.md §4 "numerical assertions
+pattern"), over multiple dtypes, in both eager (stacked-rank) and traced
+(shard_map) regimes, including sub-world process sets.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+DTYPES = [np.float32, np.int32, np.float16]
+
+
+def _tolerance(dtype):
+    return dict(rtol=1e-3, atol=1e-3) if dtype == np.float16 else dict(rtol=1e-6, atol=1e-6)
+
+
+# -- eager regime (stacked-rank convention) ---------------------------------
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_sum_eager(hvd, dtype):
+    x = np.arange(8 * 6, dtype=dtype).reshape(8, 2, 3)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    expected = np.tile(x.sum(axis=0), (8, 1, 1))
+    np.testing.assert_allclose(out, expected, **_tolerance(dtype))
+
+
+def test_allreduce_average_default(hvd):
+    x = np.random.RandomState(0).randn(8, 5).astype(np.float32)
+    out = np.asarray(hvd.allreduce(x))
+    np.testing.assert_allclose(out, np.tile(x.mean(0), (8, 1)), rtol=1e-6)
+
+
+def test_allreduce_min_max(hvd):
+    x = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Min)), np.tile(x.min(0), (8, 1))
+    )
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Max)), np.tile(x.max(0), (8, 1))
+    )
+
+
+def test_allreduce_product(hvd):
+    x = np.random.RandomState(2).uniform(0.5, 1.5, (8, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Product)),
+        np.tile(x.prod(0), (8, 1)),
+        rtol=1e-5,
+    )
+
+
+def test_allreduce_prescale_postscale(hvd):
+    x = np.ones((8, 3), dtype=np.float32)
+    out = np.asarray(
+        hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5, postscale_factor=2.0)
+    )
+    np.testing.assert_allclose(out, np.full((8, 3), 8.0))
+
+
+def test_allreduce_average_bool_compat(hvd):
+    x = np.full((8, 2), 2.0, dtype=np.float32)
+    np.testing.assert_allclose(np.asarray(hvd.allreduce(x, average=False)), 16.0)
+    with pytest.raises(ValueError):
+        hvd.allreduce(x, average=True, op=hvd.Sum)
+
+
+def test_allreduce_shape_validation(hvd):
+    with pytest.raises(ValueError, match="stacked-rank"):
+        hvd.allreduce(np.zeros((3, 2), np.float32))
+
+
+def test_allgather_eager(hvd):
+    x = np.arange(8 * 2 * 3, dtype=np.float32).reshape(8, 2, 3)
+    out = np.asarray(hvd.allgather(x))
+    concat = x.reshape(16, 3)
+    assert out.shape == (8, 16, 3)
+    for r in range(8):
+        np.testing.assert_array_equal(out[r], concat)
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast_eager(hvd, root):
+    x = np.random.RandomState(root).randn(8, 4).astype(np.float32)
+    out = np.asarray(hvd.broadcast(x, root_rank=root))
+    np.testing.assert_allclose(out, np.tile(x[root], (8, 1)), rtol=1e-6)
+
+
+def test_broadcast_root_validation(hvd):
+    with pytest.raises(ValueError):
+        hvd.broadcast(np.zeros((8, 2), np.float32), root_rank=8)
+
+
+def test_alltoall_eager(hvd):
+    # rank r sends chunk j to rank j; chunk = row block of size 1.
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    out = np.asarray(hvd.alltoall(x[:, :, None]))[..., 0]
+    np.testing.assert_array_equal(out, x.T)
+
+
+def test_alltoall_uneven_splits_rejected(hvd):
+    with pytest.raises(NotImplementedError):
+        hvd.alltoall(np.zeros((8, 8), np.float32), splits=[1] * 8)
+
+
+def test_reducescatter_eager(hvd):
+    x = np.random.RandomState(3).randn(8, 16, 3).astype(np.float32)
+    out = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+    assert out.shape == (8, 2, 3)
+    total = x.sum(axis=0)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], total[2 * r : 2 * r + 2], rtol=1e-5)
+
+
+def test_reducescatter_average(hvd):
+    x = np.ones((8, 8), dtype=np.float32)
+    out = np.asarray(hvd.reducescatter(x, op=hvd.Average))
+    np.testing.assert_allclose(out, np.ones((8, 1)))
+
+
+def test_grouped_allreduce_eager(hvd):
+    xs = [
+        np.random.RandomState(i).randn(8, 3).astype(np.float32) for i in range(3)
+    ]
+    outs = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out), np.tile(x.sum(0), (8, 1)), rtol=1e-5)
+
+
+def test_barrier(hvd):
+    hvd.barrier()  # must simply not deadlock/throw
+
+
+# -- process-set scoped collectives ----------------------------------------
+
+
+def test_allreduce_process_set(hvd):
+    ps = hvd.add_process_set([1, 3, 5, 7])
+    try:
+        x = np.random.RandomState(4).randn(4, 6).astype(np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+        np.testing.assert_allclose(out, np.tile(x.sum(0), (4, 1)), rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_broadcast_process_set_global_root_rank(hvd):
+    # root_rank is a GLOBAL rank (reference semantics): 4 is row 1 of the set.
+    ps = hvd.add_process_set([0, 4])
+    try:
+        x = np.stack([np.zeros(3), np.ones(3)]).astype(np.float32)
+        out = np.asarray(hvd.broadcast(x, root_rank=4, process_set=ps))
+        np.testing.assert_allclose(out, np.ones((2, 3)))
+        with pytest.raises(ValueError, match="not a member"):
+            hvd.broadcast(x, root_rank=1, process_set=ps)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_grouped_allreduce_adasum_not_fused(hvd):
+    """Adasum grouped results must equal per-tensor Adasum (no bucket
+    coupling of the projection factors)."""
+    xs = [
+        np.random.RandomState(i).randn(8, 3).astype(np.float32) for i in range(2)
+    ]
+    grouped = hvd.grouped_allreduce(xs, op=hvd.Adasum)
+    single = [hvd.allreduce(x, op=hvd.Adasum) for x in xs]
+    for g, s in zip(grouped, single):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(s), rtol=1e-6)
+
+
+# -- traced regime: ops inside a user shard_map ------------------------------
+
+
+def _traced(hvd, fn, in_specs, out_specs, *args):
+    mesh = hvd.global_mesh()
+    return jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    )(*args)
+
+
+def test_allreduce_traced(hvd):
+    x = np.arange(8.0, dtype=np.float32)
+
+    def step(v):
+        return hvd.allreduce(v, op=hvd.Sum)
+
+    out = _traced(hvd, step, P("hvd"), P("hvd"), x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_allreduce_traced_average(hvd):
+    x = np.arange(8.0, dtype=np.float32)
+
+    def step(v):
+        return hvd.allreduce(v)
+
+    out = _traced(hvd, step, P("hvd"), P("hvd"), x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 3.5))
+
+
+def test_broadcast_traced(hvd):
+    x = np.arange(8.0, dtype=np.float32)
+
+    def step(v):
+        return hvd.broadcast(v, root_rank=5)
+
+    out = _traced(hvd, step, P("hvd"), P("hvd"), x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 5.0))
+
+
+def test_allgather_traced(hvd):
+    x = np.arange(16.0, dtype=np.float32).reshape(8, 2)
+
+    def step(v):
+        return hvd.allgather(v)
+
+    out = _traced(hvd, step, P("hvd"), P(None), x)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_grouped_allreduce_traced_fusion(hvd):
+    """Grouped allreduce inside jit must fuse into few psums yet match
+    per-tensor results."""
+    xs = [np.random.RandomState(i).randn(8, 4).astype(np.float32) for i in range(4)]
+
+    def step(*vs):
+        return tuple(hvd.grouped_allreduce(list(vs), op=hvd.Sum))
+
+    outs = _traced(hvd, step, P("hvd"), P("hvd"), *xs)
+    for x, out in zip(xs, outs):
+        np.testing.assert_allclose(np.asarray(out), np.tile(x.sum(0), (8, 1)), rtol=1e-5)
+
+
+def test_adasum_identical_grads_idempotent(hvd):
+    """Adasum of N identical vectors returns that vector (projection rule)."""
+    base = np.random.RandomState(7).randn(4).astype(np.float32)
+    x = np.tile(base, (8, 1))
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    np.testing.assert_allclose(out, x, rtol=1e-5)
+
+
+def test_adasum_orthogonal_grads_sum(hvd):
+    """Orthogonal gradients pass through Adasum as a plain sum."""
+    x = np.zeros((8, 8), dtype=np.float32)
+    for r in range(8):
+        x[r, r] = float(r + 1)
+    out = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    expected = np.tile(np.arange(1.0, 9.0, dtype=np.float32), (8, 1))
+    np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+
+# -- executable cache (the response-cache analog) ----------------------------
+
+
+def test_executable_cache_hits(hvd):
+    from horovod_tpu.ops.executable_cache import global_cache
+
+    cache = global_cache()
+    x = np.random.RandomState(5).randn(8, 7).astype(np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    misses = cache.misses
+    hits = cache.hits
+    hvd.allreduce(x + 1, op=hvd.Sum)  # same signature -> hit
+    assert cache.misses == misses
+    assert cache.hits == hits + 1
