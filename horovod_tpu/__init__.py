@@ -61,3 +61,14 @@ from .process_sets import (  # noqa: F401
     global_process_set,
     remove_process_set,
 )
+from .compression import Compression  # noqa: F401
+from .optimizer import DistributedOptimizer, grad  # noqa: F401
+from .functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from . import elastic  # noqa: F401
+from . import parallel  # noqa: F401
+from .parallel import data_parallel  # noqa: F401

@@ -1,0 +1,71 @@
+"""Gradient compression for the wire (ICI) leg of allreduce.
+
+Parity: ``horovod/torch/compression.py`` / ``horovod/tensorflow/compression.py``
+(``Compression.none`` / ``Compression.fp16``). TPU-native addition:
+``Compression.bf16`` — bfloat16 is the MXU's native reduced precision and
+halves ICI bytes without fp16's range cliffs, so it is the compressor TPU
+users should reach for; fp16 is kept for script parity.
+
+A compressor is a pair of pure functions used around the collective:
+``compress(tensor) -> (wire_tensor, ctx)`` and
+``decompress(wire_tensor, ctx) -> tensor``. Both compile into the step.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Base compressor: subclasses override compress/decompress."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        del ctx
+        return tensor
+
+
+class NoneCompressor(Compressor):
+    """Identity (default)."""
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        tensor = jnp.asarray(tensor)
+        ctx = tensor.dtype
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float grads to float16 on the wire (reference parity)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast float grads to bfloat16 on the wire (TPU-native choice)."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace mirroring ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,2 @@
+from .state import ObjectState, State, TpuState  # noqa: F401
+from .runner import run  # noqa: F401

@@ -1,0 +1,91 @@
+"""The elastic retry loop: ``@hvd.elastic.run``.
+
+Parity with the reference's ``horovod/common/elastic.py — run_fn()``
+(SURVEY.md §4.4): the decorated training function survives peer
+failure/addition by catching the two recovery exceptions:
+
+- ``HorovodInternalError`` (a collective failed — e.g. a TPU VM in the
+  slice was preempted mid-step): restore() to the last commit, tear down
+  and re-initialize the world, then retry.
+- ``HostsUpdatedInterrupt`` (driver says the host set changed, nothing
+  failed): keep in-memory state, re-rendezvous, sync, continue.
+
+TPU divergence (by design, SURVEY.md §4.4 "Elastic × ICI topology"): worlds
+re-form on valid sub-topologies only — the new device set after re-init is
+whatever the re-rendezvous yields; per-chip shrink inside a slice is not a
+thing on ICI, so recovery granularity is the host (TPU VM). The re-init path
+rebuilds meshes and recompiles steps against the new world size (an
+executable-cache flush, handled in ``shutdown()``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .. import basics
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+
+
+def run(func):
+    """Decorator: ``@hvd.elastic.run`` / ``hvd.elastic.run(train)(state, ...)``.
+
+    The wrapped function receives a ``State`` first argument; it is retried
+    until it returns, with restore/sync + world re-initialization between
+    attempts, mirroring the reference's retry loop.
+    """
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        log = get_logger()
+        notification_manager.init()
+        skip_sync = False
+        while True:
+            if not basics.is_initialized():
+                basics.init()
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except HorovodInternalError as e:
+                log.warning("elastic: collective failure (%s); restoring", e)
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                log.info("elastic: hosts updated; re-syncing")
+                skip_sync = e.skip_sync
+            # Tear down and re-form the world, then notify user callbacks.
+            basics.shutdown()
+            basics.init()
+            state.on_reset()
+
+    return wrapper
+
+
+class _NotificationManager:
+    """Receives host-change notifications from the elastic driver.
+
+    The reference runs a ``WorkerNotificationService`` TCP listener in each
+    worker (``horovod/runner/elastic/worker.py``); here the driver pokes a
+    file/socket and `handle_hosts_updated` arms an interrupt that surfaces
+    as ``HostsUpdatedInterrupt`` at the next ``state.commit()`` /
+    ``check_host_updates()`` call.
+    """
+
+    def __init__(self):
+        self._pending = False
+        self._initialized = False
+
+    def init(self):
+        self._initialized = True
+
+    def handle_hosts_updated(self):
+        self._pending = True
+
+    def check_host_updates(self):
+        if self._pending:
+            self._pending = False
+            raise HostsUpdatedInterrupt()
+
+
+notification_manager = _NotificationManager()

@@ -1,0 +1,140 @@
+"""Elastic state: commit/restore/sync over preemption-prone worlds.
+
+Re-design of the reference's framework-agnostic elastic state machine
+(``horovod/common/elastic.py — State, ObjectState``) plus the torch flavor
+(``horovod/torch/elastic/state.py — TorchState``). The contract is
+unchanged:
+
+- ``commit()``: snapshot training state in host memory (cheap, frequent) —
+  the rollback point when a peer dies mid-step.
+- ``restore()``: roll back to the last commit (after HorovodInternalError).
+- ``sync()``: make all workers agree on rank-0's state (after re-rendezvous
+  or host changes) — broadcast parameters/optimizer/user objects.
+- reset callbacks: user hooks run after the world re-forms (e.g. re-shard
+  the dataset for the new size).
+
+TPU-native notes: state lives as jax pytrees; commit() pulls them to host
+numpy (surviving device loss on preemption); sync() broadcasts over DCN via
+the host-level collective in ``functions.broadcast_parameters``. Durable
+checkpoints (orbax-style sharded saves) layer on top — the reference
+likewise delegates durable checkpointing to frameworks (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from ..functions import broadcast_object, broadcast_parameters
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+class State:
+    """Base elastic state with reset-callback plumbing."""
+
+    def __init__(self, **kwargs):
+        self._reset_callbacks: list[Callable[[], None]] = []
+        self._kwargs = kwargs
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def check_host_updates(self) -> None:
+        """Surface pending driver notifications as HostsUpdatedInterrupt.
+
+        Called from commit() (as in the reference: commit is the safe point
+        to interrupt, since it just snapshotted a consistent state).
+        """
+        from .runner import notification_manager
+
+        notification_manager.check_host_updates()
+
+    def commit(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state backed by picklable attributes (reference parity:
+    ``horovod/common/elastic.py — ObjectState``). Attributes passed as
+    kwargs become state; commit snapshots them, sync broadcasts rank-0's."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved: dict[str, Any] = {}
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._known = list(kwargs.keys())
+        self.commit()
+
+    def commit(self) -> None:
+        self._saved = {k: getattr(self, k) for k in self._known}
+        self.check_host_updates()
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, v)
+
+    def sync(self) -> None:
+        synced = broadcast_object({k: getattr(self, k) for k in self._known})
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.commit()
+
+
+class TpuState(State):
+    """Elastic state for jax training loops: params/opt_state pytrees +
+    arbitrary picklable extras (epoch, step, ...).
+
+    The jax-native analog of ``TorchState(model=..., optimizer=...)``::
+
+        state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
+                                     epoch=0, batch=0)
+    """
+
+    def __init__(self, params=None, opt_state=None, **extras):
+        super().__init__()
+        self.params = params
+        self.opt_state = opt_state
+        for k, v in extras.items():
+            setattr(self, k, v)
+        self._extras = list(extras.keys())
+        self._saved: dict[str, Any] | None = None
+        self.commit()
+
+    def commit(self) -> None:
+        self._saved = {
+            "params": _to_host(self.params),
+            "opt_state": _to_host(self.opt_state),
+            **{k: getattr(self, k) for k in self._extras},
+        }
+        self.check_host_updates()
+
+    def restore(self) -> None:
+        assert self._saved is not None
+        self.params = self._saved["params"]
+        self.opt_state = self._saved["opt_state"]
+        for k in self._extras:
+            setattr(self, k, self._saved[k])
+
+    def sync(self) -> None:
+        self.params = broadcast_parameters(self.params, root_rank=0)
+        self.opt_state = broadcast_parameters(self.opt_state, root_rank=0)
+        extras = broadcast_object({k: getattr(self, k) for k in self._extras})
+        for k, v in extras.items():
+            setattr(self, k, v)
+        self.commit()

@@ -1,0 +1,112 @@
+"""State-sync helpers: broadcast_parameters / broadcast_object /
+allgather_object.
+
+Parity: ``horovod/torch/functions.py``. In the reference these push rank-0
+state to all ranks at (re)start — the resume path after elastic recovery and
+the init path after ``hvd.init()``. The compiled-SPMD equivalents:
+
+- Within one controller process, parameters live as replicated jax.Arrays —
+  already identical on every device — so ``broadcast_parameters`` is the
+  cross-*host* sync: processes agree on rank-0's copy via a host-level
+  broadcast over DCN (``multihost_utils.broadcast_one_to_all``).
+- Object (de)serialization uses pickle -> uint8 tensor -> collective ->
+  unpickle, with a size-exchange first (XLA needs static shapes, so objects
+  are padded to the max size — same design as the reference's
+  ``broadcast_object`` which sends a size header first).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Sync a parameter pytree from `root_rank`'s host to all hosts.
+
+    Parity: ``hvd.broadcast_parameters(model.state_dict(), root_rank=0)``.
+    Single-process worlds return the tree unchanged (devices under one
+    controller are already consistent by construction).
+    """
+    if jax.process_count() == 1:
+        return params
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        params, is_source=jax.process_index() == root_rank
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Parity: ``hvd.broadcast_optimizer_state``; optax states are pytrees,
+    so this is the same sync as parameters."""
+    return broadcast_parameters(opt_state, root_rank=root_rank)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str | None = None):
+    """Broadcast an arbitrary picklable object from root to all processes.
+
+    Two-phase like the reference: broadcast the size header, then the padded
+    payload (static shapes for the collective leg).
+    """
+    del name
+    if jax.process_count() == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    is_root = jax.process_index() == root_rank
+    payload = _to_bytes_tree(obj) if is_root else np.zeros(0, dtype=np.uint8)
+    size = multihost_utils.broadcast_one_to_all(
+        np.array([payload.size], dtype=np.int32), is_source=is_root
+    )
+    buf = np.zeros(int(size[0]), dtype=np.uint8)
+    if is_root:
+        buf[:] = payload
+    data = multihost_utils.broadcast_one_to_all(buf, is_source=is_root)
+    return pickle.loads(np.asarray(data).tobytes())
+
+
+def _to_bytes_tree(obj: Any) -> np.ndarray:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf)
+    return np.frombuffer(buf.getvalue(), dtype=np.uint8)
+
+
+def allgather_object(obj: Any, process_set=None, name: str | None = None) -> list:
+    """Gather one picklable object per rank into a list on every rank.
+
+    Parity: ``hvd.allgather_object``. Implemented over the eager uint8
+    allgather with a size pre-exchange + padding (static shapes on TPU).
+    In the single-controller regime every "rank" holds the same controller
+    object, so the result is `size()` copies — kept for script parity.
+    """
+    del name
+    from . import basics
+    from .ops import allgather
+    from .process_sets import global_process_set
+
+    ps = process_set if process_set is not None else global_process_set
+    n = ps.size()
+    payload = _to_bytes_tree(obj)
+    if jax.process_count() == 1:
+        # One controller: all ranks' objects are this object.
+        return [pickle.loads(payload.tobytes()) for _ in range(n)]
+
+    # Multi-host: pad to max size, exchange through the stacked convention.
+    # Size pre-exchange: per-rank tensor (1,) -> stacked (n, 1); allgather
+    # concatenates along dim 0, so each output row is the (n,) size vector.
+    sizes = np.asarray(
+        allgather(np.full((n, 1), payload.size, dtype=np.int32), process_set=ps)
+    )[0]
+    max_size = int(sizes.max())
+    # Per-rank tensor (1, max) -> stacked (n, 1, max); output rows (n, max).
+    padded = np.zeros((n, 1, max_size), dtype=np.uint8)
+    padded[:, 0, : payload.size] = payload
+    gathered = np.asarray(allgather(padded, process_set=ps))[0]
+    return [
+        pickle.loads(gathered[r, : int(sizes[r])].tobytes()) for r in range(n)
+    ]

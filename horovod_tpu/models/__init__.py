@@ -1,0 +1,2 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401

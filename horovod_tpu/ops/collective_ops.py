@@ -169,10 +169,34 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
         )
         return jax.jit(fn)
 
-    compiled = global_cache().get_or_build(key, build)
+    from ..stall import get_inspector
+    from ..timeline import activity
+
+    cache = global_cache()
+    misses_before = cache.misses
+    compiled = cache.get_or_build(key, build)
     sharding = NamedSharding(mesh, P(axis))
     x = jax.device_put(x, sharding)
-    return compiled(x)
+    # Eager ops are synchronous (reference parity: hvd.allreduce blocks;
+    # async flavors live in the runtime backend) — and blocking inside the
+    # ticket window is what lets the stall inspector see execution hangs,
+    # not just dispatch.
+    ticket = get_inspector().begin(f"{kind}[{x.shape}]")
+    try:
+        with activity(
+            kind,
+            "collective",
+            args={
+                "shape": list(x.shape),
+                "dtype": str(x.dtype),
+                "cache": "miss" if cache.misses > misses_before else "hit",
+            },
+        ):
+            out = compiled(x)
+            jax.block_until_ready(out)
+            return out
+    finally:
+        get_inspector().end(ticket)
 
 
 # ---------------------------------------------------------------------------

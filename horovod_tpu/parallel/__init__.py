@@ -1,0 +1,2 @@
+from .mesh import MeshSpec, build_mesh  # noqa: F401
+from .data_parallel import make_train_step  # noqa: F401

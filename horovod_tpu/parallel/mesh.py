@@ -1,0 +1,90 @@
+"""Multi-axis device meshes: dp / tp / pp / sp / ep.
+
+The reference is data-parallel-only (SURVEY.md §3.6); its nearest concept is
+process sets (rank subgroups). In the TPU-native design, parallelism
+strategies are **axes of one device mesh** — the factorization XLA's
+collectives are compiled against, laid out so that the fastest-varying axes
+sit on adjacent ICI links:
+
+- ``dp``: data parallel — gradient allreduce (the Horovod core capability)
+- ``tp``: tensor parallel — layer-internal psum/all_gather
+- ``pp``: pipeline parallel — stage-to-stage ppermute
+- ``sp``: sequence/context parallel — ring attention over ICI neighbors
+- ``ep``: expert parallel — alltoall dispatch (the reference's ``alltoall``
+  primitive, given a consumer)
+
+Axis order in the mesh tuple = topology-major order: tp innermost (most
+bandwidth-hungry, shortest ICI hops), then sp, ep, pp, dp outermost
+(allreduce tolerates the longest hops / DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")  # outermost -> innermost
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = -1  # -1: infer from device count
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        fixed = math.prod(v for v in sizes.values() if v > 0)
+        inferred = [a for a, v in sizes.items() if v <= 0]
+        if len(inferred) > 1:
+            raise ValueError(f"at most one axis may be inferred, got {inferred}")
+        if inferred:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"cannot infer {inferred[0]}: {n_devices} devices not "
+                    f"divisible by {fixed}"
+                )
+            sizes[inferred[0]] = n_devices // fixed
+        if math.prod(sizes.values()) != n_devices:
+            raise ValueError(
+                f"mesh {sizes} does not cover {n_devices} devices"
+            )
+        return sizes
+
+
+def build_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[Any] | None = None,
+    **axis_sizes: int,
+):
+    """Build a named mesh over the canonical ICI-ordered device list.
+
+    ``build_mesh(dp=4, tp=2)`` or ``build_mesh(MeshSpec(dp=-1, tp=2))``.
+    Devices default to the initialized world's topology order, so contiguous
+    tp groups are ICI-contiguous.
+    """
+    from jax.sharding import Mesh
+
+    from ..topology import sorted_devices
+
+    if spec is None:
+        spec = MeshSpec(**{a: axis_sizes.get(a, -1 if a == "dp" else 1) for a in AXIS_ORDER})
+    elif axis_sizes:
+        raise ValueError("pass either a MeshSpec or axis sizes, not both")
+
+    if devices is None:
+        from .. import basics
+
+        if basics.is_initialized():
+            devices = basics._state.topology.devices
+        else:
+            devices = sorted_devices()
+    sizes = spec.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    array = np.array(devices).reshape(shape)
+    return Mesh(array, AXIS_ORDER)

@@ -1,0 +1,139 @@
+"""Stall inspector: the distributed-deadlock detector.
+
+Parity: ``horovod/common/stall_inspector.cc``. The classic failure it
+catches: a conditional diverged across ranks, so rank A submitted a
+collective rank B will never submit — the job hangs with no error. The
+reference warns after ``HOROVOD_STALL_CHECK_TIME`` (60s) and can shut down
+after ``HOROVOD_STALL_SHUTDOWN_TIME``, naming the offending tensors and the
+ranks still missing.
+
+In the compiled SPMD path whole-program dataflow already prevents intra-step
+divergence (all ranks run the same program — a diverged `if` cannot
+compile). What can still stall is the **host level**: one controller process
+enters a different eager collective or a different step count than its
+peers (multi-host), or a TPU VM hangs. The inspector therefore watches
+host-side dispatch: every eager collective / step registers a ticket; a
+watchdog thread reports tickets outstanding past the warning threshold with
+their names — the same user experience the reference provides (your hang
+has a name attached).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .utils.env import get_float
+from .utils.logging import get_logger
+
+
+class StallInspector:
+    def __init__(
+        self,
+        warning_s: float | None = None,
+        shutdown_s: float | None = None,
+    ):
+        self.warning_s = (
+            get_float("HOROVOD_STALL_CHECK_TIME", 60.0)
+            if warning_s is None
+            else warning_s
+        )
+        self.shutdown_s = (
+            get_float("HOROVOD_STALL_SHUTDOWN_TIME", 0.0)
+            if shutdown_s is None
+            else shutdown_s
+        )
+        self._outstanding: dict[int, tuple[str, float]] = {}
+        self._next_ticket = 0
+        self._lock = threading.Lock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._warned: set[int] = set()
+        self.failed = False  # set when a stall passed the shutdown threshold
+
+    # -- ticket API (called by dispatch sites) ------------------------------
+
+    def begin(self, name: str) -> int:
+        with self._lock:
+            ticket = self._next_ticket
+            self._next_ticket += 1
+            self._outstanding[ticket] = (name, time.monotonic())
+            self._ensure_watchdog()
+        return ticket
+
+    def end(self, ticket: int) -> None:
+        with self._lock:
+            self._outstanding.pop(ticket, None)
+            self._warned.discard(ticket)
+
+    # -- watchdog -----------------------------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        if self._thread is None and self.warning_s > 0:
+            self._thread = threading.Thread(
+                target=self._watch, name="hvd-stall-inspector", daemon=True
+            )
+            self._thread.start()
+
+    def check_once(self, now: float | None = None) -> list[str]:
+        """One inspection pass; returns names of stalled operations."""
+        now = time.monotonic() if now is None else now
+        stalled = []
+        with self._lock:
+            for ticket, (name, start) in self._outstanding.items():
+                age = now - start
+                if age >= self.warning_s and ticket not in self._warned:
+                    stalled.append(f"{name} (outstanding {age:.0f}s)")
+                    self._warned.add(ticket)
+        if stalled:
+            get_logger().warning(
+                "Stall detected: one or more collectives have been "
+                "outstanding for over %.0fs — this usually means a rank "
+                "diverged (conditional collective) or a host hung: %s",
+                self.warning_s,
+                "; ".join(stalled),
+            )
+        return stalled
+
+    def _watch(self) -> None:
+        interval = max(self.warning_s / 4.0, 0.25)
+        while not self._stop.wait(interval):
+            self.check_once()
+            if self.shutdown_s > 0 and not self.failed:
+                with self._lock:
+                    oldest = min(
+                        (start for _, start in self._outstanding.values()),
+                        default=None,
+                    )
+                if oldest is not None and time.monotonic() - oldest >= self.shutdown_s:
+                    get_logger().error(
+                        "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME=%.0fs; "
+                        "interrupting the main thread (the reference shuts "
+                        "the job down at this point)",
+                        self.shutdown_s,
+                    )
+                    # A daemon thread cannot raise into the trainer; flag the
+                    # failure (observed by the elastic loop / collectives)
+                    # and interrupt the main thread so the hang breaks.
+                    self.failed = True
+                    import _thread
+
+                    _thread.interrupt_main()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+_inspector: StallInspector | None = None
+_ins_lock = threading.Lock()
+
+
+def get_inspector() -> StallInspector:
+    global _inspector
+    with _ins_lock:
+        if _inspector is None:
+            _inspector = StallInspector()
+        return _inspector

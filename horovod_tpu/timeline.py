@@ -1,0 +1,131 @@
+"""Chrome-trace timeline of collective lifecycles.
+
+Parity: ``horovod/common/timeline.cc`` — activated by
+``HOROVOD_TIMELINE=/path.json``, viewable in ``chrome://tracing`` /
+Perfetto. The reference records per-tensor negotiation phases
+(NEGOTIATE → WAIT_FOR_DATA → QUEUE → MEMCPY_IN → NCCL_* → MEMCPY_OUT)
+from its background thread. In the compiled world most of those phases
+don't exist at runtime — so the TPU timeline records what *does* happen on
+the host: eager-collective dispatch (cache hit/miss, compile time, execute
+time), trace-time fusion decisions (bucket layouts), and step markers; for
+on-device phases, point xprof at the same run and merge in the viewer.
+
+Events are written on a dedicated writer thread (as in the reference, so
+the hot path never blocks on file IO) in Chrome trace-event JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any
+
+_timeline: "Timeline | None" = None
+_lock = threading.Lock()
+
+
+class Timeline:
+    def __init__(self, path: str):
+        self.path = path
+        self._queue: "queue.Queue[dict[str, Any] | None]" = queue.Queue()
+        self._start = time.perf_counter_ns()
+        self._thread = threading.Thread(
+            target=self._writer, name="hvd-timeline-writer", daemon=True
+        )
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._dead = False
+        self._thread.start()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter_ns() - self._start) / 1e3
+
+    def _writer(self) -> None:
+        while True:
+            event = self._queue.get()
+            if event is None:
+                break
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            self._file.write(json.dumps(event))
+            self._file.flush()
+        self._file.write("\n]\n")
+        self._file.close()
+
+    def _emit(self, name: str, phase: str, category: str, ts_us: float, dur_us: float = None, args=None):
+        if self._dead:
+            return
+        event = {
+            "name": name,
+            "ph": phase,
+            "cat": category,
+            "ts": ts_us,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 1_000_000,
+        }
+        if dur_us is not None:
+            event["dur"] = dur_us
+        if args:
+            event["args"] = args
+        self._queue.put(event)
+
+    def complete(self, name: str, category: str, start_us: float, args=None) -> None:
+        """Record a completed activity [start_us, now]."""
+        self._emit(
+            name, "X", category, start_us, self._now_us() - start_us, args
+        )
+
+    def instant(self, name: str, category: str = "marker", args=None) -> None:
+        self._emit(name, "i", category, self._now_us(), args=args)
+
+    def now_us(self) -> float:
+        return self._now_us()
+
+    def shutdown(self) -> None:
+        global _timeline
+        if self._dead:
+            return
+        self._dead = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        with _lock:
+            if _timeline is self:
+                _timeline = None
+
+
+def get_timeline() -> Timeline | None:
+    """The process timeline, or None when HOROVOD_TIMELINE is unset."""
+    global _timeline
+    with _lock:
+        if _timeline is None:
+            path = os.environ.get("HOROVOD_TIMELINE", "")
+            if not path:
+                return None
+            _timeline = Timeline(path)
+        return _timeline
+
+
+class activity:
+    """Context manager: ``with activity('allreduce.dense_1', 'collective')``."""
+
+    def __init__(self, name: str, category: str = "collective", args=None):
+        self.name = name
+        self.category = category
+        self.args = args
+        self._tl = get_timeline()
+        self._start = 0.0
+
+    def __enter__(self):
+        if self._tl is not None:
+            self._start = self._tl.now_us()
+        return self
+
+    def __exit__(self, *exc):
+        if self._tl is not None:
+            self._tl.complete(self.name, self.category, self._start, self.args)
+        return False

@@ -23,6 +23,10 @@ jax.config.update("jax_num_cpu_devices", 8)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running end-to-end tests")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _hvd_world():
     import horovod_tpu as hvd

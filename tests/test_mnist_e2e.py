@@ -1,0 +1,91 @@
+"""The minimum end-to-end slice (SURVEY.md §7 step 2 exit criterion):
+MNIST-shaped LeNet trained data-parallel on the 8-device mesh must match
+single-replica full-batch training loss step for step.
+
+This is BASELINE config #1 (reference:
+``examples/pytorch/pytorch_mnist.py``) re-expressed: with op=Average, equal
+shards, and SGD, DP gradients equal the full-batch gradient, so the loss
+trajectories must agree to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.models.lenet import LeNet, cross_entropy_loss
+
+
+def _synthetic_mnist(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.slow
+def test_mnist_dp_loss_parity(hvd):
+    model = LeNet()
+    global_batch = 64
+    steps = 5
+    x, y = _synthetic_mnist(global_batch * steps)
+
+    key = jax.random.PRNGKey(42)
+    params = model.init(key, jnp.zeros((1, 28, 28, 1)))
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return cross_entropy_loss(model.apply(p, bx), by)
+
+    # --- single-replica full-batch reference ---
+    ref_opt = optax.sgd(0.05)
+    ref_state = ref_opt.init(params)
+    ref_params = params
+    ref_losses = []
+
+    @jax.jit
+    def ref_step(p, s, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+        updates, s = ref_opt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    for i in range(steps):
+        batch = (
+            x[i * global_batch : (i + 1) * global_batch],
+            y[i * global_batch : (i + 1) * global_batch],
+        )
+        ref_params, ref_state, loss = ref_step(ref_params, ref_state, batch)
+        ref_losses.append(float(loss))
+
+    # --- 8-way data parallel with DistributedOptimizer ---
+    opt = hvd.DistributedOptimizer(optax.sgd(0.05))
+    step = hvd.data_parallel.make_train_step(loss_fn, opt, donate=False)
+    dp_params = hvd.data_parallel.replicate(params)
+    dp_state = hvd.data_parallel.replicate(opt.init(params))
+    dp_losses = []
+    for i in range(steps):
+        batch = hvd.data_parallel.shard_batch(
+            (
+                x[i * global_batch : (i + 1) * global_batch],
+                y[i * global_batch : (i + 1) * global_batch],
+            )
+        )
+        dp_params, dp_state, loss = step(dp_params, dp_state, batch)
+        dp_losses.append(float(loss))
+
+    np.testing.assert_allclose(dp_losses, ref_losses, rtol=1e-4, atol=1e-5)
+    # parameters converge identically too
+    for a, b in zip(jax.tree.leaves(dp_params), jax.tree.leaves(ref_params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_functions_single_process(hvd):
+    params = {"w": jnp.ones((3,))}
+    assert hvd.broadcast_parameters(params, root_rank=0) is params
+    assert hvd.broadcast_object({"a": 1}) == {"a": 1}
+    objs = hvd.allgather_object({"r": 7})
+    assert len(objs) == hvd.size()
+    assert all(o == {"r": 7} for o in objs)

@@ -1,0 +1,54 @@
+"""Timeline + stall inspector, mirroring the reference's env-flag smoke
+tests (SURVEY.md §4: timeline/stall have env-activation contracts)."""
+
+import json
+import time
+
+import numpy as np
+
+
+def test_timeline_records_collectives(hvd, tmp_path, monkeypatch):
+    import horovod_tpu.timeline as tl
+
+    path = tmp_path / "timeline.json"
+    monkeypatch.setenv("HOROVOD_TIMELINE", str(path))
+    monkeypatch.setattr(tl, "_timeline", None)
+
+    x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.allreduce(x + 1, op=hvd.Sum)  # cache hit event
+
+    timeline = tl.get_timeline()
+    assert timeline is not None
+    timeline.shutdown()
+    events = json.loads(path.read_text())
+    names = [e["name"] for e in events]
+    assert "allreduce" in names
+    caches = [e["args"]["cache"] for e in events if e["name"] == "allreduce"]
+    assert "hit" in caches  # second identical call must hit the cache
+    monkeypatch.setattr(tl, "_timeline", None)
+
+
+def test_stall_inspector_reports_outstanding():
+    from horovod_tpu.stall import StallInspector
+
+    ins = StallInspector(warning_s=0.01, shutdown_s=0.0)
+    ticket = ins.begin("allreduce.layer0")
+    time.sleep(0.02)
+    stalled = ins.check_once()
+    assert len(stalled) == 1
+    assert "allreduce.layer0" in stalled[0]
+    # once warned, not re-reported
+    assert ins.check_once() == []
+    ins.end(ticket)
+    ins.stop()
+
+
+def test_stall_inspector_clean_ops_not_reported():
+    from horovod_tpu.stall import StallInspector
+
+    ins = StallInspector(warning_s=10.0)
+    t = ins.begin("fast_op")
+    ins.end(t)
+    assert ins.check_once() == []
+    ins.stop()

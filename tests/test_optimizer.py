@@ -1,0 +1,127 @@
+"""DistributedOptimizer correctness — the analog of the reference's
+``test/parallel/test_torch.py`` DistributedOptimizer-vs-manual-averaging
+equivalence tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+
+def _traced_update(hvd, opt, grads_per_rank, params):
+    """Run one optimizer update inside shard_map; grads differ per rank."""
+    mesh = hvd.global_mesh()
+
+    def step(g):
+        g = jax.tree.map(lambda a: a[0], g)  # strip the shard's stacking axis
+        state = opt.init(params)
+        updates, _ = opt.update(g, state, params)
+        return updates
+
+    f = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=P("hvd"), out_specs=P(), check_vma=False
+        )
+    )
+    return f(grads_per_rank)
+
+
+def test_distributed_sgd_equals_manual_average(hvd):
+    params = {"w": jnp.ones((4,)), "b": jnp.zeros((2,))}
+    gw = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    gb = np.random.RandomState(1).randn(8, 2).astype(np.float32)
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    updates = _traced_update(
+        hvd, opt, {"w": gw, "b": gb}, params
+    )
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), -0.1 * gw.mean(0), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(updates["b"]), -0.1 * gb.mean(0), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_distributed_optimizer_sum_op(hvd):
+    params = {"w": jnp.zeros((3,))}
+    gw = np.random.RandomState(2).randn(8, 3).astype(np.float32)
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Sum)
+    updates = _traced_update(hvd, opt, {"w": gw}, params)
+    np.testing.assert_allclose(np.asarray(updates["w"]), -gw.sum(0), rtol=1e-5)
+
+
+def test_distributed_optimizer_fp16_compression(hvd):
+    params = {"w": jnp.zeros((5,))}
+    gw = np.random.RandomState(3).randn(8, 5).astype(np.float32)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(1.0), compression=hvd.Compression.fp16
+    )
+    updates = _traced_update(hvd, opt, {"w": gw}, params)
+    # fp16 wire: tolerances loosened accordingly, dtype restored to f32.
+    assert updates["w"].dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), -gw.mean(0), rtol=1e-2, atol=1e-3
+    )
+
+
+def test_backward_passes_per_step_accumulates(hvd):
+    """k=2: first microstep produces zero updates; second applies the
+    allreduced mean of the accumulated grads."""
+    mesh = hvd.global_mesh()
+    params = {"w": jnp.zeros((3,))}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), backward_passes_per_step=2)
+    g1 = np.random.RandomState(4).randn(8, 3).astype(np.float32)
+    g2 = np.random.RandomState(5).randn(8, 3).astype(np.float32)
+
+    def two_steps(ga, gb):
+        ga, gb = {"w": ga[0]}, {"w": gb[0]}
+        state = opt.init(params)
+        u1, state = opt.update(ga, state, params)
+        u2, state = opt.update(gb, state, params)
+        return u1, u2
+
+    f = jax.jit(
+        jax.shard_map(
+            two_steps,
+            mesh=mesh,
+            in_specs=(P("hvd"), P("hvd")),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+    u1, u2 = f(g1, g2)
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.zeros(3))
+    expected = -((g1 + g2) / 2).mean(0)
+    np.testing.assert_allclose(np.asarray(u2["w"]), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_grad_wrapper_averages(hvd):
+    """hvd.grad == DistributedGradientTape parity."""
+    mesh = hvd.global_mesh()
+
+    def loss_fn(w, x):
+        return jnp.sum(w * x)
+
+    gfn = hvd.grad(loss_fn)
+    w = jnp.ones((3,))
+    xs = np.random.RandomState(6).randn(8, 3).astype(np.float32)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda x: gfn(w, x),
+            mesh=mesh,
+            in_specs=P("hvd"),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+    np.testing.assert_allclose(np.asarray(f(xs)), xs.mean(0), rtol=1e-5)
+
+
+def test_invalid_backward_passes(hvd):
+    with pytest.raises(ValueError):
+        hvd.DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=0)
