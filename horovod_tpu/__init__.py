@@ -68,6 +68,7 @@ from .functions import (  # noqa: F401
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,
+    to_local,
 )
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401

@@ -53,6 +53,7 @@ class _GlobalState:
         self.config: RuntimeConfig | None = None
         self.mesh = None  # global 1-D jax Mesh over all ranks, axis 'hvd'
         self.axis_name = "hvd"
+        self.distributed_initialized = False
 
     def require_init(self) -> "_GlobalState":
         if not self.initialized:
@@ -63,7 +64,7 @@ class _GlobalState:
 _state = _GlobalState()
 
 
-def _maybe_init_distributed(config: RuntimeConfig) -> None:
+def _maybe_init_distributed() -> None:
     """Multi-host bootstrap over DCN via jax.distributed.
 
     The launcher (``horovod_tpu.runner``) writes the coordinator address in
@@ -71,6 +72,16 @@ def _maybe_init_distributed(config: RuntimeConfig) -> None:
     which case this is a no-op.
     """
     import jax
+
+    # Elastic mode: the world config lives in the rendezvous KV (it changes
+    # across epochs); refresh the env contract before reading it. Env check
+    # first so non-elastic workers never import the launcher machinery.
+    if os.environ.get("HOROVOD_ELASTIC", "") == "1":
+        from .runner.elastic import worker as elastic_worker
+
+        ctx = elastic_worker.get_worker_context()
+        ctx.apply_to_env(ctx.fetch_assignment())
+        ctx.start_polling()
 
     coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
     nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)
@@ -81,6 +92,7 @@ def _maybe_init_distributed(config: RuntimeConfig) -> None:
             num_processes=nprocs,
             process_id=proc_id,
         )
+        _state.distributed_initialized = True
 
 
 def init(devices: Sequence[Any] | None = None) -> None:
@@ -99,8 +111,10 @@ def init(devices: Sequence[Any] | None = None) -> None:
     with _lock:
         if _state.initialized:
             return
+        # Distributed bootstrap first: in elastic mode it refreshes the env
+        # world facts from the KV, which from_env() must then see.
+        _maybe_init_distributed()
         config = RuntimeConfig.from_env()
-        _maybe_init_distributed(config)
         topo = Topology(devices)
         _state.topology = topo
         _state.config = config
@@ -131,6 +145,12 @@ def shutdown() -> None:
         # world must not hit them (stale devices / reused process-set ids).
         global_cache().clear()
         process_sets._clear()
+        if _state.distributed_initialized:
+            # Elastic re-init forms a new jax.distributed world next time.
+            import jax
+
+            jax.distributed.shutdown()
+            _state.distributed_initialized = False
         _state.initialized = False
         _state.topology = None
         _state.mesh = None

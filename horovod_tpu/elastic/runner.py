@@ -23,7 +23,11 @@ from __future__ import annotations
 import functools
 
 from .. import basics
-from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    RemovedFromWorldError,
+)
 from ..utils.logging import get_logger
 
 
@@ -37,27 +41,52 @@ def run(func):
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        import sys
+        import time
+
         log = get_logger()
         notification_manager.init()
         skip_sync = False
+        needs_reset = False
+        backoff = 0.5
         while True:
-            if not basics.is_initialized():
-                basics.init()
+            # World (re-)formation runs INSIDE the retry scope: init() can
+            # itself fail transiently during an elastic reconfiguration
+            # (driver mid-publish, KV briefly unreachable) and must retry,
+            # not kill the worker.
             try:
+                if not basics.is_initialized():
+                    basics.init()
+                    if needs_reset:
+                        state.on_reset()
+                        needs_reset = False
+                backoff = 0.5
                 if not skip_sync:
                     state.sync()
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
-                log.warning("elastic: collective failure (%s); restoring", e)
-                state.restore()
+                log.warning("elastic: internal failure (%s); restoring", e)
+                if basics.is_initialized():
+                    state.restore()
                 skip_sync = False
+                time.sleep(min(backoff, 5.0))
+                backoff *= 2
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-syncing")
                 skip_sync = e.skip_sync
-            # Tear down and re-form the world, then notify user callbacks.
-            basics.shutdown()
-            basics.init()
-            state.on_reset()
+            except RemovedFromWorldError:
+                # This host left the world: exit with the driver's sentinel
+                # code (not success, not a blacklisting failure).
+                from ..runner.elastic.constants import EXIT_REMOVED
+
+                log.info("elastic: removed from world; exiting")
+                sys.exit(EXIT_REMOVED)
+            # Tear down; the next iteration re-forms the world.
+            try:
+                basics.shutdown()
+            except Exception as e:  # keep retrying even if teardown is dirty
+                log.warning("elastic: shutdown during reset failed: %s", e)
+            needs_reset = True
 
     return wrapper
 

@@ -36,6 +36,14 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
+class RemovedFromWorldError(HorovodTpuError):
+    """This worker's host was dropped from the elastic world.
+
+    The elastic loop exits the process with the driver's EXIT_REMOVED code
+    (neither job success nor a blacklisting failure).
+    """
+
+
 class NotInitializedError(HorovodTpuError):
     """An API that requires ``init()`` was called before initialization."""
 

@@ -70,6 +70,26 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str | None = None):
     return pickle.loads(np.asarray(data).tobytes())
 
 
+def to_local(array) -> np.ndarray:
+    """This process's rows of a stacked-rank eager-op result, as numpy.
+
+    In multi-host worlds eager collectives return arrays sharded over the
+    global mesh; a process may only read its addressable shards (its local
+    devices' rows). For allreduce/allgather/broadcast results every row is
+    identical, so ``to_local(out)[0]`` is the process's answer — the analog
+    of the reference's per-rank return value.
+    """
+    import numpy as _np
+
+    arr = jax.numpy.asarray(array) if not hasattr(array, "addressable_shards") else array
+    if getattr(arr, "is_fully_addressable", True):
+        return _np.asarray(arr)
+    shards = sorted(
+        arr.addressable_shards, key=lambda s: s.index[0].start or 0
+    )
+    return _np.concatenate([_np.asarray(s.data) for s in shards], axis=0)
+
+
 def _to_bytes_tree(obj: Any) -> np.ndarray:
     buf = io.BytesIO()
     pickle.dump(obj, buf)
@@ -99,14 +119,14 @@ def allgather_object(obj: Any, process_set=None, name: str | None = None) -> lis
     # Multi-host: pad to max size, exchange through the stacked convention.
     # Size pre-exchange: per-rank tensor (1,) -> stacked (n, 1); allgather
     # concatenates along dim 0, so each output row is the (n,) size vector.
-    sizes = np.asarray(
+    sizes = to_local(
         allgather(np.full((n, 1), payload.size, dtype=np.int32), process_set=ps)
     )[0]
     max_size = int(sizes.max())
     # Per-rank tensor (1, max) -> stacked (n, 1, max); output rows (n, max).
     padded = np.zeros((n, 1, max_size), dtype=np.uint8)
     padded[:, 0, : payload.size] = payload
-    gathered = np.asarray(allgather(padded, process_set=ps))[0]
+    gathered = to_local(allgather(padded, process_set=ps))[0]
     return [
         pickle.loads(gathered[r, : int(sizes[r])].tobytes()) for r in range(n)
     ]

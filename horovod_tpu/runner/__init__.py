@@ -1,0 +1,64 @@
+"""The launcher / orchestration layer (SURVEY.md §3.4).
+
+TPU-native re-design of ``horovod/runner/``: the ``hvdrun`` CLI
+(``launch.py``), host parsing + one-process-per-host rank assignment
+(``hosts.py``), the rendezvous KV server (``http/kv_server.py``), worker
+exec with output multiplexing (``exec_utils.py``), and the elastic driver
+(``elastic/``).
+
+Programmatic entry (parity: ``horovod.run()``)::
+
+    from horovod_tpu.runner import run
+    run(["python", "train.py"], np=2, cpu_mode=True)
+"""
+
+from __future__ import annotations
+
+from .hosts import HostInfo, get_host_assignments, parse_hostfile, parse_hosts  # noqa: F401
+from .http.kv_server import KVClient, RendezvousServer  # noqa: F401
+from .launch import (  # noqa: F401
+    Settings,
+    args_to_env,
+    parse_args,
+    run_commandline,
+    run_static,
+    settings_from_args,
+)
+
+
+def run(
+    command: list[str],
+    np: int = 1,
+    hosts: str | None = None,
+    hostfile: str | None = None,
+    cpu_mode: bool = False,
+    min_np: int | None = None,
+    max_np: int | None = None,
+    host_discovery_script: str | None = None,
+    extra_args: list[str] | None = None,
+    sink=None,
+) -> int:
+    """Programmatic launch (the reference's ``horovod.run()``)."""
+    argv: list[str] = ["-np", str(np)]
+    if hosts:
+        argv += ["-H", hosts]
+    if hostfile:
+        argv += ["--hostfile", hostfile]
+    if cpu_mode:
+        argv += ["--cpu-mode"]
+    if min_np is not None:
+        argv += ["--min-np", str(min_np)]
+    if max_np is not None:
+        argv += ["--max-np", str(max_np)]
+    if host_discovery_script:
+        argv += ["--host-discovery-script", host_discovery_script]
+    if extra_args:
+        argv += extra_args
+    argv += command
+    args = parse_args(argv)
+    settings = settings_from_args(args)
+    if settings.elastic:
+        from .elastic.driver import run_elastic
+
+        return run_elastic(settings, sink=sink)
+    return run_static(settings, sink=sink)

@@ -1,0 +1,5 @@
+"""``python -m horovod_tpu.runner`` == the ``hvdrun`` console script."""
+
+from .launch import main
+
+main()

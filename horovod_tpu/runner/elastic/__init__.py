@@ -1,0 +1,7 @@
+from .discovery import (  # noqa: F401
+    FixedHostDiscovery,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from .driver import ElasticDriver, run_elastic  # noqa: F401

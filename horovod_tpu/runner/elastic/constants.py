@@ -1,0 +1,7 @@
+"""Shared driver↔worker constants (parity:
+``horovod/runner/elastic/constants.py``)."""
+
+# Exit code for a worker whose host was dropped from the world: neither
+# success (which would end the whole job) nor failure (which would
+# blacklist a healthy host).
+EXIT_REMOVED = 202

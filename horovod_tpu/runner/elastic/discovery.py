@@ -1,0 +1,133 @@
+"""Elastic host discovery.
+
+Parity with ``horovod/runner/elastic/discovery.py`` (``HostDiscovery``,
+``HostDiscoveryScript``, ``HostManager``): the driver periodically asks a
+user-provided source which hosts exist; the manager diffs successive views,
+maintains the failure blacklist, and answers "which hosts may run workers
+right now".
+
+TPU divergence (SURVEY.md §4.4): a discovered host is a TPU VM worker; host
+removal ≙ preemption. The manager additionally snaps the usable host count to
+a topology-valid world size (``valid_sizes``) — ICI slices cannot shrink by
+arbitrary chip counts, so the driver only forms worlds whose host count is in
+the valid set (default: any count — DCN data-parallel groups have no such
+constraint).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Callable, Sequence
+
+from ..hosts import HostInfo
+
+
+class HostDiscovery:
+    """Interface: return the current world as {hostname: slots}."""
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs a user script that prints ``host:slots`` (or ``host``) per line.
+
+    The reference's fault-injection test pattern drives this: tests edit the
+    file the script reads, and the driver picks up the change on the next
+    poll. Keep that contract — it is the cheapest chaos harness there is.
+    """
+
+    def __init__(self, script_path: str, timeout: float = 10.0):
+        self._script = script_path
+        self._timeout = timeout
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        out = subprocess.run(
+            [self._script],
+            capture_output=True,
+            timeout=self._timeout,
+            check=True,
+            text=True,
+            shell=False,
+        ).stdout
+        hosts: dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            info = HostInfo.from_string(line)
+            hosts[info.hostname] = info.slots
+        return hosts
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """Static host set (used when elastic runs with a fixed -H list)."""
+
+    def __init__(self, hosts: Sequence[HostInfo]):
+        self._hosts = {h.hostname: h.slots for h in hosts}
+
+    def find_available_hosts_and_slots(self) -> dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks discovered hosts, the blacklist, and world-size validity."""
+
+    def __init__(
+        self,
+        discovery: HostDiscovery,
+        valid_sizes: Callable[[int], bool] | None = None,
+    ):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._current: dict[str, int] = {}
+        self._blacklist: set[str] = set()
+        self._valid = valid_sizes or (lambda n: n >= 1)
+
+    def update_available_hosts(self) -> bool:
+        """Poll discovery; returns True if the usable host set changed."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            before = self._usable_locked()
+            self._current = found
+            after = self._usable_locked()
+            return before != after
+
+    def blacklist(self, hostname: str) -> None:
+        with self._lock:
+            self._blacklist.add(hostname)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+    def _usable_locked(self) -> dict[str, int]:
+        return {
+            h: s for h, s in self._current.items() if h not in self._blacklist
+        }
+
+    def usable_hosts(self) -> list[HostInfo]:
+        with self._lock:
+            return [HostInfo(h, s) for h, s in sorted(self._usable_locked().items())]
+
+    def pick_world(
+        self, preferred: Sequence[str], max_np: int | None
+    ) -> list[HostInfo]:
+        """Choose the next world's hosts: keep `preferred` (current workers)
+        first for rank stability, append new hosts, cap at max_np, then snap
+        down to the largest topology-valid count."""
+        with self._lock:
+            usable = self._usable_locked()
+        ordered: list[HostInfo] = []
+        for h in preferred:
+            if h in usable:
+                ordered.append(HostInfo(h, usable[h]))
+        for h, s in sorted(usable.items()):
+            if all(o.hostname != h for o in ordered):
+                ordered.append(HostInfo(h, s))
+        if max_np is not None:
+            ordered = ordered[:max_np]
+        while ordered and not self._valid(len(ordered)):
+            ordered.pop()
+        return ordered

@@ -1,0 +1,229 @@
+"""The elastic driver: keeps min_np ≤ world ≤ max_np across host churn.
+
+Parity with ``horovod/runner/elastic/driver.py — ElasticDriver`` +
+``registration.py`` + ``worker.py``: polls host discovery, launches and
+monitors workers, blacklists failing hosts, re-forms the world on change,
+and notifies surviving workers.
+
+TPU-native notification contract (replacing the reference's per-worker
+``WorkerNotificationService`` TCP push): the driver publishes each world
+epoch to the rendezvous KV server —
+
+- ``GET /_version``                      → current world version (bumped on
+  every reconfiguration; workers poll this cheaply)
+- ``GET /world/<version>``  (key = hostname) → JSON assignment for that host:
+  ``{"process_id", "num_processes", "coordinator", "slots", "hosts"}``
+
+Workers poll the version between commits (``worker.py — ElasticWorkerLoop``);
+a bump surfaces as ``HostsUpdatedInterrupt`` and the worker re-reads its
+assignment for the new version. A host absent from the new epoch exits
+cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ...utils.logging import get_logger
+from ..exec_utils import (
+    WorkerProc,
+    build_worker_env,
+    launch_worker,
+    terminate_worker,
+)
+from ..hosts import HostInfo, get_host_assignments
+from ..http.kv_server import RendezvousServer
+from ..network import coordinator_addr, driver_addr, free_port
+from .discovery import FixedHostDiscovery, HostDiscoveryScript, HostManager
+
+from .constants import EXIT_REMOVED  # noqa: E402  (re-export for driver users)
+
+WORLD_SCOPE = "world"
+
+
+class ElasticDriver:
+    def __init__(
+        self,
+        settings,  # runner.launch.Settings
+        discovery=None,
+        sink=None,
+        poll_interval: float = 1.0,
+    ):
+        self._settings = settings
+        self._log = get_logger()
+        self._sink = sink
+        self._poll_interval = poll_interval
+        if discovery is None:
+            if settings.discovery_script:
+                discovery = HostDiscoveryScript(settings.discovery_script)
+            else:
+                discovery = FixedHostDiscovery(settings.hosts)
+        self._manager = HostManager(discovery)
+        self._server = RendezvousServer()
+        self._workers: dict[str, WorkerProc] = {}
+        self._world_hosts: list[HostInfo] = []
+        self._coord_port: int = 0
+        self._shutdown = False
+        self._min_np = settings.min_np or 1
+        self._max_np = settings.max_np
+
+    # -- world formation -----------------------------------------------------
+
+    def _wait_for_available_slots(self, min_np: int, timeout: float) -> list[HostInfo]:
+        """Block until discovery yields ≥ min_np usable hosts (parity:
+        ``ElasticDriver.wait_for_available_slots``)."""
+        deadline = time.time() + timeout
+        while True:
+            try:
+                self._manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup: retry
+                self._log.warning("elastic: discovery failed (%s); retrying", e)
+            hosts = self._manager.pick_world(
+                [h.hostname for h in self._world_hosts], self._max_np
+            )
+            if len(hosts) >= min_np:
+                return hosts
+            if time.time() >= deadline:
+                raise TimeoutError(
+                    f"elastic: {len(hosts)} host(s) available after "
+                    f"{timeout:.0f}s; need min_np={min_np}"
+                )
+            time.sleep(self._poll_interval)
+
+    def _publish_world(self, hosts: list[HostInfo]) -> int:
+        """Publish the new epoch's assignments, then bump the version (the
+        scope is written before the bump so in-flight workers of the
+        previous epoch never read a hole)."""
+        assignments = get_host_assignments(hosts)
+        coord = coordinator_addr([h.hostname for h in hosts])
+        self._coord_port = free_port()
+        data = {
+            a.hostname: json.dumps(
+                {
+                    "process_id": a.rank,
+                    "num_processes": a.size,
+                    "coordinator": f"{coord}:{self._coord_port}",
+                    "slots": a.slots,
+                    "hosts": [[h.hostname, h.slots] for h in hosts],
+                }
+            ).encode()
+            for a in assignments
+        }
+        version = self._server.publish_epoch(WORLD_SCOPE, data)
+        self._world_hosts = hosts
+        return version
+
+    def _launch_missing_workers(self, version: int) -> None:
+        assignments = get_host_assignments(self._world_hosts)
+        kv_addr = driver_addr([a.hostname for a in assignments])
+        coord_addr = coordinator_addr([a.hostname for a in assignments])
+        for a in assignments:
+            if a.hostname in self._workers:
+                continue
+            env = build_worker_env(
+                a,
+                base_env=dict(os.environ),
+                rendezvous_addr=kv_addr,
+                rendezvous_port=self._server.port,
+                coordinator_addr=coord_addr,
+                coordinator_port=self._coord_port,
+                cpu_mode=self._settings.cpu_mode,
+                extra_env={
+                    **self._settings.env,
+                    "HOROVOD_ELASTIC": "1",
+                    "HOROVOD_WORLD_VERSION": str(version),
+                    "HOROVOD_HOSTNAME": a.hostname,
+                },
+            )
+            self._log.info(
+                "elastic: launching worker on %s (process %d/%d, v%d)",
+                a.hostname, a.rank, a.size, version,
+            )
+            self._workers[a.hostname] = launch_worker(
+                a, self._settings.command, env,
+                ssh_port=self._settings.ssh_port, sink=self._sink,
+            )
+
+    def _reconfigure(self) -> None:
+        hosts = self._manager.pick_world(
+            [h.hostname for h in self._world_hosts], self._max_np
+        )
+        if len(hosts) < self._min_np:
+            hosts = self._wait_for_available_slots(
+                self._min_np, self._settings.elastic_timeout
+            )
+        keep = {h.hostname for h in hosts}
+        # Kill workers on hosts that left the world.
+        for name in [n for n in self._workers if n not in keep]:
+            self._log.info("elastic: removing worker on %s", name)
+            terminate_worker(self._workers.pop(name))
+        version = self._publish_world(hosts)
+        self._launch_missing_workers(version)
+
+    # -- main loop -----------------------------------------------------------
+
+    def run(self) -> int:
+        hosts = self._wait_for_available_slots(
+            self._min_np, self._settings.elastic_timeout
+        )
+        self._server.start()
+        version = self._publish_world(hosts)
+        self._launch_missing_workers(version)
+        try:
+            return self._monitor()
+        finally:
+            for w in self._workers.values():
+                terminate_worker(w)
+            self._server.stop()
+
+    def _monitor(self) -> int:
+        last_poll = 0.0
+        while True:
+            # 1. Reap exited workers.
+            finished = {
+                n: w for n, w in self._workers.items()
+                if w.popen.poll() is not None
+            }
+            need_reconfigure = False
+            for name, w in finished.items():
+                rc = w.popen.returncode
+                del self._workers[name]
+                if rc == 0:
+                    # Success on any worker ⇒ the job completed (reference
+                    # semantics: the training function returned).
+                    self._log.info("elastic: worker on %s finished ok", name)
+                    return 0
+                if rc == EXIT_REMOVED:
+                    # Clean self-exit of a worker dropped from the world —
+                    # not a failure, not job completion.
+                    self._log.info("elastic: removed worker on %s exited", name)
+                    continue
+                self._log.warning(
+                    "elastic: worker on %s failed (rc=%d); blacklisting",
+                    name, rc,
+                )
+                self._manager.blacklist(name)
+                need_reconfigure = True
+            if need_reconfigure:
+                self._reconfigure()
+                continue
+            # 2. Poll discovery.
+            if time.time() - last_poll >= self._poll_interval:
+                last_poll = time.time()
+                try:
+                    changed = self._manager.update_available_hosts()
+                except Exception as e:
+                    self._log.warning("elastic: discovery failed: %s", e)
+                    changed = False
+                if changed:
+                    self._log.info("elastic: host set changed; reconfiguring")
+                    self._reconfigure()
+            time.sleep(0.05)
+
+
+def run_elastic(settings, sink=None, discovery=None) -> int:
+    """Entry used by ``hvdrun --host-discovery-script ...``."""
+    driver = ElasticDriver(settings, discovery=discovery, sink=sink)
+    return driver.run()

@@ -1,0 +1,113 @@
+"""Worker-side elastic plumbing: world-version polling + assignment fetch.
+
+Parity with ``horovod/runner/elastic/worker.py`` (``WorkerNotificationClient``
+/ ``WorkerNotificationService``), inverted for the KV-polling contract (see
+``driver.py``): instead of the driver pushing to a TCP listener in every
+worker, workers poll the rendezvous KV's world version — a bump arms
+``notification_manager`` so the next ``state.commit()`` raises
+``HostsUpdatedInterrupt`` (SURVEY.md §4.4 recovery loop).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from ...elastic.runner import notification_manager
+from ...utils.logging import get_logger
+from ..http.kv_server import KVClient
+
+
+def elastic_enabled() -> bool:
+    return os.environ.get("HOROVOD_ELASTIC", "") == "1"
+
+
+class ElasticWorkerContext:
+    """This worker's view of the elastic world, refreshed per epoch."""
+
+    def __init__(self):
+        addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
+        port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
+        self.hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
+        self.client = KVClient(addr, port)
+        self.version = int(os.environ.get("HOROVOD_WORLD_VERSION", "0"))
+        self._poller: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def fetch_assignment(self, version: int | None = None) -> dict:
+        """Read this host's assignment for a world version (JSON dict with
+        process_id / num_processes / coordinator / slots / hosts).
+
+        Raises ``RemovedFromWorldError`` when the epoch exists but this host
+        is not in it, and ``HorovodInternalError`` for transient KV failures
+        (driver restarting / network blip) so the elastic loop retries.
+        """
+        from ...exceptions import HorovodInternalError, RemovedFromWorldError
+
+        # Always read the *latest* world: a worker re-initializing after an
+        # interrupt must join the current epoch, not the one it started in.
+        try:
+            v = self.client.world_version() if version is None else version
+            if v < self.version:
+                v = self.version
+            raw = self.client.get(f"world/{v}", self.hostname)
+        except Exception as e:
+            raise HorovodInternalError(f"rendezvous KV unreachable: {e}") from e
+        if raw is None:
+            raise RemovedFromWorldError(
+                f"host {self.hostname!r} has no assignment in world v{v}"
+            )
+        self.version = v
+        return json.loads(raw)
+
+    def apply_to_env(self, assignment: dict) -> None:
+        """Refresh the env contract so re-init picks up the new world."""
+        os.environ["HOROVOD_PROCESS_ID"] = str(assignment["process_id"])
+        os.environ["HOROVOD_NUM_PROCESSES"] = str(assignment["num_processes"])
+        os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coordinator"]
+        os.environ["HOROVOD_RANK"] = str(assignment["process_id"])
+        os.environ["HOROVOD_SIZE"] = str(assignment["num_processes"])
+        os.environ["HOROVOD_CROSS_RANK"] = str(assignment["process_id"])
+        os.environ["HOROVOD_CROSS_SIZE"] = str(assignment["num_processes"])
+
+    def check_for_update(self) -> bool:
+        """One poll: True (and notification armed) if the world moved on."""
+        current = self.client.world_version()
+        if current != self.version:
+            self.version = current
+            notification_manager.handle_hosts_updated()
+            return True
+        return False
+
+    def start_polling(self, interval: float = 1.0) -> None:
+        if self._poller is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval):
+                try:
+                    self.check_for_update()
+                except Exception as e:  # KV unreachable: driver died/restarting
+                    get_logger().debug("elastic poll failed: %s", e)
+
+        self._poller = threading.Thread(
+            target=loop, name="hvd-elastic-poll", daemon=True
+        )
+        self._poller.start()
+
+    def stop_polling(self) -> None:
+        self._stop.set()
+        if self._poller:
+            self._poller.join(timeout=5)
+            self._poller = None
+
+
+_context: ElasticWorkerContext | None = None
+
+
+def get_worker_context() -> ElasticWorkerContext:
+    global _context
+    if _context is None:
+        _context = ElasticWorkerContext()
+    return _context

@@ -1,0 +1,214 @@
+"""Worker process execution: env plumbing, spawn, output multiplexing.
+
+TPU-native analog of the reference's Gloo launch path
+(``horovod/runner/gloo_run.py — launch_gloo``): per-rank env construction,
+exec on each host (local fork or ssh), stdout/stderr multiplexed with rank
+prefixes, first failure propagated by terminating the rest.
+
+Divergences, by design: workers are one controller process per host; the env
+block carries both the reference's world facts (``HOROVOD_RANK/SIZE/...``)
+and the JAX multi-host bootstrap (``HOROVOD_COORDINATOR_ADDR`` →
+``jax.distributed.initialize``). CPU dev-mode fabricates virtual devices per
+host via ``XLA_FLAGS=--xla_force_host_platform_device_count``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Sequence
+
+from .hosts import ProcessAssignment
+
+_LOCAL_HOSTS = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local(hostname: str) -> bool:
+    import socket
+
+    return hostname in _LOCAL_HOSTS or hostname == socket.gethostname()
+
+
+def build_worker_env(
+    assignment: ProcessAssignment,
+    base_env: dict[str, str],
+    rendezvous_addr: str,
+    rendezvous_port: int,
+    coordinator_addr: str,
+    coordinator_port: int,
+    cpu_mode: bool = False,
+    extra_env: dict[str, str] | None = None,
+) -> dict[str, str]:
+    """The env contract between launcher and worker.
+
+    Mirrors the reference's env block (``HOROVOD_RANK`` et al. written in
+    ``launch_gloo``) and adds the JAX bootstrap triple. ``RuntimeConfig``
+    (utils/env.py) parses the same names on the worker side.
+    """
+    a = assignment
+    env = dict(base_env)
+    env.update(
+        {
+            "HOROVOD_RANK": str(a.rank),
+            "HOROVOD_SIZE": str(a.size),
+            "HOROVOD_LOCAL_RANK": str(a.local_rank),
+            "HOROVOD_LOCAL_SIZE": str(a.local_size),
+            "HOROVOD_CROSS_RANK": str(a.cross_rank),
+            "HOROVOD_CROSS_SIZE": str(a.cross_size),
+            "HOROVOD_CONTROLLER": "tpu",
+            "HOROVOD_RENDEZVOUS_ADDR": rendezvous_addr,
+            "HOROVOD_RENDEZVOUS_PORT": str(rendezvous_port),
+            # Reference-compat aliases (Gloo names; RuntimeConfig reads them).
+            "HOROVOD_GLOO_RENDEZVOUS_ADDR": rendezvous_addr,
+            "HOROVOD_GLOO_RENDEZVOUS_PORT": str(rendezvous_port),
+            # JAX multi-host bootstrap (consumed by basics._maybe_init_distributed).
+            "HOROVOD_COORDINATOR_ADDR": f"{coordinator_addr}:{coordinator_port}",
+            "HOROVOD_NUM_PROCESSES": str(a.size),
+            "HOROVOD_PROCESS_ID": str(a.rank),
+        }
+    )
+    if cpu_mode:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={a.slots}".strip()
+        )
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+@dataclasses.dataclass
+class WorkerProc:
+    assignment: ProcessAssignment
+    popen: subprocess.Popen
+    pump: threading.Thread
+
+
+def _pump_output(
+    proc: subprocess.Popen,
+    prefix: str,
+    sink: Callable[[str], None],
+) -> None:
+    """Line-multiplex a worker's combined stdout/stderr with a rank prefix.
+
+    Parity: the reference's ``MultiFile``/prefixed streaming in
+    ``gloo_run``; rank prefixes like ``[1]<stdout>`` become ``[1] `` here.
+    """
+    assert proc.stdout is not None
+    for raw in iter(proc.stdout.readline, b""):
+        line = raw.decode(errors="replace").rstrip("\n")
+        sink(f"{prefix}{line}")
+    proc.stdout.close()
+
+
+def launch_worker(
+    assignment: ProcessAssignment,
+    command: Sequence[str],
+    env: dict[str, str],
+    ssh_port: int | None = None,
+    sink: Callable[[str], None] | None = None,
+) -> WorkerProc:
+    """Start one worker (local subprocess, or ssh for a remote host)."""
+    sink = sink or (lambda s: print(s, flush=True))
+    if is_local(assignment.hostname):
+        popen = subprocess.Popen(
+            list(command),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+    else:
+        # Remote: ssh with the env inlined (the reference does the same —
+        # env vars exported in the remote command line).
+        exports = " ".join(
+            f"export {k}={shlex.quote(v)};"
+            for k, v in env.items()
+            if k.startswith(("HOROVOD_", "JAX_", "XLA_", "TPU_", "PATH", "PYTHON"))
+        )
+        remote_cmd = f"cd {shlex.quote(os.getcwd())} >/dev/null 2>&1; {exports} " + " ".join(
+            shlex.quote(c) for c in command
+        )
+        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        if ssh_port:
+            ssh_cmd += ["-p", str(ssh_port)]
+        ssh_cmd += [assignment.hostname, remote_cmd]
+        popen = subprocess.Popen(
+            ssh_cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+    pump = threading.Thread(
+        target=_pump_output,
+        args=(popen, f"[{assignment.rank}] ", sink),
+        name=f"hvd-pump-{assignment.rank}",
+        daemon=True,
+    )
+    pump.start()
+    return WorkerProc(assignment, popen, pump)
+
+
+def wait_for_workers(
+    workers: list[WorkerProc],
+    poll_interval: float = 0.1,
+    on_failure: str = "kill",
+) -> int:
+    """Wait for all workers; on first non-zero exit, terminate the rest.
+
+    Returns the first failing exit code, or 0. Parity: the reference
+    propagates the first failure and kills remaining workers so a crashed
+    rank cannot hang the job (the surviving ranks would block in collectives
+    forever — the exact stall the stall inspector warns about).
+    """
+    pending = {w.assignment.rank: w for w in workers}
+    first_rc = 0
+    while pending:
+        done = [r for r, w in pending.items() if w.popen.poll() is not None]
+        for r in done:
+            w = pending.pop(r)
+            rc = w.popen.returncode
+            if rc != 0 and first_rc == 0:
+                first_rc = rc if rc is not None else 1
+                if on_failure == "kill":
+                    for other in pending.values():
+                        terminate_worker(other)
+        if not done:
+            time.sleep(poll_interval)
+    for w in workers:
+        w.pump.join(timeout=5)
+    return first_rc
+
+
+def terminate_worker(w: WorkerProc, grace_s: float = 5.0) -> None:
+    """SIGTERM the worker's process group, escalate to SIGKILL."""
+    if w.popen.poll() is not None:
+        return
+    try:
+        os.killpg(os.getpgid(w.popen.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.time() + grace_s
+    while time.time() < deadline:
+        if w.popen.poll() is not None:
+            return
+        time.sleep(0.05)
+    try:
+        os.killpg(os.getpgid(w.popen.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def python_command(script_and_args: Sequence[str]) -> list[str]:
+    """Prefix a user command with the current interpreter when it's a .py."""
+    cmd = list(script_and_args)
+    if cmd and cmd[0].endswith(".py"):
+        return [sys.executable] + cmd
+    return cmd

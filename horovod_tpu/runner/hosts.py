@@ -1,0 +1,138 @@
+"""Host parsing and rank assignment for the launcher.
+
+TPU-native re-design of the reference's host bookkeeping
+(``horovod/runner/common/util/hosts.py — parse_hosts, get_host_assignments``).
+The reference assigns one rank per GPU in host:slot order. Here the unit of
+launch is one **controller process per host** (JAX single-controller SPMD: a
+process drives all of its host's chips), so "slots" count the chips a host
+contributes — they size the per-host device world, not extra processes.
+
+For CPU dev-mode (``--cpu-mode``), slots instead mean emulated device ranks:
+each process is told to fabricate ``slots`` virtual CPU devices via
+``XLA_FLAGS=--xla_force_host_platform_device_count``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+
+class HostParseError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class HostInfo:
+    """One host spec: ``hostname:slots`` (slots default 1)."""
+
+    hostname: str
+    slots: int
+
+    @classmethod
+    def from_string(cls, spec: str) -> "HostInfo":
+        spec = spec.strip()
+        m = re.fullmatch(r"([^\s:]+)(?::(\d+))?", spec)
+        if not m:
+            raise HostParseError(f"bad host spec {spec!r}; expected host[:slots]")
+        slots = int(m.group(2)) if m.group(2) else 1
+        if slots < 1:
+            raise HostParseError(f"bad slot count in {spec!r}: must be >= 1")
+        return cls(m.group(1), slots)
+
+
+def parse_hosts(hosts_string: str) -> list[HostInfo]:
+    """Parse ``-H h1:4,h2:4`` (comma separated host:slots)."""
+    hosts = [
+        HostInfo.from_string(s) for s in hosts_string.split(",") if s.strip()
+    ]
+    if not hosts:
+        raise HostParseError(f"no hosts in {hosts_string!r}")
+    seen: set[str] = set()
+    for h in hosts:
+        if h.hostname in seen:
+            raise HostParseError(f"duplicate host {h.hostname!r}")
+        seen.add(h.hostname)
+    return hosts
+
+
+def parse_hostfile(path: str) -> list[HostInfo]:
+    """Parse a hostfile: one ``host slots=N`` or ``host:N`` per line."""
+    hosts: list[HostInfo] = []
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.fullmatch(r"(\S+)\s+slots\s*=\s*(\d+)", line)
+            if m:
+                hosts.append(HostInfo(m.group(1), int(m.group(2))))
+            else:
+                hosts.append(HostInfo.from_string(line))
+    if not hosts:
+        raise HostParseError(f"hostfile {path!r} contains no hosts")
+    return hosts
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessAssignment:
+    """One launched worker process and its world facts.
+
+    ``rank`` here is the *process* rank (the reference's rank): the device
+    ranks a process owns are ``[first_device_rank, first_device_rank +
+    num_devices)`` in the canonical ICI order once JAX initializes.
+    """
+
+    hostname: str
+    rank: int  # process index (HOROVOD_PROCESS_ID / jax process_index)
+    size: int  # total processes
+    local_rank: int  # index among processes on this host (always 0 here)
+    local_size: int  # processes on this host (always 1: one per host)
+    cross_rank: int  # host index
+    cross_size: int  # number of hosts
+    slots: int  # chips this host contributes (device count)
+    first_device_rank: int  # offset of this host's devices in rank space
+
+
+def get_host_assignments(
+    hosts: list[HostInfo], np: int | None = None
+) -> list[ProcessAssignment]:
+    """Assign one controller process per host, hosts in given order.
+
+    Parity: ``horovod/runner/common/util/hosts.py — get_host_assignments``,
+    re-shaped for the one-process-per-host model. ``np`` (if given) limits the
+    number of *processes* (hosts used); the reference's per-GPU ``-np`` maps
+    to the chip total, which is ``sum(slots)`` of the hosts used.
+
+    Host order is rank order at the process level; within the device world,
+    ``horovod_tpu.topology`` re-sorts chips into ICI order at init. Keeping
+    the host list stable across elastic re-assignments minimizes rank churn
+    (the reference rebalances the same way).
+    """
+    use = hosts if np is None else hosts[:np]
+    if np is not None and len(hosts) < np:
+        raise HostParseError(
+            f"requested {np} processes but only {len(hosts)} hosts available"
+        )
+    out: list[ProcessAssignment] = []
+    offset = 0
+    for i, h in enumerate(use):
+        out.append(
+            ProcessAssignment(
+                hostname=h.hostname,
+                rank=i,
+                size=len(use),
+                local_rank=0,
+                local_size=1,
+                cross_rank=i,
+                cross_size=len(use),
+                slots=h.slots,
+                first_device_rank=offset,
+            )
+        )
+        offset += h.slots
+    return out
+
+
+def total_slots(assignments: list[ProcessAssignment]) -> int:
+    return sum(a.slots for a in assignments)

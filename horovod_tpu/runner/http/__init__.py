@@ -1,0 +1,1 @@
+from .kv_server import KVClient, RendezvousServer  # noqa: F401

@@ -1,0 +1,176 @@
+"""Rendezvous key-value server over HTTP.
+
+TPU-native analog of the reference's launcher-side KV store
+(``horovod/runner/http/http_server.py — RendezvousServer, KVStoreHandler``),
+which Gloo contexts rendezvoused against. Here the *data plane* needs no
+rendezvous (XLA collectives bootstrap via ``jax.distributed``); the KV server
+serves the **control plane**: worker registration, elastic host-update
+notification, and generic scoped key/value exchange (used e.g. by
+``broadcast_object`` fallbacks and the native runtime's coordinator
+discovery).
+
+Protocol: ``PUT /scope/key`` (body = value bytes), ``GET /scope/key``
+(200 + bytes, or 404), ``DELETE /scope`` (drop a scope),
+``GET /_scope/scope`` (list keys, newline separated). A monotonically
+increasing ``version`` is bumped by ``reset()`` on elastic reconfiguration;
+workers read it at ``GET /_version``.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.error import HTTPError
+from urllib.request import Request, urlopen
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # Silence per-request stderr logging (the launcher multiplexes worker
+    # output; interleaved request logs would corrupt it).
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _split(self):
+        # Key = last path component; scope = everything before it (scopes may
+        # contain slashes, e.g. "world/3").
+        path = self.path.strip("/")
+        if path.startswith("_scope/"):
+            return "_scope", path[len("_scope/"):]
+        if "/" not in path:
+            return path, None
+        scope, key = path.rsplit("/", 1)
+        return scope, key
+
+    def do_GET(self):  # noqa: N802
+        store = self.server.store  # type: ignore[attr-defined]
+        scope, key = self._split()
+        if scope == "_version":
+            body = str(self.server.version).encode()  # type: ignore[attr-defined]
+            return self._reply(200, body)
+        if scope == "_scope":
+            with self.server.lock:  # type: ignore[attr-defined]
+                keys = sorted(store.get(key or "", {}).keys())
+            return self._reply(200, ("\n".join(keys)).encode())
+        with self.server.lock:  # type: ignore[attr-defined]
+            val = store.get(scope, {}).get(key)
+        if val is None:
+            return self._reply(404, b"")
+        self._reply(200, val)
+
+    def do_PUT(self):  # noqa: N802
+        scope, key = self._split()
+        if key is None:
+            return self._reply(400, b"missing key")
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
+        self._reply(200, b"")
+
+    def do_DELETE(self):  # noqa: N802
+        scope = self.path.strip("/")
+        with self.server.lock:  # type: ignore[attr-defined]
+            self.server.store.pop(scope, None)  # type: ignore[attr-defined]
+        self._reply(200, b"")
+
+    def _reply(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class RendezvousServer:
+    """In-memory scoped KV over HTTP, owned by the launcher."""
+
+    def __init__(self, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, 0), _KVHandler)
+        self._httpd.store = {}  # type: ignore[attr-defined]
+        self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.version = 0  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def version(self) -> int:
+        return self._httpd.version  # type: ignore[attr-defined]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-rendezvous", daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def reset(self) -> int:
+        """Elastic reconfiguration: clear state, bump the world version."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.clear()  # type: ignore[attr-defined]
+            self._httpd.version += 1  # type: ignore[attr-defined]
+            return self._httpd.version  # type: ignore[attr-defined]
+
+    def publish_epoch(self, scope_prefix: str, data: dict[str, bytes],
+                      keep_epochs: int = 2) -> int:
+        """Atomically publish a new epoch: write ``<scope_prefix>/<v+1>``
+        first, THEN bump the version — in-flight readers of the previous
+        epoch keep seeing their scope (the last ``keep_epochs`` are kept)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            version = self._httpd.version + 1  # type: ignore[attr-defined]
+            store = self._httpd.store  # type: ignore[attr-defined]
+            store[f"{scope_prefix}/{version}"] = dict(data)
+            stale = version - keep_epochs
+            if stale > 0:
+                store.pop(f"{scope_prefix}/{stale}", None)
+            self._httpd.version = version  # type: ignore[attr-defined]
+            return version
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+class KVClient:
+    """Worker-side client for the rendezvous KV server."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 10.0):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+        )
+        with urlopen(req, timeout=self._timeout):
+            pass
+
+    def get(self, scope: str, key: str) -> bytes | None:
+        try:
+            with urlopen(
+                f"{self._base}/{scope}/{key}", timeout=self._timeout
+            ) as r:
+                return r.read()
+        except HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def keys(self, scope: str) -> list[str]:
+        with urlopen(f"{self._base}/_scope/{scope}", timeout=self._timeout) as r:
+            body = r.read().decode()
+        return [k for k in body.split("\n") if k]
+
+    def delete_scope(self, scope: str) -> None:
+        req = Request(f"{self._base}/{scope}", method="DELETE")
+        with urlopen(req, timeout=self._timeout):
+            pass
+
+    def world_version(self) -> int:
+        with urlopen(f"{self._base}/_version", timeout=self._timeout) as r:
+            return int(r.read())

@@ -1,0 +1,293 @@
+"""``hvdrun`` — the launcher CLI.
+
+TPU-native re-design of the reference's ``horovodrun``
+(``horovod/runner/launch.py — parse_args(), run_commandline()``). The flag
+surface keeps the reference's names where the concept survives; every runtime
+flag is translated into the corresponding ``HOROVOD_*`` env var for the
+children (the same CLI→env→config precedence contract, see
+``horovod_tpu/utils/env.py``).
+
+Differences, by design:
+- workers are one controller process per host (JAX SPMD), so ``-np`` is the
+  number of processes; per-chip ranks come from the device world at init.
+- there is no MPI path: the launch substrate is always
+  rendezvous-KV + (local fork | ssh), the analog of the reference's Gloo path.
+- ``--cpu-mode`` runs the whole job on virtual CPU devices (dev/CI parity
+  with the reference's CPU/Gloo mode).
+
+Usage::
+
+    hvdrun -np 2 -H host1:4,host2:4 python train.py
+    hvdrun -np 2 --cpu-mode python train.py        # 2 local procs
+    hvdrun -np 2 --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh python train.py   # elastic
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+
+from . import network
+from .exec_utils import (
+    build_worker_env,
+    launch_worker,
+    python_command,
+    wait_for_workers,
+)
+from .hosts import (
+    HostInfo,
+    get_host_assignments,
+    parse_hostfile,
+    parse_hosts,
+)
+from .http.kv_server import RendezvousServer
+
+
+@dataclasses.dataclass
+class Settings:
+    """Resolved launch settings (reference: ``horovod/runner/common/util/
+    settings.py — Settings``)."""
+
+    num_proc: int
+    hosts: list[HostInfo]
+    command: list[str]
+    cpu_mode: bool = False
+    ssh_port: int | None = None
+    start_timeout: float = 30.0
+    verbose: bool = False
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    # Elastic:
+    elastic: bool = False
+    min_np: int | None = None
+    max_np: int | None = None
+    discovery_script: str | None = None
+    elastic_timeout: float = 600.0
+
+
+def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu job across TPU VM hosts.",
+        allow_abbrev=False,
+    )
+    p.add_argument("-v", "--version", action="store_true", help="print version")
+    p.add_argument("-np", "--num-proc", type=int, default=None,
+                   help="number of worker processes (one per host)")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="comma separated host:slots (slots = chips per host)")
+    p.add_argument("--hostfile", default=None,
+                   help="hostfile path (host slots=N per line)")
+    p.add_argument("--cpu-mode", action="store_true",
+                   help="run on virtual CPU devices (dev/CI mode); slots = "
+                        "virtual devices per process")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--start-timeout", type=float,
+                   default=float(os.environ.get("HOROVOD_START_TIMEOUT", 30)))
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="print framework capabilities and exit")
+    # Runtime knobs → env for children (names match the reference CLI).
+    p.add_argument("--fusion-threshold-mb", type=int, default=None)
+    p.add_argument("--cycle-time-ms", type=float, default=None)
+    p.add_argument("--cache-capacity", type=int, default=None)
+    p.add_argument("--timeline-filename", default=None)
+    p.add_argument("--timeline-mark-cycles", action="store_true")
+    p.add_argument("--autotune", action="store_true")
+    p.add_argument("--autotune-log-file", default=None)
+    p.add_argument("--hierarchical-allreduce", action="store_true")
+    p.add_argument("--log-level", default=None,
+                   choices=["trace", "debug", "info", "warning", "error", "fatal"])
+    p.add_argument("--stall-check-time", type=float, default=None)
+    p.add_argument("--stall-shutdown-time", type=float, default=None)
+    # Elastic.
+    p.add_argument("--min-np", type=int, default=None)
+    p.add_argument("--max-np", type=int, default=None)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="script printing 'host:slots' per line; enables "
+                        "elastic mode")
+    p.add_argument("--elastic-timeout", type=float,
+                   default=float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", 600)))
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command (python train.py ...)")
+    return p.parse_args(argv)
+
+
+def args_to_env(args: argparse.Namespace) -> dict[str, str]:
+    """CLI flags → HOROVOD_* env for children (the reference's contract)."""
+    env: dict[str, str] = {}
+    if args.fusion_threshold_mb is not None:
+        env["HOROVOD_FUSION_THRESHOLD"] = str(args.fusion_threshold_mb * 1024 * 1024)
+    if args.cycle_time_ms is not None:
+        env["HOROVOD_CYCLE_TIME"] = str(args.cycle_time_ms)
+    if args.cache_capacity is not None:
+        env["HOROVOD_CACHE_CAPACITY"] = str(args.cache_capacity)
+    if args.timeline_filename:
+        env["HOROVOD_TIMELINE"] = args.timeline_filename
+    if args.timeline_mark_cycles:
+        env["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+    if args.autotune:
+        env["HOROVOD_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HOROVOD_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.hierarchical_allreduce:
+        env["HOROVOD_HIERARCHICAL_ALLREDUCE"] = "1"
+    if args.log_level:
+        env["HOROVOD_LOG_LEVEL"] = args.log_level
+    if args.stall_check_time is not None:
+        env["HOROVOD_STALL_CHECK_TIME"] = str(args.stall_check_time)
+    if args.stall_shutdown_time is not None:
+        env["HOROVOD_STALL_SHUTDOWN_TIME"] = str(args.stall_shutdown_time)
+    return env
+
+
+def settings_from_args(args: argparse.Namespace) -> Settings:
+    if args.hosts and args.hostfile:
+        raise SystemExit("specify either -H/--hosts or --hostfile, not both")
+    command = python_command([c for c in args.command if c != "--"])
+    if not command:
+        raise SystemExit("no training command given")
+    elastic = args.host_discovery_script is not None
+    if elastic:
+        # Reference semantics: -np is the starting/target world size;
+        # min/max default to it when not given explicitly.
+        hosts = []  # discovered at runtime
+        np = args.num_proc or (args.min_np or 1)
+        if args.min_np is None:
+            args.min_np = np
+        if args.max_np is None and args.num_proc is not None:
+            args.max_np = args.num_proc
+    else:
+        if args.hosts:
+            hosts = parse_hosts(args.hosts)
+        elif args.hostfile:
+            hosts = parse_hostfile(args.hostfile)
+        else:
+            n = args.num_proc or 1
+            hosts = [HostInfo("localhost", 1)]
+            if n > 1:
+                if not args.cpu_mode:
+                    raise SystemExit(
+                        "-np > 1 without -H/--hostfile requires --cpu-mode "
+                        "(local multi-process is a CPU dev-mode feature; on "
+                        "TPU each host runs one process)"
+                    )
+                hosts = [HostInfo("localhost", 1) for _ in range(n)]
+        np = args.num_proc or len(hosts)
+        if np > len(hosts):
+            raise SystemExit(
+                f"-np {np} exceeds {len(hosts)} host(s); one process per host"
+            )
+    return Settings(
+        num_proc=np,
+        hosts=hosts,
+        command=command,
+        cpu_mode=args.cpu_mode,
+        ssh_port=args.ssh_port,
+        start_timeout=args.start_timeout,
+        verbose=args.verbose,
+        env=args_to_env(args),
+        elastic=elastic,
+        min_np=args.min_np,
+        max_np=args.max_np,
+        discovery_script=args.host_discovery_script,
+        elastic_timeout=args.elastic_timeout,
+    )
+
+
+def run_static(settings: Settings, sink=None) -> int:
+    """The static (non-elastic) launch path.
+
+    Parity: ``gloo_run`` — start rendezvous, assign ranks, exec workers,
+    multiplex output, propagate first failure.
+    """
+    # Local multi-process: assignments replicate localhost.
+    if settings.hosts and all(h.hostname == "localhost" for h in settings.hosts):
+        hosts = settings.hosts[: settings.num_proc]
+    else:
+        hosts = settings.hosts
+    assignments = get_host_assignments(hosts, settings.num_proc)
+
+    server = RendezvousServer()
+    port = server.start()
+    hostnames = [h.hostname for h in hosts]
+    kv_addr = network.driver_addr(hostnames)
+    coord_addr = network.coordinator_addr(hostnames)
+    coord_port = network.free_port()
+    try:
+        workers = []
+        for a in assignments:
+            env = build_worker_env(
+                a,
+                base_env=dict(os.environ),
+                rendezvous_addr=kv_addr,
+                rendezvous_port=port,
+                coordinator_addr=coord_addr,
+                coordinator_port=coord_port,
+                cpu_mode=settings.cpu_mode,
+                extra_env=settings.env,
+            )
+            workers.append(
+                launch_worker(
+                    a, settings.command, env,
+                    ssh_port=settings.ssh_port, sink=sink,
+                )
+            )
+        return wait_for_workers(workers)
+    finally:
+        server.stop()
+
+
+def check_build() -> str:
+    from .. import __version__
+
+    lines = [
+        f"horovod_tpu v{__version__}",
+        "",
+        "Available frameworks:",
+        "    [X] JAX / Flax",
+        "    [X] NumPy (eager collectives)",
+        "",
+        "Available controllers:",
+        "    [X] rendezvous-KV (TCP)",
+        "",
+        "Available collective backends:",
+        "    [X] XLA:TPU (ICI/DCN)",
+        "    [X] XLA:CPU (dev mode)",
+        "",
+        "Available features:",
+        "    [X] elastic",
+        "    [X] process sets",
+        "    [X] grouped allreduce / tensor fusion",
+        "    [X] adasum",
+        "    [X] timeline / stall inspector",
+    ]
+    return "\n".join(lines)
+
+
+def run_commandline(argv: list[str] | None = None) -> int:
+    args = parse_args(argv)
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return 0
+    if args.check_build:
+        print(check_build())
+        return 0
+    settings = settings_from_args(args)
+    if settings.elastic:
+        from .elastic.driver import run_elastic
+
+        return run_elastic(settings)
+    return run_static(settings)
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,38 @@
+"""Small networking helpers shared by the launcher and the elastic driver
+(parity: ``horovod/runner/util/network.py``)."""
+
+from __future__ import annotations
+
+import socket
+
+LOCAL_NAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def is_local(hostname: str) -> bool:
+    return hostname in LOCAL_NAMES or hostname == socket.gethostname()
+
+
+def driver_addr(hostnames: list[str]) -> str:
+    """The address workers use to reach services running in the launcher
+    (rendezvous KV). Loopback when the whole world is local; otherwise this
+    host's routable address."""
+    if all(is_local(h) for h in hostnames):
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.gethostname()
+
+
+def coordinator_addr(hostnames: list[str]) -> str:
+    """The address of the jax.distributed coordinator — process 0's host."""
+    first = hostnames[0]
+    if first in LOCAL_NAMES:
+        return "127.0.0.1"
+    return first

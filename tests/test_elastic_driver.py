@@ -1,0 +1,203 @@
+"""Elastic driver tests, using the reference's fault-injection harness
+pattern (``test/integration/elastic_common.py``): the discovery script reads
+a file the test mutates; worker failures are induced via behavior flags; the
+test asserts the world-version trajectory and recovery."""
+
+import json
+import os
+import stat
+import sys
+import textwrap
+import time
+
+import pytest
+
+from horovod_tpu.runner.elastic.discovery import (
+    FixedHostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.runner.elastic.driver import run_elastic
+from horovod_tpu.runner.hosts import HostInfo
+from horovod_tpu.runner.launch import Settings
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Distinct names that all resolve to this machine — localhost-as-cluster.
+LOCAL_ALIASES = ["localhost", "127.0.0.1"]
+
+
+def _write_discovery(tmp_path, hosts: list[str]):
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("\n".join(hosts) + "\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script), hosts_file
+
+
+class TestHostManager:
+    def test_blacklist_and_pick(self):
+        m = HostManager(FixedHostDiscovery([HostInfo("a", 1), HostInfo("b", 1)]))
+        m.update_available_hosts()
+        assert [h.hostname for h in m.usable_hosts()] == ["a", "b"]
+        m.blacklist("a")
+        assert [h.hostname for h in m.usable_hosts()] == ["b"]
+        # preference keeps running hosts first; blacklisted never returns
+        world = m.pick_world(["a", "b"], max_np=None)
+        assert [h.hostname for h in world] == ["b"]
+
+    def test_pick_world_stability_and_cap(self):
+        m = HostManager(
+            FixedHostDiscovery(
+                [HostInfo("a", 1), HostInfo("b", 1), HostInfo("c", 1)]
+            )
+        )
+        m.update_available_hosts()
+        world = m.pick_world(["c", "b"], max_np=2)
+        assert [h.hostname for h in world] == ["c", "b"]
+
+    def test_valid_sizes_snap(self):
+        # Topology constraint: only even world sizes are valid (e.g. paired
+        # ICI hosts); 3 usable hosts must snap down to 2.
+        m = HostManager(
+            FixedHostDiscovery(
+                [HostInfo("a", 1), HostInfo("b", 1), HostInfo("c", 1)]
+            ),
+            valid_sizes=lambda n: n % 2 == 0,
+        )
+        m.update_available_hosts()
+        assert len(m.pick_world([], max_np=None)) == 2
+
+    def test_discovery_script(self, tmp_path):
+        script, hosts_file = _write_discovery(tmp_path, ["h1:2", "h2"])
+        d = HostDiscoveryScript(script)
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 1}
+        hosts_file.write_text("h1:2\n")
+        assert d.find_available_hosts_and_slots() == {"h1": 2}
+
+
+def _elastic_worker(tmp_path) -> str:
+    """Worker driven by a behavior map {hostname: behavior}:
+    - "fail_once": exit 1 on first launch, 0 on relaunch
+    - "wait_for_version:N": poll the KV until world version >= N, print the
+      assignment, exit 0 (exit 3 on timeout)
+    """
+    path = tmp_path / "elastic_worker.py"
+    path.write_text(
+        textwrap.dedent(
+            f"""
+            import json, os, sys, time
+            sys.path.insert(0, {str(REPO_ROOT)!r})
+            from horovod_tpu.runner.http.kv_server import KVClient
+
+            host = os.environ["HOROVOD_HOSTNAME"]
+            client = KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                              int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
+            behavior = json.load(open(os.environ["TEST_BEHAVIOR_FILE"])).get(
+                host, "wait_for_version:1")
+            print("start host=%s v=%s behavior=%s" % (
+                host, os.environ["HOROVOD_WORLD_VERSION"], behavior), flush=True)
+            if behavior == "fail_once":
+                marker = os.environ["TEST_TMP"] + "/failed_" + host
+                if not os.path.exists(marker):
+                    open(marker, "w").close()
+                    sys.exit(1)
+                sys.exit(0)
+            target = int(behavior.split(":")[1])
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                v = client.world_version()
+                if v >= target:
+                    a = json.loads(client.get("world/%d" % v, host) or "{{}}")
+                    print("host=%s sees v%d np=%s" % (
+                        host, v, a.get("num_processes")), flush=True)
+                    sys.exit(0)
+                time.sleep(0.05)
+            sys.exit(3)
+            """
+        )
+    )
+    return str(path)
+
+
+def _settings(tmp_path, script, behavior: dict, min_np=1, max_np=None):
+    behavior_file = tmp_path / "behavior.json"
+    behavior_file.write_text(json.dumps(behavior))
+    worker = _elastic_worker(tmp_path)
+    return Settings(
+        num_proc=1,
+        hosts=[],
+        command=[sys.executable, worker],
+        cpu_mode=False,
+        elastic=True,
+        min_np=min_np,
+        max_np=max_np,
+        discovery_script=script,
+        elastic_timeout=20.0,
+        env={
+            "TEST_BEHAVIOR_FILE": str(behavior_file),
+            "TEST_TMP": str(tmp_path),
+        },
+    )
+
+
+class TestElasticDriver:
+    def test_completes_when_worker_exits_zero(self, tmp_path):
+        script, _ = _write_discovery(tmp_path, ["localhost"])
+        settings = _settings(
+            tmp_path, script, {"localhost": "wait_for_version:1"}
+        )
+        lines: list[str] = []
+        assert run_elastic(settings, sink=lines.append) == 0
+        assert any("sees v1 np=1" in l for l in lines)
+
+    def test_worker_failure_blacklists_and_recovers(self, tmp_path):
+        # Two "hosts"; the first fails once. The driver must blacklist it,
+        # re-form the world as {127.0.0.1} (v2), and the survivor finishes.
+        script, _ = _write_discovery(tmp_path, LOCAL_ALIASES)
+        settings = _settings(
+            tmp_path,
+            script,
+            {"localhost": "fail_once", "127.0.0.1": "wait_for_version:2"},
+            min_np=1,
+        )
+        lines: list[str] = []
+        assert run_elastic(settings, sink=lines.append) == 0
+        assert any("host=127.0.0.1 sees v2 np=1" in l for l in lines)
+
+    def test_scale_up_on_host_added(self, tmp_path):
+        # Start with one host; add a second mid-run by editing the hosts
+        # file (the reference's fault-injection idiom). Workers wait for v2.
+        script, hosts_file = _write_discovery(tmp_path, ["localhost"])
+        settings = _settings(
+            tmp_path,
+            script,
+            {
+                "localhost": "wait_for_version:2",
+                "127.0.0.1": "wait_for_version:2",
+            },
+        )
+        lines: list[str] = []
+
+        import threading
+
+        def add_host():
+            time.sleep(1.5)
+            hosts_file.write_text("localhost\n127.0.0.1\n")
+
+        t = threading.Thread(target=add_host)
+        t.start()
+        rc = run_elastic(settings, sink=lines.append)
+        t.join()
+        assert rc == 0
+        assert any("sees v2 np=2" in l for l in lines)
+
+    def test_times_out_below_min_np(self, tmp_path):
+        script, _ = _write_discovery(tmp_path, ["localhost"])
+        settings = _settings(
+            tmp_path, script, {}, min_np=2
+        )
+        settings.elastic_timeout = 1.0
+        with pytest.raises(TimeoutError):
+            run_elastic(settings, sink=lambda s: None)
