@@ -87,12 +87,49 @@ def _maybe_init_distributed() -> None:
     nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)
     proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "-1") or -1)
     if coord and nprocs > 1 and proc_id >= 0:
+        coord = _exchange_coordinator_port(coord, proc_id)
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nprocs,
             process_id=proc_id,
         )
         _state.distributed_initialized = True
+
+
+def _exchange_coordinator_port(coord: str, proc_id: int) -> str:
+    """Let process 0 pick the coordinator port ON ITS OWN HOST and publish
+    it via the rendezvous KV; everyone else polls for it.
+
+    The launcher cannot probe a free port on a remote coordinator host
+    (classic TOCTOU across machines); its port choice is only a fallback
+    for worlds launched without a rendezvous server.
+    """
+    import time
+
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "-1") or -1)
+    if not addr or port < 0:
+        return coord  # manual launch: trust the env as given
+    from .runner.http.kv_server import KVClient
+    from .runner.network import free_port
+
+    host = coord.rsplit(":", 1)[0]
+    version = os.environ.get("HOROVOD_WORLD_VERSION", "static")
+    scope = f"coord/{version}"
+    kv = KVClient(addr, port)
+    if proc_id == 0:
+        chosen = f"{host}:{free_port()}"
+        kv.put(scope, "addr", chosen.encode())
+        return chosen
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        val = kv.get(scope, "addr")
+        if val is not None:
+            return val.decode()
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"coordinator address not published to rendezvous KV scope {scope!r}"
+    )
 
 
 def init(devices: Sequence[Any] | None = None) -> None:
@@ -136,6 +173,17 @@ def init(devices: Sequence[Any] | None = None) -> None:
 def shutdown() -> None:
     """Tear down world state (elastic re-init calls this before re-forming)."""
     with _lock:
+        # Distributed teardown runs even when init() died half-way (after
+        # jax.distributed came up but before _state.initialized was set) —
+        # otherwise the next init() hits "already initialized" forever.
+        if _state.distributed_initialized:
+            import jax
+
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # broken world: still clear the flag
+                get_logger().warning("jax.distributed.shutdown failed: %s", e)
+            _state.distributed_initialized = False
         if not _state.initialized:
             return
         from . import process_sets
@@ -145,12 +193,6 @@ def shutdown() -> None:
         # world must not hit them (stale devices / reused process-set ids).
         global_cache().clear()
         process_sets._clear()
-        if _state.distributed_initialized:
-            # Elastic re-init forms a new jax.distributed world next time.
-            import jax
-
-            jax.distributed.shutdown()
-            _state.distributed_initialized = False
         _state.initialized = False
         _state.topology = None
         _state.mesh = None

@@ -42,24 +42,50 @@ def run(func):
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
         import sys
-        import time
+        import time  # noqa: F401  (used below)
+
+        import os
 
         log = get_logger()
         notification_manager.init()
         skip_sync = False
         needs_reset = False
         backoff = 0.5
+        first_init_failure = None
+        init_retry_limit_s = float(
+            os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600") or 600
+        )
         while True:
             # World (re-)formation runs INSIDE the retry scope: init() can
             # itself fail transiently during an elastic reconfiguration
             # (driver mid-publish, KV briefly unreachable) and must retry,
-            # not kill the worker.
+            # not kill the worker. Non-framework exceptions out of init()
+            # (e.g. jax.distributed RuntimeError) are wrapped as internal
+            # errors; persistent failure past the elastic timeout re-raises.
             try:
                 if not basics.is_initialized():
-                    basics.init()
+                    try:
+                        basics.init()
+                    except (HorovodInternalError, HostsUpdatedInterrupt,
+                            RemovedFromWorldError):
+                        raise
+                    except Exception as e:
+                        now = time.time()
+                        if first_init_failure is None:
+                            first_init_failure = now
+                        if now - first_init_failure > init_retry_limit_s:
+                            log.error(
+                                "elastic: re-initialization failing for "
+                                ">%ss; giving up", init_retry_limit_s,
+                            )
+                            raise
+                        raise HorovodInternalError(
+                            f"world re-initialization failed: {e}"
+                        ) from e
                     if needs_reset:
                         state.on_reset()
                         needs_reset = False
+                first_init_failure = None
                 backoff = 0.5
                 if not skip_sync:
                     state.sync()
@@ -115,6 +141,11 @@ class _NotificationManager:
         if self._pending:
             self._pending = False
             raise HostsUpdatedInterrupt()
+
+    def clear(self):
+        """Drop a stale notification (the worker already joined the epoch
+        the notification was about — e.g. via re-init after a failure)."""
+        self._pending = False
 
 
 notification_manager = _NotificationManager()

@@ -79,15 +79,13 @@ def to_local(array) -> np.ndarray:
     identical, so ``to_local(out)[0]`` is the process's answer — the analog
     of the reference's per-rank return value.
     """
-    import numpy as _np
-
     arr = jax.numpy.asarray(array) if not hasattr(array, "addressable_shards") else array
     if getattr(arr, "is_fully_addressable", True):
-        return _np.asarray(arr)
+        return np.asarray(arr)
     shards = sorted(
         arr.addressable_shards, key=lambda s: s.index[0].start or 0
     )
-    return _np.concatenate([_np.asarray(s.data) for s in shards], axis=0)
+    return np.concatenate([np.asarray(s.data) for s in shards], axis=0)
 
 
 def _to_bytes_tree(obj: Any) -> np.ndarray:

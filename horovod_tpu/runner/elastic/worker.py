@@ -59,6 +59,9 @@ class ElasticWorkerContext:
                 f"host {self.hostname!r} has no assignment in world v{v}"
             )
         self.version = v
+        # Joining the latest epoch satisfies any pending hosts-updated
+        # notification — clearing it avoids a spurious second teardown.
+        notification_manager.clear()
         return json.loads(raw)
 
     def apply_to_env(self, assignment: dict) -> None:

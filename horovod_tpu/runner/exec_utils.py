@@ -25,14 +25,7 @@ import time
 from typing import Callable, Sequence
 
 from .hosts import ProcessAssignment
-
-_LOCAL_HOSTS = {"localhost", "127.0.0.1", "::1"}
-
-
-def is_local(hostname: str) -> bool:
-    import socket
-
-    return hostname in _LOCAL_HOSTS or hostname == socket.gethostname()
+from .network import is_local
 
 
 def build_worker_env(

@@ -1,0 +1,262 @@
+"""Python binding for the native runtime core (``libhvdrt.so``).
+
+The native core is the TPU-native re-design of the reference's C++ runtime
+(``horovod/common/``, SURVEY.md §3.1): background negotiation thread, rank-0
+controller with response-cache bitvector fast path, tensor fusion, ring data
+plane over TCP, stall inspector, Chrome-trace timeline. Its role in this
+framework (SURVEY.md §7 design stance):
+
+- **host/DCN leg**: eager host-tensor collectives across controller
+  processes — gradient/metric reduction outside jit, object exchange, the
+  cross-slice leg of hierarchical ops. The ICI leg stays XLA-compiled.
+- **reference-parity async API**: ``allreduce_async_`` → handle,
+  ``synchronize(handle)``, matching ``horovod.torch.mpi_ops`` semantics for
+  host (numpy) tensors.
+
+Binding is ctypes on a C API (no pybind11 in this environment — see
+``cpp/runtime.cc`` for the exported surface).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Any
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libhvdrt.so")
+
+# Enum contracts with cpp/common.h.
+OP_ALLREDUCE, OP_ALLGATHER, OP_BROADCAST, OP_ALLTOALL, OP_REDUCESCATTER, \
+    OP_BARRIER = range(6)
+RED_SUM, RED_AVERAGE, RED_MIN, RED_MAX = range(4)
+
+_DTYPE_MAP = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.float16): 5,
+}
+try:  # bfloat16 comes from ml_dtypes (always present with jax)
+    import ml_dtypes
+
+    _DTYPE_MAP[np.dtype(ml_dtypes.bfloat16)] = 6
+except ImportError:  # pragma: no cover
+    pass
+
+_REDUCE_MAP = {"sum": RED_SUM, "average": RED_AVERAGE, "min": RED_MIN,
+               "max": RED_MAX}
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    subprocess.run(
+        ["make", "-s", "-C", os.path.join(_HERE, "cpp")],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load_library() -> ctypes.CDLL:
+    """Load (building on demand) the native core."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            _build()
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.hvdrt_init.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_double,
+        ]
+        lib.hvdrt_init.restype = ctypes.c_int
+        lib.hvdrt_shutdown.restype = ctypes.c_int
+        lib.hvdrt_rank.restype = ctypes.c_int
+        lib.hvdrt_size.restype = ctypes.c_int
+        lib.hvdrt_is_initialized.restype = ctypes.c_int
+        lib.hvdrt_enqueue.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double,
+        ]
+        lib.hvdrt_enqueue.restype = ctypes.c_int
+        lib.hvdrt_poll.argtypes = [ctypes.c_int]
+        lib.hvdrt_poll.restype = ctypes.c_int
+        lib.hvdrt_wait.argtypes = [ctypes.c_int, ctypes.c_double]
+        lib.hvdrt_wait.restype = ctypes.c_int
+        lib.hvdrt_cache_hits.restype = ctypes.c_longlong
+        lib.hvdrt_cache_misses.restype = ctypes.c_longlong
+        lib.hvdrt_cycles.restype = ctypes.c_longlong
+        lib.hvdrt_last_error.restype = ctypes.c_char_p
+        _lib = lib
+        return lib
+
+
+class NativeRuntimeError(RuntimeError):
+    pass
+
+
+def _raise_last(lib, what: str):
+    msg = lib.hvdrt_last_error().decode(errors="replace")
+    # Control-plane/peer failures surface as HorovodInternalError so the
+    # elastic retry loop treats them as recoverable.
+    from ..exceptions import HorovodInternalError
+
+    if "peer closed" in msg or "control plane" in msg or "dead" in msg:
+        raise HorovodInternalError(f"{what}: {msg}")
+    raise NativeRuntimeError(f"{what}: {msg}")
+
+
+class NativeWorld:
+    """One process's membership in the native runtime world."""
+
+    def __init__(self, rank: int, size: int, coord_addr: str, coord_port: int,
+                 timeout_s: float = 30.0):
+        self._lib = load_library()
+        rc = self._lib.hvdrt_init(
+            rank, size, coord_addr.encode(), coord_port, timeout_s
+        )
+        if rc != 0:
+            _raise_last(self._lib, "native init failed")
+        self.rank = rank
+        self.size = size
+        # Keep (input, output) arrays alive until their handle completes.
+        self._inflight: dict[int, tuple[Any, Any]] = {}
+        self._inflight_lock = threading.Lock()
+        self._name_counter = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def shutdown(self) -> None:
+        if self._lib.hvdrt_is_initialized():
+            self._lib.hvdrt_shutdown()
+
+    @property
+    def cache_hits(self) -> int:
+        return int(self._lib.hvdrt_cache_hits())
+
+    @property
+    def cache_misses(self) -> int:
+        return int(self._lib.hvdrt_cache_misses())
+
+    @property
+    def cycles(self) -> int:
+        return int(self._lib.hvdrt_cycles())
+
+    # -- async API (reference: allreduce_async_ / synchronize / poll) --------
+
+    def _auto_name(self, prefix: str) -> str:
+        self._name_counter += 1
+        return f"{prefix}.{self._name_counter}"
+
+    def _enqueue(self, op: int, x: np.ndarray, out: np.ndarray,
+                 name: str | None, reduce_op: str = "sum", root_rank: int = 0,
+                 prescale: float = 1.0, postscale: float = 1.0) -> int:
+        if x.dtype not in _DTYPE_MAP:
+            raise TypeError(f"unsupported dtype {x.dtype} for native runtime")
+        x = np.ascontiguousarray(x)
+        handle = self._lib.hvdrt_enqueue(
+            (name or self._auto_name("op")).encode(),
+            op,
+            _REDUCE_MAP[reduce_op],
+            _DTYPE_MAP[x.dtype],
+            x.ctypes.data_as(ctypes.c_void_p),
+            out.ctypes.data_as(ctypes.c_void_p),
+            x.size,
+            root_rank,
+            prescale,
+            postscale,
+        )
+        if handle < 0:
+            _raise_last(self._lib, "enqueue failed")
+        with self._inflight_lock:
+            self._inflight[handle] = (x, out)
+        return handle
+
+    def poll(self, handle: int) -> bool:
+        return self._lib.hvdrt_poll(handle) == 1
+
+    def synchronize(self, handle: int, timeout_s: float = 600.0) -> np.ndarray:
+        rc = self._lib.hvdrt_wait(handle, timeout_s)
+        with self._inflight_lock:
+            _, out = self._inflight.pop(handle, (None, None))
+        if rc != 0:
+            _raise_last(self._lib, "collective failed")
+        return out
+
+    def allreduce_async_(self, x: np.ndarray, name: str | None = None,
+                         op: str = "average", prescale_factor: float = 1.0,
+                         postscale_factor: float = 1.0) -> int:
+        out = np.empty_like(np.ascontiguousarray(x))
+        return self._enqueue(OP_ALLREDUCE, x, out, name, reduce_op=op,
+                             prescale=prescale_factor,
+                             postscale=postscale_factor)
+
+    def allgather_async(self, x: np.ndarray, name: str | None = None) -> int:
+        x = np.ascontiguousarray(x)
+        out = np.empty((self.size * x.shape[0],) + x.shape[1:], dtype=x.dtype) \
+            if x.ndim else np.empty((self.size,), dtype=x.dtype)
+        return self._enqueue(OP_ALLGATHER, x, out, name)
+
+    def broadcast_async(self, x: np.ndarray, root_rank: int,
+                        name: str | None = None) -> int:
+        out = np.ascontiguousarray(x).copy()
+        return self._enqueue(OP_BROADCAST, x, out, name, root_rank=root_rank)
+
+    def alltoall_async(self, x: np.ndarray, name: str | None = None) -> int:
+        out = np.empty_like(np.ascontiguousarray(x))
+        return self._enqueue(OP_ALLTOALL, x, out, name)
+
+    def reducescatter_async(self, x: np.ndarray, name: str | None = None,
+                            op: str = "sum") -> int:
+        x = np.ascontiguousarray(x)
+        if x.shape[0] % self.size != 0:
+            raise ValueError(
+                f"reducescatter dim0 ({x.shape[0]}) must divide by world "
+                f"size ({self.size})"
+            )
+        out = np.empty((x.shape[0] // self.size,) + x.shape[1:], dtype=x.dtype)
+        return self._enqueue(OP_REDUCESCATTER, x, out, name, reduce_op=op)
+
+    # -- blocking wrappers ----------------------------------------------------
+
+    def allreduce(self, x, name=None, op="average", **kw) -> np.ndarray:
+        return self.synchronize(self.allreduce_async_(x, name, op=op, **kw))
+
+    def allgather(self, x, name=None) -> np.ndarray:
+        return self.synchronize(self.allgather_async(x, name))
+
+    def broadcast(self, x, root_rank: int, name=None) -> np.ndarray:
+        return self.synchronize(self.broadcast_async(x, root_rank, name))
+
+    def alltoall(self, x, name=None) -> np.ndarray:
+        return self.synchronize(self.alltoall_async(x, name))
+
+    def reducescatter(self, x, name=None, op="sum") -> np.ndarray:
+        return self.synchronize(self.reducescatter_async(x, name, op=op))
+
+    def barrier(self) -> None:
+        token = np.zeros(1, dtype=np.int32)
+        out = np.empty_like(token)
+        self.synchronize(
+            self._enqueue(OP_BARRIER, token, out, self._auto_name("barrier"))
+        )
+
+    def grouped_allreduce(self, tensors, name=None, op="average") -> list:
+        """Enqueue a list together; the controller fuses them into one ring
+        collective (the native analog of ``hvd.grouped_allreduce``)."""
+        base = name or self._auto_name("group")
+        handles = [
+            self.allreduce_async_(t, f"{base}.{i}", op=op)
+            for i, t in enumerate(tensors)
+        ]
+        return [self.synchronize(h) for h in handles]

@@ -1,0 +1,92 @@
+// Core types for the native runtime.
+//
+// TPU-native re-design of the reference's horovod/common/common.h: the same
+// structural roles (dtype enum, op types, status, config) re-derived for a
+// host-side control plane whose data plane is either the TCP ring (CPU/dev,
+// DCN leg) or XLA executables driven from Python (ICI leg). Nothing here is
+// a translation; the wire protocol and buffer model are original.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdrt {
+
+enum class OpType : uint8_t {
+  kAllreduce = 0,
+  kAllgather = 1,
+  kBroadcast = 2,
+  kAlltoall = 3,
+  kReducescatter = 4,
+  kBarrier = 5,
+};
+
+enum class ReduceOp : uint8_t {
+  kSum = 0,
+  kAverage = 1,
+  kMin = 2,
+  kMax = 3,
+};
+
+enum class DType : uint8_t {
+  kFloat32 = 0,
+  kFloat64 = 1,
+  kInt32 = 2,
+  kInt64 = 3,
+  kUint8 = 4,
+  kFloat16 = 5,   // reduced on host as float (reference: half.cc)
+  kBFloat16 = 6,  // same
+};
+
+inline size_t DTypeSize(DType t) {
+  switch (t) {
+    case DType::kFloat32: return 4;
+    case DType::kFloat64: return 8;
+    case DType::kInt32: return 4;
+    case DType::kInt64: return 8;
+    case DType::kUint8: return 1;
+    case DType::kFloat16: return 2;
+    case DType::kBFloat16: return 2;
+  }
+  return 0;
+}
+
+struct Config {
+  int64_t fusion_threshold_bytes = 64 * 1024 * 1024;
+  double cycle_time_ms = 1.0;
+  int cache_capacity = 1024;
+  double stall_warning_s = 60.0;
+  double stall_shutdown_s = 0.0;
+  std::string timeline_path;  // empty = disabled
+  int log_level = 2;          // 0 trace .. 5 fatal; default warning(3)? see logging
+};
+
+struct Status {
+  bool ok = true;
+  std::string error;
+  static Status OK() { return {}; }
+  static Status Error(std::string msg) { return {false, std::move(msg)}; }
+};
+
+// A tensor enqueued by the framework layer, staged until the controller
+// schedules it (reference role: TensorTableEntry).
+struct TensorEntry {
+  int32_t handle = -1;
+  std::string name;
+  OpType op;
+  ReduceOp reduce_op = ReduceOp::kSum;
+  DType dtype;
+  int64_t count = 0;     // element count of the *input*
+  int32_t root_rank = 0; // broadcast only
+  double prescale = 1.0;
+  double postscale = 1.0;
+  const void* input = nullptr;
+  void* output = nullptr;
+  double enqueue_time_s = 0.0;
+};
+
+double NowSeconds();
+
+}  // namespace hvdrt

@@ -1,0 +1,300 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <sstream>
+
+#include "logging.h"
+
+namespace hvdrt {
+
+// -- ResponseCache -----------------------------------------------------------
+
+int ResponseCache::Lookup(const Request& req) const {
+  auto it = by_name_.find(req.name);
+  if (it == by_name_.end()) return -1;
+  // Signature change (same name, new shape/dtype/op) invalidates the hit.
+  if (!entries_[it->second].SameSignature(req)) return -1;
+  return it->second;
+}
+
+void ResponseCache::Put(const Request& req) {
+  if (static_cast<int>(entries_.size()) >= capacity_) return;  // cache full
+  auto it = by_name_.find(req.name);
+  if (it != by_name_.end()) {
+    entries_[it->second] = req;  // re-keyed signature (e.g. re-used name)
+    return;
+  }
+  by_name_[req.name] = static_cast<int>(entries_.size());
+  entries_.push_back(req);
+}
+
+void ResponseCache::Clear() {
+  entries_.clear();
+  by_name_.clear();
+}
+
+// -- StallInspector ----------------------------------------------------------
+
+void StallInspector::RecordPending(const std::string& name,
+                                   const std::vector<int>& missing_ranks) {
+  auto it = pending_.find(name);
+  if (it == pending_.end()) {
+    pending_[name] = Pending{NowSeconds(), missing_ranks, false};
+  } else {
+    it->second.missing = missing_ranks;
+  }
+}
+
+void StallInspector::RecordResolved(const std::string& name) {
+  pending_.erase(name);
+}
+
+std::string StallInspector::Check(bool* fatal) {
+  *fatal = false;
+  if (warning_s_ <= 0) return "";
+  double now = NowSeconds();
+  std::ostringstream report;
+  for (auto& [name, p] : pending_) {
+    double waited = now - p.first_seen_s;
+    if (shutdown_s_ > 0 && waited > shutdown_s_) {
+      *fatal = true;
+    } else if (waited <= warning_s_ || p.warned) {
+      continue;
+    }
+    p.warned = true;
+    report << "tensor " << name << " submitted " << static_cast<int>(waited)
+           << "s ago, still missing from rank(s) [";
+    for (size_t i = 0; i < p.missing.size(); ++i) {
+      if (i) report << ",";
+      report << p.missing[i];
+    }
+    report << "]; ";
+  }
+  return report.str();
+}
+
+// -- Controller --------------------------------------------------------------
+
+Controller::Controller(Transport* transport, const Config& config)
+    : transport_(transport),
+      config_(config),
+      cache_(config.cache_capacity),
+      stall_(config.stall_warning_s, config.stall_shutdown_s) {}
+
+Status Controller::ComputeResponseList(const std::vector<Request>& ready,
+                                       bool request_shutdown,
+                                       ResponseList* out) {
+  // Split announcements: cached signatures -> bitvector, rest -> requests.
+  RequestList mine;
+  mine.shutdown = request_shutdown;
+  int nbits = cache_.size();
+  mine.cache_bits.assign((nbits + 63) / 64, 0);
+  for (const auto& req : ready) {
+    int id = cache_.Lookup(req);
+    if (id >= 0 && id < nbits) {
+      mine.cache_bits[id / 64] |= (1ull << (id % 64));
+      cache_.CountHit();
+    } else {
+      mine.requests.push_back(req);
+      cache_.CountMiss();
+    }
+  }
+
+  if (transport_->rank() == 0) {
+    Status s = CoordinatorCycle(mine, out);
+    if (!s.ok) return s;
+  } else {
+    Status s = transport_->GatherToRoot(SerializeRequestList(mine), nullptr);
+    if (!s.ok) return s;
+    std::string frame;
+    s = transport_->BcastFromRoot(&frame);
+    if (!s.ok) return s;
+    s = ParseResponseList(frame, out);
+    if (!s.ok) return s;
+  }
+
+  // Every rank mirrors the cache update from the broadcast responses, so
+  // cache-id assignment stays rank-identical (ids follow response order).
+  for (const auto& resp : out->responses) {
+    if (!resp.error.empty() || resp.op == OpType::kBarrier) continue;
+    for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+      Request sig;
+      sig.name = resp.tensor_names[i];
+      sig.op = resp.op;
+      sig.reduce_op = resp.reduce_op;
+      sig.dtype = resp.dtype;
+      sig.count = resp.counts[i];
+      sig.root_rank = resp.root_rank;
+      sig.prescale = resp.prescale;
+      sig.postscale = resp.postscale;
+      if (cache_.Lookup(sig) < 0) cache_.Put(sig);
+    }
+  }
+  return Status::OK();
+}
+
+Status Controller::CoordinatorCycle(const RequestList& mine,
+                                    ResponseList* out) {
+  std::vector<std::string> frames;
+  Status s = transport_->GatherToRoot(SerializeRequestList(mine), &frames);
+  if (!s.ok) return s;
+
+  int size = transport_->size();
+  std::vector<RequestList> lists(size);
+  bool shutdown = false;
+  for (int r = 0; r < size; ++r) {
+    if (r == 0) {
+      lists[0] = mine;
+    } else {
+      s = ParseRequestList(frames[r], &lists[r]);
+      if (!s.ok) return s;
+    }
+    shutdown = shutdown || lists[r].shutdown;
+  }
+
+  std::vector<Response> responses;
+
+  // 1. Cache fast path: AND all ready-bitvectors; every agreed bit is a
+  //    ready tensor with a known signature — no bookkeeping needed.
+  size_t words = lists[0].cache_bits.size();
+  for (int r = 1; r < size; ++r) words = std::min(words, lists[r].cache_bits.size());
+  for (size_t w = 0; w < words; ++w) {
+    uint64_t agreed = ~0ull;
+    for (int r = 0; r < size; ++r) agreed &= lists[r].cache_bits[w];
+    while (agreed) {
+      int bit = __builtin_ctzll(agreed);
+      agreed &= agreed - 1;
+      int id = static_cast<int>(w) * 64 + bit;
+      const Request& sig = cache_.Get(id);
+      Response resp;
+      resp.op = sig.op;
+      resp.reduce_op = sig.reduce_op;
+      resp.dtype = sig.dtype;
+      resp.root_rank = sig.root_rank;
+      resp.prescale = sig.prescale;
+      resp.postscale = sig.postscale;
+      resp.tensor_names = {sig.name};
+      resp.counts = {sig.count};
+      responses.push_back(std::move(resp));
+    }
+  }
+  // Cached-but-not-agreed bits stay pending on the ranks that set them; they
+  // will be re-announced next cycle (the entry lives in the worker's queue).
+
+  // 2. Slow path: full requests into the message table.
+  for (int r = 0; r < size; ++r) {
+    for (const auto& req : lists[r].requests) {
+      auto [it, inserted] = message_table_.try_emplace(req.name);
+      PendingTensor& pt = it->second;
+      if (inserted) {
+        pt.request = req;
+        pt.announced.assign(size, false);
+      } else if (!pt.request.SameSignature(req)) {
+        Response err;
+        err.op = req.op;
+        err.dtype = req.dtype;
+        err.tensor_names = {req.name};
+        err.counts = {req.count};
+        err.error = "mismatched signature for tensor '" + req.name +
+                    "' across ranks (op/dtype/shape must agree)";
+        responses.push_back(std::move(err));
+        message_table_.erase(it);
+        continue;
+      }
+      if (!pt.announced[r]) {
+        pt.announced[r] = true;
+        pt.announce_count++;
+      }
+    }
+  }
+
+  // 3. Promote fully-announced tensors to responses (deterministic order:
+  //    map iteration is name-sorted).
+  for (auto it = message_table_.begin(); it != message_table_.end();) {
+    PendingTensor& pt = it->second;
+    if (pt.announce_count == size) {
+      const Request& req = pt.request;
+      Response resp;
+      resp.op = req.op;
+      resp.reduce_op = req.reduce_op;
+      resp.dtype = req.dtype;
+      resp.root_rank = req.root_rank;
+      resp.prescale = req.prescale;
+      resp.postscale = req.postscale;
+      resp.tensor_names = {req.name};
+      resp.counts = {req.count};
+      responses.push_back(std::move(resp));
+      stall_.RecordResolved(it->first);
+      it = message_table_.erase(it);
+    } else {
+      std::vector<int> missing;
+      for (int r = 0; r < size; ++r) {
+        if (!pt.announced[r]) missing.push_back(r);
+      }
+      stall_.RecordPending(it->first, missing);
+      ++it;
+    }
+  }
+
+  // 4. Stall check.
+  bool fatal = false;
+  std::string report = stall_.Check(&fatal);
+  if (!report.empty()) {
+    HVD_LOG(kWarning) << "stall detected: " << report
+                      << "(ranks diverged? see HOROVOD_STALL_CHECK_TIME)";
+  }
+  if (fatal) {
+    return Status::Error("stalled past HOROVOD_STALL_SHUTDOWN_TIME: " + report);
+  }
+
+  // 5. Fuse + broadcast.
+  FuseResponses(&responses);
+  out->responses = std::move(responses);
+  out->shutdown = shutdown;
+  std::string frame = SerializeResponseList(*out);
+  return transport_->BcastFromRoot(&frame);
+}
+
+void Controller::FuseResponses(std::vector<Response>* responses) {
+  // Pack same-(op, reduce_op, dtype, scale) single-tensor allreduce /
+  // reducescatter responses into fused responses up to the threshold.
+  // (Reference: Controller::FuseResponses; allgather/broadcast/alltoall are
+  // not fused — layouts differ per tensor.)
+  std::vector<Response> fused;
+  std::vector<Response*> fusable;
+  for (auto& r : *responses) {
+    if (r.error.empty() &&
+        (r.op == OpType::kAllreduce)) {
+      fusable.push_back(&r);
+    } else {
+      fused.push_back(std::move(r));
+    }
+  }
+  size_t i = 0;
+  while (i < fusable.size()) {
+    Response& base = *fusable[i];
+    int64_t bytes = base.counts[0] * static_cast<int64_t>(DTypeSize(base.dtype));
+    size_t j = i + 1;
+    while (j < fusable.size()) {
+      Response& cand = *fusable[j];
+      int64_t cand_bytes =
+          cand.counts[0] * static_cast<int64_t>(DTypeSize(cand.dtype));
+      if (cand.op == base.op && cand.reduce_op == base.reduce_op &&
+          cand.dtype == base.dtype && cand.prescale == base.prescale &&
+          cand.postscale == base.postscale &&
+          bytes + cand_bytes <= config_.fusion_threshold_bytes) {
+        base.tensor_names.push_back(cand.tensor_names[0]);
+        base.counts.push_back(cand.counts[0]);
+        bytes += cand_bytes;
+        fusable.erase(fusable.begin() + j);
+      } else {
+        ++j;
+      }
+    }
+    fused.push_back(std::move(base));
+    ++i;
+  }
+  *responses = std::move(fused);
+}
+
+}  // namespace hvdrt

@@ -1,0 +1,101 @@
+// Rank-0 coordinator: tensor-readiness negotiation, response cache with the
+// bitvector fast path, response fusion, stall inspection.
+//
+// Reference roles: horovod/common/controller.{h,cc} (ComputeResponseList,
+// FuseResponses, CoordinateCacheAndState), response_cache.{h,cc},
+// stall_inspector.{h,cc}. Original implementation: the cache assigns stable
+// ids to signatures; steady-state cycles exchange only ready-bitvectors,
+// AND-ed at root — full request serialization happens only on cache misses.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+#include "transport.h"
+
+namespace hvdrt {
+
+// Signature -> stable id cache, consistent across ranks because ids are
+// assigned in Response broadcast order (every rank sees the same stream).
+class ResponseCache {
+ public:
+  explicit ResponseCache(int capacity) : capacity_(capacity) {}
+
+  // Returns the cache id for a request's signature, or -1.
+  int Lookup(const Request& req) const;
+  // Record a negotiated single-tensor response (called on ALL ranks while
+  // applying the broadcast ResponseList, keeping id assignment identical).
+  void Put(const Request& req);
+  const Request& Get(int cache_id) const { return entries_[cache_id]; }
+  int size() const { return static_cast<int>(entries_.size()); }
+  int64_t hits() const { return hits_; }
+  int64_t misses() const { return misses_; }
+  void CountHit() { ++hits_; }
+  void CountMiss() { ++misses_; }
+  void Clear();
+
+ private:
+  int capacity_;
+  std::vector<Request> entries_;  // id -> signature
+  std::unordered_map<std::string, int> by_name_;
+  int64_t hits_ = 0, misses_ = 0;
+};
+
+// Tracks tensors announced by some-but-not-all ranks (coordinator only).
+// Reference role: stall_inspector.cc.
+class StallInspector {
+ public:
+  StallInspector(double warning_s, double shutdown_s)
+      : warning_s_(warning_s), shutdown_s_(shutdown_s) {}
+
+  void RecordPending(const std::string& name, const std::vector<int>& missing_ranks);
+  void RecordResolved(const std::string& name);
+  // Returns a non-empty report if some tensor stalled past the warning
+  // threshold; sets *fatal if past the shutdown threshold.
+  std::string Check(bool* fatal);
+
+ private:
+  struct Pending {
+    double first_seen_s;
+    std::vector<int> missing;
+    bool warned = false;
+  };
+  double warning_s_, shutdown_s_;
+  std::unordered_map<std::string, Pending> pending_;
+};
+
+class Controller {
+ public:
+  Controller(Transport* transport, const Config& config);
+
+  // One negotiation cycle: announce `ready` tensors (+ cache bitvector),
+  // receive the fused ResponseList every rank must execute in order.
+  // On the coordinator this also runs bookkeeping + fusion + stall checks.
+  Status ComputeResponseList(const std::vector<Request>& ready,
+                             bool request_shutdown, ResponseList* out);
+
+  ResponseCache& cache() { return cache_; }
+
+ private:
+  Status CoordinatorCycle(const RequestList& mine, ResponseList* out);
+  void FuseResponses(std::vector<Response>* responses);
+
+  Transport* transport_;
+  Config config_;
+  ResponseCache cache_;
+  StallInspector stall_;
+  // Coordinator: tensor name -> set of ranks that announced it + signature.
+  struct PendingTensor {
+    Request request;
+    std::vector<bool> announced;
+    int announce_count = 0;
+  };
+  std::map<std::string, PendingTensor> message_table_;  // ordered: determinism
+};
+
+}  // namespace hvdrt

@@ -1,0 +1,69 @@
+// Control-plane wire messages.
+//
+// Reference role: horovod/common/message.{h,cc} (Request/Response +
+// serialization). Original binary format: little-endian scalar writer, no
+// external serializer dependency.
+#pragma once
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+
+namespace hvdrt {
+
+// A worker announcing one ready tensor to the coordinator.
+struct Request {
+  std::string name;
+  OpType op;
+  ReduceOp reduce_op;
+  DType dtype;
+  int64_t count;
+  int32_t root_rank;
+  double prescale;
+  double postscale;
+
+  // Signature identity: two requests match iff all of these agree. The
+  // coordinator validates cross-rank consistency (mismatch = user bug).
+  bool SameSignature(const Request& o) const {
+    return name == o.name && op == o.op && reduce_op == o.reduce_op &&
+           dtype == o.dtype && count == o.count && root_rank == o.root_rank &&
+           prescale == o.prescale && postscale == o.postscale;
+  }
+};
+
+// One worker's per-cycle announcement: full requests for uncached tensors +
+// a bitvector of ready tensors the response cache already knows.
+struct RequestList {
+  std::vector<Request> requests;
+  std::vector<uint64_t> cache_bits;  // bit i = cached signature i is ready
+  bool shutdown = false;
+};
+
+// Coordinator's instruction: execute these tensors as one fused operation.
+struct Response {
+  OpType op;
+  ReduceOp reduce_op;
+  DType dtype;
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::vector<std::string> tensor_names;  // >1 = fused
+  std::vector<int64_t> counts;            // per-tensor element counts
+  std::string error;                      // non-empty = abort these tensors
+};
+
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+};
+
+// -- serialization ----------------------------------------------------------
+
+std::string SerializeRequestList(const RequestList& list);
+Status ParseRequestList(const std::string& data, RequestList* out);
+std::string SerializeResponseList(const ResponseList& list);
+Status ParseResponseList(const std::string& data, ResponseList* out);
+
+}  // namespace hvdrt

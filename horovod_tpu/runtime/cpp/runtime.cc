@@ -1,0 +1,456 @@
+// Global runtime state, background negotiation/execution loop, and the
+// ctypes-facing C API.
+//
+// Reference roles: horovod/common/operations.cc (HorovodGlobalState,
+// InitializeHorovodOnce, BackgroundThreadLoop, RunLoopOnce,
+// PerformOperation, EnqueueTensor*, the horovod_* C API),
+// tensor_queue.{h,cc}, fusion_buffer_manager.{h,cc}. Original design:
+// negotiation runs over the TCP star, execution over the TCP ring; the
+// async-handle contract (enqueue -> handle; poll/wait) matches the
+// reference's torch mpi_ops so the Python layer can offer
+// allreduce_async_/synchronize parity for host tensors (the DCN leg; the
+// ICI leg stays XLA-compiled in Python).
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "controller.h"
+#include "logging.h"
+#include "message.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdrt {
+namespace {
+
+struct HandleState {
+  bool done = false;
+  std::string error;
+};
+
+struct GlobalState {
+  std::mutex mu;                 // guards queue + handles
+  std::condition_variable cv;    // signaled on handle completion
+  std::deque<TensorEntry> queue;              // enqueued, not yet announced
+  std::unordered_map<std::string, TensorEntry> pending;  // announced, waiting
+  std::unordered_map<int32_t, HandleState> handles;
+  int32_t next_handle = 0;
+
+  std::unique_ptr<Transport> transport;
+  std::unique_ptr<Controller> controller;
+  Timeline timeline;
+  Config config;
+  bool mark_cycles = false;
+
+  std::thread background;
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> initialized{false};
+  std::atomic<bool> background_dead{false};
+  std::string fatal_error;  // set by background thread before dying
+  std::vector<char> fusion_buffer;
+
+  int rank = -1, size = 0;
+  std::atomic<int64_t> cycles{0};
+};
+
+GlobalState* g = nullptr;
+std::mutex g_init_mu;
+thread_local std::string tl_last_error;
+
+void SetError(const std::string& e) { tl_last_error = e; }
+
+void FailAllPending(GlobalState* st, const std::string& error) {
+  std::lock_guard<std::mutex> lock(st->mu);
+  for (auto& e : st->queue) {
+    st->handles[e.handle] = {true, error};
+  }
+  st->queue.clear();
+  for (auto& [name, e] : st->pending) {
+    st->handles[e.handle] = {true, error};
+  }
+  st->pending.clear();
+  st->cv.notify_all();
+}
+
+// Execute one (possibly fused) response on this rank.
+void PerformOperation(GlobalState* st, const Response& resp) {
+  // Collect the local entries; a rank can only execute a response if it has
+  // all fused tensors locally (guaranteed: responses only form when every
+  // rank announced every tensor).
+  std::vector<TensorEntry> entries;
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    for (const auto& name : resp.tensor_names) {
+      auto it = st->pending.find(name);
+      if (it == st->pending.end()) {
+        // Protocol violation; fail loudly.
+        HVD_LOG(kError) << "response for unknown tensor " << name;
+        return;
+      }
+      entries.push_back(it->second);
+      st->pending.erase(it);
+    }
+  }
+
+  auto finish = [&](const Status& s) {
+    std::lock_guard<std::mutex> lock(st->mu);
+    for (const auto& e : entries) {
+      st->handles[e.handle] = {true, s.ok ? "" : s.error};
+    }
+    st->cv.notify_all();
+  };
+
+  if (!resp.error.empty()) {
+    finish(Status::Error(resp.error));
+    return;
+  }
+
+  Transport* t = st->transport.get();
+  Status s = Status::OK();
+  size_t elem = DTypeSize(resp.dtype);
+
+  switch (resp.op) {
+    case OpType::kAllreduce: {
+      int64_t total = 0;
+      for (int64_t c : resp.counts) total += c;
+      // Fused path: pack into the persistent fusion buffer, one ring
+      // allreduce, unpack. Single tensor reduces in place in the output.
+      const std::string& tname = resp.tensor_names[0];
+      if (entries.size() == 1) {
+        TensorEntry& e = entries[0];
+        std::memcpy(e.output, e.input, static_cast<size_t>(total) * elem);
+        if (e.prescale != 1.0) ScaleBuffer(e.output, total, resp.dtype, e.prescale);
+        st->timeline.Begin(tname, "RING_ALLREDUCE");
+        s = t->Allreduce(e.output, total, resp.dtype, resp.reduce_op);
+        st->timeline.End(tname);
+        if (s.ok && e.postscale != 1.0) {
+          ScaleBuffer(e.output, total, resp.dtype, e.postscale);
+        }
+      } else {
+        size_t bytes = static_cast<size_t>(total) * elem;
+        if (st->fusion_buffer.size() < bytes) st->fusion_buffer.resize(bytes);
+        char* buf = st->fusion_buffer.data();
+        size_t off = 0;
+        for (auto& e : entries) {
+          st->timeline.Begin(e.name, "FUSION_PACK");
+          std::memcpy(buf + off, e.input, static_cast<size_t>(e.count) * elem);
+          if (e.prescale != 1.0) {
+            ScaleBuffer(buf + off, e.count, resp.dtype, e.prescale);
+          }
+          off += static_cast<size_t>(e.count) * elem;
+          st->timeline.End(e.name);
+        }
+        st->timeline.Begin(tname, "RING_ALLREDUCE_FUSED");
+        s = t->Allreduce(buf, total, resp.dtype, resp.reduce_op);
+        st->timeline.End(tname);
+        if (s.ok) {
+          off = 0;
+          for (auto& e : entries) {
+            st->timeline.Begin(e.name, "FUSION_UNPACK");
+            std::memcpy(e.output, buf + off, static_cast<size_t>(e.count) * elem);
+            if (e.postscale != 1.0) {
+              ScaleBuffer(e.output, e.count, resp.dtype, e.postscale);
+            }
+            off += static_cast<size_t>(e.count) * elem;
+            st->timeline.End(e.name);
+          }
+        }
+      }
+      break;
+    }
+    case OpType::kAllgather: {
+      TensorEntry& e = entries[0];
+      st->timeline.Begin(e.name, "RING_ALLGATHER");
+      s = t->Allgather(e.input, e.output, e.count, resp.dtype);
+      st->timeline.End(e.name);
+      break;
+    }
+    case OpType::kBroadcast: {
+      TensorEntry& e = entries[0];
+      if (t->rank() == resp.root_rank) {
+        std::memcpy(e.output, e.input, static_cast<size_t>(e.count) * elem);
+      }
+      st->timeline.Begin(e.name, "RING_BROADCAST");
+      s = t->Broadcast(e.output, e.count, resp.dtype, resp.root_rank);
+      st->timeline.End(e.name);
+      break;
+    }
+    case OpType::kAlltoall: {
+      TensorEntry& e = entries[0];
+      st->timeline.Begin(e.name, "RING_ALLTOALL");
+      s = t->Alltoall(e.input, e.output, e.count, resp.dtype);
+      st->timeline.End(e.name);
+      break;
+    }
+    case OpType::kReducescatter: {
+      TensorEntry& e = entries[0];
+      st->timeline.Begin(e.name, "RING_REDUCESCATTER");
+      s = t->Reducescatter(e.input, e.output, e.count, resp.dtype,
+                           resp.reduce_op);
+      st->timeline.End(e.name);
+      break;
+    }
+    case OpType::kBarrier: {
+      s = t->Barrier();
+      break;
+    }
+  }
+  finish(s);
+}
+
+bool RunLoopOnce(GlobalState* st) {
+  // Drain newly enqueued entries into the pending table; announce
+  // everything pending (cached entries announce as bits each cycle until
+  // their response arrives).
+  std::vector<Request> ready;
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    while (!st->queue.empty()) {
+      TensorEntry e = std::move(st->queue.front());
+      st->queue.pop_front();
+      st->timeline.Begin(e.name, "NEGOTIATE");
+      st->pending.emplace(e.name, std::move(e));
+    }
+    ready.reserve(st->pending.size());
+    for (auto& [name, e] : st->pending) {
+      Request r;
+      r.name = name;
+      r.op = e.op;
+      r.reduce_op = e.reduce_op;
+      r.dtype = e.dtype;
+      r.count = e.count;
+      r.root_rank = e.root_rank;
+      r.prescale = e.prescale;
+      r.postscale = e.postscale;
+      ready.push_back(std::move(r));
+    }
+  }
+
+  bool want_shutdown = st->shutdown_requested.load();
+  ResponseList responses;
+  Status s = st->controller->ComputeResponseList(ready, want_shutdown,
+                                                 &responses);
+  if (!s.ok) {
+    st->fatal_error = s.error;
+    FailAllPending(st, "control plane failed: " + s.error);
+    return false;
+  }
+  for (const auto& resp : responses.responses) {
+    for (const auto& name : resp.tensor_names) st->timeline.End(name);
+    PerformOperation(st, resp);
+  }
+  if (st->mark_cycles) st->timeline.Mark("cycle");
+  st->cycles.fetch_add(1);
+  return !responses.shutdown;
+}
+
+void BackgroundThreadLoop(GlobalState* st) {
+  while (RunLoopOnce(st)) {
+    // Steady-state pacing: only sleep when nothing is in flight, so hot
+    // streams negotiate back-to-back (cycle_time is the idle poll period).
+    bool idle;
+    {
+      std::lock_guard<std::mutex> lock(st->mu);
+      idle = st->queue.empty() && st->pending.empty();
+    }
+    if (idle) {
+      std::this_thread::sleep_for(std::chrono::duration<double, std::milli>(
+          st->config.cycle_time_ms));
+    }
+  }
+  if (!st->fatal_error.empty()) {
+    HVD_LOG(kError) << "background loop exiting: " << st->fatal_error;
+    st->background_dead.store(true);
+    FailAllPending(st, st->fatal_error);
+  } else {
+    st->background_dead.store(true);
+    FailAllPending(st, "runtime shut down");
+  }
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || v[0] == '\0') return dflt;
+  return std::atof(v);
+}
+
+int64_t EnvInt(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || v[0] == '\0') return dflt;
+  return std::atoll(v);
+}
+
+}  // namespace
+}  // namespace hvdrt
+
+// ---------------------------------------------------------------------------
+// C API (ctypes surface; reference: the horovod_* C API in operations.cc)
+// ---------------------------------------------------------------------------
+
+using namespace hvdrt;
+
+extern "C" {
+
+// Returns 0 on success, -1 on error (hvdrt_last_error() has details).
+int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
+               double timeout_s) {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g != nullptr && g->initialized.load()) {
+    SetError("already initialized");
+    return -1;
+  }
+  auto* st = new GlobalState();
+  st->rank = rank;
+  st->size = size;
+  st->config.fusion_threshold_bytes =
+      EnvInt("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024);
+  st->config.cycle_time_ms = EnvDouble("HOROVOD_CYCLE_TIME", 1.0);
+  st->config.cache_capacity =
+      static_cast<int>(EnvInt("HOROVOD_CACHE_CAPACITY", 1024));
+  st->config.stall_warning_s = EnvDouble("HOROVOD_STALL_CHECK_TIME", 60.0);
+  st->config.stall_shutdown_s = EnvDouble("HOROVOD_STALL_SHUTDOWN_TIME", 0.0);
+  const char* tl = std::getenv("HOROVOD_TIMELINE");
+  if (tl != nullptr) st->config.timeline_path = tl;
+  st->mark_cycles = EnvInt("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
+
+  Status s = Transport::Create(rank, size, coord_addr ? coord_addr : "127.0.0.1",
+                               coord_port, timeout_s, &st->transport);
+  if (!s.ok) {
+    SetError(s.error);
+    delete st;
+    return -1;
+  }
+  st->controller.reset(new Controller(st->transport.get(), st->config));
+  st->timeline.Initialize(st->config.timeline_path, rank);
+  st->background = std::thread([st] { BackgroundThreadLoop(st); });
+  st->initialized.store(true);
+  delete g;  // previous (shut down) epoch, if any
+  g = st;
+  return 0;
+}
+
+int hvdrt_shutdown() {
+  std::lock_guard<std::mutex> lock(g_init_mu);
+  if (g == nullptr || !g->initialized.load()) return 0;
+  g->shutdown_requested.store(true);
+  if (g->background.joinable()) g->background.join();
+  g->timeline.Shutdown();
+  g->initialized.store(false);
+  return 0;
+}
+
+int hvdrt_rank() { return g ? g->rank : -1; }
+int hvdrt_size() { return g ? g->size : 0; }
+int hvdrt_is_initialized() {
+  return (g != nullptr && g->initialized.load()) ? 1 : 0;
+}
+
+// Enqueue a collective; returns handle >= 0, or -1 on error.
+// count semantics per op: allreduce/broadcast: elements of the tensor;
+// allgather: input elements (output = size*count); alltoall: input elements
+// (must divide by size); reducescatter: input elements (output = count/size).
+int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
+                  const void* input, void* output, long long count,
+                  int root_rank, double prescale, double postscale) {
+  if (g == nullptr || !g->initialized.load()) {
+    SetError("not initialized");
+    return -1;
+  }
+  if (g->background_dead.load()) {
+    SetError("runtime is dead: " + g->fatal_error);
+    return -1;
+  }
+  if (static_cast<OpType>(op) == OpType::kBroadcast &&
+      (root_rank < 0 || root_rank >= g->size)) {
+    SetError("broadcast root_rank " + std::to_string(root_rank) +
+             " out of range for world size " + std::to_string(g->size));
+    return -1;
+  }
+  TensorEntry e;
+  e.name = name;
+  e.op = static_cast<OpType>(op);
+  e.reduce_op = static_cast<ReduceOp>(reduce_op);
+  e.dtype = static_cast<DType>(dtype);
+  e.count = count;
+  e.root_rank = root_rank;
+  e.prescale = prescale;
+  e.postscale = postscale;
+  e.input = input;
+  e.output = output;
+  e.enqueue_time_s = NowSeconds();
+  std::lock_guard<std::mutex> lock(g->mu);
+  if (g->pending.count(e.name) ||
+      std::any_of(g->queue.begin(), g->queue.end(),
+                  [&](const TensorEntry& q) { return q.name == e.name; })) {
+    SetError("tensor '" + e.name + "' is already in flight (names must be "
+             "unique per outstanding op, as in the reference)");
+    return -1;
+  }
+  int32_t handle = g->next_handle++;
+  e.handle = handle;
+  g->handles[handle] = HandleState{};
+  g->queue.push_back(std::move(e));
+  return handle;
+}
+
+// 1 = done, 0 = pending, -1 = unknown handle.
+int hvdrt_poll(int handle) {
+  if (g == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(g->mu);
+  auto it = g->handles.find(handle);
+  if (it == g->handles.end()) return -1;
+  return it->second.done ? 1 : 0;
+}
+
+// 0 = ok; -1 = error (collective failed / timeout / unknown); frees handle.
+int hvdrt_wait(int handle, double timeout_s) {
+  if (g == nullptr) {
+    SetError("not initialized");
+    return -1;
+  }
+  std::unique_lock<std::mutex> lock(g->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<std::chrono::nanoseconds>(
+                      std::chrono::duration<double>(timeout_s));
+  auto it = g->handles.find(handle);
+  if (it == g->handles.end()) {
+    SetError("unknown handle");
+    return -1;
+  }
+  bool ok = g->cv.wait_until(lock, deadline, [&] {
+    it = g->handles.find(handle);
+    return it != g->handles.end() && it->second.done;
+  });
+  if (!ok) {
+    SetError("wait timed out");
+    return -1;
+  }
+  std::string err = it->second.error;
+  g->handles.erase(it);
+  if (!err.empty()) {
+    SetError(err);
+    return -1;
+  }
+  return 0;
+}
+
+long long hvdrt_cache_hits() {
+  return g ? g->controller->cache().hits() : 0;
+}
+long long hvdrt_cache_misses() {
+  return g ? g->controller->cache().misses() : 0;
+}
+long long hvdrt_cycles() { return g ? g->cycles.load() : 0; }
+
+const char* hvdrt_last_error() { return tl_last_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,100 @@
+#include "timeline.h"
+
+#include <cstdio>
+#include <functional>
+
+#include "common.h"
+
+namespace hvdrt {
+
+void Timeline::Initialize(const std::string& path, int rank) {
+  if (path.empty() || initialized_) return;
+  // Per-rank file: "<path>" on rank 0, "<path>.rank<r>" elsewhere (the
+  // reference writes only on the coordinator; per-rank is strictly more
+  // useful for a multi-host controller).
+  std::string full = rank == 0 ? path : path + ".rank" + std::to_string(rank);
+  file_.open(full, std::ios::out | std::ios::trunc);
+  if (!file_.is_open()) return;
+  rank_ = rank;
+  start_s_ = NowSeconds();
+  file_ << "[\n";
+  shutting_down_ = false;
+  writer_ = std::thread([this] { WriterLoop(); });
+  initialized_ = true;
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    shutting_down_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  file_ << "\n]\n";
+  file_.close();
+  initialized_ = false;
+}
+
+void Timeline::Begin(const std::string& tensor, const std::string& phase) {
+  if (!initialized_) return;
+  char buf[512];
+  double us = (NowSeconds() - start_s_) * 1e6;
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\": \"%s\", \"cat\": \"tensor\", \"ph\": \"B\", "
+                "\"ts\": %.1f, \"pid\": %d, \"tid\": %zu, "
+                "\"args\": {\"tensor\": \"%s\"}}",
+                phase.c_str(), us, rank_,
+                std::hash<std::string>{}(tensor) % 997, tensor.c_str());
+  Emit(buf);
+}
+
+void Timeline::End(const std::string& tensor) {
+  if (!initialized_) return;
+  char buf[256];
+  double us = (NowSeconds() - start_s_) * 1e6;
+  std::snprintf(buf, sizeof(buf),
+                "{\"ph\": \"E\", \"ts\": %.1f, \"pid\": %d, \"tid\": %zu}",
+                us, rank_, std::hash<std::string>{}(tensor) % 997);
+  Emit(buf);
+}
+
+void Timeline::Mark(const std::string& name) {
+  if (!initialized_) return;
+  char buf[256];
+  double us = (NowSeconds() - start_s_) * 1e6;
+  std::snprintf(buf, sizeof(buf),
+                "{\"name\": \"%s\", \"ph\": \"i\", \"ts\": %.1f, "
+                "\"pid\": %d, \"s\": \"p\"}",
+                name.c_str(), us, rank_);
+  Emit(buf);
+}
+
+void Timeline::Emit(std::string&& json) {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    queue_.push_back(std::move(json));
+  }
+  cv_.notify_one();
+}
+
+void Timeline::WriterLoop() {
+  std::vector<std::string> batch;
+  while (true) {
+    {
+      std::unique_lock<std::mutex> lock(mu_);
+      cv_.wait(lock, [this] { return !queue_.empty() || shutting_down_; });
+      batch.swap(queue_);
+      if (batch.empty() && shutting_down_) return;
+    }
+    for (auto& e : batch) {
+      if (!first_event_) file_ << ",\n";
+      first_event_ = false;
+      file_ << e;
+    }
+    file_.flush();
+    batch.clear();
+  }
+}
+
+}  // namespace hvdrt

@@ -1,0 +1,47 @@
+// Chrome-trace timeline with a dedicated writer thread.
+//
+// Reference role: horovod/common/timeline.{h,cc} — same activation contract
+// (HOROVOD_TIMELINE=<path>), same viewer (chrome://tracing), per-tensor
+// phase events (NEGOTIATE / QUEUE / FUSION_PACK / EXEC(<backend op>) /
+// FUSION_UNPACK) plus optional cycle markers.
+#pragma once
+
+#include <condition_variable>
+#include <fstream>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace hvdrt {
+
+class Timeline {
+ public:
+  void Initialize(const std::string& path, int rank);
+  bool Initialized() const { return initialized_; }
+  void Shutdown();
+  ~Timeline() { Shutdown(); }
+
+  // Duration events per tensor (tid = hash of name for row grouping).
+  void Begin(const std::string& tensor, const std::string& phase);
+  void End(const std::string& tensor);
+  // Instant event (cycle markers: HOROVOD_TIMELINE_MARK_CYCLES).
+  void Mark(const std::string& name);
+
+ private:
+  void Emit(std::string&& json);
+  void WriterLoop();
+
+  bool initialized_ = false;
+  int rank_ = 0;
+  std::ofstream file_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<std::string> queue_;
+  bool shutting_down_ = false;
+  bool first_event_ = true;
+  std::thread writer_;
+  double start_s_ = 0.0;
+};
+
+}  // namespace hvdrt

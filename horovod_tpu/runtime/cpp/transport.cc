@@ -1,0 +1,525 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "logging.h"
+
+namespace hvdrt {
+
+namespace {
+
+// fp16/bf16 host math (reference role: horovod/common/half.cc — but done
+// portably via float round-trips, no intrinsics).
+inline float HalfToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1F;
+  uint32_t mant = h & 0x3FF;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3FF;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1F) {
+    bits = sign | 0x7F800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToHalf(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xFF) - 127 + 15;
+  uint32_t mant = bits & 0x7FFFFF;
+  if (exp <= 0) return static_cast<uint16_t>(sign);  // flush to zero
+  if (exp >= 0x1F) return static_cast<uint16_t>(sign | 0x7C00u);
+  return static_cast<uint16_t>(sign | (exp << 10) | (mant >> 13));
+}
+
+inline float BF16ToFloat(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t FloatToBF16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round-to-nearest-even
+  uint32_t rounded = bits + 0x7FFF + ((bits >> 16) & 1);
+  return static_cast<uint16_t>(rounded >> 16);
+}
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::kSum:
+    case ReduceOp::kAverage:  // averaged by scaling at the end
+      for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
+      break;
+    case ReduceOp::kMin:
+      for (int64_t i = 0; i < n; ++i) dst[i] = src[i] < dst[i] ? src[i] : dst[i];
+      break;
+    case ReduceOp::kMax:
+      for (int64_t i = 0; i < n; ++i) dst[i] = src[i] > dst[i] ? src[i] : dst[i];
+      break;
+  }
+}
+
+template <float (*ToF)(uint16_t), uint16_t (*FromF)(float)>
+void Reduce16(uint16_t* dst, const uint16_t* src, int64_t n, ReduceOp op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = ToF(dst[i]), b = ToF(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::kSum:
+      case ReduceOp::kAverage: r = a + b; break;
+      case ReduceOp::kMin: r = b < a ? b : a; break;
+      case ReduceOp::kMax: r = b > a ? b : a; break;
+      default: r = a + b;
+    }
+    dst[i] = FromF(r);
+  }
+}
+
+}  // namespace
+
+void ReduceBuffers(void* dst, const void* src, int64_t count, DType dtype,
+                   ReduceOp op) {
+  switch (dtype) {
+    case DType::kFloat32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src),
+                  count, op);
+      break;
+    case DType::kFloat64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src),
+                  count, op);
+      break;
+    case DType::kInt32:
+      ReduceTyped(static_cast<int32_t*>(dst), static_cast<const int32_t*>(src),
+                  count, op);
+      break;
+    case DType::kInt64:
+      ReduceTyped(static_cast<int64_t*>(dst), static_cast<const int64_t*>(src),
+                  count, op);
+      break;
+    case DType::kUint8:
+      ReduceTyped(static_cast<uint8_t*>(dst), static_cast<const uint8_t*>(src),
+                  count, op);
+      break;
+    case DType::kFloat16:
+      Reduce16<HalfToFloat, FloatToHalf>(static_cast<uint16_t*>(dst),
+                                         static_cast<const uint16_t*>(src),
+                                         count, op);
+      break;
+    case DType::kBFloat16:
+      Reduce16<BF16ToFloat, FloatToBF16>(static_cast<uint16_t*>(dst),
+                                         static_cast<const uint16_t*>(src),
+                                         count, op);
+      break;
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t count, DType dtype, double factor) {
+  if (factor == 1.0) return;
+  switch (dtype) {
+    case DType::kFloat32: {
+      float* p = static_cast<float*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] = static_cast<float>(p[i] * factor);
+      break;
+    }
+    case DType::kFloat64: {
+      double* p = static_cast<double*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DType::kInt32: {
+      int32_t* p = static_cast<int32_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int32_t>(std::llround(p[i] * factor));
+      break;
+    }
+    case DType::kInt64: {
+      int64_t* p = static_cast<int64_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int64_t>(std::llround(p[i] * factor));
+      break;
+    }
+    case DType::kUint8: {
+      uint8_t* p = static_cast<uint8_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<uint8_t>(std::llround(p[i] * factor));
+      break;
+    }
+    case DType::kFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToHalf(static_cast<float>(HalfToFloat(p[i]) * factor));
+      break;
+    }
+    case DType::kBFloat16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBF16(static_cast<float>(BF16ToFloat(p[i]) * factor));
+      break;
+    }
+  }
+}
+
+// -- bootstrap ---------------------------------------------------------------
+
+Status Transport::Create(int rank, int size, const std::string& coord_addr,
+                         int coord_port, double timeout_s,
+                         std::unique_ptr<Transport>* out) {
+  std::unique_ptr<Transport> t(new Transport(rank, size));
+  if (size == 1) {
+    *out = std::move(t);
+    return Status::OK();
+  }
+
+  // Every rank opens its data listener first (ephemeral port).
+  Listener data_listener;
+  Status s = data_listener.Bind(0);
+  if (!s.ok) return s;
+
+  // Peer table: "addr:port" per rank, distributed by root.
+  std::vector<std::string> peers(size);
+
+  if (rank == 0) {
+    Listener control_listener;
+    s = control_listener.Bind(coord_port);
+    if (!s.ok) return s;
+    t->control_.resize(size - 1);
+    peers[0] = "127.0.0.1:" + std::to_string(data_listener.Port());
+    int connected = 0;
+    double deadline = NowSeconds() + timeout_s;
+    while (connected < size - 1) {
+      Socket sock;
+      s = control_listener.Accept(&sock, deadline - NowSeconds());
+      if (!s.ok) return s;
+      // Hello frame: "<rank> <data_port>".
+      std::string hello;
+      s = sock.ReadFrame(&hello);
+      if (!s.ok) return s;
+      int peer_rank = -1, peer_port = -1;
+      if (std::sscanf(hello.c_str(), "%d %d", &peer_rank, &peer_port) != 2 ||
+          peer_rank < 1 || peer_rank >= size) {
+        return Status::Error("bad hello frame: " + hello);
+      }
+      // The worker's address as seen from root.
+      sockaddr_in addr{};
+      socklen_t alen = sizeof(addr);
+      char ip[64] = "127.0.0.1";
+      if (::getpeername(sock.fd(), reinterpret_cast<sockaddr*>(&addr),
+                        &alen) == 0) {
+        ::inet_ntop(AF_INET, &addr.sin_addr, ip, sizeof(ip));
+      }
+      peers[peer_rank] = std::string(ip) + ":" + std::to_string(peer_port);
+      t->control_[peer_rank - 1] = std::move(sock);
+      connected++;
+    }
+    // Root's own data address: reachable at coord_addr.
+    peers[0] = coord_addr + ":" + std::to_string(data_listener.Port());
+    // Broadcast the peer table.
+    std::string table;
+    for (const auto& p : peers) {
+      table += p;
+      table += '\n';
+    }
+    for (auto& sock : t->control_) {
+      s = sock.WriteFrame(table);
+      if (!s.ok) return s;
+    }
+  } else {
+    s = Socket::Connect(coord_addr, coord_port, timeout_s, &t->to_root_);
+    if (!s.ok) return s;
+    std::string hello =
+        std::to_string(rank) + " " + std::to_string(data_listener.Port());
+    s = t->to_root_.WriteFrame(hello);
+    if (!s.ok) return s;
+    std::string table;
+    s = t->to_root_.ReadFrame(&table);
+    if (!s.ok) return s;
+    size_t pos = 0;
+    for (int i = 0; i < size; ++i) {
+      size_t nl = table.find('\n', pos);
+      if (nl == std::string::npos) return Status::Error("bad peer table");
+      peers[i] = table.substr(pos, nl - pos);
+      pos = nl + 1;
+    }
+  }
+
+  // Ring wiring: connect to successor, accept from predecessor. To avoid a
+  // cycle deadlock, even ranks connect first then accept; odd ranks accept
+  // first. (With size>=2 this breaks the symmetric wait.)
+  int succ = (rank + 1) % size;
+  const std::string& succ_peer = peers[succ];
+  size_t colon = succ_peer.rfind(':');
+  std::string succ_host = succ_peer.substr(0, colon);
+  int succ_port = std::atoi(succ_peer.c_str() + colon + 1);
+
+  auto do_connect = [&]() -> Status {
+    Status cs = Socket::Connect(succ_host, succ_port, timeout_s, &t->succ_);
+    if (!cs.ok) return cs;
+    return t->succ_.WriteFrame(std::to_string(rank));
+  };
+  auto do_accept = [&]() -> Status {
+    // Accept until the connection from our predecessor arrives.
+    double deadline = NowSeconds() + timeout_s;
+    while (true) {
+      Socket sock;
+      Status as = data_listener.Accept(&sock, deadline - NowSeconds());
+      if (!as.ok) return as;
+      std::string who;
+      as = sock.ReadFrame(&who);
+      if (!as.ok) return as;
+      if (std::atoi(who.c_str()) == (rank - 1 + size) % size) {
+        t->pred_ = std::move(sock);
+        return Status::OK();
+      }
+      // Not our ring predecessor — shouldn't happen; drop it.
+    }
+  };
+  if (rank % 2 == 0) {
+    s = do_connect();
+    if (!s.ok) return s;
+    s = do_accept();
+    if (!s.ok) return s;
+  } else {
+    s = do_accept();
+    if (!s.ok) return s;
+    s = do_connect();
+    if (!s.ok) return s;
+  }
+  *out = std::move(t);
+  return Status::OK();
+}
+
+// -- control plane -----------------------------------------------------------
+
+Status Transport::GatherToRoot(const std::string& mine,
+                               std::vector<std::string>* all) {
+  if (size_ == 1) {
+    if (all) *all = {mine};
+    return Status::OK();
+  }
+  if (rank_ == 0) {
+    all->assign(size_, "");
+    (*all)[0] = mine;
+    for (int r = 1; r < size_; ++r) {
+      Status s = control_[r - 1].ReadFrame(&(*all)[r]);
+      if (!s.ok) return s;
+    }
+    return Status::OK();
+  }
+  return to_root_.WriteFrame(mine);
+}
+
+Status Transport::BcastFromRoot(std::string* frame) {
+  if (size_ == 1) return Status::OK();
+  if (rank_ == 0) {
+    for (auto& sock : control_) {
+      Status s = sock.WriteFrame(*frame);
+      if (!s.ok) return s;
+    }
+    return Status::OK();
+  }
+  return to_root_.ReadFrame(frame);
+}
+
+// -- data plane (ring) -------------------------------------------------------
+
+namespace {
+// Chunk layout for ring algorithms: size chunks covering count elements.
+void ChunkLayout(int64_t count, int size, std::vector<int64_t>* offsets,
+                 std::vector<int64_t>* counts) {
+  offsets->resize(size);
+  counts->resize(size);
+  int64_t base = count / size, rem = count % size;
+  int64_t off = 0;
+  for (int i = 0; i < size; ++i) {
+    (*offsets)[i] = off;
+    (*counts)[i] = base + (i < rem ? 1 : 0);
+    off += (*counts)[i];
+  }
+}
+}  // namespace
+
+Status Transport::RingReduceScatterInplace(char* data, int64_t count,
+                                           DType dtype, ReduceOp op,
+                                           std::vector<int64_t>* offsets,
+                                           std::vector<int64_t>* chunk_counts) {
+  size_t elem = DTypeSize(dtype);
+  ChunkLayout(count, size_, offsets, chunk_counts);
+  std::vector<char> recv_buf;
+  // After size-1 steps, rank r owns the fully reduced chunk (r+1) % size.
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_chunk = (rank_ - step + size_) % size_;
+    int recv_chunk = (rank_ - step - 1 + size_) % size_;
+    int64_t send_n = (*chunk_counts)[send_chunk];
+    int64_t recv_n = (*chunk_counts)[recv_chunk];
+    recv_buf.resize(static_cast<size_t>(recv_n) * elem);
+    Status s = succ_.WriteAll(data + (*offsets)[send_chunk] * elem,
+                              static_cast<size_t>(send_n) * elem);
+    if (!s.ok) return s;
+    s = pred_.ReadAll(recv_buf.data(), recv_buf.size());
+    if (!s.ok) return s;
+    ReduceBuffers(data + (*offsets)[recv_chunk] * elem, recv_buf.data(),
+                  recv_n, dtype, op);
+  }
+  return Status::OK();
+}
+
+Status Transport::RingAllgatherChunks(char* data,
+                                      const std::vector<int64_t>& offsets,
+                                      const std::vector<int64_t>& chunk_counts,
+                                      size_t elem, int owner_shift) {
+  // Each rank starts owning chunk (rank + owner_shift) % size fully; after
+  // size-1 forwarding steps every rank has every chunk.
+  for (int step = 0; step < size_ - 1; ++step) {
+    int send_chunk = (rank_ + owner_shift - step + size_ * 2) % size_;
+    int recv_chunk = (rank_ + owner_shift - step - 1 + size_ * 2) % size_;
+    Status s = succ_.WriteAll(
+        data + offsets[send_chunk] * elem,
+        static_cast<size_t>(chunk_counts[send_chunk]) * elem);
+    if (!s.ok) return s;
+    s = pred_.ReadAll(data + offsets[recv_chunk] * elem,
+                      static_cast<size_t>(chunk_counts[recv_chunk]) * elem);
+    if (!s.ok) return s;
+  }
+  return Status::OK();
+}
+
+Status Transport::Allreduce(void* buf, int64_t count, DType dtype,
+                            ReduceOp op) {
+  if (size_ > 1) {
+    char* data = static_cast<char*>(buf);
+    std::vector<int64_t> offsets, chunk_counts;
+    Status s = RingReduceScatterInplace(data, count, dtype, op, &offsets,
+                                        &chunk_counts);
+    if (!s.ok) return s;
+    s = RingAllgatherChunks(data, offsets, chunk_counts, DTypeSize(dtype),
+                            /*owner_shift=*/1);
+    if (!s.ok) return s;
+  }
+  if (op == ReduceOp::kAverage) ScaleBuffer(buf, count, dtype, 1.0 / size_);
+  return Status::OK();
+}
+
+Status Transport::Allgather(const void* input, void* output, int64_t count,
+                            DType dtype) {
+  size_t elem = DTypeSize(dtype);
+  char* out = static_cast<char*>(output);
+  std::memcpy(out + rank_ * count * elem, input,
+              static_cast<size_t>(count) * elem);
+  if (size_ == 1) return Status::OK();
+  // Uniform chunks of `count`; rank r owns chunk r (owner_shift 0).
+  std::vector<int64_t> offsets(size_), chunk_counts(size_, count);
+  for (int i = 0; i < size_; ++i) offsets[i] = i * count;
+  return RingAllgatherChunks(out, offsets, chunk_counts, elem,
+                             /*owner_shift=*/0);
+}
+
+Status Transport::Broadcast(void* buf, int64_t count, DType dtype, int root) {
+  if (size_ == 1) return Status::OK();
+  size_t bytes = static_cast<size_t>(count) * DTypeSize(dtype);
+  // Ring pipeline from root; root's predecessor is the sink.
+  if (rank_ == root) {
+    return succ_.WriteAll(buf, bytes);
+  }
+  Status s = pred_.ReadAll(buf, bytes);
+  if (!s.ok) return s;
+  if ((rank_ + 1) % size_ != root) {
+    return succ_.WriteAll(buf, bytes);
+  }
+  return Status::OK();
+}
+
+Status Transport::Alltoall(const void* input, void* output, int64_t count,
+                           DType dtype) {
+  // count = total input elements on this rank (size uniform blocks). Built
+  // on allgather then block transpose — O(size*count) memory; fine for the
+  // control/dev role this backend plays.
+  if (count % size_ != 0) {
+    return Status::Error("alltoall count must be divisible by world size");
+  }
+  size_t elem = DTypeSize(dtype);
+  int64_t block = count / size_;
+  if (size_ == 1) {
+    std::memcpy(output, input, static_cast<size_t>(count) * elem);
+    return Status::OK();
+  }
+  std::vector<char> gathered(static_cast<size_t>(count) * elem * size_);
+  Status s = Allgather(input, gathered.data(), count, dtype);
+  if (!s.ok) return s;
+  char* out = static_cast<char*>(output);
+  for (int src = 0; src < size_; ++src) {
+    const char* src_block =
+        gathered.data() + (static_cast<size_t>(src) * count + rank_ * block) * elem;
+    std::memcpy(out + static_cast<size_t>(src) * block * elem, src_block,
+                static_cast<size_t>(block) * elem);
+  }
+  return Status::OK();
+}
+
+Status Transport::Reducescatter(const void* input, void* output, int64_t count,
+                                DType dtype, ReduceOp op) {
+  // count = total input elements; rank r keeps chunk r (uniform layout,
+  // count divisible by size — enforced by the Python layer like XLA does).
+  if (count % size_ != 0) {
+    return Status::Error("reducescatter count must be divisible by world size");
+  }
+  size_t elem = DTypeSize(dtype);
+  int64_t chunk = count / size_;
+  std::vector<char> work(static_cast<size_t>(count) * elem);
+  std::memcpy(work.data(), input, work.size());
+  if (size_ > 1) {
+    std::vector<int64_t> offsets, chunk_counts;
+    Status s = RingReduceScatterInplace(work.data(), count, dtype, op,
+                                        &offsets, &chunk_counts);
+    if (!s.ok) return s;
+    // Rank r owns fully-reduced chunk (r+1)%size after reduce-scatter; the
+    // API contract is "rank r keeps chunk r". Chunk r sits on rank r-1, so
+    // ONE forward ring rotation delivers every chunk to its home rank.
+    int have = (rank_ + 1) % size_;
+    Status ss = succ_.WriteAll(work.data() + offsets[have] * elem,
+                               static_cast<size_t>(chunk) * elem);
+    if (!ss.ok) return ss;
+    ss = pred_.ReadAll(output, static_cast<size_t>(chunk) * elem);
+    if (!ss.ok) return ss;
+  } else {
+    std::memcpy(output, work.data(), static_cast<size_t>(chunk) * elem);
+  }
+  if (op == ReduceOp::kAverage) {
+    ScaleBuffer(output, chunk, dtype, 1.0 / size_);
+  }
+  return Status::OK();
+}
+
+Status Transport::Barrier() {
+  int32_t token = 1;
+  return Allreduce(&token, 1, DType::kInt32, ReduceOp::kSum);
+}
+
+}  // namespace hvdrt

@@ -1,0 +1,74 @@
+// Control plane (star to rank 0) + data plane (neighbor ring) over TCP.
+//
+// Reference roles: the control-plane transport under
+// mpi_controller/gloo_controller (gather/bcast of serialized lists) and the
+// CPU data-plane ops (gloo_operations ring collectives). Original design:
+// one star socket per worker for control; one ring (successor/predecessor)
+// socket pair for data; ring reduce-scatter + allgather for allreduce.
+//
+// TPU mapping: this is the host/DCN leg. The ICI leg is XLA-compiled and
+// driven from Python; hierarchical ops compose the two (ICI reduce-scatter →
+// this allreduce across hosts → ICI allgather), mirroring how the reference
+// composed NCCL intra-node with MPI across nodes.
+#pragma once
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "socketio.h"
+
+namespace hvdrt {
+
+class Transport {
+ public:
+  // Collective bootstrap. rank 0 listens on coord_port; everyone ends up
+  // with control sockets (star) + ring neighbor sockets (data).
+  static Status Create(int rank, int size, const std::string& coord_addr,
+                       int coord_port, double timeout_s,
+                       std::unique_ptr<Transport>* out);
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // -- control plane (frames) ----------------------------------------------
+  // Root gathers one frame from every rank (index = rank; root contributes
+  // its own), then broadcasts one frame to all.
+  Status GatherToRoot(const std::string& mine, std::vector<std::string>* all);
+  Status BcastFromRoot(std::string* frame);  // root: in-out; others: out
+
+  // -- data plane (raw buffers, ring) --------------------------------------
+  Status Allreduce(void* buf, int64_t count, DType dtype, ReduceOp op);
+  Status Allgather(const void* input, void* output, int64_t count, DType dtype);
+  Status Broadcast(void* buf, int64_t count, DType dtype, int root);
+  Status Alltoall(const void* input, void* output, int64_t count, DType dtype);
+  Status Reducescatter(const void* input, void* output, int64_t count,
+                       DType dtype, ReduceOp op);
+  Status Barrier();
+
+ private:
+  Transport(int rank, int size) : rank_(rank), size_(size) {}
+  Status RingReduceScatterInplace(char* data, int64_t count, DType dtype,
+                                  ReduceOp op, std::vector<int64_t>* offsets,
+                                  std::vector<int64_t>* chunk_counts);
+  Status RingAllgatherChunks(char* data, const std::vector<int64_t>& offsets,
+                             const std::vector<int64_t>& chunk_counts,
+                             size_t elem, int owner_shift);
+
+  int rank_, size_;
+  // Control: root holds size-1 worker sockets (index rank-1); workers hold
+  // one socket to root.
+  std::vector<Socket> control_;
+  Socket to_root_;
+  // Ring: send to successor, receive from predecessor.
+  Socket succ_, pred_;
+};
+
+// Element-wise reduction: dst[i] op= src[i].
+void ReduceBuffers(void* dst, const void* src, int64_t count, DType dtype,
+                   ReduceOp op);
+// Scale in place (Average finalization, pre/postscale).
+void ScaleBuffer(void* buf, int64_t count, DType dtype, double factor);
+
+}  // namespace hvdrt

@@ -1,0 +1,222 @@
+"""Native runtime (libhvdrt) tests: N real processes over localhost TCP —
+the reference's localhost-as-cluster pattern (SURVEY.md §4) applied to the
+C++ core: negotiation, fusion, response-cache bitvector fast path, stall
+inspection, timeline, peer-failure propagation."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, os.environ["REPO_ROOT"])
+    from horovod_tpu.runtime import NativeWorld
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    rank = int(os.environ["TEST_RANK"]); size = int(os.environ["TEST_SIZE"])
+    port = int(os.environ["TEST_PORT"]); mode = os.environ["TEST_MODE"]
+    w = NativeWorld(rank, size, "127.0.0.1", port, timeout_s=30.0)
+
+    def check(got, want, what):
+        if not np.allclose(np.asarray(got), want, rtol=1e-3, atol=1e-3):
+            print(f"MISMATCH {what} rank{rank}: {got} != {want}", flush=True)
+            sys.exit(10)
+
+    if mode == "battery":
+        R = np.arange(size)
+        # allreduce sum f32
+        x = np.arange(8, dtype=np.float32) + rank
+        check(w.allreduce(x, "ar.sum", op="sum"),
+              np.arange(8) * size + R.sum(), "allreduce.sum")
+        # allreduce average f64 with prescale
+        x64 = np.full((5,), float(rank + 1), np.float64)
+        check(w.allreduce(x64, "ar.avg", op="average", prescale_factor=2.0),
+              2 * (R + 1).mean(), "allreduce.avg.prescale")
+        # min/max int32
+        xi = np.array([rank, -rank, 100], np.int32)
+        check(w.allreduce(xi, "ar.min", op="min"), [0, -(size - 1), 100], "min")
+        check(w.allreduce(xi, "ar.max", op="max"), [size - 1, 0, 100], "max")
+        # fp16
+        xh = np.full((4,), 0.5, np.float16)
+        check(w.allreduce(xh, "ar.f16", op="sum"), 0.5 * size, "fp16 sum")
+        # out-of-order enqueue across ranks: negotiation must line them up
+        if rank % 2 == 0:
+            h1 = w.allreduce_async_(np.full(3, 1.0, np.float32), "ooo.a", op="sum")
+            h2 = w.allreduce_async_(np.full(3, 2.0, np.float32), "ooo.b", op="sum")
+        else:
+            h2 = w.allreduce_async_(np.full(3, 2.0, np.float32), "ooo.b", op="sum")
+            h1 = w.allreduce_async_(np.full(3, 1.0, np.float32), "ooo.a", op="sum")
+        check(w.synchronize(h1), size * 1.0, "ooo.a")
+        check(w.synchronize(h2), size * 2.0, "ooo.b")
+        # grouped (fused) allreduce
+        outs = w.grouped_allreduce(
+            [np.full(4, float(rank), np.float32),
+             np.full(2, 10.0 + rank, np.float32)], "grp", op="sum")
+        check(outs[0], R.sum(), "group.0")
+        check(outs[1], 10 * size + R.sum(), "group.1")
+        # allgather
+        g = w.allgather(np.full((2, 3), float(rank), np.float32), "ag")
+        want = np.repeat(R.astype(np.float32), 2)[:, None] * np.ones(3)
+        check(g, want, "allgather")
+        # broadcast from the highest valid root
+        root = min(2, size - 1)
+        b = w.broadcast(np.full(4, float(rank), np.float32), root, "bc")
+        check(b, float(root), "broadcast")
+        # alltoall: block j of rank r = r*10 + j
+        blocks = np.concatenate(
+            [np.full(2, rank * 10 + j, np.float32) for j in range(size)])
+        a2a = w.alltoall(blocks, "a2a")
+        want = np.concatenate(
+            [np.full(2, s * 10 + rank, np.float32) for s in range(size)])
+        check(a2a, want, "alltoall")
+        # reducescatter
+        rs = w.reducescatter(
+            np.arange(size * 3, dtype=np.float32) + rank, "rs", op="sum")
+        base = np.arange(size * 3, dtype=np.float32) * size + R.sum()
+        check(rs, base[rank * 3:(rank + 1) * 3], "reducescatter")
+        w.barrier()
+        # steady-state cache: repeat named allreduces; later steps must hit
+        misses_before = w.cache_misses
+        for step in range(5):
+            for t in range(3):
+                w.allreduce(np.full(8, float(step), np.float32),
+                            f"grad.{t}", op="sum")
+        hits = w.cache_hits
+        misses = w.cache_misses - misses_before
+        if hits < 3 * 3:  # at least the last 3+ steps should be all-hit
+            print(f"CACHE rank{rank}: hits={hits} misses={misses}", flush=True)
+            sys.exit(11)
+        print(f"rank{rank} battery ok (cache hits={hits} "
+              f"misses={misses} cycles={w.cycles})", flush=True)
+        w.shutdown()
+    elif mode == "stall":
+        os.environ.setdefault("NOOP", "1")
+        if rank == 0:
+            h = w.allreduce_async_(np.ones(4, np.float32), "stall.t", op="sum")
+        else:
+            time.sleep(2.0)  # > HOROVOD_STALL_CHECK_TIME=0.5
+            h = w.allreduce_async_(np.ones(4, np.float32), "stall.t", op="sum")
+        w.synchronize(h)
+        print(f"rank{rank} stall-resolved ok", flush=True)
+        w.shutdown()
+    elif mode == "peerdeath":
+        if rank == size - 1:
+            w.allreduce(np.ones(4, np.float32), "pd.warmup", op="sum")
+            os._exit(1)  # die abruptly mid-job
+        try:
+            w.allreduce(np.ones(4, np.float32), "pd.warmup", op="sum")
+            # Next collective can never complete; must raise, not hang.
+            w.allreduce(np.ones(4, np.float32), "pd.next", op="sum")
+            print(f"rank{rank} UNEXPECTED success", flush=True)
+            sys.exit(12)
+        except HorovodInternalError as e:
+            print(f"rank{rank} got HorovodInternalError ok", flush=True)
+            sys.exit(0)
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(tmp_path, size: int, mode: str, extra_env=None, timeout=90):
+    script = tmp_path / "native_worker.py"
+    script.write_text(WORKER)
+    port = _free_port()
+    procs = []
+    for r in range(size):
+        env = dict(
+            os.environ,
+            REPO_ROOT=REPO_ROOT,
+            TEST_RANK=str(r),
+            TEST_SIZE=str(size),
+            TEST_PORT=str(port),
+            TEST_MODE=mode,
+        )
+        env.update(extra_env or {})
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    results = []
+    for r, p in enumerate(procs):
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError(f"rank {r} timed out (deadlock?)")
+        results.append((p.returncode, out, err))
+    return results
+
+
+class TestNativeRuntime:
+    def test_battery_4_processes(self, tmp_path):
+        results = _run_world(tmp_path, 4, "battery")
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
+            assert f"rank{r} battery ok" in out
+
+    def test_single_process_world(self, tmp_path):
+        results = _run_world(tmp_path, 1, "battery")
+        rc, out, err = results[0]
+        assert rc == 0, f"{out}\n{err}"
+
+    def test_stall_inspector_warns_then_resolves(self, tmp_path):
+        results = _run_world(
+            tmp_path, 2, "stall",
+            extra_env={"HOROVOD_STALL_CHECK_TIME": "0.5"},
+        )
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r}: {out}\n{err}"
+        # The coordinator (rank 0) must have printed the stall warning
+        # naming the tensor and the missing rank.
+        stderr0 = results[0][2]
+        assert "stall detected" in stderr0 and "stall.t" in stderr0, stderr0
+        assert "[1]" in stderr0
+
+    def test_peer_death_raises_internal_error(self, tmp_path):
+        results = _run_world(tmp_path, 3, "peerdeath")
+        # Last rank deliberately dies with rc=1; survivors must get
+        # HorovodInternalError (rc=0 from the except branch), not hang.
+        assert results[2][0] == 1
+        for r in (0, 1):
+            rc, out, err = results[r]
+            assert rc == 0, f"rank {r}: {out}\n{err}"
+            assert "got HorovodInternalError ok" in out
+
+    def test_timeline_written(self, tmp_path):
+        tl = tmp_path / "timeline.json"
+        results = _run_world(
+            tmp_path, 2, "battery",
+            extra_env={"HOROVOD_TIMELINE": str(tl),
+                       "HOROVOD_TIMELINE_MARK_CYCLES": "1"},
+        )
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r}: {out}\n{err}"
+        import json
+
+        for path in (tl, tmp_path / "timeline.json.rank1"):
+            assert path.exists()
+            events = json.loads(path.read_text())
+            names = {e.get("name") for e in events}
+            assert "RING_ALLREDUCE" in names
+            assert "NEGOTIATE" in names
+            assert "cycle" in names  # mark_cycles
