@@ -1,0 +1,191 @@
+"""Flash (blockwise, online-softmax) attention — the local compute of the
+sequence-parallel schemes, and the framework's hot-op Pallas deliverable.
+
+No reference counterpart: the reference (Horovod) predates long-context
+training and never partitions attention (SURVEY.md §6 "Long-context /
+sequence parallelism: absent"); this subsystem is the TPU-native extension
+the north star requires. Design sources are the public blockwise-attention
+recipes (PAPERS.md): tile K/V, keep running max ``m``, normalizer ``l`` and
+un-normalized output ``o`` in fp32, rescale on each new tile.
+
+Two implementations, one semantics:
+- ``flash_attention``: Pallas TPU kernel (MXU-tiled, fp32 accumulators in
+  VMEM scratch, grid over (batch*heads, Q blocks)); ``interpret=True`` makes
+  it runnable on the CPU dev mesh.
+- ``blockwise_attention_reference``: pure-jnp same math; the numerics
+  oracle in tests and the fallback for shapes the kernel doesn't tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _attend_block(q, k, v, m, l, o, mask=None, scale=1.0):
+    """One online-softmax step: fold K/V tile (k, v) into (m, l, o).
+
+    q: [Sq, D]; k, v: [Sk, D]; m, l: [Sq]; o: [Sq, D] (fp32).
+    """
+    s = (q.astype(jnp.float32) @ k.astype(jnp.float32).T) * scale  # [Sq, Sk]
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # All-masked rows keep m at NEG_INF; exp(NEG_INF - NEG_INF) would be 1,
+    # so clamp the correction to stay a no-op for untouched rows.
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[:, None] + p @ v.astype(jnp.float32)
+    return m_new, l_new, o_new
+
+
+def _finalize(l, o):
+    # Rows that saw no unmasked key (l == 0) return 0, not NaN.
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    return o / safe_l[:, None]
+
+
+def blockwise_attention_reference(q, k, v, causal=False, block_size=128,
+                                  q_offset=0, k_offset=0):
+    """Numerics oracle: [B, H, S, D] blockwise attention in pure jnp.
+
+    ``q_offset``/``k_offset`` are the global positions of element 0 — the
+    hook ring attention uses to apply a causal mask across shards.
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    scale = 1.0 / (D ** 0.5)
+    nq = max(1, (Sq + block_size - 1) // block_size)
+
+    def one_head(qh, kh, vh):
+        outs = []
+        for i in range(nq):
+            qs = i * block_size
+            qb = qh[qs:qs + block_size]
+            m = jnp.full((qb.shape[0],), NEG_INF, jnp.float32)
+            l = jnp.zeros((qb.shape[0],), jnp.float32)
+            o = jnp.zeros((qb.shape[0], D), jnp.float32)
+            nk = max(1, (Sk + block_size - 1) // block_size)
+            for j in range(nk):
+                ks = j * block_size
+                kb = kh[ks:ks + block_size]
+                vb = vh[ks:ks + block_size]
+                mask = None
+                if causal:
+                    qpos = q_offset + qs + jnp.arange(qb.shape[0])
+                    kpos = k_offset + ks + jnp.arange(kb.shape[0])
+                    mask = qpos[:, None] >= kpos[None, :]
+                m, l, o = _attend_block(qb, kb, vb, m, l, o, mask, scale)
+            outs.append(_finalize(l, o))
+        return jnp.concatenate(outs, axis=0)
+
+    fn = jax.vmap(jax.vmap(one_head))
+    return fn(q, k, v).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  block_k: int, seq_k: int, causal: bool, scale: float,
+                  block_q: int):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [block_q, D]
+
+    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+    acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    num_kb = seq_k // block_k
+
+    def body(j, _):
+        k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k_tile.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(qpos >= kpos, p, 0.0)
+        l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p, v_tile.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+        return 0
+
+    jax.lax.fori_loop(0, num_kb, body, 0)
+    l = l_scr[:, 0]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Pallas flash attention. q, k, v: [B, H, S, D] → [B, H, S, D].
+
+    Grid: (B*H, S/block_q); each program streams K/V tiles from VMEM blocks
+    with fp32 running-max/normalizer/accumulator scratch. S must divide by
+    the block sizes (pad upstream — XLA-style static shapes).
+    """
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
+            f"({block_q}, {block_k}); pad to a multiple"
+        )
+    scale = 1.0 / (D ** 0.5)
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, seq_k=Sk, causal=causal,
+        scale=scale, block_q=block_q,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(B * H, Sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, D), jnp.float32),  # fp32 accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(B, H, Sq, D)

@@ -1,2 +1,8 @@
 from .mesh import MeshSpec, build_mesh  # noqa: F401
 from .data_parallel import make_train_step  # noqa: F401
+from .sequence import (  # noqa: F401
+    make_sp_attention_step,
+    ring_attention,
+    shard_sequence,
+    ulysses_attention,
+)

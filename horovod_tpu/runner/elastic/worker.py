@@ -66,6 +66,9 @@ class ElasticWorkerContext:
 
     def apply_to_env(self, assignment: dict) -> None:
         """Refresh the env contract so re-init picks up the new world."""
+        # The version keys the coordinator-port KV scope; survivors and
+        # newly spawned workers must agree on it.
+        os.environ["HOROVOD_WORLD_VERSION"] = str(self.version)
         os.environ["HOROVOD_PROCESS_ID"] = str(assignment["process_id"])
         os.environ["HOROVOD_NUM_PROCESSES"] = str(assignment["num_processes"])
         os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coordinator"]

@@ -187,6 +187,18 @@ class NativeWorld:
 
     def synchronize(self, handle: int, timeout_s: float = 600.0) -> np.ndarray:
         rc = self._lib.hvdrt_wait(handle, timeout_s)
+        if rc != 0:
+            if self._lib.hvdrt_poll(handle) == 0:
+                # Still in flight: the C++ side holds raw pointers into the
+                # numpy buffers — keep our references alive and surface the
+                # timeout without freeing them.
+                raise NativeRuntimeError(
+                    f"synchronize timed out after {timeout_s}s; the op is "
+                    "still pending (buffers kept alive)"
+                )
+            if self._lib.hvdrt_poll(handle) == 1:
+                # Completed between the timeout and now: collect its status.
+                rc = self._lib.hvdrt_wait(handle, 1.0)
         with self._inflight_lock:
             _, out = self._inflight.pop(handle, (None, None))
         if rc != 0:

@@ -159,8 +159,30 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
   size_t words = lists[0].cache_bits.size();
   for (int r = 1; r < size; ++r) words = std::min(words, lists[r].cache_bits.size());
   for (size_t w = 0; w < words; ++w) {
-    uint64_t agreed = ~0ull;
-    for (int r = 0; r < size; ++r) agreed &= lists[r].cache_bits[w];
+    uint64_t agreed = ~0ull, seen = 0ull;
+    for (int r = 0; r < size; ++r) {
+      agreed &= lists[r].cache_bits[w];
+      seen |= lists[r].cache_bits[w];
+    }
+    // Cached tensors announced by some-but-not-all ranks are stalls in the
+    // making too — track them so steady-state hangs still get reported.
+    uint64_t disagreed = seen & ~agreed;
+    while (disagreed) {
+      int bit = __builtin_ctzll(disagreed);
+      disagreed &= disagreed - 1;
+      int id = static_cast<int>(w) * 64 + bit;
+      std::vector<int> missing;
+      for (int r = 0; r < size; ++r) {
+        if (!(lists[r].cache_bits[w] & (1ull << bit))) missing.push_back(r);
+      }
+      stall_.RecordPending(cache_.Get(id).name, missing);
+    }
+    uint64_t resolved = agreed;
+    while (resolved) {
+      int bit = __builtin_ctzll(resolved);
+      resolved &= resolved - 1;
+      stall_.RecordResolved(cache_.Get(static_cast<int>(w) * 64 + bit).name);
+    }
     while (agreed) {
       int bit = __builtin_ctzll(agreed);
       agreed &= agreed - 1;

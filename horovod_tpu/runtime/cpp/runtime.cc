@@ -62,7 +62,12 @@ struct GlobalState {
   std::atomic<int64_t> cycles{0};
 };
 
-GlobalState* g = nullptr;
+// Atomic: readers (poll/wait/rank) may race an elastic re-init's pointer
+// swap. Superseded epochs are intentionally leaked — a waiter woken by
+// FailAllPending may still touch the old state's mutex/cv, and destroying
+// those under it is UB; epochs are rare (elastic reconfigurations only) and
+// small, so the leak is bounded and safe.
+std::atomic<GlobalState*> g{nullptr};
 std::mutex g_init_mu;
 thread_local std::string tl_last_error;
 
@@ -304,7 +309,8 @@ extern "C" {
 int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
                double timeout_s) {
   std::lock_guard<std::mutex> lock(g_init_mu);
-  if (g != nullptr && g->initialized.load()) {
+  GlobalState* prev = g.load();
+  if (prev != nullptr && prev->initialized.load()) {
     SetError("already initialized");
     return -1;
   }
@@ -333,25 +339,32 @@ int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
   st->timeline.Initialize(st->config.timeline_path, rank);
   st->background = std::thread([st] { BackgroundThreadLoop(st); });
   st->initialized.store(true);
-  delete g;  // previous (shut down) epoch, if any
-  g = st;
+  g.store(st);  // previous epoch (if any) intentionally leaked; see above
   return 0;
 }
 
 int hvdrt_shutdown() {
   std::lock_guard<std::mutex> lock(g_init_mu);
-  if (g == nullptr || !g->initialized.load()) return 0;
-  g->shutdown_requested.store(true);
-  if (g->background.joinable()) g->background.join();
-  g->timeline.Shutdown();
-  g->initialized.store(false);
+  GlobalState* st = g.load();
+  if (st == nullptr || !st->initialized.load()) return 0;
+  st->shutdown_requested.store(true);
+  if (st->background.joinable()) st->background.join();
+  st->timeline.Shutdown();
+  st->initialized.store(false);
   return 0;
 }
 
-int hvdrt_rank() { return g ? g->rank : -1; }
-int hvdrt_size() { return g ? g->size : 0; }
+int hvdrt_rank() {
+  GlobalState* st = g.load();
+  return st ? st->rank : -1;
+}
+int hvdrt_size() {
+  GlobalState* st = g.load();
+  return st ? st->size : 0;
+}
 int hvdrt_is_initialized() {
-  return (g != nullptr && g->initialized.load()) ? 1 : 0;
+  GlobalState* st = g.load();
+  return (st != nullptr && st->initialized.load()) ? 1 : 0;
 }
 
 // Enqueue a collective; returns handle >= 0, or -1 on error.
@@ -361,18 +374,19 @@ int hvdrt_is_initialized() {
 int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
                   const void* input, void* output, long long count,
                   int root_rank, double prescale, double postscale) {
-  if (g == nullptr || !g->initialized.load()) {
+  GlobalState* st = g.load();
+  if (st == nullptr || !st->initialized.load()) {
     SetError("not initialized");
     return -1;
   }
-  if (g->background_dead.load()) {
-    SetError("runtime is dead: " + g->fatal_error);
+  if (st->background_dead.load()) {
+    SetError("runtime is dead: " + st->fatal_error);
     return -1;
   }
   if (static_cast<OpType>(op) == OpType::kBroadcast &&
-      (root_rank < 0 || root_rank >= g->size)) {
+      (root_rank < 0 || root_rank >= st->size)) {
     SetError("broadcast root_rank " + std::to_string(root_rank) +
-             " out of range for world size " + std::to_string(g->size));
+             " out of range for world size " + std::to_string(st->size));
     return -1;
   }
   TensorEntry e;
@@ -387,55 +401,57 @@ int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
   e.input = input;
   e.output = output;
   e.enqueue_time_s = NowSeconds();
-  std::lock_guard<std::mutex> lock(g->mu);
-  if (g->pending.count(e.name) ||
-      std::any_of(g->queue.begin(), g->queue.end(),
+  std::lock_guard<std::mutex> lock(st->mu);
+  if (st->pending.count(e.name) ||
+      std::any_of(st->queue.begin(), st->queue.end(),
                   [&](const TensorEntry& q) { return q.name == e.name; })) {
     SetError("tensor '" + e.name + "' is already in flight (names must be "
              "unique per outstanding op, as in the reference)");
     return -1;
   }
-  int32_t handle = g->next_handle++;
+  int32_t handle = st->next_handle++;
   e.handle = handle;
-  g->handles[handle] = HandleState{};
-  g->queue.push_back(std::move(e));
+  st->handles[handle] = HandleState{};
+  st->queue.push_back(std::move(e));
   return handle;
 }
 
 // 1 = done, 0 = pending, -1 = unknown handle.
 int hvdrt_poll(int handle) {
-  if (g == nullptr) return -1;
-  std::lock_guard<std::mutex> lock(g->mu);
-  auto it = g->handles.find(handle);
-  if (it == g->handles.end()) return -1;
+  GlobalState* st = g.load();
+  if (st == nullptr) return -1;
+  std::lock_guard<std::mutex> lock(st->mu);
+  auto it = st->handles.find(handle);
+  if (it == st->handles.end()) return -1;
   return it->second.done ? 1 : 0;
 }
 
 // 0 = ok; -1 = error (collective failed / timeout / unknown); frees handle.
 int hvdrt_wait(int handle, double timeout_s) {
-  if (g == nullptr) {
+  GlobalState* st = g.load();
+  if (st == nullptr) {
     SetError("not initialized");
     return -1;
   }
-  std::unique_lock<std::mutex> lock(g->mu);
+  std::unique_lock<std::mutex> lock(st->mu);
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::duration_cast<std::chrono::nanoseconds>(
                       std::chrono::duration<double>(timeout_s));
-  auto it = g->handles.find(handle);
-  if (it == g->handles.end()) {
+  auto it = st->handles.find(handle);
+  if (it == st->handles.end()) {
     SetError("unknown handle");
     return -1;
   }
-  bool ok = g->cv.wait_until(lock, deadline, [&] {
-    it = g->handles.find(handle);
-    return it != g->handles.end() && it->second.done;
+  bool ok = st->cv.wait_until(lock, deadline, [&] {
+    it = st->handles.find(handle);
+    return it != st->handles.end() && it->second.done;
   });
   if (!ok) {
     SetError("wait timed out");
     return -1;
   }
   std::string err = it->second.error;
-  g->handles.erase(it);
+  st->handles.erase(it);
   if (!err.empty()) {
     SetError(err);
     return -1;
@@ -444,12 +460,17 @@ int hvdrt_wait(int handle, double timeout_s) {
 }
 
 long long hvdrt_cache_hits() {
-  return g ? g->controller->cache().hits() : 0;
+  GlobalState* st = g.load();
+  return st ? st->controller->cache().hits() : 0;
 }
 long long hvdrt_cache_misses() {
-  return g ? g->controller->cache().misses() : 0;
+  GlobalState* st = g.load();
+  return st ? st->controller->cache().misses() : 0;
 }
-long long hvdrt_cycles() { return g ? g->cycles.load() : 0; }
+long long hvdrt_cycles() {
+  GlobalState* st = g.load();
+  return st ? st->cycles.load() : 0;
+}
 
 const char* hvdrt_last_error() { return tl_last_error.c_str(); }
 

@@ -84,6 +84,15 @@ Status Socket::ReadFrame(std::string* payload) {
   return ReadAll(payload->data(), len);
 }
 
+void Socket::SetRecvTimeout(double seconds) {
+  timeval tv{};
+  if (seconds > 0) {
+    tv.tv_sec = static_cast<time_t>(seconds);
+    tv.tv_usec = static_cast<suseconds_t>((seconds - tv.tv_sec) * 1e6);
+  }
+  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
 std::string Socket::LocalAddr() const {
   sockaddr_in addr{};
   socklen_t len = sizeof(addr);
@@ -152,7 +161,10 @@ Status Listener::Bind(int port) {
 
 Status Listener::Accept(Socket* out, double timeout_s) {
   pollfd pfd{fd_, POLLIN, 0};
-  int rc = ::poll(&pfd, 1, static_cast<int>(timeout_s * 1000));
+  // Clamp an already-passed deadline to an immediate poll — a negative
+  // value would mean "block forever" and defeat the bootstrap timeout.
+  int timeout_ms = timeout_s <= 0 ? 0 : static_cast<int>(timeout_s * 1000);
+  int rc = ::poll(&pfd, 1, timeout_ms);
   if (rc == 0) return Status::Error("accept timed out");
   if (rc < 0) return Status::Error(std::string("poll: ") + std::strerror(errno));
   int cfd = ::accept(fd_, nullptr, nullptr);

@@ -38,6 +38,10 @@ class Socket {
   // The address this socket's local end binds to (for peer discovery).
   std::string LocalAddr() const;
 
+  // Bound the next blocking reads (0 restores blocking). Used during
+  // bootstrap so a connected-but-silent peer cannot hang the handshake.
+  void SetRecvTimeout(double seconds);
+
   static Status Connect(const std::string& host, int port, double timeout_s,
                         Socket* out);
 

@@ -4,6 +4,7 @@
 #include <netinet/in.h>
 #include <sys/socket.h>
 
+#include <algorithm>
 #include <cmath>
 #include <cstdio>
 #include <cstdlib>
@@ -218,10 +219,13 @@ Status Transport::Create(int rank, int size, const std::string& coord_addr,
       Socket sock;
       s = control_listener.Accept(&sock, deadline - NowSeconds());
       if (!s.ok) return s;
-      // Hello frame: "<rank> <data_port>".
+      // Hello frame: "<rank> <data_port>". Bounded read: a silent peer
+      // must not hang the whole bootstrap past its deadline.
+      sock.SetRecvTimeout(std::max(1.0, deadline - NowSeconds()));
       std::string hello;
       s = sock.ReadFrame(&hello);
       if (!s.ok) return s;
+      sock.SetRecvTimeout(0);
       int peer_rank = -1, peer_port = -1;
       if (std::sscanf(hello.c_str(), "%d %d", &peer_rank, &peer_port) != 2 ||
           peer_rank < 1 || peer_rank >= size) {
@@ -291,9 +295,11 @@ Status Transport::Create(int rank, int size, const std::string& coord_addr,
       Socket sock;
       Status as = data_listener.Accept(&sock, deadline - NowSeconds());
       if (!as.ok) return as;
+      sock.SetRecvTimeout(std::max(1.0, deadline - NowSeconds()));
       std::string who;
       as = sock.ReadFrame(&who);
       if (!as.ok) return as;
+      sock.SetRecvTimeout(0);
       if (std::atoi(who.c_str()) == (rank - 1 + size) % size) {
         t->pred_ = std::move(sock);
         return Status::OK();
