@@ -70,6 +70,8 @@ from .functions import (  # noqa: F401
     broadcast_parameters,
     to_local,
 )
+from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import data_parallel  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401

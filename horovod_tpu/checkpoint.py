@@ -1,0 +1,107 @@
+"""Sharded async checkpointing with rank-0 + broadcast-on-resume semantics.
+
+The reference has no core checkpoint subsystem (SURVEY.md §5: "delegated to
+frameworks"); its shipped pattern is rank-0 ``torch.save`` per epoch plus
+``broadcast_parameters``/``broadcast_object`` on (re)start. The TPU-native
+equivalent is orbax: sharded, async (the save overlaps the next step), a
+retention policy, and restore that re-shards to the current mesh — with the
+reference's API shape kept: ``save`` is a no-op off the coordinator unless
+the backend needs every host (orbax multihost saves cooperatively), and
+``restore`` leaves every rank consistent.
+
+Used by the elastic ``State`` machinery as the durable layer underneath the
+in-memory commit/restore cycle.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+
+class Checkpointer:
+    """Orbax-backed checkpoint manager for train state pytrees."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._dir = os.path.abspath(directory)
+        os.makedirs(self._dir, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def save(self, step: int, state: Any, wait: bool = False) -> None:
+        """Save a pytree (params/opt_state/...) at `step`.
+
+        Async by default: returns once the on-device arrays are snapshotted;
+        the write to storage overlaps subsequent steps (the TPU-idiomatic
+        equivalent of the reference's rank-0 torch.save which blocked the
+        loop)."""
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self._mgr.wait_until_finished()
+
+    def restore(self, step: int | None = None, template: Any = None) -> Any:
+        """Restore the latest (or given) step, re-sharded like `template`.
+
+        Every process restores cooperatively (orbax reads shards local to
+        each host) — the sharded-native form of the reference's
+        rank-0-load + broadcast_parameters resume."""
+        import orbax.checkpoint as ocp
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        if template is not None:
+            args = ocp.args.StandardRestore(template)
+        else:
+            args = ocp.args.StandardRestore()
+        return self._mgr.restore(step, args=args)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_on_rank_0(path: str, tree: Any) -> None:
+    """The reference idiom (`if hvd.rank() == 0: torch.save(...)`) for small
+    host-side objects; pairs with ``load_and_broadcast``."""
+    import pickle
+
+    from . import basics
+
+    if basics.rank() == 0:
+        with open(path, "wb") as f:
+            pickle.dump(jax.tree.map(lambda x: jax.device_get(x), tree), f)
+
+
+def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
+    """Rank 0 loads; everyone receives via broadcast_object (resume parity
+    with ``hvd.broadcast_object(torch.load(...))``)."""
+    import pickle
+
+    from . import basics
+    from .functions import broadcast_object
+
+    obj = None
+    if basics.rank() == root_rank and os.path.exists(path):
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    return broadcast_object(obj, root_rank=root_rank)

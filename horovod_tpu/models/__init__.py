@@ -1,2 +1,10 @@
 from .lenet import LeNet  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .bert import (  # noqa: F401
+    BERT_BASE,
+    BERT_LARGE,
+    BERT_TINY,
+    Bert,
+    BertConfig,
+    mlm_loss,
+)

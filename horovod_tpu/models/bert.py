@@ -1,0 +1,194 @@
+"""BERT encoder family — the framework's transformer benchmark model.
+
+BASELINE config #3 is the reference's BERT-Large TF/Keras benchmark
+(Horovod's second headline model alongside ResNet). TPU-first choices:
+bfloat16 activations with float32 params/layernorm accumulation, attention
+via the framework's own blockwise/flash kernels
+(``horovod_tpu.ops.attention``), sequence dimension ready for the
+sequence-parallel schemes in ``horovod_tpu.parallel.sequence`` (pass
+``attention_fn=`` to swap in ring/Ulysses inside a sharded step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import blockwise_attention_reference, flash_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+BERT_BASE = BertConfig()
+BERT_LARGE = BertConfig(
+    hidden_size=1024, num_layers=24, num_heads=16, intermediate_size=4096
+)
+BERT_TINY = BertConfig(  # test-sized
+    vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+    intermediate_size=128, max_position_embeddings=128,
+)
+
+
+def default_attention(q, k, v, mask_bias, dtype):
+    """[B, S, H, D] inputs; dense attention with an additive mask bias.
+
+    Uses the blockwise oracle math (fp32 online softmax). ``mask_bias`` is
+    [B, 1, 1, S] with 0 for visible and -1e30 for padding.
+    """
+    B, S, H, D = q.shape
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt.astype(jnp.float32),
+                   kt.astype(jnp.float32)) * scale
+    s = s + mask_bias.astype(jnp.float32)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, vt.astype(jnp.float32))
+    return out.transpose(0, 2, 1, 3).astype(dtype)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, mask_bias, deterministic: bool):
+        cfg = self.config
+        dense = partial(
+            nn.DenseGeneral, dtype=cfg.dtype, param_dtype=jnp.float32
+        )
+        qkv_shape = (cfg.num_heads, cfg.head_dim)
+        q = dense(features=qkv_shape, name="query")(x)
+        k = dense(features=qkv_shape, name="key")(x)
+        v = dense(features=qkv_shape, name="value")(x)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, mask_bias, cfg.dtype)
+        else:
+            out = default_attention(q, k, v, mask_bias, cfg.dtype)
+        out = nn.DenseGeneral(
+            features=cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="out",
+        )(out)
+        out = nn.Dropout(cfg.dropout_rate)(out, deterministic=deterministic)
+        return out
+
+
+class TransformerLayer(nn.Module):
+    config: BertConfig
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, mask_bias, deterministic: bool):
+        cfg = self.config
+        # Post-LN (original BERT): sublayer -> residual -> LayerNorm.
+        attn = SelfAttention(cfg, self.attention_fn, name="attention")(
+            x, mask_bias, deterministic
+        )
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + attn)
+        x = x.astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_out")(h)
+        h = nn.Dropout(cfg.dropout_rate)(h, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+        return x.astype(cfg.dtype)
+
+
+class Bert(nn.Module):
+    """BERT encoder with MLM head (tied embeddings).
+
+    Call: ``model.apply(vars, input_ids, attention_mask, token_type_ids,
+    train=...)`` → ``(sequence_output [B,S,E], mlm_logits [B,S,V])``.
+    """
+
+    config: BertConfig = BERT_BASE
+    attention_fn: Callable | None = None
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = False):
+        cfg = self.config
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, S), jnp.int32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((B, S), jnp.int32)
+
+        tok_emb = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                           param_dtype=jnp.float32, name="token_embeddings")
+        x = tok_emb(input_ids)
+        x = x + nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            param_dtype=jnp.float32, name="position_embeddings",
+        )(jnp.arange(S)[None, :])
+        x = x + nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size,
+            param_dtype=jnp.float32, name="type_embeddings",
+        )(token_type_ids)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x)
+        x = nn.Dropout(cfg.dropout_rate)(x, deterministic=not train)
+        x = x.astype(cfg.dtype)
+
+        # Additive mask bias [B, 1, 1, S]: 0 visible, -1e30 padding.
+        mask_bias = (1.0 - attention_mask[:, None, None, :].astype(
+            jnp.float32)) * -1e30
+
+        for i in range(cfg.num_layers):
+            x = TransformerLayer(cfg, self.attention_fn, name=f"layer_{i}")(
+                x, mask_bias, deterministic=not train
+            )
+
+        # MLM head with tied input embeddings.
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlm_transform")(x)
+        h = nn.gelu(h)
+        h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h)
+        logits = tok_emb.attend(h.astype(jnp.float32))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32
+        )
+        return x, logits
+
+
+def mlm_loss(logits, labels, label_mask):
+    """Masked-LM cross entropy: mean over positions where label_mask == 1."""
+    import jax
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    mask = label_mask.astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def flash_attention_fn(q, k, v, mask_bias, dtype, interpret: bool = False):
+    """Adapter plugging the Pallas flash kernel into ``Bert`` for unpadded
+    batches (mask_bias all-zero): [B, S, H, D] -> transpose -> kernel."""
+    del mask_bias  # full-visibility batches only; padded path uses default
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3), causal=False, interpret=interpret,
+    )
+    return out.transpose(0, 2, 1, 3).astype(dtype)

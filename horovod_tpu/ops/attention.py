@@ -13,7 +13,9 @@ Two implementations, one semantics:
   VMEM scratch, grid over (batch*heads, Q blocks)); ``interpret=True`` makes
   it runnable on the CPU dev mesh.
 - ``blockwise_attention_reference``: pure-jnp same math; the numerics
-  oracle in tests and the fallback for shapes the kernel doesn't tile.
+  oracle in tests. The kernel requires block-divisible sequence lengths
+  (raises otherwise) — pad upstream, or call the reference directly for
+  ragged shapes.
 """
 
 from __future__ import annotations
@@ -98,50 +100,53 @@ def blockwise_attention_reference(q, k, v, causal=False, block_size=128,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  block_k: int, seq_k: int, causal: bool, scale: float,
-                  block_q: int):
+                  causal: bool, scale: float, block_q: int, block_k: int):
+    # Grid (BH, num_q_blocks, num_k_blocks), K innermost: only ONE
+    # [block_k, D] K/V tile is VMEM-resident per step (long sequences never
+    # exceed VMEM); scratch carries (m, l, acc) across the K dimension.
     qi = pl.program_id(1)
-    q = q_ref[0]  # [block_q, D]
+    j = pl.program_id(2)
+    num_kb = pl.num_programs(2)
 
-    m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-    l_scr[:] = jnp.zeros_like(l_scr)
-    acc_scr[:] = jnp.zeros_like(acc_scr)
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    num_kb = seq_k // block_k
+    q = q_ref[0]       # [block_q, D]
+    k_tile = k_ref[0]  # [block_k, D]
+    v_tile = v_ref[0]
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32), k_tile.astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(qpos >= kpos, s, NEG_INF)
+    m_prev = m_scr[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    if causal:
+        p = jnp.where(qpos >= kpos, p, 0.0)
+    l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
+    acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+        p, v_tile.astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:, 0] = m_new
 
-    def body(j, _):
-        k_tile = k_ref[0, pl.ds(j * block_k, block_k), :]
-        v_tile = v_ref[0, pl.ds(j * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q.astype(jnp.float32), k_tile.astype(jnp.float32),
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
-        if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kpos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_prev = m_scr[:, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1))
-        corr = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        if causal:
-            p = jnp.where(qpos >= kpos, p, 0.0)
-        l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
-        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-            p, v_tile.astype(jnp.float32),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        m_scr[:, 0] = m_new
-        return 0
-
-    jax.lax.fori_loop(0, num_kb, body, 0)
-    l = l_scr[:, 0]
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+    @pl.when(j == num_kb - 1)
+    def _finalize_block():
+        l = l_scr[:, 0]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -168,18 +173,18 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     vr = v.reshape(B * H, Sk, D)
 
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, seq_k=Sk, causal=causal,
-        scale=scale, block_q=block_q,
+        _flash_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
     )
     out = pl.pallas_call(
         kernel,
-        grid=(B * H, Sq // block_q),
+        grid=(B * H, Sq // block_q, Sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max m

@@ -53,9 +53,19 @@ Status Socket::WriteAll(const void* data, size_t n) {
   return Status::OK();
 }
 
-Status Socket::ReadAll(void* data, size_t n) {
+Status Socket::ReadAll(void* data, size_t n, double deadline) {
   char* p = static_cast<char*>(data);
   while (n > 0) {
+    if (deadline > 0) {
+      double remaining = deadline - NowSeconds();
+      if (remaining <= 0) return Status::Error("read deadline exceeded");
+      pollfd pfd{fd_, POLLIN, 0};
+      int rc = ::poll(&pfd, 1, static_cast<int>(remaining * 1000) + 1);
+      if (rc == 0) return Status::Error("read deadline exceeded");
+      if (rc < 0 && errno != EINTR) {
+        return Status::Error(std::string("poll: ") + std::strerror(errno));
+      }
+    }
     ssize_t r = ::recv(fd_, p, n, 0);
     if (r < 0) {
       if (errno == EINTR) continue;
@@ -75,22 +85,13 @@ Status Socket::WriteFrame(const std::string& payload) {
   return WriteAll(payload.data(), payload.size());
 }
 
-Status Socket::ReadFrame(std::string* payload) {
+Status Socket::ReadFrame(std::string* payload, double deadline) {
   uint32_t len = 0;
-  Status s = ReadAll(&len, sizeof(len));
+  Status s = ReadAll(&len, sizeof(len), deadline);
   if (!s.ok) return s;
   payload->resize(len);
   if (len == 0) return Status::OK();
-  return ReadAll(payload->data(), len);
-}
-
-void Socket::SetRecvTimeout(double seconds) {
-  timeval tv{};
-  if (seconds > 0) {
-    tv.tv_sec = static_cast<time_t>(seconds);
-    tv.tv_usec = static_cast<suseconds_t>((seconds - tv.tv_sec) * 1e6);
-  }
-  ::setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  return ReadAll(payload->data(), len, deadline);
 }
 
 std::string Socket::LocalAddr() const {

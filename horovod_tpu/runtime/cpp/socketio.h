@@ -27,20 +27,18 @@ class Socket {
   int fd() const { return fd_; }
   void Close();
 
-  // Raw IO: loop until all n bytes moved (or error).
+  // Raw IO: loop until all n bytes moved (or error). A nonzero deadline
+  // (NowSeconds()-based) bounds the WHOLE read — a trickling peer cannot
+  // reset it per recv the way SO_RCVTIMEO alone would allow.
   Status WriteAll(const void* data, size_t n);
-  Status ReadAll(void* data, size_t n);
+  Status ReadAll(void* data, size_t n, double deadline = 0.0);
 
   // Framed IO: uint32 little-endian length prefix.
   Status WriteFrame(const std::string& payload);
-  Status ReadFrame(std::string* payload);
+  Status ReadFrame(std::string* payload, double deadline = 0.0);
 
   // The address this socket's local end binds to (for peer discovery).
   std::string LocalAddr() const;
-
-  // Bound the next blocking reads (0 restores blocking). Used during
-  // bootstrap so a connected-but-silent peer cannot hang the handshake.
-  void SetRecvTimeout(double seconds);
 
   static Status Connect(const std::string& host, int port, double timeout_s,
                         Socket* out);

@@ -219,13 +219,11 @@ Status Transport::Create(int rank, int size, const std::string& coord_addr,
       Socket sock;
       s = control_listener.Accept(&sock, deadline - NowSeconds());
       if (!s.ok) return s;
-      // Hello frame: "<rank> <data_port>". Bounded read: a silent peer
-      // must not hang the whole bootstrap past its deadline.
-      sock.SetRecvTimeout(std::max(1.0, deadline - NowSeconds()));
+      // Hello frame: "<rank> <data_port>". Deadline-bounded read: neither
+      // a silent nor a trickling peer can hang the bootstrap.
       std::string hello;
-      s = sock.ReadFrame(&hello);
+      s = sock.ReadFrame(&hello, deadline);
       if (!s.ok) return s;
-      sock.SetRecvTimeout(0);
       int peer_rank = -1, peer_port = -1;
       if (std::sscanf(hello.c_str(), "%d %d", &peer_rank, &peer_port) != 2 ||
           peer_rank < 1 || peer_rank >= size) {
@@ -295,11 +293,9 @@ Status Transport::Create(int rank, int size, const std::string& coord_addr,
       Socket sock;
       Status as = data_listener.Accept(&sock, deadline - NowSeconds());
       if (!as.ok) return as;
-      sock.SetRecvTimeout(std::max(1.0, deadline - NowSeconds()));
       std::string who;
-      as = sock.ReadFrame(&who);
+      as = sock.ReadFrame(&who, deadline);
       if (!as.ok) return as;
-      sock.SetRecvTimeout(0);
       if (std::atoi(who.c_str()) == (rank - 1 + size) % size) {
         t->pred_ = std::move(sock);
         return Status::OK();

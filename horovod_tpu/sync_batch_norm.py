@@ -1,0 +1,65 @@
+"""SyncBatchNorm: batch statistics reduced across the process set.
+
+Parity: ``horovod/torch/sync_batch_norm.py`` / ``horovod/tensorflow/
+sync_batch_norm.py`` — the reference allgathers per-rank sums/counts and
+reduces on every rank. TPU-native form: Flax's BatchNorm already supports
+cross-device stat reduction via ``axis_name`` (a psum over the mapped axis
+at trace time — exactly the compiled equivalent of the reference's
+hand-rolled allgather). This wrapper binds that to the framework's world:
+default axis is the global ``'hvd'`` axis; pass a process set to scope the
+sync to its sub-axis.
+
+Use inside the sharded step (the only place cross-device stats exist)::
+
+    norm = hvd.SyncBatchNorm(use_running_average=not train)
+    # inside shard_map over 'hvd': stats are psum'd across all ranks
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class SyncBatchNorm(nn.BatchNorm):
+    """``nn.BatchNorm`` whose batch stats sync over the framework axis.
+
+    All ``nn.BatchNorm`` kwargs are accepted; ``axis_name`` defaults to the
+    global process set's axis ('hvd'). Outside any mapped axis (plain
+    single-device apply) it degrades to local BatchNorm, mirroring the
+    reference's behavior when world size is 1.
+    """
+
+    axis_name: str | None = "hvd"
+    use_running_average: bool | None = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any | None = None
+
+    @nn.compact
+    def __call__(self, x, use_running_average=None, **kwargs):
+        axis = self.axis_name
+        if axis is not None:
+            from .basics import in_axis_scope, is_initialized
+
+            # Degrade gracefully when called outside shard_map/pmap (or
+            # before init): local stats only, like the reference with np=1.
+            if not is_initialized() or not in_axis_scope(axis):
+                axis = None
+        # Rebind the parent implementation with the resolved axis.
+        bn = nn.BatchNorm(
+            use_running_average=self.use_running_average,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            use_bias=self.use_bias,
+            use_scale=self.use_scale,
+            bias_init=self.bias_init,
+            scale_init=self.scale_init,
+            axis_name=axis,
+            name="bn",
+        )
+        return bn(x, use_running_average=use_running_average, **kwargs)

@@ -1,0 +1,184 @@
+"""BERT, SyncBatchNorm, callbacks, checkpoint tests (8-device CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import BERT_TINY, Bert, mlm_loss
+from horovod_tpu import callbacks as cb
+
+
+class TestBert:
+    def test_forward_shapes_and_mask(self):
+        cfg = BERT_TINY
+        model = Bert(cfg)
+        B, S = 2, 16
+        ids = jnp.ones((B, S), jnp.int32)
+        mask = jnp.concatenate(
+            [jnp.ones((B, S // 2), jnp.int32),
+             jnp.zeros((B, S // 2), jnp.int32)], axis=1)
+        variables = model.init(jax.random.PRNGKey(0), ids, mask)
+        seq, logits = model.apply(variables, ids, mask)
+        assert seq.shape == (B, S, cfg.hidden_size)
+        assert logits.shape == (B, S, cfg.vocab_size)
+        assert np.isfinite(np.asarray(logits, np.float32)).all()
+
+    def test_mlm_loss_and_train_step(self, hvd):
+        cfg = BERT_TINY
+        model = Bert(cfg)
+        B, S = 8, 16
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        labels = rng.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+        lmask = (rng.rand(B, S) < 0.15).astype(np.int32)
+        variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids)[:1])
+
+        def loss_fn(params, batch):
+            i, y, m = batch
+            _, logits = model.apply(params, i)
+            return mlm_loss(logits, y, m)
+
+        opt = hvd.DistributedOptimizer(optax.adam(1e-3))
+        step = hvd.data_parallel.make_train_step(loss_fn, opt, donate=False)
+        params = hvd.data_parallel.replicate(variables)
+        opt_state = hvd.data_parallel.replicate(opt.init(variables))
+        batch = hvd.data_parallel.shard_batch((ids, labels, lmask))
+        p1, o1, loss1 = step(params, opt_state, batch)
+        p2, _, loss2 = step(p1, o1, batch)
+        assert float(loss2) < float(loss1)  # learns on a fixed batch
+
+    def test_flash_attention_plugs_in(self):
+        from horovod_tpu.models.bert import flash_attention_fn
+        import functools
+
+        cfg = BERT_TINY
+        ids = jnp.ones((1, 128), jnp.int32)
+        model_ref = Bert(cfg)
+        variables = model_ref.init(jax.random.PRNGKey(0), ids)
+        _, ref_logits = model_ref.apply(variables, ids)
+        model_flash = Bert(cfg, attention_fn=functools.partial(
+            flash_attention_fn, interpret=True))
+        _, flash_logits = model_flash.apply(variables, ids)
+        np.testing.assert_allclose(
+            np.asarray(flash_logits), np.asarray(ref_logits),
+            rtol=5e-2, atol=5e-2,
+        )
+
+
+class TestSyncBatchNorm:
+    def test_syncs_stats_across_ranks(self, hvd):
+        n = hvd.size()
+        mesh = hvd.global_mesh()
+        model = hvd.SyncBatchNorm(use_running_average=False, momentum=0.0)
+        # Per-rank distinct data: local mean differs per shard; synced BN
+        # must normalize by the GLOBAL mean/var.
+        x = (jnp.arange(n, dtype=jnp.float32)[:, None, None]
+             * jnp.ones((n, 4, 3)))
+        variables = model.init(jax.random.PRNGKey(0), x[0])
+
+        def apply_shard(xs):
+            out, updates = model.apply(
+                variables, xs[0], mutable=["batch_stats"])
+            return out[None], updates["batch_stats"]["bn"]["mean"][None]
+
+        fn = jax.jit(jax.shard_map(
+            apply_shard, mesh=mesh, in_specs=P("hvd"),
+            out_specs=(P("hvd"), P("hvd")), check_vma=False,
+        ))
+        out, means = fn(x)
+        global_mean = float(np.arange(n).mean())
+        # Every rank's running mean is the global batch mean.
+        np.testing.assert_allclose(
+            np.asarray(means), global_mean, rtol=1e-5)
+        # Output is globally normalized: rank r's constant input maps to
+        # (r - mean)/std, identical across features.
+        got = np.asarray(out)[:, 0, 0]
+        std = np.arange(n).std()
+        np.testing.assert_allclose(
+            got, (np.arange(n) - global_mean) / std, rtol=1e-3, atol=1e-3)
+
+    def test_local_fallback_outside_axis(self):
+        model = hvd.SyncBatchNorm(use_running_average=False)
+        x = jnp.ones((2, 3, 4))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        out, _ = model.apply(variables, x, mutable=["batch_stats"])
+        assert out.shape == x.shape
+
+
+class _State:
+    def __init__(self):
+        self.params = {"w": jnp.ones((2,))}
+        self.opt_state = {}
+        self.lr_scale = 1.0
+
+
+class TestCallbacks:
+    def test_metric_average(self, hvd):
+        logs = {"loss": 2.0, "acc": 0.5, "name": "skip-me"}
+        cb.MetricAverageCallback().on_epoch_end(0, logs, _State())
+        # Single controller: every rank's metric is the same value.
+        assert logs["loss"] == 2.0 and logs["acc"] == 0.5
+        assert logs["name"] == "skip-me"
+
+    def test_warmup_multiplier_ramps(self, hvd):
+        c = cb.LearningRateWarmupCallback(warmup_epochs=4)
+        st = _State()
+        scales = []
+        for e in range(5):
+            c.on_epoch_begin(e, st)
+            scales.append(st.lr_scale)
+        assert scales[0] < scales[1] < scales[2] < scales[3]
+        assert scales[3] == pytest.approx(1.0)
+        # epoch 4 is past warmup: callback inactive, scale untouched
+        assert scales[4] == scales[3]
+
+    def test_warmup_schedule_optax(self, hvd):
+        sched = cb.warmup_schedule(0.8, warmup_steps=8)
+        assert float(sched(0)) == pytest.approx(0.8 / hvd.size())
+        assert float(sched(8)) == pytest.approx(0.8)
+
+    def test_broadcast_callback_and_list(self, hvd):
+        st = _State()
+        calls = []
+
+        class Probe(cb.Callback):
+            def on_train_begin(self, state):
+                calls.append("begin")
+
+        cl = cb.CallbackList(
+            [cb.BroadcastGlobalVariablesCallback(0), Probe()])
+        cl.on_train_begin(st)
+        assert calls == ["begin"]
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import Checkpointer
+
+        state = {
+            "params": {"w": jnp.arange(8.0), "b": jnp.zeros((3,))},
+            "step": jnp.asarray(7),
+        }
+        ckpt = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
+        ckpt.save(7, state, wait=True)
+        ckpt.save(9, jax.tree.map(lambda x: x + 1, state), wait=True)
+        assert ckpt.all_steps() == [7, 9]
+        restored = ckpt.restore(template=state)
+        np.testing.assert_array_equal(
+            np.asarray(restored["params"]["w"]), np.arange(8.0) + 1)
+        old = ckpt.restore(step=7, template=state)
+        np.testing.assert_array_equal(
+            np.asarray(old["params"]["w"]), np.arange(8.0))
+        ckpt.close()
+
+    def test_rank0_save_load_broadcast(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "small.pkl")
+        save_on_rank_0(path, {"epoch": 3})
+        got = load_and_broadcast(path)
+        assert got == {"epoch": 3}
