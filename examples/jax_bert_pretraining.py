@@ -1,0 +1,74 @@
+"""BERT MLM pretraining step benchmark (BASELINE config #3 analog).
+
+Synthetic masked-LM batches over BERT-Base/Large; data-parallel with the
+DistributedOptimizer, bf16 wire compression, LR warmup schedule::
+
+    python examples/jax_bert_pretraining.py --config base --steps 10
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.callbacks import warmup_schedule
+from horovod_tpu.models import BERT_BASE, BERT_LARGE, BERT_TINY, Bert, mlm_loss
+
+CONFIGS = {"tiny": BERT_TINY, "base": BERT_BASE, "large": BERT_LARGE}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    cfg = CONFIGS[args.config]
+    model = Bert(cfg)
+    gb = args.batch_size * hvd.size()
+    S = min(args.seq_len, cfg.max_position_embeddings)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (gb, S)).astype(np.int32)
+    labels = rng.randint(0, cfg.vocab_size, (gb, S)).astype(np.int32)
+    lmask = (rng.rand(gb, S) < 0.15).astype(np.int32)
+
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(ids)[:1])
+    variables = hvd.broadcast_parameters(variables)
+
+    opt = hvd.DistributedOptimizer(
+        optax.adamw(warmup_schedule(1e-4, warmup_steps=100)),
+        compression=hvd.Compression.bf16,
+    )
+
+    def loss_fn(params, batch):
+        i, y, m = batch
+        _, logits = model.apply(params, i)
+        return mlm_loss(logits, y, m)
+
+    step = hvd.data_parallel.make_train_step(loss_fn, opt, donate=False)
+    params = hvd.data_parallel.replicate(variables)
+    opt_state = hvd.data_parallel.replicate(opt.init(variables))
+    batch = hvd.data_parallel.shard_batch((ids, labels, lmask))
+
+    params, opt_state, loss = step(params, opt_state, batch)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.steps
+    if hvd.rank() == 0:
+        print(f"BERT-{args.config}: {gb / dt:.1f} sequences/sec "
+              f"({dt * 1e3:.1f} ms/step, loss {float(loss):.3f})")
+
+
+if __name__ == "__main__":
+    main()

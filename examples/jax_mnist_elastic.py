@@ -1,0 +1,67 @@
+"""Elastic MNIST — parity with the reference's
+``examples/elastic/pytorch/pytorch_mnist_elastic.py``::
+
+    hvdrun --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh \
+        python examples/jax_mnist_elastic.py
+
+The training function is wrapped by ``@hvd.elastic.run``; it survives host
+addition/removal via commit/restore of an ``ObjectState``. Preempting a TPU
+VM mid-epoch rolls back to the last commit instead of killing the job.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ObjectState
+from horovod_tpu.models.lenet import LeNet, cross_entropy_loss
+
+
+def build(lr_scale):
+    model = LeNet()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01 * lr_scale))
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return cross_entropy_loss(model.apply(p, x), y)
+
+    return params, opt, hvd.data_parallel.make_train_step(loss_fn, opt)
+
+
+@hvd.elastic.run
+def train(state):
+    rng = np.random.RandomState(state.batch)
+    while state.epoch < 3:
+        params, opt, step = build(hvd.size())
+        params = hvd.data_parallel.replicate(
+            state.params if state.params is not None else params)
+        opt_state = hvd.data_parallel.replicate(opt.init(params))
+        for b in range(state.batch, 20):
+            gb = 32 * hvd.size()
+            x = rng.rand(gb, 28, 28, 1).astype(np.float32)
+            y = rng.randint(0, 10, size=(gb,)).astype(np.int32)
+            params, opt_state, loss = step(
+                params, opt_state, hvd.data_parallel.shard_batch((x, y)))
+            state.params = jax.device_get(params)
+            state.batch = b + 1
+            if b % 5 == 0:
+                # commit() checkpoints in memory AND polls for host updates
+                # (raises HostsUpdatedInterrupt -> re-rendezvous).
+                state.commit()
+                if hvd.rank() == 0:
+                    print(f"epoch {state.epoch} batch {b} "
+                          f"loss {float(loss):.4f} world {hvd.size()}")
+        state.epoch += 1
+        state.batch = 0
+        state.commit()
+
+
+if __name__ == "__main__":
+    hvd.init()
+    train(ObjectState(params=None, epoch=0, batch=0))
+    if hvd.rank() == 0:
+        print("elastic training done")

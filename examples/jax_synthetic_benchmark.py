@@ -1,0 +1,79 @@
+"""Synthetic ResNet benchmark — parity with the reference's
+``examples/pytorch/pytorch_synthetic_benchmark.py``: fixed random batch,
+timed steps, images/sec (+ per-rank and scaling summary on rank 0)."""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.lenet import cross_entropy_loss
+from horovod_tpu.models.resnet import ResNet50, ResNet101, ResNet152
+
+MODELS = {"resnet50": ResNet50, "resnet101": ResNet101, "resnet152": ResNet152}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50", choices=sorted(MODELS))
+    p.add_argument("--batch-size", type=int, default=32,
+                   help="per-device batch size")
+    p.add_argument("--image-size", type=int, default=None)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--num-warmup", type=int, default=3)
+    p.add_argument("--fp16-allreduce", action="store_true",
+                   help="bf16 wire compression (the TPU fp16 analog)")
+    args = p.parse_args()
+
+    hvd.init()
+    on_tpu = jax.default_backend() == "tpu"
+    image = args.image_size or (224 if on_tpu else 32)
+    global_batch = args.batch_size * hvd.size()
+
+    model = MODELS[args.model](
+        num_classes=1000, dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    rng = np.random.RandomState(0)
+    x = rng.rand(global_batch, image, image, 3).astype(np.float32)
+    y = rng.randint(0, 1000, size=(global_batch,)).astype(np.int32)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, image, image, 3)), train=True)
+
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01),
+        compression=hvd.Compression.bf16 if args.fp16_allreduce
+        else hvd.Compression.none,
+    )
+
+    def loss_fn(params, batch):
+        xb, yb = batch
+        logits, _ = model.apply(
+            params, xb, train=True, mutable=["batch_stats"])
+        return cross_entropy_loss(logits, yb, num_classes=1000)
+
+    step = hvd.data_parallel.make_train_step(loss_fn, opt, donate=False)
+    params = hvd.data_parallel.replicate(variables)
+    opt_state = hvd.data_parallel.replicate(opt.init(variables))
+    batch = hvd.data_parallel.shard_batch((x, y))
+
+    for _ in range(args.num_warmup):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, loss = step(params, opt_state, batch)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / args.num_iters
+
+    if hvd.rank() == 0:
+        ips = global_batch / dt
+        print(f"Model: {args.model}  ranks: {hvd.size()}")
+        print(f"Img/sec total: {ips:.1f}  per rank: {ips / hvd.size():.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,7 @@ from .functions import (  # noqa: F401
     broadcast_parameters,
     to_local,
 )
+from . import autotune  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401

@@ -1,0 +1,117 @@
+"""Autotuning surface for the compiled (JAX) path.
+
+Parity: the reference's autotuner (``horovod/common/parameter_manager.cc`` +
+``optim/bayesian_optimization.cc``) tunes runtime knobs online. The native
+runtime embeds that machinery directly (``HOROVOD_AUTOTUNE=1`` tunes the
+background loop's fusion threshold + cycle time; see ``cpp/autotune.cc``).
+This module exposes the SAME native Bayesian optimizer to Python for the
+JAX path, where the tunable is the trace-time gradient-bucketing threshold:
+each candidate re-compiles the step, so the tuner times steady-state steps
+per candidate and converges on the best bucket size.
+
+Usage::
+
+    best = hvd.autotune.tune_fusion_threshold(
+        build_step,   # (threshold_bytes) -> step callable
+        run_steps,    # (step) -> seconds per step (user-timed window)
+        rounds=12,
+    )
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Sequence
+
+from .utils.logging import get_logger
+
+
+class BayesianTuner:
+    """ctypes wrapper over the native GP/EI optimizer (maximizes score)."""
+
+    def __init__(self, lows: Sequence[float], highs: Sequence[float],
+                 seed: int = 42):
+        from .runtime import load_library
+
+        self._lib = load_library()
+        self._lib.hvdrt_bo_new.restype = ctypes.c_int
+        self._lib.hvdrt_bo_new.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_longlong,
+        ]
+        self._lib.hvdrt_bo_add.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+            ctypes.c_double,
+        ]
+        self._lib.hvdrt_bo_suggest.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        self._lib.hvdrt_bo_best.restype = ctypes.c_double
+        self._lib.hvdrt_bo_best.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+        ]
+        self._dims = len(lows)
+        arr = ctypes.c_double * self._dims
+        self._id = self._lib.hvdrt_bo_new(
+            self._dims, arr(*lows), arr(*highs), seed
+        )
+
+    def add_sample(self, params: Sequence[float], score: float) -> None:
+        arr = (ctypes.c_double * self._dims)(*params)
+        self._lib.hvdrt_bo_add(self._id, arr, self._dims, score)
+
+    def suggest(self) -> list[float]:
+        out = (ctypes.c_double * self._dims)()
+        rc = self._lib.hvdrt_bo_suggest(self._id, out, self._dims)
+        if rc != 0:
+            raise RuntimeError("BO suggest failed")
+        return list(out)
+
+    def best(self) -> tuple[list[float], float]:
+        out = (ctypes.c_double * self._dims)()
+        score = self._lib.hvdrt_bo_best(self._id, out, self._dims)
+        return list(out), score
+
+    def close(self) -> None:
+        self._lib.hvdrt_bo_free(self._id)
+
+
+def tune_fusion_threshold(
+    build_step: Callable[[int], Callable],
+    time_step: Callable[[Callable], float],
+    rounds: int = 12,
+    low_bytes: int = 64 * 1024,
+    high_bytes: int = 128 * 1024 * 1024,
+    log_path: str | None = None,
+) -> int:
+    """Search the gradient-bucketing threshold for the fastest step.
+
+    ``build_step(threshold)`` returns a (re)compiled step; ``time_step``
+    measures steady-state seconds/step (caller warms up + times). Returns
+    the best threshold in bytes. Throughput = 1/seconds is the score.
+    """
+    log = get_logger()
+    tuner = BayesianTuner([float(low_bytes)], [float(high_bytes)])
+    try:
+        for r in range(rounds):
+            (candidate,) = tuner.suggest()
+            threshold = max(low_bytes, int(candidate))
+            step = build_step(threshold)
+            seconds = time_step(step)
+            score = 1.0 / max(seconds, 1e-9)
+            tuner.add_sample([float(threshold)], score)
+            log.info(
+                "autotune round %d: threshold=%d -> %.4fs/step", r,
+                threshold, seconds,
+            )
+            if log_path:
+                with open(log_path, "a") as f:
+                    f.write(f"{threshold},{seconds:.6f},{score:.3f}\n")
+        (best_params, best_score) = tuner.best()
+        log.info(
+            "autotune best: threshold=%d (score %.1f)", int(best_params[0]),
+            best_score,
+        )
+        return int(best_params[0])
+    finally:
+        tuner.close()

@@ -111,9 +111,13 @@ def _exchange_coordinator_port(coord: str, proc_id: int) -> str:
     if not addr or port < 0:
         return coord  # manual launch: trust the env as given
     from .runner.http.kv_server import KVClient
-    from .runner.network import free_port
+    from .runner.network import free_port, routable_addr
 
     host = coord.rsplit(":", 1)[0]
+    if host == "self":
+        # Cluster integrations (Ray/Spark) can't know which node rank 0
+        # lands on; the sentinel makes process 0 publish its own address.
+        host = routable_addr()
     version = os.environ.get("HOROVOD_WORLD_VERSION", "static")
     scope = f"coord/{version}"
     kv = KVClient(addr, port)

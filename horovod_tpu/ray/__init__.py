@@ -1,0 +1,102 @@
+"""Ray integration: place framework workers as Ray actors.
+
+Parity: ``horovod/ray/runner.py — RayExecutor`` (SURVEY.md §3.5). The
+TPU-native shape: one actor per host (JAX single-controller), the driver
+runs the rendezvous KV server, actors receive the same env contract the
+``hvdrun`` launcher writes (``build_worker_env``), then user functions run
+with ``hvd.init()`` forming the world over DCN.
+
+Ray is an optional dependency — constructing an executor without ray
+installed raises with guidance rather than at import time.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+from ..runner.http.kv_server import RendezvousServer
+from ..runner.network import driver_addr, free_port
+from ..runner.ray_spark_common import task_env as worker_env_for_rank
+
+
+def _require_ray():
+    try:
+        import ray  # noqa: F401
+
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray requires the 'ray' package. Install ray "
+            "(pip install ray) or use the hvdrun launcher "
+            "(horovod_tpu.runner) instead."
+        ) from e
+
+
+class RayExecutor:
+    """Run a function on N framework workers placed as Ray actors.
+
+    Parity surface: ``RayExecutor(settings, num_workers=...)``,
+    ``start()``, ``run(fn, args)``, ``execute(fn)``, ``shutdown()``.
+    """
+
+    def __init__(self, num_workers: int, use_current_placement_group=False,
+                 cpus_per_worker: int = 1, resources_per_worker=None,
+                 cpu_mode: bool = False):
+        self._ray = _require_ray()
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.resources_per_worker = resources_per_worker or {}
+        self.cpu_mode = cpu_mode
+        self._workers: list[Any] = []
+        self._server: RendezvousServer | None = None
+
+    def start(self):
+        ray = self._ray
+        if not ray.is_initialized():
+            ray.init()
+        self._server = RendezvousServer()
+        kv_port = self._server.start()
+        kv_addr = driver_addr([])  # routable address of this driver
+        coord_port = free_port()
+
+        @ray.remote(num_cpus=self.cpus_per_worker,
+                    resources=self.resources_per_worker)
+        class _Worker:
+            def __init__(self, env: dict):
+                os.environ.update(env)
+
+            def run(self, fn, args, kwargs):
+                return fn(*args, **kwargs)
+
+        self._workers = [
+            _Worker.remote(
+                worker_env_for_rank(
+                    r, self.num_workers, kv_addr, kv_port, kv_addr,
+                    coord_port, self.cpu_mode,
+                )
+            )
+            for r in range(self.num_workers)
+        ]
+        return self
+
+    def run(self, fn: Callable, args=(), kwargs=None) -> list:
+        """Execute ``fn`` on every worker; returns per-rank results."""
+        ray = self._ray
+        if not self._workers:
+            raise RuntimeError("call start() before run()")
+        return ray.get([
+            w.run.remote(fn, args, kwargs or {}) for w in self._workers
+        ])
+
+    # Reference alias.
+    execute = run
+
+    def shutdown(self):
+        ray = self._ray
+        for w in self._workers:
+            ray.kill(w)
+        self._workers = []
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

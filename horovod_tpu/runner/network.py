@@ -18,16 +18,22 @@ def is_local(hostname: str) -> bool:
     return hostname in LOCAL_NAMES or hostname == socket.gethostname()
 
 
-def driver_addr(hostnames: list[str]) -> str:
-    """The address workers use to reach services running in the launcher
-    (rendezvous KV). Loopback when the whole world is local; otherwise this
-    host's routable address."""
-    if all(is_local(h) for h in hostnames):
-        return "127.0.0.1"
+def routable_addr() -> str:
+    """This host's address as reachable from other machines."""
     try:
         return socket.gethostbyname(socket.gethostname())
     except OSError:
         return socket.gethostname()
+
+
+def driver_addr(hostnames: list[str]) -> str:
+    """The address workers use to reach services running in the launcher
+    (rendezvous KV). Loopback only when the world is known-local (a
+    NON-EMPTY all-local host list); otherwise this host's routable address
+    — an empty/unknown list must assume remote workers."""
+    if hostnames and all(is_local(h) for h in hostnames):
+        return "127.0.0.1"
+    return routable_addr()
 
 
 def coordinator_addr(hostnames: list[str]) -> str:

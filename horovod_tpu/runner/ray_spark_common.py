@@ -1,0 +1,24 @@
+"""Shared worker-env construction for cluster integrations (Ray/Spark)."""
+
+from __future__ import annotations
+
+from .exec_utils import build_worker_env
+from .hosts import HostInfo, get_host_assignments
+
+
+def task_env(rank: int, size: int, kv_addr: str, kv_port: int,
+             coord_addr: str, coord_port: int,
+             cpu_mode: bool = False) -> dict[str, str]:
+    """The launcher env contract for an externally placed worker (one task
+    per host): same keys ``hvdrun`` writes (see exec_utils)."""
+    hosts = [HostInfo(f"host-{i}", 1) for i in range(size)]
+    assignment = get_host_assignments(hosts)[rank]
+    return build_worker_env(
+        assignment,
+        base_env={},
+        rendezvous_addr=kv_addr,
+        rendezvous_port=kv_port,
+        coordinator_addr=coord_addr,
+        coordinator_port=coord_port,
+        cpu_mode=cpu_mode,
+    )

@@ -1,0 +1,217 @@
+#include "autotune.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+
+#include "logging.h"
+
+namespace hvdrt {
+
+// -- GaussianProcess ---------------------------------------------------------
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (size_t i = 0; i < a.size(); ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return signal_var_ * std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+void GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  size_t n = x.size();
+  x_ = x;
+  // Standardize targets for a stable prior.
+  y_mean_ = 0.0;
+  for (double v : y) y_mean_ += v;
+  y_mean_ /= static_cast<double>(n);
+  double var = 0.0;
+  for (double v : y) var += (v - y_mean_) * (v - y_mean_);
+  y_std_ = std::sqrt(var / std::max<size_t>(1, n - 1));
+  if (y_std_ < 1e-12) y_std_ = 1.0;
+
+  // K + noise I, Cholesky factorization L L^T.
+  std::vector<std::vector<double>> k(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      k[i][j] = k[j][i] = Kernel(x[i], x[j]);
+    }
+    k[i][i] += noise_var_;
+  }
+  l_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double sum = k[i][j];
+      for (size_t p = 0; p < j; ++p) sum -= l_[i][p] * l_[j][p];
+      if (i == j) {
+        l_[i][i] = std::sqrt(std::max(sum, 1e-12));
+      } else {
+        l_[i][j] = sum / l_[j][j];
+      }
+    }
+  }
+  // alpha = K^-1 y' via two triangular solves.
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = (y[i] - y_mean_) / y_std_;
+    for (size_t p = 0; p < i; ++p) sum -= l_[i][p] * z[p];
+    z[i] = sum / l_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double sum = z[ii];
+    for (size_t p = ii + 1; p < n; ++p) sum -= l_[p][ii] * alpha_[p];
+    alpha_[ii] = sum / l_[ii][ii];
+  }
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mu,
+                              double* sigma) const {
+  size_t n = x_.size();
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; ++i) kstar[i] = Kernel(x, x_[i]);
+  double mean = 0.0;
+  for (size_t i = 0; i < n; ++i) mean += kstar[i] * alpha_[i];
+  // v = L^-1 k*; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double sum = kstar[i];
+    for (size_t p = 0; p < i; ++p) sum -= l_[i][p] * v[p];
+    v[i] = sum / l_[i][i];
+  }
+  double var = Kernel(x, x) + noise_var_;
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mu = mean * y_std_ + y_mean_;
+  *sigma = std::sqrt(std::max(var, 1e-12)) * y_std_;
+}
+
+// -- BayesianOptimizer -------------------------------------------------------
+
+BayesianOptimizer::BayesianOptimizer(std::vector<double> lows,
+                                     std::vector<double> highs, uint64_t seed)
+    : lows_(std::move(lows)), highs_(std::move(highs)), rng_(seed) {}
+
+std::vector<double> BayesianOptimizer::Denormalize(
+    const std::vector<double>& unit) const {
+  std::vector<double> out(unit.size());
+  for (size_t i = 0; i < unit.size(); ++i) {
+    out[i] = lows_[i] + unit[i] * (highs_[i] - lows_[i]);
+  }
+  return out;
+}
+
+void BayesianOptimizer::AddSample(const std::vector<double>& params,
+                                  double score) {
+  std::vector<double> unit(params.size());
+  for (size_t i = 0; i < params.size(); ++i) {
+    double span = highs_[i] - lows_[i];
+    unit[i] = span > 0 ? (params[i] - lows_[i]) / span : 0.0;
+    unit[i] = std::clamp(unit[i], 0.0, 1.0);
+  }
+  x_.push_back(unit);
+  y_.push_back(score);
+  if (score > best_score_) {
+    best_score_ = score;
+    best_params_ = params;
+  }
+  gp_.Fit(x_, y_);
+}
+
+std::vector<double> BayesianOptimizer::Suggest() {
+  std::uniform_real_distribution<double> uni(0.0, 1.0);
+  size_t d = lows_.size();
+  if (static_cast<int>(y_.size()) < warmup_ || !gp_.fitted()) {
+    std::vector<double> unit(d);
+    for (auto& u : unit) u = uni(rng_);
+    return Denormalize(unit);
+  }
+  // Expected improvement over 256 random candidates.
+  double best = best_score_;
+  double best_ei = -1.0;
+  std::vector<double> best_unit(d, 0.5);
+  for (int c = 0; c < 256; ++c) {
+    std::vector<double> unit(d);
+    for (auto& u : unit) u = uni(rng_);
+    double mu, sigma;
+    gp_.Predict(unit, &mu, &sigma);
+    double z = (mu - best) / sigma;
+    double cdf = 0.5 * std::erfc(-z / std::sqrt(2.0));
+    double pdf = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+    double ei = (mu - best) * cdf + sigma * pdf;
+    if (ei > best_ei) {
+      best_ei = ei;
+      best_unit = unit;
+    }
+  }
+  return Denormalize(best_unit);
+}
+
+// -- ParameterManager --------------------------------------------------------
+
+ParameterManager::ParameterManager(int64_t initial_threshold,
+                                   double initial_cycle_ms,
+                                   const std::string& log_path)
+    // Search space mirrors the reference's tunables: threshold 0..128 MiB
+    // (log2-ish handled by the GP), cycle 0.5..50 ms.
+    : bo_({0.0, 0.5}, {128.0 * 1024 * 1024, 50.0}),
+      current_threshold_(initial_threshold),
+      current_cycle_ms_(initial_cycle_ms),
+      log_path_(log_path) {}
+
+void ParameterManager::ApplyPoint(const std::vector<double>& p) {
+  current_threshold_ = std::max<int64_t>(1024, static_cast<int64_t>(p[0]));
+  current_cycle_ms_ = std::max(0.1, p[1]);
+}
+
+void ParameterManager::Log(double score) {
+  if (log_path_.empty()) return;
+  FILE* f = std::fopen(log_path_.c_str(), "a");
+  if (f == nullptr) return;
+  std::fprintf(f, "%lld,%.3f,%.1f\n",
+               static_cast<long long>(current_threshold_), current_cycle_ms_,
+               score);
+  std::fclose(f);
+}
+
+bool ParameterManager::Update(int64_t bytes, double seconds) {
+  if (converged_) return false;
+  windows_seen_++;
+  if (windows_seen_ <= warmup_windows_) return false;  // discard warmup
+  window_bytes_ += bytes;
+  window_seconds_ += seconds;
+  int windows_in_sample =
+      windows_seen_ - warmup_windows_ -
+      bo_.num_samples() * window_per_sample_;
+  if (windows_in_sample < window_per_sample_) return false;
+
+  double score = window_seconds_ > 0
+                     ? static_cast<double>(window_bytes_) / window_seconds_
+                     : 0.0;
+  bo_.AddSample({static_cast<double>(current_threshold_), current_cycle_ms_},
+                score);
+  Log(score);
+  window_bytes_ = 0;
+  window_seconds_ = 0.0;
+
+  if (bo_.best_score() > last_best_ * 1.02) {
+    last_best_ = bo_.best_score();
+    no_improve_ = 0;
+  } else {
+    no_improve_++;
+  }
+  if (no_improve_ >= patience_) {
+    converged_ = true;
+    ApplyPoint(bo_.best_params());
+    HVD_LOG(kInfo) << "autotune converged: threshold="
+                   << current_threshold_ << " cycle_ms=" << current_cycle_ms_
+                   << " score=" << bo_.best_score();
+    return true;
+  }
+  ApplyPoint(bo_.Suggest());
+  return true;
+}
+
+}  // namespace hvdrt

@@ -1,0 +1,98 @@
+// Online autotuning: Bayesian optimization of runtime knobs.
+//
+// Reference roles: horovod/common/parameter_manager.{h,cc} +
+// horovod/common/optim/{bayesian_optimization,gaussian_process}.cc.
+// Original implementation: a compact GP (RBF kernel, Cholesky solve, no
+// Eigen) with expected-improvement acquisition over random candidate
+// draws; the ParameterManager scores (fusion_threshold, cycle_time) by
+// observed negotiated throughput and steps the runtime's live knobs.
+#pragma once
+
+#include <cstdint>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace hvdrt {
+
+// Dense symmetric-positive-definite solver pieces for the GP.
+class GaussianProcess {
+ public:
+  // Fit on normalized inputs X in [0,1]^d with targets y (standardized
+  // internally). Complexity O(n^3), n = samples (small by construction).
+  void Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+  // Posterior mean + stddev at a point.
+  void Predict(const std::vector<double>& x, double* mu, double* sigma) const;
+  bool fitted() const { return !x_.empty(); }
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;           // K^-1 (y - mean)
+  std::vector<std::vector<double>> l_;  // Cholesky factor of K + noise I
+  double y_mean_ = 0.0, y_std_ = 1.0;
+  double length_scale_ = 0.2, signal_var_ = 1.0, noise_var_ = 1e-4;
+};
+
+class BayesianOptimizer {
+ public:
+  BayesianOptimizer(std::vector<double> lows, std::vector<double> highs,
+                    uint64_t seed = 42);
+  void AddSample(const std::vector<double>& params, double score);
+  // Next point to try (denormalized). First `warmup` suggestions are
+  // quasi-random exploration; afterwards argmax-EI over random draws.
+  std::vector<double> Suggest();
+  const std::vector<double>& best_params() const { return best_params_; }
+  double best_score() const { return best_score_; }
+  int num_samples() const { return static_cast<int>(y_.size()); }
+
+ private:
+  std::vector<double> Denormalize(const std::vector<double>& unit) const;
+  std::vector<double> lows_, highs_;
+  std::vector<std::vector<double>> x_;  // normalized
+  std::vector<double> y_;
+  std::vector<double> best_params_;
+  double best_score_ = -1e300;
+  GaussianProcess gp_;
+  std::mt19937_64 rng_;
+  int warmup_ = 5;
+};
+
+// Tunes (fusion_threshold_bytes, cycle_time_ms) online from observed
+// throughput. Thread-compatible with the background loop (single caller).
+class ParameterManager {
+ public:
+  ParameterManager(int64_t initial_threshold, double initial_cycle_ms,
+                   const std::string& log_path);
+  // Report one negotiation/execution window: bytes moved + wall seconds.
+  // Returns true if the knobs changed (caller re-reads getters).
+  bool Update(int64_t bytes, double seconds);
+  int64_t fusion_threshold() const { return current_threshold_; }
+  double cycle_time_ms() const { return current_cycle_ms_; }
+  // After convergence (no improvement for `patience` suggestions) the
+  // manager pins the best point and stops exploring.
+  bool converged() const { return converged_; }
+
+ private:
+  void ApplyPoint(const std::vector<double>& p);
+  void Log(double score);
+
+  BayesianOptimizer bo_;
+  int64_t current_threshold_;
+  double current_cycle_ms_;
+  std::string log_path_;
+  // Sampling state: accumulate a window before scoring a point.
+  int64_t window_bytes_ = 0;
+  double window_seconds_ = 0.0;
+  int windows_seen_ = 0;
+  int warmup_windows_ = 3;   // discard initial windows (compile warmup)
+  int window_per_sample_ = 5;
+  bool converged_ = false;
+  double last_best_ = -1e300;
+  int no_improve_ = 0;
+  int patience_ = 10;
+};
+
+}  // namespace hvdrt

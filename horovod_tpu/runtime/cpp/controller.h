@@ -81,6 +81,13 @@ class Controller {
 
   ResponseCache& cache() { return cache_; }
 
+  // Live autotune hook: the background loop re-points the fusion budget
+  // when the ParameterManager steps (reference: ParameterManager feeding
+  // Controller's fusion threshold).
+  void set_fusion_threshold(int64_t bytes) {
+    config_.fusion_threshold_bytes = bytes;
+  }
+
  private:
   Status CoordinatorCycle(const RequestList& mine, ResponseList* out);
   void FuseResponses(std::vector<Response>* responses);

@@ -26,6 +26,7 @@
 #include "controller.h"
 #include "logging.h"
 #include "message.h"
+#include "autotune.h"
 #include "timeline.h"
 #include "transport.h"
 
@@ -47,6 +48,7 @@ struct GlobalState {
 
   std::unique_ptr<Transport> transport;
   std::unique_ptr<Controller> controller;
+  std::unique_ptr<ParameterManager> autotune;
   Timeline timeline;
   Config config;
   bool mark_cycles = false;
@@ -249,9 +251,22 @@ bool RunLoopOnce(GlobalState* st) {
     FailAllPending(st, "control plane failed: " + s.error);
     return false;
   }
+  double exec_start = NowSeconds();
+  int64_t cycle_bytes = 0;
   for (const auto& resp : responses.responses) {
     for (const auto& name : resp.tensor_names) st->timeline.End(name);
+    if (resp.error.empty()) {
+      for (int64_t c : resp.counts) {
+        cycle_bytes += c * static_cast<int64_t>(DTypeSize(resp.dtype));
+      }
+    }
     PerformOperation(st, resp);
+  }
+  if (st->autotune && cycle_bytes > 0) {
+    if (st->autotune->Update(cycle_bytes, NowSeconds() - exec_start)) {
+      st->controller->set_fusion_threshold(st->autotune->fusion_threshold());
+      st->config.cycle_time_ms = st->autotune->cycle_time_ms();
+    }
   }
   if (st->mark_cycles) st->timeline.Mark("cycle");
   st->cycles.fetch_add(1);
@@ -336,6 +351,12 @@ int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
     return -1;
   }
   st->controller.reset(new Controller(st->transport.get(), st->config));
+  if (EnvInt("HOROVOD_AUTOTUNE", 0) != 0) {
+    const char* at_log = std::getenv("HOROVOD_AUTOTUNE_LOG");
+    st->autotune.reset(new ParameterManager(
+        st->config.fusion_threshold_bytes, st->config.cycle_time_ms,
+        at_log ? at_log : ""));
+  }
   st->timeline.Initialize(st->config.timeline_path, rank);
   st->background = std::thread([st] { BackgroundThreadLoop(st); });
   st->initialized.store(true);
@@ -473,5 +494,57 @@ long long hvdrt_cycles() {
 }
 
 const char* hvdrt_last_error() { return tl_last_error.c_str(); }
+
+// -- generic Bayesian optimizer (Python-side autotuning reuses the native
+// implementation; reference: bayesian_optimization.cc) ----------------------
+
+static std::mutex bo_mu;
+static std::unordered_map<int, std::unique_ptr<BayesianOptimizer>> bo_table;
+static int bo_next_id = 1;
+
+int hvdrt_bo_new(int dims, const double* lows, const double* highs,
+                 long long seed) {
+  std::lock_guard<std::mutex> lock(bo_mu);
+  int id = bo_next_id++;
+  bo_table[id].reset(new BayesianOptimizer(
+      std::vector<double>(lows, lows + dims),
+      std::vector<double>(highs, highs + dims),
+      static_cast<uint64_t>(seed)));
+  return id;
+}
+
+int hvdrt_bo_add(int id, const double* params, int dims, double score) {
+  std::lock_guard<std::mutex> lock(bo_mu);
+  auto it = bo_table.find(id);
+  if (it == bo_table.end()) return -1;
+  it->second->AddSample(std::vector<double>(params, params + dims), score);
+  return 0;
+}
+
+int hvdrt_bo_suggest(int id, double* out, int dims) {
+  std::lock_guard<std::mutex> lock(bo_mu);
+  auto it = bo_table.find(id);
+  if (it == bo_table.end()) return -1;
+  std::vector<double> p = it->second->Suggest();
+  if (static_cast<int>(p.size()) != dims) return -1;
+  for (int i = 0; i < dims; ++i) out[i] = p[i];
+  return 0;
+}
+
+double hvdrt_bo_best(int id, double* out, int dims) {
+  std::lock_guard<std::mutex> lock(bo_mu);
+  auto it = bo_table.find(id);
+  if (it == bo_table.end()) return -1e300;
+  const auto& p = it->second->best_params();
+  if (out != nullptr && static_cast<int>(p.size()) == dims) {
+    for (int i = 0; i < dims; ++i) out[i] = p[i];
+  }
+  return it->second->best_score();
+}
+
+int hvdrt_bo_free(int id) {
+  std::lock_guard<std::mutex> lock(bo_mu);
+  return bo_table.erase(id) ? 0 : -1;
+}
 
 }  // extern "C"

@@ -1,0 +1,72 @@
+"""Spark integration: run framework jobs inside Spark executors.
+
+Parity: ``horovod.spark.run()`` (SURVEY.md §3.5) — launch one framework
+worker per Spark task in a barrier stage, driver hosting the rendezvous KV.
+The Estimator API (KerasEstimator/TorchEstimator) is out of scope for the
+JAX-native build; ``run()`` covers the launch substrate the estimators sit
+on. pyspark is optional — calling without it raises with guidance.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+from ..runner.network import driver_addr, free_port
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.spark requires the 'pyspark' package. Install "
+            "pyspark or use the hvdrun launcher (horovod_tpu.runner) "
+            "instead."
+        ) from e
+
+
+def run(fn: Callable, args=(), kwargs=None, num_proc: int | None = None,
+        spark_context=None) -> list:
+    """Run ``fn`` on ``num_proc`` Spark executors as one framework world.
+
+    Parity: ``horovod.spark.run(fn, args, num_proc)``. Uses a barrier-mode
+    mapPartitions stage so all workers start together; each task applies
+    the launcher env contract, calls ``fn``, returns its result to the
+    driver (rank order preserved).
+    """
+    _require_pyspark()
+    from pyspark import SparkContext
+
+    from ..runner.ray_spark_common import task_env  # shared env builder
+
+    sc = spark_context or SparkContext.getOrCreate()
+    n = num_proc or int(sc.defaultParallelism)
+    from ..runner.http.kv_server import RendezvousServer
+
+    server = RendezvousServer()
+    kv_port = server.start()
+    kv_addr = driver_addr([])
+    coord_port = free_port()
+    kwargs = kwargs or {}
+
+    def task(iterator):
+        from pyspark import BarrierTaskContext
+
+        ctx = BarrierTaskContext.get()
+        rank = ctx.partitionId()
+        os.environ.update(
+            task_env(rank, n, kv_addr, kv_port, kv_addr, coord_port)
+        )
+        ctx.barrier()
+        yield rank, fn(*args, **kwargs)
+
+    try:
+        results = (
+            sc.parallelize(range(n), n).barrier().mapPartitions(task).collect()
+        )
+        return [r for _, r in sorted(results)]
+    finally:
+        server.stop()
