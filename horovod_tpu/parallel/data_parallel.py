@@ -87,7 +87,16 @@ def shard_batch(batch, mesh=None, axis_name: str | None = None):
 
 
 def replicate(tree, mesh=None):
-    """Place params/opt_state replicated over the mesh."""
+    """Place params/opt_state replicated over the mesh.
+
+    Always copies: the result owns fresh buffers, so donating it to a
+    jitted step (``donate_argnums``) can never invalidate the caller's
+    source arrays. ``jax.device_put`` alone aliases the source into shard 0
+    of the replicated array (even with ``may_alias=False``), and a donated
+    step then silently deletes the original tree; the explicit ``jnp.copy``
+    breaks that alias.
+    """
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding
 
     from .. import basics
@@ -95,4 +104,9 @@ def replicate(tree, mesh=None):
     if mesh is None:
         mesh = basics.global_mesh()
     sharding = NamedSharding(mesh, P())
-    return jax.tree.map(partial(jax.device_put, device=sharding), tree)
+
+    def _copy_put(leaf):
+        leaf = jnp.copy(leaf) if isinstance(leaf, jax.Array) else leaf
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree.map(_copy_put, tree)
