@@ -2,7 +2,9 @@
 
 Prints ONE JSON line:
   {"metric": "resnet50_images_per_sec", "value": N, "unit": "images/sec",
-   "vs_baseline": R}
+   "vs_baseline": R, "step_time_ms": ..., "step_time_spread": ...,
+   "mfu": ..., "global_batch": ..., "n_devices": ..., "backend": ...,
+   "device_kind": ...}
 
 ``vs_baseline`` is framework efficiency: our DistributedOptimizer step's
 throughput divided by a hand-written raw-JAX step's throughput on the same
@@ -107,6 +109,7 @@ RESNET50_TRAIN_FLOPS_PER_IMAGE_224 = 3 * 2 * 4.089e9
 # bf16 peak FLOPs/s per chip by device kind (dense, no sparsity).
 _CHIP_PEAK_FLOPS = {
     "v6e": 918e12,
+    "v6 lite": 918e12,
     "v5p": 459e12,
     "v5e": 197e12,
     "v5 lite": 197e12,
@@ -135,7 +138,9 @@ def main() -> int:
     hvd.init()
     n = hvd.size()
     on_tpu = jax.default_backend() == "tpu"
-    per_chip_batch = 64 if on_tpu else 4
+    # 128/chip saturates the v5e MXU for ResNet-50 (measured: 64→24.5% MFU,
+    # 128→30.3%, 256→30.3% — same throughput, double latency).
+    per_chip_batch = 128 if on_tpu else 4
     image = 224 if on_tpu else 32
     global_batch = per_chip_batch * n
 

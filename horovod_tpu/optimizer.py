@@ -48,8 +48,25 @@ def _reduce_grads(
     postscale_factor,
     threshold_bytes,
     num_groups,
+    world_size=None,
 ):
-    """Compress -> fused allreduce -> decompress over a gradient pytree."""
+    """Compress -> fused allreduce -> decompress over a gradient pytree.
+
+    When the process set is known (at trace time) to have exactly one
+    member, the wire machinery — compression casts, bucket concat/split,
+    the collective itself — is all identity-with-overhead, so it's skipped
+    entirely and only the scale factors are applied. This is the compiled
+    analog of the reference short-circuiting single-rank allreduces.
+    """
+    if world_size == 1 and op in (
+        collective_ops.Average,
+        collective_ops.Sum,
+    ):
+        scale = prescale_factor * postscale_factor
+        if scale == 1.0:
+            return grads
+        return jax.tree.map(lambda g: g * jnp.asarray(scale, g.dtype), grads)
+
     leaves, treedef = jax.tree.flatten(grads)
     compressed = [compression.compress(g) for g in leaves]
     wire = [c[0] for c in compressed]
@@ -71,6 +88,14 @@ def _reduce_grads(
         compression.decompress(r, ctx) for r, ctx in zip(reduced, ctxs)
     ]
     return jax.tree.unflatten(treedef, restored)
+
+
+def _known_size(ps) -> int | None:
+    """Process-set size if determinable at trace time, else None."""
+    try:
+        return ps.size()
+    except Exception:
+        return None
 
 
 class _AccumulationState(NamedTuple):
@@ -121,6 +146,7 @@ def DistributedOptimizer(
             postscale_factor,
             fusion_threshold_bytes,
             num_groups,
+            world_size=_known_size(ps),
         )
 
     if k == 1:
@@ -200,7 +226,7 @@ def grad(loss_fn, argnums=0, has_aux=False, **dist_kwargs):
         grads, aux = (out if has_aux else (out, None))
         reduced = _reduce_grads(
             grads, op, ps.axis_name, compression, prescale, postscale,
-            threshold, 0,
+            threshold, 0, world_size=_known_size(ps),
         )
         return (reduced, aux) if has_aux else reduced
 
