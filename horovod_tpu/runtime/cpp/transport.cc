@@ -1,7 +1,9 @@
 #include "transport.h"
 
 #include <arpa/inet.h>
+#include <cerrno>
 #include <netinet/in.h>
+#include <poll.h>
 #include <sys/socket.h>
 
 #include <algorithm>
@@ -194,6 +196,7 @@ Status Transport::Create(int rank, int size, const std::string& coord_addr,
                          int coord_port, double timeout_s,
                          std::unique_ptr<Transport>* out) {
   std::unique_ptr<Transport> t(new Transport(rank, size));
+  t->timeout_s_ = timeout_s;
   if (size == 1) {
     *out = std::move(t);
     return Status::OK();
@@ -368,6 +371,80 @@ void ChunkLayout(int64_t count, int size, std::vector<int64_t>* offsets,
 }
 }  // namespace
 
+Status Transport::RingExchange(const void* send_buf, size_t send_n,
+                               void* recv_buf, size_t recv_n) {
+  const char* out = static_cast<const char*>(send_buf);
+  char* in = static_cast<char*>(recv_buf);
+  size_t sent = 0, recvd = 0;
+  // Inactivity deadline from the Create-time timeout (<=0 = block forever):
+  // re-armed whenever bytes move in either direction. This bounds true
+  // deadlock (zero progress) without capping how long a slow-but-moving
+  // link may take; stalled-but-alive *peers* are the stall inspector's job,
+  // a dead wire is ours.
+  const bool bounded = timeout_s_ > 0;
+  double deadline = bounded ? NowSeconds() + timeout_s_ : 0.0;
+  while (sent < send_n || recvd < recv_n) {
+    struct pollfd fds[2];
+    int nfds = 0;
+    int send_idx = -1, recv_idx = -1;
+    if (sent < send_n) {
+      fds[nfds].fd = succ_.fd();
+      fds[nfds].events = POLLOUT;
+      fds[nfds].revents = 0;
+      send_idx = nfds++;
+    }
+    if (recvd < recv_n) {
+      fds[nfds].fd = pred_.fd();
+      fds[nfds].events = POLLIN;
+      fds[nfds].revents = 0;
+      recv_idx = nfds++;
+    }
+    int poll_ms = -1;
+    if (bounded) {
+      double remain = deadline - NowSeconds();
+      if (remain <= 0) {
+        return Status::Error("ring exchange made no progress for " +
+                             std::to_string(timeout_s_) + "s");
+      }
+      poll_ms = static_cast<int>(remain * 1e3) + 1;
+    }
+    int rc = ::poll(fds, nfds, poll_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return Status::Error(std::string("ring exchange poll failed: ") +
+                           std::strerror(errno));
+    }
+    if (rc == 0) continue;  // deadline check at loop top
+    bool progressed = false;
+    if (send_idx >= 0 && (fds[send_idx].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t n = ::send(succ_.fd(), out + sent, send_n - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (n > 0) {
+        sent += static_cast<size_t>(n);
+        progressed = true;
+      } else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK &&
+                 errno != EINTR) {
+        return Status::Error(std::string("ring exchange send failed: ") +
+                             std::strerror(errno));
+      }
+    }
+    if (recv_idx >= 0 && (fds[recv_idx].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t n = ::recv(pred_.fd(), in + recvd, recv_n - recvd, MSG_DONTWAIT);
+      if (n > 0) {
+        recvd += static_cast<size_t>(n);
+        progressed = true;
+      } else if (n == 0) {
+        return Status::Error("ring peer closed connection");
+      } else if (errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR) {
+        return Status::Error(std::string("ring exchange recv failed: ") +
+                             std::strerror(errno));
+      }
+    }
+    if (progressed && bounded) deadline = NowSeconds() + timeout_s_;
+  }
+  return Status::OK();
+}
+
 Status Transport::RingReduceScatterInplace(char* data, int64_t count,
                                            DType dtype, ReduceOp op,
                                            std::vector<int64_t>* offsets,
@@ -382,10 +459,9 @@ Status Transport::RingReduceScatterInplace(char* data, int64_t count,
     int64_t send_n = (*chunk_counts)[send_chunk];
     int64_t recv_n = (*chunk_counts)[recv_chunk];
     recv_buf.resize(static_cast<size_t>(recv_n) * elem);
-    Status s = succ_.WriteAll(data + (*offsets)[send_chunk] * elem,
-                              static_cast<size_t>(send_n) * elem);
-    if (!s.ok) return s;
-    s = pred_.ReadAll(recv_buf.data(), recv_buf.size());
+    Status s = RingExchange(data + (*offsets)[send_chunk] * elem,
+                            static_cast<size_t>(send_n) * elem,
+                            recv_buf.data(), recv_buf.size());
     if (!s.ok) return s;
     ReduceBuffers(data + (*offsets)[recv_chunk] * elem, recv_buf.data(),
                   recv_n, dtype, op);
@@ -402,12 +478,11 @@ Status Transport::RingAllgatherChunks(char* data,
   for (int step = 0; step < size_ - 1; ++step) {
     int send_chunk = (rank_ + owner_shift - step + size_ * 2) % size_;
     int recv_chunk = (rank_ + owner_shift - step - 1 + size_ * 2) % size_;
-    Status s = succ_.WriteAll(
+    Status s = RingExchange(
         data + offsets[send_chunk] * elem,
-        static_cast<size_t>(chunk_counts[send_chunk]) * elem);
-    if (!s.ok) return s;
-    s = pred_.ReadAll(data + offsets[recv_chunk] * elem,
-                      static_cast<size_t>(chunk_counts[recv_chunk]) * elem);
+        static_cast<size_t>(chunk_counts[send_chunk]) * elem,
+        data + offsets[recv_chunk] * elem,
+        static_cast<size_t>(chunk_counts[recv_chunk]) * elem);
     if (!s.ok) return s;
   }
   return Status::OK();
@@ -446,14 +521,26 @@ Status Transport::Allgather(const void* input, void* output, int64_t count,
 Status Transport::Broadcast(void* buf, int64_t count, DType dtype, int root) {
   if (size_ == 1) return Status::OK();
   size_t bytes = static_cast<size_t>(count) * DTypeSize(dtype);
-  // Ring pipeline from root; root's predecessor is the sink.
-  if (rank_ == root) {
-    return succ_.WriteAll(buf, bytes);
-  }
-  Status s = pred_.ReadAll(buf, bytes);
-  if (!s.ok) return s;
-  if ((rank_ + 1) % size_ != root) {
-    return succ_.WriteAll(buf, bytes);
+  // Ring pipeline from root in 1 MiB segments: each non-root rank forwards
+  // segment k while segment k+1 is still in flight upstream, so large
+  // buffers stream through the chain instead of store-and-forwarding whole.
+  // Root's predecessor is the sink (chain, not cycle — no deadlock risk).
+  constexpr size_t kSeg = 1 << 20;
+  char* data = static_cast<char*>(buf);
+  bool is_sink = ((rank_ + 1) % size_ == root);
+  for (size_t off = 0; off < bytes; off += kSeg) {
+    size_t n = std::min(kSeg, bytes - off);
+    if (rank_ == root) {
+      Status s = succ_.WriteAll(data + off, n);
+      if (!s.ok) return s;
+    } else {
+      Status s = pred_.ReadAll(data + off, n);
+      if (!s.ok) return s;
+      if (!is_sink) {
+        s = succ_.WriteAll(data + off, n);
+        if (!s.ok) return s;
+      }
+    }
   }
   return Status::OK();
 }
@@ -505,10 +592,9 @@ Status Transport::Reducescatter(const void* input, void* output, int64_t count,
     // API contract is "rank r keeps chunk r". Chunk r sits on rank r-1, so
     // ONE forward ring rotation delivers every chunk to its home rank.
     int have = (rank_ + 1) % size_;
-    Status ss = succ_.WriteAll(work.data() + offsets[have] * elem,
-                               static_cast<size_t>(chunk) * elem);
-    if (!ss.ok) return ss;
-    ss = pred_.ReadAll(output, static_cast<size_t>(chunk) * elem);
+    Status ss = RingExchange(work.data() + offsets[have] * elem,
+                             static_cast<size_t>(chunk) * elem, output,
+                             static_cast<size_t>(chunk) * elem);
     if (!ss.ok) return ss;
   } else {
     std::memcpy(output, work.data(), static_cast<size_t>(chunk) * elem);

@@ -49,6 +49,13 @@ class Transport {
 
  private:
   Transport(int rank, int size) : rank_(rank), size_(size) {}
+  // Full-duplex neighbor exchange: send `send_n` bytes to the successor
+  // while receiving `recv_n` bytes from the predecessor, making progress on
+  // whichever direction the kernel can take (poll + nonblocking IO). The
+  // blocking send-then-receive alternative deadlocks once a chunk exceeds
+  // kernel TCP buffering: every rank sits in write() with no one reading.
+  Status RingExchange(const void* send_buf, size_t send_n, void* recv_buf,
+                      size_t recv_n);
   Status RingReduceScatterInplace(char* data, int64_t count, DType dtype,
                                   ReduceOp op, std::vector<int64_t>* offsets,
                                   std::vector<int64_t>* chunk_counts);
@@ -57,6 +64,9 @@ class Transport {
                              size_t elem, int owner_shift);
 
   int rank_, size_;
+  // Inactivity bound for ring exchanges (from Create's timeout_s; <=0 =
+  // block forever).
+  double timeout_s_ = 0.0;
   // Control: root holds size-1 worker sockets (index rank-1); workers hold
   // one socket to root.
   std::vector<Socket> control_;

@@ -108,6 +108,28 @@ WORKER = textwrap.dedent(
         w.synchronize(h)
         print(f"rank{rank} stall-resolved ok", flush=True)
         w.shutdown()
+    elif mode == "large":
+        # Regression: ring steps used blocking send-then-recv, which
+        # deadlocks once a chunk exceeds kernel TCP buffering (~MBs). A
+        # 128 MB allreduce must complete and be numerically right.
+        n = 32 * 1024 * 1024  # 128 MB of f32
+        x = (np.arange(n) % 997).astype(np.float32) + rank
+        out = np.asarray(w.allreduce(x, "big.ar", op="sum"))
+        R = np.arange(size)
+        want_head = (np.arange(64) % 997).astype(np.float32) * size + R.sum()
+        check(out[:64], want_head, "big.allreduce.head")
+        tail_idx = np.arange(n - 64, n)
+        want_tail = (tail_idx % 997).astype(np.float32) * size + R.sum()
+        check(out[-64:], want_tail, "big.allreduce.tail")
+        mid = n // 2
+        want_mid = (np.arange(mid, mid + 8) % 997) * size + R.sum()
+        check(out[mid:mid + 8], want_mid, "big.allreduce.mid")
+        # Large broadcast streams through the pipelined chain.
+        b = np.asarray(w.broadcast(
+            np.full(8 * 1024 * 1024, float(rank), np.float32), 0, "big.bc"))
+        check(b[::1024 * 1024], 0.0, "big.broadcast")
+        print(f"rank{rank} large ok", flush=True)
+        w.shutdown()
     elif mode == "peerdeath":
         if rank == size - 1:
             w.allreduce(np.ones(4, np.float32), "pd.warmup", op="sum")
@@ -178,6 +200,16 @@ class TestNativeRuntime:
         results = _run_world(tmp_path, 1, "battery")
         rc, out, err = results[0]
         assert rc == 0, f"{out}\n{err}"
+
+    @pytest.mark.slow
+    def test_large_tensor_ring_no_deadlock(self, tmp_path):
+        # 128 MB allreduce between 2 ranks: chunks (64 MB) far exceed kernel
+        # TCP buffering, so this deadlocks unless ring steps overlap send
+        # and receive (RingExchange).
+        results = _run_world(tmp_path, 2, "large", timeout=120)
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
+            assert f"rank{r} large ok" in out
 
     def test_stall_inspector_warns_then_resolves(self, tmp_path):
         results = _run_world(
