@@ -207,14 +207,18 @@ def is_initialized() -> bool:
     return _state.initialized
 
 
-def in_axis_scope(axis_name: str) -> bool:
+def in_axis_scope(axis_name) -> bool:
     """True when called under shard_map/pmap with `axis_name` bound.
 
     The single shared probe used by every dual-regime API (rank(),
     local_rank(), the collective ops) to decide traced vs eager dispatch.
+    Accepts a tuple of axis names (the hierarchical ``(cross, local)``
+    mesh); all must be bound.
     """
     import jax
 
+    if isinstance(axis_name, (tuple, list)):
+        return all(in_axis_scope(a) for a in axis_name)
     try:
         jax.lax.axis_index(axis_name)
         return True
@@ -222,12 +226,23 @@ def in_axis_scope(axis_name: str) -> bool:
         return False
 
 
-def _axis_index_or_none(axis_name: str):
-    """Per-device rank if called under a mapped axis, else None."""
+def _axis_index_or_none(axis_name):
+    """Per-device rank if called under a mapped axis, else None.
+
+    Falls back to the hierarchical ``(cross, local)`` axes when the flat
+    axis is unbound: ``lax.axis_index`` over the tuple yields the
+    flattened (cross-major) index, which is the rank order of the
+    hierarchical mesh.
+    """
     import jax
 
     if in_axis_scope(axis_name):
         return jax.lax.axis_index(axis_name)
+    if axis_name == _state.axis_name:
+        from .parallel.hierarchical import HIERARCHICAL_AXES
+
+        if in_axis_scope(HIERARCHICAL_AXES):
+            return jax.lax.axis_index(HIERARCHICAL_AXES)
     return None
 
 

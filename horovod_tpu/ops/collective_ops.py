@@ -58,11 +58,29 @@ def _resolve_process_set(process_set):
     return process_set
 
 
-def _in_axis_scope(axis_name: str) -> bool:
+def _in_axis_scope(axis_name) -> bool:
     """True when called under shard_map/pmap with `axis_name` bound."""
     from ..basics import in_axis_scope
 
     return in_axis_scope(axis_name)
+
+
+def _effective_traced_axis(ps):
+    """The axis (name or hierarchical tuple) bound in the current trace.
+
+    Inside a shard_map over the process set's own axis, that's the axis;
+    inside a shard_map over the hierarchical ``(cross, local)`` mesh (only
+    meaningful for the global set), it's the axis tuple — collectives then
+    take the two-level form. None → not in a trace (eager regime).
+    """
+    if _in_axis_scope(ps.axis_name):
+        return ps.axis_name
+    if ps.process_set_id == 0:
+        from ..parallel.hierarchical import HIERARCHICAL_AXES
+
+        if _in_axis_scope(HIERARCHICAL_AXES):
+            return HIERARCHICAL_AXES
+    return None
 
 
 def _axis_size(axis_name: str) -> int:
@@ -75,6 +93,23 @@ def _axis_size(axis_name: str) -> int:
 
 
 def _allreduce_traced(x, op, axis_name, prescale_factor, postscale_factor):
+    if isinstance(axis_name, (tuple, list)) and len(axis_name) == 2:
+        # Hierarchical (cross, local) axes: Sum/Average/Adasum take the
+        # two-level ICI+DCN composition (reduce-scatter local → allreduce
+        # cross → allgather local); Min/Max/Product fall through — lax
+        # reduces over an axis tuple directly.
+        if op in (Sum, Average, Adasum):
+            from ..parallel.hierarchical import hierarchical_allreduce
+
+            return hierarchical_allreduce(
+                x,
+                op,
+                cross_axis=axis_name[0],
+                local_axis=axis_name[1],
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+        axis_name = tuple(axis_name)
     if prescale_factor != 1.0:
         x = x * jnp.asarray(prescale_factor, dtype=x.dtype)
     if op == Sum:
@@ -235,9 +270,10 @@ def allreduce(
     del name  # names exist for the reference's negotiation; nothing to key here
     op = _resolve_op(op, average)
     ps = _resolve_process_set(process_set)
-    if _in_axis_scope(ps.axis_name):
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
         return _allreduce_traced(
-            tensor, op, ps.axis_name, prescale_factor, postscale_factor
+            tensor, op, traced_axis, prescale_factor, postscale_factor
         )
     traced = functools.partial(
         _allreduce_traced,
@@ -268,13 +304,14 @@ def grouped_allreduce(
     """
     op = _resolve_op(op, average)
     ps = _resolve_process_set(process_set)
-    if _in_axis_scope(ps.axis_name):
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
         from .fusion import fused_allreduce
 
         return fused_allreduce(
             list(tensors),
             op=op,
-            axis_name=ps.axis_name,
+            axis_name=traced_axis,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
         )
@@ -299,8 +336,9 @@ def allgather(tensor, process_set=None, name: str | None = None):
     """
     del name
     ps = _resolve_process_set(process_set)
-    if _in_axis_scope(ps.axis_name):
-        return _allgather_traced(tensor, ps.axis_name)
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
+        return _allgather_traced(tensor, traced_axis)
 
     # Eager stacked form: (n, d0, ...) -> (n, n*d0, ...): every row holds the
     # concatenation. all_gather(tiled) inside gives per-shard (n*d0, ...).
@@ -327,8 +365,9 @@ def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None)
             f"root_rank {root_rank} (a global rank) is not a member of "
             f"process set {ps.ranks}"
         ) from None
-    if _in_axis_scope(ps.axis_name):
-        return _broadcast_traced(tensor, relative_root, ps.axis_name)
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
+        return _broadcast_traced(tensor, relative_root, traced_axis)
 
     def traced(x):
         return _broadcast_traced(x, relative_root, ps.axis_name)
@@ -352,8 +391,9 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
             "horovod_tpu.ops.fusion.pad_to_multiple)"
         )
     ps = _resolve_process_set(process_set)
-    if _in_axis_scope(ps.axis_name):
-        return _alltoall_traced(tensor, ps.axis_name)
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
+        return _alltoall_traced(tensor, traced_axis)
 
     def traced(x):
         return _alltoall_traced(x, ps.axis_name)
@@ -377,9 +417,10 @@ def reducescatter(
     del name
     op = _resolve_op(op, None) if op is not None else Average
     ps = _resolve_process_set(process_set)
-    if _in_axis_scope(ps.axis_name):
+    traced_axis = _effective_traced_axis(ps)
+    if traced_axis is not None:
         return _reducescatter_traced(
-            tensor, op, ps.axis_name, prescale_factor, postscale_factor
+            tensor, op, traced_axis, prescale_factor, postscale_factor
         )
 
     def traced(x):

@@ -137,10 +137,16 @@ def DistributedOptimizer(
         raise ValueError("backward_passes_per_step must be >= 1")
 
     def reduce_fn(grads):
+        # Trace-time axis resolution: inside a step shard_mapped over the
+        # hierarchical (cross, local) mesh the reduction takes the two-level
+        # form automatically (HOROVOD_HIERARCHICAL_ALLREDUCE's consumer).
+        from .ops.collective_ops import _effective_traced_axis
+
+        effective = _effective_traced_axis(ps) or axis_name
         return _reduce_grads(
             grads,
             op,
-            axis_name,
+            effective,
             compression,
             prescale_factor,
             postscale_factor,

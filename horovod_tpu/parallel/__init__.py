@@ -1,5 +1,13 @@
 from .mesh import MeshSpec, build_mesh  # noqa: F401
 from .data_parallel import make_train_step  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    CROSS_AXIS,
+    HIERARCHICAL_AXES,
+    LOCAL_AXIS,
+    hierarchical_allreduce,
+    hierarchical_mesh,
+    host_hierarchical_allreduce,
+)
 from .sequence import (  # noqa: F401
     make_sp_attention_step,
     ring_attention,

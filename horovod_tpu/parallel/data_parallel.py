@@ -27,6 +27,7 @@ def make_train_step(
     axis_name: str | None = None,
     donate: bool = True,
     loss_is_averaged: bool = True,
+    hierarchical: bool | tuple | None = None,
 ):
     """Build a jitted SPMD train step.
 
@@ -38,6 +39,12 @@ def make_train_step(
       mesh: defaults to the global 1-D 'hvd' mesh from ``init()``.
       axis_name: collective axis (defaults to the global axis).
       loss_is_averaged: if True the reported loss is pmean'd across shards.
+      hierarchical: two-level (cross, local) sharding — the consumer of
+        ``HOROVOD_HIERARCHICAL_ALLREDUCE`` (reference:
+        ``NCCLHierarchicalAllreduce``). None → follow the env flag; True →
+        mesh from host topology; a ``(cross, local)`` tuple → explicit
+        factors. The DistributedOptimizer then reduces gradients
+        reduce-scatter(ICI) → allreduce(DCN) → allgather(ICI).
 
     Returns:
       ``step(params, opt_state, batch) -> (params, opt_state, loss)``,
@@ -48,6 +55,30 @@ def make_train_step(
 
     from .. import basics
 
+    from_env = hierarchical is None
+    if from_env:
+        cfg = basics._state.config
+        hierarchical = bool(cfg and cfg.hierarchical_allreduce)
+    if hierarchical and mesh is not None:
+        if not from_env:
+            raise ValueError(
+                "pass either hierarchical=... or mesh=, not both (an "
+                "explicit mesh defines its own axes)"
+            )
+        # Env flag + explicit mesh: the explicit mesh wins, loudly.
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE is set but make_train_step got "
+            "an explicit mesh; using the explicit mesh (flat reduction)"
+        )
+        hierarchical = False
+    if hierarchical:
+        from .hierarchical import HIERARCHICAL_AXES, hierarchical_mesh
+
+        factors = hierarchical if isinstance(hierarchical, tuple) else (None, None)
+        mesh = hierarchical_mesh(*factors)
+        axis_name = HIERARCHICAL_AXES
     if mesh is None:
         mesh = basics.global_mesh()
     if axis_name is None:

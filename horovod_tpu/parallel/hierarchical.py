@@ -1,0 +1,224 @@
+"""Hierarchical (two-level) allreduce: the ICI+DCN composition.
+
+Reference role: ``NCCLHierarchicalAllreduce``
+(``horovod/common/ops/nccl_operations.cc``) — NCCL reduce-scatter within a
+node, MPI allreduce across nodes on host, NCCL allgather within the node,
+enabled by ``HOROVOD_HIERARCHICAL_ALLREDUCE``. The TPU mapping (SURVEY.md
+§6): the fast "intra" leg is the ICI mesh inside a slice, the slow "cross"
+leg is DCN between hosts/slices.
+
+Two forms, mirroring the framework's two regimes:
+
+- **Traced**: over a 2-D ``(cross, local)`` mesh —
+  ``psum_scatter`` over the local axis → ``psum`` over the cross axis →
+  ``all_gather`` over the local axis. Each device moves 1/local_size of
+  the payload across the slow axis instead of the whole tensor, which is
+  exactly the reference's bandwidth argument for the NCCL+MPI composition.
+  Build the mesh with :func:`hierarchical_mesh`; inside a
+  ``shard_map`` over both axes every collective op accepts the
+  ``(cross, local)`` axis tuple transparently. Rank-order caveat: the
+  hierarchical mesh's rank order is host-grouped (cross-major), which on
+  interleaved ICI topologies differs from the canonical flat rank order —
+  reductions are unaffected, but rank-sensitive ops (allgather
+  concatenation, broadcast root, alltoall blocks, ``hvd.rank()``) follow
+  the host-grouped order inside a hierarchical step.
+
+- **Host/eager**: each controller process reduces its local shards with
+  XLA, then the **cross-process leg runs through the native C++ runtime**
+  (``horovod_tpu.runtime.NativeWorld`` — negotiation, fusion, response
+  cache, ring TCP), making libhvdrt the DCN leg the way MPI was for the
+  reference. See :func:`host_hierarchical_allreduce`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+CROSS_AXIS = "hvd_cross"
+LOCAL_AXIS = "hvd_local"
+HIERARCHICAL_AXES = (CROSS_AXIS, LOCAL_AXIS)
+
+
+def hierarchical_mesh(cross_size: int | None = None,
+                      local_size: int | None = None) -> Mesh:
+    """A 2-D ``(cross, local)`` mesh over the world's devices in ICI order.
+
+    Defaults to the topology's host structure (``cross_size`` hosts ×
+    ``local_size`` chips per host) so the local axis rides ICI and the
+    cross axis spans DCN. The canonical ICI rank order does NOT group a
+    host's chips contiguously (``topology.py``), so rows are built by
+    grouping devices by host, never by reshaping the flat order — a row
+    that mixed hosts would put the full-payload reduce-scatter/allgather
+    legs on DCN and invert the optimization. Explicit factors exist for
+    tests and for splits that intentionally differ from host boundaries
+    (those reshape the canonical order and must multiply to the world
+    size).
+    """
+    from .. import basics
+
+    topo = basics._state.require_init().topology
+    if cross_size is None and local_size is None:
+        if topo.size == topo.cross_size * topo.local_size:
+            # Host-grouped rows: row i = host i's chips in canonical order.
+            by_host: dict[int, list] = {}
+            for d in topo.devices:
+                by_host.setdefault(d.process_index, []).append(d)
+            rows = [by_host[p] for p in sorted(by_host)]
+            if len({len(r) for r in rows}) != 1:
+                rows = [[d] for d in topo.devices]  # ragged: flat cross
+            return Mesh(np.array(rows), HIERARCHICAL_AXES)
+        # Heterogeneous hosts: fall back to a flat cross axis.
+        cross_size, local_size = topo.size, 1
+    elif cross_size is None:
+        cross_size = topo.size // local_size
+    elif local_size is None:
+        local_size = topo.size // cross_size
+    if cross_size * local_size != topo.size:
+        raise ValueError(
+            f"hierarchical mesh {cross_size}x{local_size} does not cover "
+            f"the {topo.size}-device world"
+        )
+    devices = np.array(topo.devices).reshape(cross_size, local_size)
+    return Mesh(devices, HIERARCHICAL_AXES)
+
+
+def hierarchical_allreduce(
+    x,
+    op: str = "average",
+    cross_axis: str = CROSS_AXIS,
+    local_axis: str = LOCAL_AXIS,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Traced two-level allreduce (call under shard_map over both axes).
+
+    Sum/Average take the bandwidth-optimal reduce-scatter → cross-allreduce
+    → allgather composition; Min/Max/Product reduce over both axes directly
+    (already latency-optimal as one HLO); Adasum mirrors the reference's
+    GPU hierarchy — average within the fast domain, Adasum across the slow
+    one (``adasum_gpu_operations.cc`` semantics).
+    """
+    from ..ops.collective_ops import (
+        Adasum, Average, Max, Min, Product, Sum, _VALID_OPS,
+    )
+
+    if op in (Min, Max, Product):
+        from ..ops.collective_ops import _allreduce_traced
+
+        return _allreduce_traced(
+            x, op, (cross_axis, local_axis), prescale_factor, postscale_factor
+        )
+    if op == Adasum:
+        from ..ops.adasum import adasum_reduce
+
+        if prescale_factor != 1.0:
+            x = x * jnp.asarray(prescale_factor, x.dtype)
+        out = lax.pmean(x, local_axis)
+        out = adasum_reduce(out, cross_axis)
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
+    if op not in (Sum, Average):
+        raise ValueError(f"unknown reduce op {op!r}; expected {_VALID_OPS}")
+
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, x.dtype)
+    local_n = lax.psum(1, local_axis)
+
+    shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % local_n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    # Each device keeps 1/local_n of the payload for the slow-axis hop.
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, cross_axis)
+    full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    if pad:
+        full = full[: flat.size - pad]
+    out = full.reshape(shape)
+
+    scale = postscale_factor
+    if op == Average:
+        scale = scale / (local_n * lax.psum(1, cross_axis))
+    if scale != 1.0:
+        out = out * jnp.asarray(scale, out.dtype)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Host/eager form: XLA local leg + native-runtime (libhvdrt) cross leg.
+# ---------------------------------------------------------------------------
+
+_host_world = None
+
+
+def _default_native_world():
+    """Process-wide NativeWorld from the launcher's env contract."""
+    global _host_world
+    if _host_world is None:
+        import os
+
+        from ..runtime import NativeWorld
+
+        nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+        proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "0") or 0)
+        addr = os.environ.get("HOROVOD_COORDINATOR_ADDR", "127.0.0.1")
+        addr = addr.rsplit(":", 1)[0]
+        port = int(os.environ.get("HOROVOD_NATIVE_PORT", "0") or 0)
+        if nprocs > 1 and not port:
+            raise RuntimeError(
+                "host_hierarchical_allreduce needs HOROVOD_NATIVE_PORT (the "
+                "native runtime's coordinator port) in a multi-process world"
+            )
+        _host_world = NativeWorld(proc_id, nprocs, addr, port or 29500)
+    return _host_world
+
+
+def host_hierarchical_allreduce(
+    stacked,
+    name: str,
+    op: str = "average",
+    world=None,
+):
+    """Eager hierarchical allreduce across controller processes.
+
+    ``stacked`` follows the eager stacked-rank convention for THIS
+    process's local shards: shape ``(local_n, *t)``. The local leg reduces
+    those shards with XLA; the cross leg allreduces the partial through the
+    native C++ runtime (negotiation + response cache + ring TCP over
+    DCN — the reference's MPI role); the result is the full reduction over
+    all ``local_n × n_processes`` logical ranks, returned stacked.
+    """
+    from ..ops.collective_ops import Average, Sum
+
+    if op not in (Sum, Average):
+        raise ValueError(f"host hierarchical allreduce supports sum/average, got {op!r}")
+    w = world if world is not None else _default_native_world()
+    x = jnp.asarray(stacked)
+    if x.ndim < 1:
+        raise ValueError("expected stacked-rank input (local_n, *shape)")
+    local_n = x.shape[0]
+    local_sum = jnp.sum(x, axis=0)  # ICI leg (XLA)
+    cross = np.asarray(
+        w.allreduce(np.asarray(local_sum), name, op="sum")
+    )  # DCN leg (libhvdrt)
+    if op == Average:
+        # Processes may carry different shard counts; the divisor is the
+        # true logical rank count, agreed through the same runtime.
+        total = float(
+            np.asarray(
+                w.allreduce(
+                    np.asarray([local_n], np.float32), name + "/count",
+                    op="sum",
+                )
+            )[0]
+        )
+        cross = cross / total
+    return jnp.broadcast_to(cross, x.shape)
