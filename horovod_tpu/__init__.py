@@ -68,6 +68,8 @@ from .functions import (  # noqa: F401
     broadcast_object,
     broadcast_optimizer_state,
     broadcast_parameters,
+    join,
+    masked_average,
     to_local,
 )
 from . import autotune  # noqa: F401

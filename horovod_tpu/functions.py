@@ -128,3 +128,64 @@ def allgather_object(obj: Any, process_set=None, name: str | None = None) -> lis
     return [
         pickle.loads(gathered[r, : int(sizes[r])].tobytes()) for r in range(n)
     ]
+
+
+def join(timeout_s: float = 600.0) -> int:
+    """Uneven-data termination barrier. Parity: ``hvd.join()`` (reference:
+    ``JoinOp`` in ``horovod/common/ops/collective_operations.cc``).
+
+    Multi-process worlds: delegates to the native runtime's JoinOp — this
+    process blocks, serving peers' allreduces with zero contributions,
+    until every process joins; returns the last process to join. Requires
+    the launcher env (``HOROVOD_NATIVE_PORT``) or a prior
+    ``host_hierarchical_allreduce`` world.
+
+    Single-controller worlds (one process driving all devices): uneven
+    per-rank batch counts cannot arise — the controller feeds every device
+    from one stream — so this returns immediately with the last rank id.
+    For uneven data *within* a global batch in the compiled regime, use
+    :func:`masked_average` (the traced-regime idiom).
+    """
+    import os
+
+    if int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) > 1:
+        from .parallel.hierarchical import _default_native_world
+
+        return _default_native_world().join(timeout_s)
+    from . import basics
+
+    return basics.size() - 1
+
+
+def masked_average(value, mask, process_set=None):
+    """Traced-regime uneven-data idiom: mean of ``value`` over ranks where
+    ``mask`` is nonzero.
+
+    The compiled replacement for JoinOp semantics: a rank (shard) that has
+    exhausted its data passes ``mask=0`` and contributes nothing —
+    ``psum(value * mask) / psum(mask)`` — so the average is over ranks
+    with real data only, exactly like Average with joined ranks. Call
+    inside shard_map; `value` is this shard's tensor (e.g. its loss or
+    gradient pytree leaves), `mask` a scalar 0/1.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .ops.collective_ops import _effective_traced_axis, _resolve_process_set
+
+    ps = _resolve_process_set(process_set)
+    axis = _effective_traced_axis(ps)
+    if axis is None:
+        raise RuntimeError(
+            "masked_average is a traced-regime helper; call it inside "
+            f"shard_map over axis {ps.axis_name!r}"
+        )
+    mask = jnp.asarray(mask)
+    count = lax.psum(mask.astype(jnp.float32), axis)
+    safe = jnp.maximum(count, 1.0)
+
+    def one(v):
+        num = lax.psum(v * mask.astype(v.dtype), axis)
+        return num / safe.astype(v.dtype)
+
+    return jax.tree.map(one, value)

@@ -65,13 +65,29 @@ def _build() -> None:
     )
 
 
+def _sources_newer_than_so() -> bool:
+    """Rebuild when any cpp source/header outdates the cached .so — a stale
+    binary missing a newly-exported symbol would fail symbol binding for
+    the whole library, not just the new entry point."""
+    try:
+        so_mtime = os.path.getmtime(_SO_PATH)
+        cpp_dir = os.path.join(_HERE, "cpp")
+        for f in os.listdir(cpp_dir):
+            if f.endswith((".cc", ".h")) or f == "Makefile":
+                if os.path.getmtime(os.path.join(cpp_dir, f)) > so_mtime:
+                    return True
+    except OSError:
+        return True  # unreadable state: let make decide
+    return False
+
+
 def load_library() -> ctypes.CDLL:
     """Load (building on demand) the native core."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_SO_PATH):
+        if not os.path.exists(_SO_PATH) or _sources_newer_than_so():
             _build()
         lib = ctypes.CDLL(_SO_PATH)
         lib.hvdrt_init.argtypes = [
@@ -93,6 +109,8 @@ def load_library() -> ctypes.CDLL:
         lib.hvdrt_poll.restype = ctypes.c_int
         lib.hvdrt_wait.argtypes = [ctypes.c_int, ctypes.c_double]
         lib.hvdrt_wait.restype = ctypes.c_int
+        lib.hvdrt_join.argtypes = [ctypes.c_double]
+        lib.hvdrt_join.restype = ctypes.c_int
         lib.hvdrt_cache_hits.restype = ctypes.c_longlong
         lib.hvdrt_cache_misses.restype = ctypes.c_longlong
         lib.hvdrt_cycles.restype = ctypes.c_longlong
@@ -262,6 +280,24 @@ class NativeWorld:
         self.synchronize(
             self._enqueue(OP_BARRIER, token, out, self._auto_name("barrier"))
         )
+
+    def join(self, timeout_s: float = 600.0) -> int:
+        """Uneven-data termination (parity: ``hvd.join`` / JoinOp).
+
+        Call when this rank has exhausted its data. Blocks until EVERY
+        rank has joined; while blocked, this rank participates in peers'
+        allreduces with zero contributions and Average divides by the
+        count of contributing ranks. Returns the last rank to join (so
+        callers can tell who had the most batches). Outstanding async
+        collectives must be synchronized first. Only allreduce/barrier
+        compose with joined ranks; other ops error until the join round
+        completes. Min/Max allreduce while joined sees the zero
+        contribution (reference caveat preserved).
+        """
+        rc = self._lib.hvdrt_join(timeout_s)
+        if rc < 0:
+            _raise_last(self._lib, "join failed")
+        return rc
 
     def grouped_allreduce(self, tensors, name=None, op="average") -> list:
         """Enqueue a list together; the controller fuses them into one ring

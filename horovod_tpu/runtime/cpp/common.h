@@ -21,6 +21,10 @@ enum class OpType : uint8_t {
   kAlltoall = 3,
   kReducescatter = 4,
   kBarrier = 5,
+  // Uneven-data termination (reference: JoinOp). Emitted by the
+  // coordinator once every rank has announced join; root_rank carries the
+  // last rank to join.
+  kJoin = 6,
 };
 
 enum class ReduceOp : uint8_t {

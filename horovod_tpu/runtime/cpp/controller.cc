@@ -82,11 +82,12 @@ Controller::Controller(Transport* transport, const Config& config)
       stall_(config.stall_warning_s, config.stall_shutdown_s) {}
 
 Status Controller::ComputeResponseList(const std::vector<Request>& ready,
-                                       bool request_shutdown,
+                                       bool request_shutdown, bool joining,
                                        ResponseList* out) {
   // Split announcements: cached signatures -> bitvector, rest -> requests.
   RequestList mine;
   mine.shutdown = request_shutdown;
+  mine.joined = joining;
   int nbits = cache_.size();
   mine.cache_bits.assign((nbits + 63) / 64, 0);
   for (const auto& req : ready) {
@@ -116,7 +117,10 @@ Status Controller::ComputeResponseList(const std::vector<Request>& ready,
   // Every rank mirrors the cache update from the broadcast responses, so
   // cache-id assignment stays rank-identical (ids follow response order).
   for (const auto& resp : out->responses) {
-    if (!resp.error.empty() || resp.op == OpType::kBarrier) continue;
+    if (!resp.error.empty() || resp.op == OpType::kBarrier ||
+        resp.op == OpType::kJoin) {
+      continue;
+    }
     for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
       Request sig;
       sig.name = resp.tensor_names[i];
@@ -152,17 +156,36 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
     shutdown = shutdown || lists[r].shutdown;
   }
 
+  // JoinOp bookkeeping: joined flags are sticky until every rank joins.
+  if (static_cast<int>(joined_.size()) != size) joined_.assign(size, false);
+  for (int r = 0; r < size; ++r) {
+    if (lists[r].joined && !joined_[r]) {
+      joined_[r] = true;
+      last_joined_ = r;
+    }
+  }
+  int joined_count = 0;
+  for (int r = 0; r < size; ++r) joined_count += joined_[r] ? 1 : 0;
+  const int active = size - joined_count;
+
   std::vector<Response> responses;
 
-  // 1. Cache fast path: AND all ready-bitvectors; every agreed bit is a
-  //    ready tensor with a known signature — no bookkeeping needed.
-  size_t words = lists[0].cache_bits.size();
-  for (int r = 1; r < size; ++r) words = std::min(words, lists[r].cache_bits.size());
-  for (size_t w = 0; w < words; ++w) {
+  // 1. Cache fast path: AND the ready-bitvectors of ACTIVE ranks; every
+  //    agreed bit is a ready tensor with a known signature. Joined ranks
+  //    contribute zeros at execution, so their vote is implicit.
+  size_t words = 0;
+  for (int r = 0; r < size; ++r) {
+    if (!joined_[r]) words = std::max(words, lists[r].cache_bits.size());
+  }
+  auto rank_bits = [&](int r, size_t w) -> uint64_t {
+    return w < lists[r].cache_bits.size() ? lists[r].cache_bits[w] : 0ull;
+  };
+  for (size_t w = 0; w < words && active > 0; ++w) {
     uint64_t agreed = ~0ull, seen = 0ull;
     for (int r = 0; r < size; ++r) {
-      agreed &= lists[r].cache_bits[w];
-      seen |= lists[r].cache_bits[w];
+      if (joined_[r]) continue;
+      agreed &= rank_bits(r, w);
+      seen |= rank_bits(r, w);
     }
     // Cached tensors announced by some-but-not-all ranks are stalls in the
     // making too — track them so steady-state hangs still get reported.
@@ -173,7 +196,9 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
       int id = static_cast<int>(w) * 64 + bit;
       std::vector<int> missing;
       for (int r = 0; r < size; ++r) {
-        if (!(lists[r].cache_bits[w] & (1ull << bit))) missing.push_back(r);
+        if (!joined_[r] && !(rank_bits(r, w) & (1ull << bit))) {
+          missing.push_back(r);
+        }
       }
       stall_.RecordPending(cache_.Get(id).name, missing);
     }
@@ -197,6 +222,13 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
       resp.postscale = sig.postscale;
       resp.tensor_names = {sig.name};
       resp.counts = {sig.count};
+      resp.active_ranks = active;
+      if (joined_count > 0 && sig.op != OpType::kAllreduce &&
+          sig.op != OpType::kBarrier) {
+        resp.error = "op on tensor '" + sig.name +
+                     "' is not supported while rank(s) are joined (only "
+                     "allreduce/barrier compose with zero contributions)";
+      }
       responses.push_back(std::move(resp));
     }
   }
@@ -230,11 +262,16 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
     }
   }
 
-  // 3. Promote fully-announced tensors to responses (deterministic order:
-  //    map iteration is name-sorted).
+  // 3. Promote tensors announced by every ACTIVE rank to responses
+  //    (deterministic order: map iteration is name-sorted). Joined ranks
+  //    participate in execution with zero contributions.
   for (auto it = message_table_.begin(); it != message_table_.end();) {
     PendingTensor& pt = it->second;
-    if (pt.announce_count == size) {
+    std::vector<int> missing;
+    for (int r = 0; r < size; ++r) {
+      if (!pt.announced[r] && !joined_[r]) missing.push_back(r);
+    }
+    if (missing.empty()) {
       const Request& req = pt.request;
       Response resp;
       resp.op = req.op;
@@ -245,17 +282,32 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
       resp.postscale = req.postscale;
       resp.tensor_names = {req.name};
       resp.counts = {req.count};
+      resp.active_ranks = pt.announce_count;
+      if (joined_count > 0 && req.op != OpType::kAllreduce &&
+          req.op != OpType::kBarrier) {
+        resp.error = "op on tensor '" + req.name +
+                     "' is not supported while rank(s) are joined (only "
+                     "allreduce/barrier compose with zero contributions)";
+      }
       responses.push_back(std::move(resp));
       stall_.RecordResolved(it->first);
       it = message_table_.erase(it);
     } else {
-      std::vector<int> missing;
-      for (int r = 0; r < size; ++r) {
-        if (!pt.announced[r]) missing.push_back(r);
-      }
       stall_.RecordPending(it->first, missing);
       ++it;
     }
+  }
+
+  // 3b. Everyone joined: the join round completes. root_rank carries the
+  //     last rank to join (reference: hvd.join()'s return value).
+  if (joined_count == size) {
+    Response done;
+    done.op = OpType::kJoin;
+    done.dtype = DType::kInt32;
+    done.root_rank = last_joined_;
+    responses.push_back(std::move(done));
+    joined_.assign(size, false);
+    last_joined_ = -1;
   }
 
   // 4. Stall check.
@@ -304,6 +356,7 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
       if (cand.op == base.op && cand.reduce_op == base.reduce_op &&
           cand.dtype == base.dtype && cand.prescale == base.prescale &&
           cand.postscale == base.postscale &&
+          cand.active_ranks == base.active_ranks &&
           bytes + cand_bytes <= config_.fusion_threshold_bytes) {
         base.tensor_names.push_back(cand.tensor_names[0]);
         base.counts.push_back(cand.counts[0]);

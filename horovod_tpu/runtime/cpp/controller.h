@@ -77,7 +77,8 @@ class Controller {
   // receive the fused ResponseList every rank must execute in order.
   // On the coordinator this also runs bookkeeping + fusion + stall checks.
   Status ComputeResponseList(const std::vector<Request>& ready,
-                             bool request_shutdown, ResponseList* out);
+                             bool request_shutdown, bool joining,
+                             ResponseList* out);
 
   ResponseCache& cache() { return cache_; }
 
@@ -103,6 +104,10 @@ class Controller {
     int announce_count = 0;
   };
   std::map<std::string, PendingTensor> message_table_;  // ordered: determinism
+  // JoinOp bookkeeping (coordinator): sticky per-rank joined flags for the
+  // current join round; cleared when the kJoin response fires.
+  std::vector<bool> joined_;
+  int last_joined_ = -1;
 };
 
 }  // namespace hvdrt

@@ -77,6 +77,7 @@ void PutResponse(Writer* w, const Response& r) {
   w->Put<uint8_t>(static_cast<uint8_t>(r.op));
   w->Put<uint8_t>(static_cast<uint8_t>(r.reduce_op));
   w->Put<uint8_t>(static_cast<uint8_t>(r.dtype));
+  w->Put<int32_t>(r.active_ranks);
   w->Put<int32_t>(r.root_rank);
   w->Put<double>(r.prescale);
   w->Put<double>(r.postscale);
@@ -92,8 +93,9 @@ bool GetResponse(Reader* rd, Response* r) {
   uint8_t op, rop, dt;
   uint32_t n = 0;
   if (!rd->Get(&op) || !rd->Get(&rop) || !rd->Get(&dt) ||
-      !rd->Get(&r->root_rank) || !rd->Get(&r->prescale) ||
-      !rd->Get(&r->postscale) || !rd->GetString(&r->error) || !rd->Get(&n)) {
+      !rd->Get(&r->active_ranks) || !rd->Get(&r->root_rank) ||
+      !rd->Get(&r->prescale) || !rd->Get(&r->postscale) ||
+      !rd->GetString(&r->error) || !rd->Get(&n)) {
     return false;
   }
   r->op = static_cast<OpType>(op);
@@ -114,6 +116,7 @@ bool GetResponse(Reader* rd, Response* r) {
 std::string SerializeRequestList(const RequestList& list) {
   Writer w;
   w.Put<uint8_t>(list.shutdown ? 1 : 0);
+  w.Put<uint8_t>(list.joined ? 1 : 0);
   w.Put<uint32_t>(static_cast<uint32_t>(list.cache_bits.size()));
   for (uint64_t word : list.cache_bits) w.Put<uint64_t>(word);
   w.Put<uint32_t>(static_cast<uint32_t>(list.requests.size()));
@@ -123,12 +126,13 @@ std::string SerializeRequestList(const RequestList& list) {
 
 Status ParseRequestList(const std::string& data, RequestList* out) {
   Reader rd(data);
-  uint8_t shutdown = 0;
+  uint8_t shutdown = 0, joined = 0;
   uint32_t nbits = 0, nreq = 0;
-  if (!rd.Get(&shutdown) || !rd.Get(&nbits)) {
+  if (!rd.Get(&shutdown) || !rd.Get(&joined) || !rd.Get(&nbits)) {
     return Status::Error("bad RequestList header");
   }
   out->shutdown = shutdown != 0;
+  out->joined = joined != 0;
   out->cache_bits.resize(nbits);
   for (uint32_t i = 0; i < nbits; ++i) {
     if (!rd.Get(&out->cache_bits[i])) return Status::Error("bad cache bits");

@@ -39,6 +39,7 @@ struct RequestList {
   std::vector<Request> requests;
   std::vector<uint64_t> cache_bits;  // bit i = cached signature i is ready
   bool shutdown = false;
+  bool joined = false;  // this rank exhausted its data (JoinOp)
 };
 
 // Coordinator's instruction: execute these tensors as one fused operation.
@@ -52,6 +53,10 @@ struct Response {
   std::vector<std::string> tensor_names;  // >1 = fused
   std::vector<int64_t> counts;            // per-tensor element counts
   std::string error;                      // non-empty = abort these tensors
+  // Number of ranks that contributed data (0 = all): < world size while
+  // some ranks are joined; Average divides by this, joined ranks
+  // participate in the ring with zeros.
+  int32_t active_ranks = 0;
 };
 
 struct ResponseList {

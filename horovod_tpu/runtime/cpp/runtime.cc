@@ -55,6 +55,11 @@ struct GlobalState {
 
   std::thread background;
   std::atomic<bool> shutdown_requested{false};
+  // JoinOp state: while joining, the background loop announces join each
+  // cycle and this rank participates in peers' allreduces with zeros.
+  std::atomic<bool> joining{false};
+  int32_t join_handle = -1;           // guarded by mu
+  std::atomic<int> join_result{-1};   // last rank to join, from kJoin
   std::atomic<bool> initialized{false};
   std::atomic<bool> background_dead{false};
   std::string fatal_error;  // set by background thread before dying
@@ -85,20 +90,56 @@ void FailAllPending(GlobalState* st, const std::string& error) {
     st->handles[e.handle] = {true, error};
   }
   st->pending.clear();
+  if (st->joining.load() && st->join_handle >= 0) {
+    st->handles[st->join_handle] = {true, error};
+    st->joining.store(false);
+    st->join_handle = -1;
+  }
   st->cv.notify_all();
 }
 
 // Execute one (possibly fused) response on this rank.
 void PerformOperation(GlobalState* st, const Response& resp) {
-  // Collect the local entries; a rank can only execute a response if it has
-  // all fused tensors locally (guaranteed: responses only form when every
-  // rank announced every tensor).
+  if (resp.op == OpType::kJoin) {
+    // Every rank joined: release this rank's join() waiter. join_handle
+    // is NOT cleared here — a waiter that timed out re-waits on it and
+    // hvdrt_join clears it once the result is actually consumed.
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->join_result.store(resp.root_rank);
+    if (st->join_handle >= 0) {
+      st->handles[st->join_handle] = {true, ""};
+    }
+    st->joining.store(false);
+    st->cv.notify_all();
+    return;
+  }
+
+  // Collect the local entries. A joined rank receives responses for
+  // tensors it never enqueued: it participates in the ring with
+  // zero-filled scratch (the reference JoinOp's zero contribution).
   std::vector<TensorEntry> entries;
+  std::vector<std::unique_ptr<std::vector<char>>> scratch;
   {
     std::lock_guard<std::mutex> lock(st->mu);
-    for (const auto& name : resp.tensor_names) {
+    size_t elem0 = DTypeSize(resp.dtype);
+    for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+      const auto& name = resp.tensor_names[i];
       auto it = st->pending.find(name);
       if (it == st->pending.end()) {
+        if (st->joining.load()) {
+          scratch.emplace_back(new std::vector<char>(
+              static_cast<size_t>(resp.counts[i]) * elem0, 0));
+          TensorEntry dummy;
+          dummy.handle = -1;
+          dummy.name = name;
+          dummy.op = resp.op;
+          dummy.dtype = resp.dtype;
+          dummy.count = resp.counts[i];
+          dummy.input = scratch.back()->data();
+          dummy.output = scratch.back()->data();
+          entries.push_back(std::move(dummy));
+          continue;
+        }
         // Protocol violation; fail loudly.
         HVD_LOG(kError) << "response for unknown tensor " << name;
         return;
@@ -111,6 +152,7 @@ void PerformOperation(GlobalState* st, const Response& resp) {
   auto finish = [&](const Status& s) {
     std::lock_guard<std::mutex> lock(st->mu);
     for (const auto& e : entries) {
+      if (e.handle < 0) continue;  // joined-rank dummy
       st->handles[e.handle] = {true, s.ok ? "" : s.error};
     }
     st->cv.notify_all();
@@ -129,6 +171,15 @@ void PerformOperation(GlobalState* st, const Response& resp) {
     case OpType::kAllreduce: {
       int64_t total = 0;
       for (int64_t c : resp.counts) total += c;
+      // Average divides by the CONTRIBUTING rank count: with joined ranks
+      // (zero contributions) that's resp.active_ranks, not world size —
+      // so the ring runs Sum and the scale is applied here.
+      int active = resp.active_ranks > 0 ? resp.active_ranks : t->size();
+      ReduceOp ring_op = resp.reduce_op == ReduceOp::kAverage
+                             ? ReduceOp::kSum
+                             : resp.reduce_op;
+      double avg_scale =
+          resp.reduce_op == ReduceOp::kAverage ? 1.0 / active : 1.0;
       // Fused path: pack into the persistent fusion buffer, one ring
       // allreduce, unpack. Single tensor reduces in place in the output.
       const std::string& tname = resp.tensor_names[0];
@@ -137,8 +188,11 @@ void PerformOperation(GlobalState* st, const Response& resp) {
         std::memcpy(e.output, e.input, static_cast<size_t>(total) * elem);
         if (e.prescale != 1.0) ScaleBuffer(e.output, total, resp.dtype, e.prescale);
         st->timeline.Begin(tname, "RING_ALLREDUCE");
-        s = t->Allreduce(e.output, total, resp.dtype, resp.reduce_op);
+        s = t->Allreduce(e.output, total, resp.dtype, ring_op);
         st->timeline.End(tname);
+        if (s.ok && avg_scale != 1.0) {
+          ScaleBuffer(e.output, total, resp.dtype, avg_scale);
+        }
         if (s.ok && e.postscale != 1.0) {
           ScaleBuffer(e.output, total, resp.dtype, e.postscale);
         }
@@ -157,9 +211,12 @@ void PerformOperation(GlobalState* st, const Response& resp) {
           st->timeline.End(e.name);
         }
         st->timeline.Begin(tname, "RING_ALLREDUCE_FUSED");
-        s = t->Allreduce(buf, total, resp.dtype, resp.reduce_op);
+        s = t->Allreduce(buf, total, resp.dtype, ring_op);
         st->timeline.End(tname);
         if (s.ok) {
+          if (avg_scale != 1.0) {
+            ScaleBuffer(buf, total, resp.dtype, avg_scale);
+          }
           off = 0;
           for (auto& e : entries) {
             st->timeline.Begin(e.name, "FUSION_UNPACK");
@@ -210,6 +267,8 @@ void PerformOperation(GlobalState* st, const Response& resp) {
       s = t->Barrier();
       break;
     }
+    case OpType::kJoin:
+      break;  // handled at function entry
   }
   finish(s);
 }
@@ -245,6 +304,7 @@ bool RunLoopOnce(GlobalState* st) {
   bool want_shutdown = st->shutdown_requested.load();
   ResponseList responses;
   Status s = st->controller->ComputeResponseList(ready, want_shutdown,
+                                                 st->joining.load(),
                                                  &responses);
   if (!s.ok) {
     st->fatal_error = s.error;
@@ -277,10 +337,13 @@ void BackgroundThreadLoop(GlobalState* st) {
   while (RunLoopOnce(st)) {
     // Steady-state pacing: only sleep when nothing is in flight, so hot
     // streams negotiate back-to-back (cycle_time is the idle poll period).
+    // A joining rank keeps cycling at full rate: peers' collectives (which
+    // it must serve with zeros) and the join completion both arrive
+    // through the negotiation it would otherwise be sleeping on.
     bool idle;
     {
       std::lock_guard<std::mutex> lock(st->mu);
-      idle = st->queue.empty() && st->pending.empty();
+      idle = st->queue.empty() && st->pending.empty() && !st->joining.load();
     }
     if (idle) {
       std::this_thread::sleep_for(std::chrono::duration<double, std::milli>(
@@ -478,6 +541,49 @@ int hvdrt_wait(int handle, double timeout_s) {
     return -1;
   }
   return 0;
+}
+
+// JoinOp (reference: hvd.join / JoinOp in collective_operations.cc).
+// Blocks until EVERY rank has called join; while blocked, this rank serves
+// peers' allreduces with zero contributions. Returns the last rank to
+// join (>= 0), or -1 on error. Outstanding collectives must be
+// synchronized first.
+int hvdrt_join(double timeout_s) {
+  GlobalState* st = g.load();
+  if (st == nullptr || !st->initialized.load()) {
+    SetError("not initialized");
+    return -1;
+  }
+  if (st->background_dead.load()) {
+    SetError("runtime is dead: " + st->fatal_error);
+    return -1;
+  }
+  int32_t handle;
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    if (st->join_handle >= 0) {
+      // A previous join() timed out mid-round; re-wait on the same
+      // handle instead of failing forever (the round may have completed
+      // behind our back, in which case the handle is already done).
+      handle = st->join_handle;
+    } else {
+      if (!st->queue.empty() || !st->pending.empty()) {
+        SetError("join requires all outstanding collectives to be "
+                 "synchronized first");
+        return -1;
+      }
+      handle = st->next_handle++;
+      st->handles[handle] = HandleState{};
+      st->join_handle = handle;
+      st->joining.store(true);
+    }
+  }
+  if (hvdrt_wait(handle, timeout_s) != 0) return -1;  // retryable: re-call
+  {
+    std::lock_guard<std::mutex> lock(st->mu);
+    st->join_handle = -1;
+  }
+  return st->join_result.load();
 }
 
 long long hvdrt_cache_hits() {

@@ -1,0 +1,117 @@
+"""hvd.join() + the traced-regime uneven-data idiom (masked_average).
+
+Reference parity: ``hvd.join`` / ``JoinOp``
+(``horovod/common/ops/collective_operations.cc``). The native-runtime
+multi-process JoinOp is exercised in
+``tests/test_native_runtime.py::test_join_uneven_batch_counts``; here the
+single-controller surface and the compiled idiom.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+
+def test_join_single_controller_returns_last_rank(hvd):
+    # One controller feeds every device: join is immediately complete.
+    assert hvd.join() == hvd.size() - 1
+
+
+def test_masked_average_scalar(hvd):
+    mesh, axis = hvd.global_mesh(), hvd.global_axis_name()
+
+    def body(v):
+        r = v[0, 0]
+        mask = (r < 5).astype(jnp.float32)
+        return hvd.masked_average(r, mask)[None]
+
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+    )
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = np.asarray(fn(x))
+    # Ranks 0..4 contribute their value; 5..7 are masked out.
+    np.testing.assert_allclose(out.ravel(), np.full(8, 2.0))
+
+
+def test_masked_average_all_masked_is_safe(hvd):
+    mesh, axis = hvd.global_mesh(), hvd.global_axis_name()
+
+    def body(v):
+        return hvd.masked_average(v[0], jnp.zeros(()))[None]
+
+    fn = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+            check_vma=False,
+        )
+    )
+    out = np.asarray(fn(np.ones((8, 3), np.float32)))
+    assert np.all(np.isfinite(out))  # divisor clamped, no NaN
+
+
+def test_masked_average_requires_trace(hvd):
+    import pytest
+
+    with pytest.raises(RuntimeError, match="shard_map"):
+        hvd.masked_average(np.ones(3), 1.0)
+
+
+def test_uneven_training_completes_with_correct_averaging(hvd):
+    """Shards run out of data at different steps; gradients averaged with
+    masked_average match a manual average over the active shards only."""
+    mesh, axis = hvd.global_mesh(), hvd.global_axis_name()
+    n = hvd.size()
+    # Shard r has batches_per_shard[r] batches.
+    batches_per_shard = np.array([3, 3, 2, 2, 1, 1, 1, 1], np.int32)
+
+    def step(params, batch, shard_batches, step_idx):
+        def loss_fn(p):
+            x, y = batch
+            pred = x @ p["w"]
+            return jnp.mean((pred - y) ** 2)
+
+        g = jax.grad(loss_fn)(params)
+        mask = (step_idx < shard_batches[0]).astype(jnp.float32)
+        g = hvd.masked_average(g, mask)
+        return jax.tree.map(lambda p_, g_: p_ - 0.1 * g_, params, g)
+
+    sharded = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P(axis), P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        ),
+        static_argnums=(),
+    )
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(n * 2, 4).astype(np.float32)
+    y = rng.randn(n * 2, 1).astype(np.float32)
+    params = {"w": jnp.zeros((4, 1))}
+    ref_params = {"w": np.zeros((4, 1), np.float32)}
+
+    for step_idx in range(3):
+        params = sharded(
+            params, (x, y), batches_per_shard.reshape(n, 1),
+            jnp.asarray(step_idx),
+        )
+        # Manual reference: average grads over shards still holding data.
+        active = [r for r in range(n) if step_idx < batches_per_shard[r]]
+        grads = []
+        for r in active:
+            xs, ys = x[2 * r : 2 * r + 2], y[2 * r : 2 * r + 2]
+            pred = xs @ ref_params["w"]
+            grads.append(2 * xs.T @ (pred - ys) / 2)
+        ref_params["w"] = ref_params["w"] - 0.1 * np.mean(grads, axis=0)
+
+    np.testing.assert_allclose(
+        np.asarray(params["w"]), ref_params["w"], rtol=1e-4, atol=1e-5
+    )

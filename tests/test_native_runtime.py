@@ -130,6 +130,26 @@ WORKER = textwrap.dedent(
         check(b[::1024 * 1024], 0.0, "big.broadcast")
         print(f"rank{rank} large ok", flush=True)
         w.shutdown()
+    elif mode == "join":
+        # Uneven batch counts (reference JoinOp): rank r runs r+1 steps,
+        # then joins. Step i is contributed by ranks r >= i, so its
+        # average is mean(r+1 for r in i..size-1); joined ranks serve
+        # zeros and Average divides by the contributor count.
+        for i in range(rank + 1):
+            got = w.allreduce(
+                np.full((4,), float(rank + 1), np.float32),
+                f"join.step{i}", op="average")
+            contributors = [r + 1 for r in range(i, size)]
+            check(got, sum(contributors) / len(contributors), f"join.step{i}")
+        last = w.join()
+        if last != size - 1:
+            print(f"rank{rank} JOIN RESULT {last} != {size-1}", flush=True)
+            sys.exit(13)
+        # The world is reusable after a join round completes.
+        got = w.allreduce(np.full((2,), 1.0, np.float32), "post.join", op="sum")
+        check(got, float(size), "post.join")
+        print(f"rank{rank} join ok (last={last})", flush=True)
+        w.shutdown()
     elif mode == "peerdeath":
         if rank == size - 1:
             w.allreduce(np.ones(4, np.float32), "pd.warmup", op="sum")
@@ -210,6 +230,12 @@ class TestNativeRuntime:
         for r, (rc, out, err) in enumerate(results):
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} large ok" in out
+
+    def test_join_uneven_batch_counts(self, tmp_path):
+        results = _run_world(tmp_path, 3, "join")
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
+            assert f"rank{r} join ok (last=2)" in out
 
     def test_stall_inspector_warns_then_resolves(self, tmp_path):
         results = _run_world(
