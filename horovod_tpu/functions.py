@@ -181,11 +181,19 @@ def masked_average(value, mask, process_set=None):
             f"shard_map over axis {ps.axis_name!r}"
         )
     mask = jnp.asarray(mask)
+
+    # The contributing-rank count accumulates in float32 regardless of leaf
+    # dtype: bf16 spacing is 2.0 above 256, so a bf16 count would stick on
+    # large worlds and bias the divisor; f32 is exact to 2^24 ranks.
     count = lax.psum(mask.astype(jnp.float32), axis)
     safe = jnp.maximum(count, 1.0)
 
     def one(v):
-        num = lax.psum(v * mask.astype(v.dtype), axis)
-        return num / safe.astype(v.dtype)
+        # Sum in an exact-enough accumulation dtype, divide there, and cast
+        # the result back so integer / f64-sensitive pytrees round-trip
+        # their dtypes (true division would silently promote ints).
+        acc_dtype = jnp.float64 if v.dtype == jnp.float64 else jnp.float32
+        num = lax.psum((v * mask.astype(v.dtype)).astype(acc_dtype), axis)
+        return (num / safe.astype(acc_dtype)).astype(v.dtype)
 
     return jax.tree.map(one, value)

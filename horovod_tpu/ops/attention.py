@@ -11,11 +11,19 @@ un-normalized output ``o`` in fp32, rescale on each new tile.
 Two implementations, one semantics:
 - ``flash_attention``: Pallas TPU kernel (MXU-tiled, fp32 accumulators in
   VMEM scratch, grid over (batch*heads, Q blocks)); ``interpret=True`` makes
-  it runnable on the CPU dev mesh.
+  it runnable on the CPU dev mesh. Differentiable: a ``jax.custom_vjp``
+  supplies Pallas backward kernels (dq and dk/dv) from saved
+  (out, logsumexp) residuals, so ring attention trains end-to-end.
 - ``blockwise_attention_reference``: pure-jnp same math; the numerics
   oracle in tests. The kernel requires block-divisible sequence lengths
   (raises otherwise) — pad upstream, or call the reference directly for
   ragged shapes.
+
+Causal masking uses GLOBAL positions: ``q_offset``/``k_offset`` give the
+global position of element 0 of the Q/K sequences. With ``Sq != Sk`` and
+both offsets 0 the intended alignment is ambiguous (top-left vs the
+decode-style bottom-right), so ``flash_attention`` raises and asks for
+explicit offsets rather than silently picking one.
 """
 
 from __future__ import annotations
@@ -28,6 +36,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+# logsumexp sentinel for fully-masked rows: exp(s - BIG) == 0 for any
+# representable s, so backward P/dq come out exactly 0 for those rows.
+LSE_MASKED = 1e30
 
 
 def _attend_block(q, k, v, m, l, o, mask=None, scale=1.0):
@@ -61,7 +72,10 @@ def blockwise_attention_reference(q, k, v, causal=False, block_size=128,
     """Numerics oracle: [B, H, S, D] blockwise attention in pure jnp.
 
     ``q_offset``/``k_offset`` are the global positions of element 0 — the
-    hook ring attention uses to apply a causal mask across shards.
+    hook ring attention uses to apply a causal mask across shards. With
+    defaults and ``Sq != Sk`` the mask is top-left aligned (both sequences
+    start at global position 0); pass ``q_offset=Sk - Sq`` for the
+    decode-style bottom-right alignment.
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -95,12 +109,21 @@ def blockwise_attention_reference(q, k, v, causal=False, block_size=128,
 
 
 # ---------------------------------------------------------------------------
-# Pallas kernel
+# Pallas kernels
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, scale: float, block_q: int, block_k: int):
+def _causal_mask(qi, j, block_q, block_k, q_offset, k_offset):
+    qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = k_offset + j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qpos >= kpos
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                      acc_scr, *, causal: bool, scale: float, block_q: int,
+                      block_k: int, q_offset: int, k_offset: int):
     # Grid (BH, num_q_blocks, num_k_blocks), K innermost: only ONE
     # [block_k, D] K/V tile is VMEM-resident per step (long sequences never
     # exceed VMEM); scratch carries (m, l, acc) across the K dimension.
@@ -123,17 +146,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         preferred_element_type=jnp.float32,
     ) * scale  # [block_q, block_k]
     if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        kpos = j * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(qpos >= kpos, s, NEG_INF)
+        mask = _causal_mask(qi, j, block_q, block_k, q_offset, k_offset)
+        s = jnp.where(mask, s, NEG_INF)
     m_prev = m_scr[:, 0]
     m_new = jnp.maximum(m_prev, s.max(axis=-1))
     corr = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new[:, None])
     if causal:
-        p = jnp.where(qpos >= kpos, p, 0.0)
+        p = jnp.where(mask, p, 0.0)
     l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
     acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
         p, v_tile.astype(jnp.float32),
@@ -145,20 +165,267 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     @pl.when(j == num_kb - 1)
     def _finalize_block():
         l = l_scr[:, 0]
-        safe_l = jnp.where(l == 0.0, 1.0, l)
+        empty = l == 0.0
+        safe_l = jnp.where(empty, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(empty, LSE_MASKED,
+                               m_scr[:, 0] + jnp.log(safe_l))
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_scr, *, causal: bool, scale: float, block_q: int,
+                     block_k: int, q_offset: int, k_offset: int):
+    """dQ pass. Grid (BH, num_q_blocks, num_k_blocks), K innermost;
+    accumulates dq for one Q tile across all K tiles.
+
+    P_ij = exp(s_ij - lse_i); dS = P * (dO @ V^T - delta_i);
+    dQ_i = scale * sum_j dS_ij K_j.
+    """
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    num_kb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0].astype(jnp.float32)
+    k_tile = k_ref[0].astype(jnp.float32)
+    v_tile = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]      # [block_q]
+    delta = delta_ref[0]  # [block_q]
+
+    s = jax.lax.dot_general(
+        q, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        mask = _causal_mask(qi, j, block_q, block_k, q_offset, k_offset)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    dp = jax.lax.dot_general(
+        do, v_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
+        ds, k_tile, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == num_kb - 1)
+    def _write():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                      scale: float, block_q: int, block_k: int,
+                      q_offset: int, k_offset: int):
+    """dK/dV pass. Grid (BH, num_k_blocks, num_q_blocks), Q innermost;
+    accumulates dk, dv for one K/V tile across all Q tiles.
+
+    dV_j = sum_i P_ij dO_i; dK_j = scale * sum_i dS_ij Q_i.
+    """
+    kj = pl.program_id(1)
+    i = pl.program_id(2)
+    num_qb = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q = q_ref[0].astype(jnp.float32)
+    k_tile = k_ref[0].astype(jnp.float32)
+    v_tile = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    s = jax.lax.dot_general(
+        q, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    if causal:
+        mask = _causal_mask(i, kj, block_q, block_k, q_offset, k_offset)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+    # dV_j += P^T @ dO
+    dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta[:, None])
+    # dK_j += scale * dS^T @ Q
+    dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == num_qb - 1)
+    def _write():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing (operates on [BH, S, D] collapsed arrays)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset, k_offset,
+              interpret):
+    BH, Sq, D = qr.shape
+    Sk = kr.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    kernel = functools.partial(
+        _flash_fwd_kernel, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k,
+        q_offset=q_offset, k_offset=k_offset,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, Sq // block_q, Sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
+            pltpu.VMEM((block_q, D), jnp.float32),  # fp32 accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+
+
+def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
+               res, g):
+    qr, kr, vr, out, lse = res
+    BH, Sq, D = qr.shape
+    Sk = kr.shape[1]
+    scale = 1.0 / (D ** 0.5)
+    do = g
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
+    # cheap elementwise reduce, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [BH, Sq]
+
+    q_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
+        pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+    ]
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_dq_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, q_offset=q_offset, k_offset=k_offset,
+        ),
+        grid=(BH, Sq // block_q, Sk // block_k),
+        in_specs=q_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    kv_specs = [
+        pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+        pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
+        pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_dkv_kernel, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, q_offset=q_offset, k_offset=k_offset,
+        ),
+        grid=(BH, Sk // block_k, Sq // block_q),
+        in_specs=kv_specs,
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), kr.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), vr.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+    return dq, dk, dv
+
+
+# custom_vjp over the (out, lse)-returning primal so residuals are exact.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_with_lse(qr, kr, vr, causal, block_q, block_k, q_offset,
+                    k_offset, interpret):
+    return _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset,
+                     k_offset, interpret)
+
+
+def _flash_with_lse_fwd(qr, kr, vr, causal, block_q, block_k, q_offset,
+                        k_offset, interpret):
+    out, lse = _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset,
+                         k_offset, interpret)
+    return (out, lse), (qr, kr, vr, out, lse)
+
+
+def _flash_with_lse_bwd(causal, block_q, block_k, q_offset, k_offset,
+                        interpret, res, gs):
+    g, _g_lse = gs  # gradient w.r.t. lse is not supported (internal detail)
+    return _flash_bwd(causal, block_q, block_k, q_offset, k_offset,
+                      interpret, res, g)
+
+
+_flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "q_offset", "k_offset",
+                     "interpret"),
 )
 def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
+                    block_k: int = 128, q_offset: int = 0, k_offset: int = 0,
+                    interpret: bool = False):
     """Pallas flash attention. q, k, v: [B, H, S, D] → [B, H, S, D].
 
-    Grid: (B*H, S/block_q); each program streams K/V tiles from VMEM blocks
-    with fp32 running-max/normalizer/accumulator scratch. S must divide by
-    the block sizes (pad upstream — XLA-style static shapes).
+    Forward grid: (B*H, Sq/block_q, Sk/block_k); each program streams K/V
+    tiles from VMEM blocks with fp32 running-max/normalizer/accumulator
+    scratch. S must divide by the block sizes (pad upstream — XLA-style
+    static shapes). Differentiable via ``jax.custom_vjp`` with Pallas
+    backward kernels (saved residuals: output + per-row logsumexp).
+
+    ``q_offset``/``k_offset``: global positions of element 0 of Q/K (static
+    ints) — how ring attention applies a causal mask across shards. When
+    ``causal`` and ``Sq != Sk`` you MUST pass offsets making the intended
+    alignment explicit (``q_offset=Sk - Sq`` gives decode-style bottom-right
+    alignment); with both defaulted the call raises instead of silently
+    picking top-left.
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -167,30 +434,17 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
             f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
             f"({block_q}, {block_k}); pad to a multiple"
         )
-    scale = 1.0 / (D ** 0.5)
+    if causal and Sq != Sk and q_offset == 0 and k_offset == 0:
+        raise ValueError(
+            f"causal flash_attention with Sq={Sq} != Sk={Sk} is ambiguous "
+            "without explicit offsets: pass q_offset/k_offset (e.g. "
+            f"q_offset={Sk - Sq} for bottom-right/decode alignment, or "
+            "q_offset=0, k_offset=0 is top-left — use "
+            "blockwise_attention_reference if that is what you want)"
+        )
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
-
-    kernel = functools.partial(
-        _flash_kernel, causal=causal, scale=scale,
-        block_q=block_q, block_k=block_k,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(B * H, Sq // block_q, Sk // block_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
-            pltpu.VMEM((block_q, 1), jnp.float32),  # normalizer l
-            pltpu.VMEM((block_q, D), jnp.float32),  # fp32 accumulator
-        ],
-        interpret=interpret,
-    )(qr, kr, vr)
+    out, _lse = _flash_with_lse(qr, kr, vr, causal, block_q, block_k,
+                                q_offset, k_offset, interpret)
     return out.reshape(B, H, Sq, D)
