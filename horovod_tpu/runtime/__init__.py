@@ -20,6 +20,7 @@ Binding is ctypes on a C API (no pybind11 in this environment — see
 from __future__ import annotations
 
 import ctypes
+import fcntl
 import os
 import subprocess
 import threading
@@ -65,6 +66,22 @@ def _build() -> None:
     )
 
 
+def _build_locked() -> None:
+    """Build under an inter-process flock: hvdrun workers and subprocess
+    tests all import this module concurrently, and without the lock every
+    process would race ``make`` on the same .o/.so outputs on any cold
+    start after a source change. First process in builds; the rest block
+    on the lock, then observe a fresh .so and skip."""
+    lock_path = os.path.join(_HERE, ".build.lock")
+    with open(lock_path, "w") as lock_f:
+        fcntl.flock(lock_f, fcntl.LOCK_EX)
+        try:
+            if not os.path.exists(_SO_PATH) or _sources_newer_than_so():
+                _build()
+        finally:
+            fcntl.flock(lock_f, fcntl.LOCK_UN)
+
+
 def _sources_newer_than_so() -> bool:
     """Rebuild when any cpp source/header outdates the cached .so — a stale
     binary missing a newly-exported symbol would fail symbol binding for
@@ -88,23 +105,44 @@ def load_library() -> ctypes.CDLL:
         if _lib is not None:
             return _lib
         if not os.path.exists(_SO_PATH) or _sources_newer_than_so():
-            _build()
+            _build_locked()
         lib = ctypes.CDLL(_SO_PATH)
         lib.hvdrt_init.argtypes = [
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
-            ctypes.c_double,
+            ctypes.c_double, ctypes.c_double,
         ]
         lib.hvdrt_init.restype = ctypes.c_int
         lib.hvdrt_shutdown.restype = ctypes.c_int
         lib.hvdrt_rank.restype = ctypes.c_int
         lib.hvdrt_size.restype = ctypes.c_int
         lib.hvdrt_is_initialized.restype = ctypes.c_int
+        lib.hvdrt_is_alive.restype = ctypes.c_int
         lib.hvdrt_enqueue.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
             ctypes.c_double, ctypes.c_double,
         ]
         lib.hvdrt_enqueue.restype = ctypes.c_int
+        lib.hvdrt_enqueue_ps.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_double, ctypes.c_double, ctypes.c_int,
+        ]
+        lib.hvdrt_enqueue_ps.restype = ctypes.c_int
+        lib.hvdrt_enqueue_group.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_longlong),
+            ctypes.c_int, ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.hvdrt_enqueue_group.restype = ctypes.c_int
+        lib.hvdrt_register_process_set.argtypes = [
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+        ]
+        lib.hvdrt_register_process_set.restype = ctypes.c_int
+        lib.hvdrt_process_set_size.argtypes = [ctypes.c_int]
+        lib.hvdrt_process_set_size.restype = ctypes.c_int
         lib.hvdrt_poll.argtypes = [ctypes.c_int]
         lib.hvdrt_poll.restype = ctypes.c_int
         lib.hvdrt_wait.argtypes = [ctypes.c_int, ctypes.c_double]
@@ -138,10 +176,17 @@ class NativeWorld:
     """One process's membership in the native runtime world."""
 
     def __init__(self, rank: int, size: int, coord_addr: str, coord_port: int,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0,
+                 exchange_timeout_s: float = 0.0):
+        """``timeout_s`` bounds connection setup/bootstrap only.
+        ``exchange_timeout_s`` bounds data-plane inactivity mid-collective
+        (0 = HOROVOD_EXCHANGE_TIMEOUT env or the 600s default; negative =
+        block forever) — deliberately separate knobs, a peer paused 30s
+        mid-collective is a recoverable wait, not a bootstrap failure."""
         self._lib = load_library()
         rc = self._lib.hvdrt_init(
-            rank, size, coord_addr.encode(), coord_port, timeout_s
+            rank, size, coord_addr.encode(), coord_port, timeout_s,
+            exchange_timeout_s,
         )
         if rc != 0:
             _raise_last(self._lib, "native init failed")
@@ -157,6 +202,13 @@ class NativeWorld:
     def shutdown(self) -> None:
         if self._lib.hvdrt_is_initialized():
             self._lib.hvdrt_shutdown()
+
+    @property
+    def alive(self) -> bool:
+        """True iff the runtime is initialized AND its background loop is
+        serving (a fatal control-plane error leaves it initialized-but-
+        dead; cached worlds must check this before reuse)."""
+        return bool(self._lib.hvdrt_is_alive())
 
     @property
     def cache_hits(self) -> int:
@@ -178,11 +230,12 @@ class NativeWorld:
 
     def _enqueue(self, op: int, x: np.ndarray, out: np.ndarray,
                  name: str | None, reduce_op: str = "sum", root_rank: int = 0,
-                 prescale: float = 1.0, postscale: float = 1.0) -> int:
+                 prescale: float = 1.0, postscale: float = 1.0,
+                 process_set_id: int = 0) -> int:
         if x.dtype not in _DTYPE_MAP:
             raise TypeError(f"unsupported dtype {x.dtype} for native runtime")
         x = np.ascontiguousarray(x)
-        handle = self._lib.hvdrt_enqueue(
+        args = (
             (name or self._auto_name("op")).encode(),
             op,
             _REDUCE_MAP[reduce_op],
@@ -194,11 +247,38 @@ class NativeWorld:
             prescale,
             postscale,
         )
+        if process_set_id:
+            handle = self._lib.hvdrt_enqueue_ps(*args, process_set_id)
+        else:
+            handle = self._lib.hvdrt_enqueue(*args)
         if handle < 0:
             _raise_last(self._lib, "enqueue failed")
         with self._inflight_lock:
             self._inflight[handle] = (x, out)
         return handle
+
+    # -- process sets (reference: process_set.cc / process_sets.py) ----------
+
+    def register_process_set(self, ranks) -> int:
+        """Register a subset of ranks as a process set; returns its id.
+
+        Collective contract (as in the reference's ``add_process_set``):
+        every rank must register the same sets in the same order.
+        Registration is idempotent — the same rank list returns the same id.
+        """
+        ranks = sorted({int(r) for r in ranks})
+        arr = (ctypes.c_int * len(ranks))(*ranks)
+        set_id = self._lib.hvdrt_register_process_set(arr, len(ranks))
+        if set_id < 0:
+            _raise_last(self._lib, "register_process_set failed")
+        return set_id
+
+    def process_set_size(self, process_set_id: int = 0) -> int:
+        n = self._lib.hvdrt_process_set_size(process_set_id)
+        if n < 0:
+            raise NativeRuntimeError(
+                f"unknown process set {process_set_id}")
+        return n
 
     def poll(self, handle: int) -> bool:
         return self._lib.hvdrt_poll(handle) == 1
@@ -225,26 +305,37 @@ class NativeWorld:
 
     def allreduce_async_(self, x: np.ndarray, name: str | None = None,
                          op: str = "average", prescale_factor: float = 1.0,
-                         postscale_factor: float = 1.0) -> int:
+                         postscale_factor: float = 1.0,
+                         process_set_id: int = 0) -> int:
         out = np.empty_like(np.ascontiguousarray(x))
         return self._enqueue(OP_ALLREDUCE, x, out, name, reduce_op=op,
                              prescale=prescale_factor,
-                             postscale=postscale_factor)
+                             postscale=postscale_factor,
+                             process_set_id=process_set_id)
 
-    def allgather_async(self, x: np.ndarray, name: str | None = None) -> int:
+    def allgather_async(self, x: np.ndarray, name: str | None = None,
+                        process_set_id: int = 0) -> int:
         x = np.ascontiguousarray(x)
-        out = np.empty((self.size * x.shape[0],) + x.shape[1:], dtype=x.dtype) \
-            if x.ndim else np.empty((self.size,), dtype=x.dtype)
-        return self._enqueue(OP_ALLGATHER, x, out, name)
+        n = self.process_set_size(process_set_id)
+        out = np.empty((n * x.shape[0],) + x.shape[1:], dtype=x.dtype) \
+            if x.ndim else np.empty((n,), dtype=x.dtype)
+        return self._enqueue(OP_ALLGATHER, x, out, name,
+                             process_set_id=process_set_id)
 
     def broadcast_async(self, x: np.ndarray, root_rank: int,
-                        name: str | None = None) -> int:
+                        name: str | None = None,
+                        process_set_id: int = 0) -> int:
         out = np.ascontiguousarray(x).copy()
-        return self._enqueue(OP_BROADCAST, x, out, name, root_rank=root_rank)
+        return self._enqueue(OP_BROADCAST, x, out, name, root_rank=root_rank,
+                             process_set_id=process_set_id)
 
-    def alltoall_async(self, x: np.ndarray, name: str | None = None) -> int:
+    def alltoall_async(self, x: np.ndarray, name: str | None = None,
+                       process_set_id: int = 0) -> int:
+        # Non-global sets are rejected at negotiation (clear error response)
+        # — passing the id through keeps the failure mode user-visible.
         out = np.empty_like(np.ascontiguousarray(x))
-        return self._enqueue(OP_ALLTOALL, x, out, name)
+        return self._enqueue(OP_ALLTOALL, x, out, name,
+                             process_set_id=process_set_id)
 
     def reducescatter_async(self, x: np.ndarray, name: str | None = None,
                             op: str = "sum") -> int:
@@ -262,14 +353,14 @@ class NativeWorld:
     def allreduce(self, x, name=None, op="average", **kw) -> np.ndarray:
         return self.synchronize(self.allreduce_async_(x, name, op=op, **kw))
 
-    def allgather(self, x, name=None) -> np.ndarray:
-        return self.synchronize(self.allgather_async(x, name))
+    def allgather(self, x, name=None, **kw) -> np.ndarray:
+        return self.synchronize(self.allgather_async(x, name, **kw))
 
-    def broadcast(self, x, root_rank: int, name=None) -> np.ndarray:
-        return self.synchronize(self.broadcast_async(x, root_rank, name))
+    def broadcast(self, x, root_rank: int, name=None, **kw) -> np.ndarray:
+        return self.synchronize(self.broadcast_async(x, root_rank, name, **kw))
 
-    def alltoall(self, x, name=None) -> np.ndarray:
-        return self.synchronize(self.alltoall_async(x, name))
+    def alltoall(self, x, name=None, **kw) -> np.ndarray:
+        return self.synchronize(self.alltoall_async(x, name, **kw))
 
     def reducescatter(self, x, name=None, op="sum") -> np.ndarray:
         return self.synchronize(self.reducescatter_async(x, name, op=op))
@@ -299,12 +390,44 @@ class NativeWorld:
             _raise_last(self._lib, "join failed")
         return rc
 
-    def grouped_allreduce(self, tensors, name=None, op="average") -> list:
-        """Enqueue a list together; the controller fuses them into one ring
-        collective (the native analog of ``hvd.grouped_allreduce``)."""
+    def grouped_allreduce(self, tensors, name=None, op="average",
+                          process_set_id: int = 0,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0) -> list:
+        """Atomically enqueue a list; the controller schedules the group
+        all-or-nothing and fuses it into one ring collective (reference:
+        ``hvd.grouped_allreduce`` backed by ``group_table.cc``'s
+        GroupTable — here the registration IS atomic, one C call under one
+        queue lock, not same-cycle-arrival luck)."""
         base = name or self._auto_name("group")
-        handles = [
-            self.allreduce_async_(t, f"{base}.{i}", op=op)
-            for i, t in enumerate(tensors)
-        ]
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        for x in xs:
+            if x.dtype != xs[0].dtype:
+                raise TypeError(
+                    "grouped_allreduce requires a uniform dtype per group "
+                    f"(got {x.dtype} and {xs[0].dtype}); split the group"
+                )
+            if x.dtype not in _DTYPE_MAP:
+                raise TypeError(f"unsupported dtype {x.dtype}")
+        outs = [np.empty_like(x) for x in xs]
+        n = len(xs)
+        names = [f"{base}.{i}".encode() for i in range(n)]
+        c_names = (ctypes.c_char_p * n)(*names)
+        c_ins = (ctypes.c_void_p * n)(
+            *[x.ctypes.data_as(ctypes.c_void_p).value for x in xs])
+        c_outs = (ctypes.c_void_p * n)(
+            *[o.ctypes.data_as(ctypes.c_void_p).value for o in outs])
+        c_counts = (ctypes.c_longlong * n)(*[x.size for x in xs])
+        c_handles = (ctypes.c_int * n)()
+        rc = self._lib.hvdrt_enqueue_group(
+            n, c_names, OP_ALLREDUCE, _REDUCE_MAP[op],
+            _DTYPE_MAP[xs[0].dtype], c_ins, c_outs, c_counts,
+            process_set_id, prescale_factor, postscale_factor, c_handles,
+        )
+        if rc != 0:
+            _raise_last(self._lib, "grouped enqueue failed")
+        handles = list(c_handles)
+        with self._inflight_lock:
+            for h, x, o in zip(handles, xs, outs):
+                self._inflight[h] = (x, o)
         return [self.synchronize(h) for h in handles]

@@ -89,6 +89,14 @@ struct TensorEntry {
   const void* input = nullptr;
   void* output = nullptr;
   double enqueue_time_s = 0.0;
+  // Process set this op runs over (0 = world). Reference role:
+  // horovod/common/process_set.cc — ProcessSetTable.
+  int32_t process_set_id = 0;
+  // Atomic group membership (reference role: group_table.cc — GroupTable):
+  // a non-empty key groups tensors enqueued together; the controller only
+  // schedules the group once ALL members are announced on all ranks.
+  std::string group_key;
+  int32_t group_size = 0;
 };
 
 double NowSeconds();

@@ -81,6 +81,45 @@ Controller::Controller(Transport* transport, const Config& config)
       cache_(config.cache_capacity),
       stall_(config.stall_warning_s, config.stall_shutdown_s) {}
 
+int Controller::RegisterProcessSet(std::vector<int> ranks) {
+  std::sort(ranks.begin(), ranks.end());
+  std::lock_guard<std::mutex> lock(ps_mu_);
+  // Identical registration already present -> same id (idempotent, like
+  // the reference's add_process_set of an existing set).
+  for (size_t i = 0; i < process_sets_.size(); ++i) {
+    if (process_sets_[i] == ranks) return static_cast<int>(i) + 1;
+  }
+  ranks.erase(std::unique(ranks.begin(), ranks.end()), ranks.end());
+  process_sets_.push_back(std::move(ranks));
+  return static_cast<int>(process_sets_.size());
+}
+
+std::vector<int> Controller::ProcessSetMembers(int id) const {
+  {
+    std::lock_guard<std::mutex> lock(ps_mu_);
+    if (id > 0 && id <= static_cast<int>(process_sets_.size())) {
+      return process_sets_[id - 1];
+    }
+  }
+  std::vector<int> world(transport_->size());
+  for (int r = 0; r < transport_->size(); ++r) world[r] = r;
+  return world;
+}
+
+bool Controller::KnownProcessSet(int id) const {
+  if (id == 0) return true;
+  std::lock_guard<std::mutex> lock(ps_mu_);
+  return id > 0 && id <= static_cast<int>(process_sets_.size());
+}
+
+bool Controller::IsMember(int set_id, int rank) const {
+  if (set_id <= 0) return true;
+  std::lock_guard<std::mutex> lock(ps_mu_);
+  if (set_id > static_cast<int>(process_sets_.size())) return false;
+  const auto& m = process_sets_[set_id - 1];
+  return std::binary_search(m.begin(), m.end(), rank);
+}
+
 Status Controller::ComputeResponseList(const std::vector<Request>& ready,
                                        bool request_shutdown, bool joining,
                                        ResponseList* out) {
@@ -91,7 +130,9 @@ Status Controller::ComputeResponseList(const std::vector<Request>& ready,
   int nbits = cache_.size();
   mine.cache_bits.assign((nbits + 63) / 64, 0);
   for (const auto& req : ready) {
-    int id = cache_.Lookup(req);
+    // Grouped tensors always take the slow path: the cache fast path has
+    // no group gating, and atomic groups must schedule all-or-nothing.
+    int id = req.group_key.empty() ? cache_.Lookup(req) : -1;
     if (id >= 0 && id < nbits) {
       mine.cache_bits[id / 64] |= (1ull << (id % 64));
       cache_.CountHit();
@@ -116,8 +157,10 @@ Status Controller::ComputeResponseList(const std::vector<Request>& ready,
 
   // Every rank mirrors the cache update from the broadcast responses, so
   // cache-id assignment stays rank-identical (ids follow response order).
+  // Grouped responses are excluded (their tensors must renegotiate as a
+  // group every time — see the announce phase above).
   for (const auto& resp : out->responses) {
-    if (!resp.error.empty() || resp.op == OpType::kBarrier ||
+    if (!resp.error.empty() || resp.grouped || resp.op == OpType::kBarrier ||
         resp.op == OpType::kJoin) {
       continue;
     }
@@ -131,6 +174,7 @@ Status Controller::ComputeResponseList(const std::vector<Request>& ready,
       sig.root_rank = resp.root_rank;
       sig.prescale = resp.prescale;
       sig.postscale = resp.postscale;
+      sig.process_set_id = resp.process_set_id;
       if (cache_.Lookup(sig) < 0) cache_.Put(sig);
     }
   }
@@ -166,71 +210,107 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
   }
   int joined_count = 0;
   for (int r = 0; r < size; ++r) joined_count += joined_[r] ? 1 : 0;
-  const int active = size - joined_count;
 
   std::vector<Response> responses;
 
-  // 1. Cache fast path: AND the ready-bitvectors of ACTIVE ranks; every
-  //    agreed bit is a ready tensor with a known signature. Joined ranks
-  //    contribute zeros at execution, so their vote is implicit.
-  size_t words = 0;
-  for (int r = 0; r < size; ++r) {
-    if (!joined_[r]) words = std::max(words, lists[r].cache_bits.size());
-  }
-  auto rank_bits = [&](int r, size_t w) -> uint64_t {
-    return w < lists[r].cache_bits.size() ? lists[r].cache_bits[w] : 0ull;
+  // 1. Cache fast path: a cached signature fires when every non-joined
+  //    MEMBER of its process set announced the bit. (Joined ranks
+  //    contribute zeros at execution, so their vote is implicit; non-member
+  //    ranks never vote.) Per-id scan — set-aware agreement doesn't reduce
+  //    to a word-wide AND, and nbits is small (<= cache capacity).
+  auto has_bit = [&](int r, int id) -> bool {
+    size_t w = static_cast<size_t>(id) / 64;
+    return w < lists[r].cache_bits.size() &&
+           ((lists[r].cache_bits[w] >> (id % 64)) & 1ull);
   };
-  for (size_t w = 0; w < words && active > 0; ++w) {
-    uint64_t agreed = ~0ull, seen = 0ull;
-    for (int r = 0; r < size; ++r) {
+  // Member lists resolved once per distinct set id per cycle — the cache
+  // scan runs every background cycle and must not allocate per id.
+  std::unordered_map<int32_t, std::vector<int>> members_by_set;
+  auto members_of = [&](int32_t set_id) -> const std::vector<int>& {
+    auto it = members_by_set.find(set_id);
+    if (it == members_by_set.end()) {
+      it = members_by_set.emplace(set_id, ProcessSetMembers(set_id)).first;
+    }
+    return it->second;
+  };
+  // A subset gather/broadcast whose member is joined cannot produce
+  // correct data (the joined rank's zero scratch lands verbatim in the
+  // output layout); subset allreduce composes fine — zeros plus the
+  // contributing-rank divisor, same as the world path.
+  auto joined_member_error = [&](const Request& req) -> std::string {
+    if (joined_count == 0 || req.process_set_id == 0 ||
+        req.op == OpType::kAllreduce || req.op == OpType::kBarrier) {
+      return "";
+    }
+    for (int r : members_of(req.process_set_id)) {
+      if (joined_[r]) {
+        return "op on tensor '" + req.name + "' in process set " +
+               std::to_string(req.process_set_id) + " has joined member "
+               "rank " + std::to_string(r) + "; subset collectives do not "
+               "compose with join()";
+      }
+    }
+    return "";
+  };
+  // OR all ranks' bit words first: ids nobody announced are skipped
+  // without touching the cache — idle cycles cost one word-OR pass, not a
+  // per-id scan (word-wide fast path preserved from the pre-set design).
+  size_t max_words = 0;
+  for (int r = 0; r < size; ++r) {
+    max_words = std::max(max_words, lists[r].cache_bits.size());
+  }
+  std::vector<uint64_t> any_bits(max_words, 0);
+  for (int r = 0; r < size; ++r) {
+    for (size_t w = 0; w < lists[r].cache_bits.size(); ++w) {
+      any_bits[w] |= lists[r].cache_bits[w];
+    }
+  }
+  int nbits_total = cache_.size();
+  std::vector<int> missing;  // reused across ids: no per-id allocation
+  for (int id = 0; id < nbits_total; ++id) {
+    if (!((id / 64) < static_cast<int>(any_bits.size()) &&
+          ((any_bits[id / 64] >> (id % 64)) & 1ull))) {
+      continue;  // nobody announced this id: not in flight this cycle
+    }
+    const Request& sig = cache_.Get(id);
+    const std::vector<int>& members = members_of(sig.process_set_id);
+    int contributors = 0;
+    missing.clear();
+    for (int r : members) {
       if (joined_[r]) continue;
-      agreed &= rank_bits(r, w);
-      seen |= rank_bits(r, w);
-    }
-    // Cached tensors announced by some-but-not-all ranks are stalls in the
-    // making too — track them so steady-state hangs still get reported.
-    uint64_t disagreed = seen & ~agreed;
-    while (disagreed) {
-      int bit = __builtin_ctzll(disagreed);
-      disagreed &= disagreed - 1;
-      int id = static_cast<int>(w) * 64 + bit;
-      std::vector<int> missing;
-      for (int r = 0; r < size; ++r) {
-        if (!joined_[r] && !(rank_bits(r, w) & (1ull << bit))) {
-          missing.push_back(r);
-        }
+      if (has_bit(r, id)) {
+        contributors++;
+      } else {
+        missing.push_back(r);
       }
-      stall_.RecordPending(cache_.Get(id).name, missing);
     }
-    uint64_t resolved = agreed;
-    while (resolved) {
-      int bit = __builtin_ctzll(resolved);
-      resolved &= resolved - 1;
-      stall_.RecordResolved(cache_.Get(static_cast<int>(w) * 64 + bit).name);
+    if (contributors == 0) continue;
+    if (!missing.empty()) {
+      // Announced by some-but-not-all members: a stall in the making —
+      // track it so steady-state hangs still get reported.
+      stall_.RecordPending(sig.name, missing);
+      continue;
     }
-    while (agreed) {
-      int bit = __builtin_ctzll(agreed);
-      agreed &= agreed - 1;
-      int id = static_cast<int>(w) * 64 + bit;
-      const Request& sig = cache_.Get(id);
-      Response resp;
-      resp.op = sig.op;
-      resp.reduce_op = sig.reduce_op;
-      resp.dtype = sig.dtype;
-      resp.root_rank = sig.root_rank;
-      resp.prescale = sig.prescale;
-      resp.postscale = sig.postscale;
-      resp.tensor_names = {sig.name};
-      resp.counts = {sig.count};
-      resp.active_ranks = active;
-      if (joined_count > 0 && sig.op != OpType::kAllreduce &&
-          sig.op != OpType::kBarrier) {
-        resp.error = "op on tensor '" + sig.name +
-                     "' is not supported while rank(s) are joined (only "
-                     "allreduce/barrier compose with zero contributions)";
-      }
-      responses.push_back(std::move(resp));
+    stall_.RecordResolved(sig.name);
+    Response resp;
+    resp.op = sig.op;
+    resp.reduce_op = sig.reduce_op;
+    resp.dtype = sig.dtype;
+    resp.root_rank = sig.root_rank;
+    resp.prescale = sig.prescale;
+    resp.postscale = sig.postscale;
+    resp.tensor_names = {sig.name};
+    resp.counts = {sig.count};
+    resp.active_ranks = contributors;
+    resp.process_set_id = sig.process_set_id;
+    if (joined_count > 0 && sig.process_set_id == 0 &&
+        sig.op != OpType::kAllreduce && sig.op != OpType::kBarrier) {
+      resp.error = "op on tensor '" + sig.name +
+                   "' is not supported while rank(s) are joined (only "
+                   "allreduce/barrier compose with zero contributions)";
     }
+    if (resp.error.empty()) resp.error = joined_member_error(sig);
+    responses.push_back(std::move(resp));
   }
   // Cached-but-not-agreed bits stay pending on the ranks that set them; they
   // will be re-announced next cycle (the entry lives in the worker's queue).
@@ -262,16 +342,31 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
     }
   }
 
-  // 3. Promote tensors announced by every ACTIVE rank to responses
-  //    (deterministic order: map iteration is name-sorted). Joined ranks
-  //    participate in execution with zero contributions.
+  // 3. Promote tensors announced by every ACTIVE member of their process
+  //    set (deterministic order: map iteration is name-sorted). Joined
+  //    ranks participate in execution with zero contributions. Atomic
+  //    groups (GroupTable role) promote all-or-nothing: a fully-announced
+  //    member still waits until every tensor of its group is fully
+  //    announced too.
+  auto set_missing = [&](const PendingTensor& pt, std::vector<int>* missing) {
+    for (int r : members_of(pt.request.process_set_id)) {
+      if (!pt.announced[r] && !joined_[r]) missing->push_back(r);
+    }
+  };
+  std::map<std::string, int> group_ready;  // group_key -> fully-announced
+  for (auto& [name, pt] : message_table_) {
+    if (pt.request.group_key.empty()) continue;
+    std::vector<int> missing;
+    set_missing(pt, &missing);
+    if (missing.empty()) group_ready[pt.request.group_key]++;
+  }
   for (auto it = message_table_.begin(); it != message_table_.end();) {
     PendingTensor& pt = it->second;
     std::vector<int> missing;
-    for (int r = 0; r < size; ++r) {
-      if (!pt.announced[r] && !joined_[r]) missing.push_back(r);
-    }
-    if (missing.empty()) {
+    set_missing(pt, &missing);
+    bool group_ok = pt.request.group_key.empty() ||
+                    group_ready[pt.request.group_key] >= pt.request.group_size;
+    if (missing.empty() && group_ok) {
       const Request& req = pt.request;
       Response resp;
       resp.op = req.op;
@@ -283,17 +378,28 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
       resp.tensor_names = {req.name};
       resp.counts = {req.count};
       resp.active_ranks = pt.announce_count;
-      if (joined_count > 0 && req.op != OpType::kAllreduce &&
-          req.op != OpType::kBarrier) {
+      resp.process_set_id = req.process_set_id;
+      resp.grouped = !req.group_key.empty();
+      if (joined_count > 0 && req.process_set_id == 0 &&
+          req.op != OpType::kAllreduce && req.op != OpType::kBarrier) {
         resp.error = "op on tensor '" + req.name +
                      "' is not supported while rank(s) are joined (only "
                      "allreduce/barrier compose with zero contributions)";
       }
+      if (req.process_set_id != 0 &&
+          (req.op == OpType::kAlltoall || req.op == OpType::kReducescatter)) {
+        resp.error = "op on tensor '" + req.name +
+                     "' does not support non-global process sets in the "
+                     "native data plane (allreduce/allgather/broadcast/"
+                     "barrier do); use the traced XLA path for subset " +
+                     "alltoall/reducescatter";
+      }
+      if (resp.error.empty()) resp.error = joined_member_error(req);
       responses.push_back(std::move(resp));
       stall_.RecordResolved(it->first);
       it = message_table_.erase(it);
     } else {
-      stall_.RecordPending(it->first, missing);
+      if (!missing.empty()) stall_.RecordPending(it->first, missing);
       ++it;
     }
   }
@@ -357,6 +463,8 @@ void Controller::FuseResponses(std::vector<Response>* responses) {
           cand.dtype == base.dtype && cand.prescale == base.prescale &&
           cand.postscale == base.postscale &&
           cand.active_ranks == base.active_ranks &&
+          cand.process_set_id == base.process_set_id &&
+          cand.grouped == base.grouped &&
           bytes + cand_bytes <= config_.fusion_threshold_bytes) {
         base.tensor_names.push_back(cand.tensor_names[0]);
         base.counts.push_back(cand.counts[0]);

@@ -10,6 +10,7 @@
 
 #include <cstdint>
 #include <map>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -82,6 +83,16 @@ class Controller {
 
   ResponseCache& cache() { return cache_; }
 
+  // Process-set table (reference: process_set.cc — ProcessSetTable).
+  // Registration contract mirrors the reference: every rank registers the
+  // same sets in the same order, so ids agree without extra coordination.
+  // Returns the new set id. Set 0 is the world (implicit).
+  int RegisterProcessSet(std::vector<int> ranks);
+  // Members of a set (world when id is 0 or unknown).
+  std::vector<int> ProcessSetMembers(int id) const;
+  bool IsMember(int set_id, int rank) const;
+  bool KnownProcessSet(int id) const;
+
   // Live autotune hook: the background loop re-points the fusion budget
   // when the ParameterManager steps (reference: ParameterManager feeding
   // Controller's fusion threshold).
@@ -108,6 +119,11 @@ class Controller {
   // current join round; cleared when the kJoin response fires.
   std::vector<bool> joined_;
   int last_joined_ = -1;
+  // id (minus 1) -> sorted member ranks; id 0 (world) is implicit.
+  // Guarded: registration happens on API threads while the background
+  // thread reads during negotiation/execution.
+  mutable std::mutex ps_mu_;
+  std::vector<std::vector<int>> process_sets_;
 };
 
 }  // namespace hvdrt

@@ -58,13 +58,18 @@ void PutRequest(Writer* w, const Request& r) {
   w->Put<int32_t>(r.root_rank);
   w->Put<double>(r.prescale);
   w->Put<double>(r.postscale);
+  w->Put<int32_t>(r.process_set_id);
+  w->PutString(r.group_key);
+  w->Put<int32_t>(r.group_size);
 }
 
 bool GetRequest(Reader* rd, Request* r) {
   uint8_t op, rop, dt;
   if (!rd->GetString(&r->name) || !rd->Get(&op) || !rd->Get(&rop) ||
       !rd->Get(&dt) || !rd->Get(&r->count) || !rd->Get(&r->root_rank) ||
-      !rd->Get(&r->prescale) || !rd->Get(&r->postscale)) {
+      !rd->Get(&r->prescale) || !rd->Get(&r->postscale) ||
+      !rd->Get(&r->process_set_id) || !rd->GetString(&r->group_key) ||
+      !rd->Get(&r->group_size)) {
     return false;
   }
   r->op = static_cast<OpType>(op);
@@ -78,6 +83,8 @@ void PutResponse(Writer* w, const Response& r) {
   w->Put<uint8_t>(static_cast<uint8_t>(r.reduce_op));
   w->Put<uint8_t>(static_cast<uint8_t>(r.dtype));
   w->Put<int32_t>(r.active_ranks);
+  w->Put<int32_t>(r.process_set_id);
+  w->Put<uint8_t>(r.grouped ? 1 : 0);
   w->Put<int32_t>(r.root_rank);
   w->Put<double>(r.prescale);
   w->Put<double>(r.postscale);
@@ -90,10 +97,11 @@ void PutResponse(Writer* w, const Response& r) {
 }
 
 bool GetResponse(Reader* rd, Response* r) {
-  uint8_t op, rop, dt;
+  uint8_t op, rop, dt, grouped = 0;
   uint32_t n = 0;
   if (!rd->Get(&op) || !rd->Get(&rop) || !rd->Get(&dt) ||
-      !rd->Get(&r->active_ranks) || !rd->Get(&r->root_rank) ||
+      !rd->Get(&r->active_ranks) || !rd->Get(&r->process_set_id) ||
+      !rd->Get(&grouped) || !rd->Get(&r->root_rank) ||
       !rd->Get(&r->prescale) || !rd->Get(&r->postscale) ||
       !rd->GetString(&r->error) || !rd->Get(&n)) {
     return false;
@@ -101,6 +109,7 @@ bool GetResponse(Reader* rd, Response* r) {
   r->op = static_cast<OpType>(op);
   r->reduce_op = static_cast<ReduceOp>(rop);
   r->dtype = static_cast<DType>(dt);
+  r->grouped = grouped != 0;
   r->tensor_names.resize(n);
   r->counts.resize(n);
   for (uint32_t i = 0; i < n; ++i) {

@@ -23,13 +23,19 @@ struct Request {
   int32_t root_rank;
   double prescale;
   double postscale;
+  int32_t process_set_id = 0;  // 0 = world (ProcessSetTable role)
+  std::string group_key;       // non-empty = atomic group (GroupTable role)
+  int32_t group_size = 0;
 
   // Signature identity: two requests match iff all of these agree. The
   // coordinator validates cross-rank consistency (mismatch = user bug).
+  // Group fields are deliberately excluded: grouping is scheduling intent,
+  // not tensor identity.
   bool SameSignature(const Request& o) const {
     return name == o.name && op == o.op && reduce_op == o.reduce_op &&
            dtype == o.dtype && count == o.count && root_rank == o.root_rank &&
-           prescale == o.prescale && postscale == o.postscale;
+           prescale == o.prescale && postscale == o.postscale &&
+           process_set_id == o.process_set_id;
   }
 };
 
@@ -57,6 +63,13 @@ struct Response {
   // some ranks are joined; Average divides by this, joined ranks
   // participate in the ring with zeros.
   int32_t active_ranks = 0;
+  // Process set the collective runs over (0 = world). Non-member ranks
+  // still execute the response — participating in the world ring with
+  // identity-element contributions — but have no local entries.
+  int32_t process_set_id = 0;
+  // True when these tensors were enqueued as an atomic group: excluded
+  // from the response cache so group scheduling stays all-or-nothing.
+  bool grouped = false;
 };
 
 struct ResponseList {

@@ -14,7 +14,9 @@
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
+#include <cstdlib>
 #include <cstring>
+#include <limits>
 #include <deque>
 #include <memory>
 #include <mutex>
@@ -98,6 +100,57 @@ void FailAllPending(GlobalState* st, const std::string& error) {
   st->cv.notify_all();
 }
 
+// Identity element for a reduction: contributions that cannot change the
+// result (non-member ranks of a process set ride the world ring with these).
+void FillIdentity(void* buf, int64_t count, DType dtype, ReduceOp op) {
+  if (op != ReduceOp::kMin && op != ReduceOp::kMax) {
+    std::memset(buf, 0, static_cast<size_t>(count) * DTypeSize(dtype));
+    return;
+  }
+  const bool want_max = op == ReduceOp::kMin;  // min's identity is +inf
+  switch (dtype) {
+    case DType::kFloat32: {
+      float v = want_max ? std::numeric_limits<float>::infinity()
+                         : -std::numeric_limits<float>::infinity();
+      std::fill_n(static_cast<float*>(buf), count, v);
+      break;
+    }
+    case DType::kFloat64: {
+      double v = want_max ? std::numeric_limits<double>::infinity()
+                          : -std::numeric_limits<double>::infinity();
+      std::fill_n(static_cast<double*>(buf), count, v);
+      break;
+    }
+    case DType::kInt32: {
+      int32_t v = want_max ? std::numeric_limits<int32_t>::max()
+                           : std::numeric_limits<int32_t>::min();
+      std::fill_n(static_cast<int32_t*>(buf), count, v);
+      break;
+    }
+    case DType::kInt64: {
+      int64_t v = want_max ? std::numeric_limits<int64_t>::max()
+                           : std::numeric_limits<int64_t>::min();
+      std::fill_n(static_cast<int64_t*>(buf), count, v);
+      break;
+    }
+    case DType::kUint8: {
+      std::memset(buf, want_max ? 0xFF : 0x00,
+                  static_cast<size_t>(count));
+      break;
+    }
+    case DType::kFloat16: {
+      uint16_t v = want_max ? 0x7C00 : 0xFC00;  // +/-inf
+      std::fill_n(static_cast<uint16_t*>(buf), count, v);
+      break;
+    }
+    case DType::kBFloat16: {
+      uint16_t v = want_max ? 0x7F80 : 0xFF80;  // +/-inf
+      std::fill_n(static_cast<uint16_t*>(buf), count, v);
+      break;
+    }
+  }
+}
+
 // Execute one (possibly fused) response on this rank.
 void PerformOperation(GlobalState* st, const Response& resp) {
   if (resp.op == OpType::kJoin) {
@@ -114,9 +167,13 @@ void PerformOperation(GlobalState* st, const Response& resp) {
     return;
   }
 
-  // Collect the local entries. A joined rank receives responses for
-  // tensors it never enqueued: it participates in the ring with
-  // zero-filled scratch (the reference JoinOp's zero contribution).
+  // Collect the local entries. Two cases legitimately have none: a joined
+  // rank serving peers' allreduces (zero contribution — the reference
+  // JoinOp), and a rank outside the response's process set riding the
+  // world ring with identity-element contributions.
+  const bool is_member =
+      resp.process_set_id == 0 ||
+      st->controller->IsMember(resp.process_set_id, st->rank);
   std::vector<TensorEntry> entries;
   std::vector<std::unique_ptr<std::vector<char>>> scratch;
   {
@@ -126,9 +183,15 @@ void PerformOperation(GlobalState* st, const Response& resp) {
       const auto& name = resp.tensor_names[i];
       auto it = st->pending.find(name);
       if (it == st->pending.end()) {
-        if (st->joining.load()) {
+        if (st->joining.load() || !is_member) {
           scratch.emplace_back(new std::vector<char>(
               static_cast<size_t>(resp.counts[i]) * elem0, 0));
+          if (!is_member && resp.op == OpType::kAllreduce) {
+            // Joined ranks contribute zeros even to Min/Max (reference
+            // caveat, docs/join.md); non-members must be invisible.
+            FillIdentity(scratch.back()->data(), resp.counts[i], resp.dtype,
+                         resp.reduce_op);
+          }
           TensorEntry dummy;
           dummy.handle = -1;
           dummy.name = name;
@@ -173,8 +236,23 @@ void PerformOperation(GlobalState* st, const Response& resp) {
       for (int64_t c : resp.counts) total += c;
       // Average divides by the CONTRIBUTING rank count: with joined ranks
       // (zero contributions) that's resp.active_ranks, not world size —
-      // so the ring runs Sum and the scale is applied here.
-      int active = resp.active_ranks > 0 ? resp.active_ranks : t->size();
+      // so the ring runs Sum and the scale is applied here. This is an
+      // intentional deviation from the reference (which divides by full
+      // process-set size, diluting the gradient as ranks join);
+      // HOROVOD_JOIN_FULL_DIVISOR=1 restores reference behavior.
+      static const bool full_divisor = [] {
+        const char* env = std::getenv("HOROVOD_JOIN_FULL_DIVISOR");
+        return env && std::atoi(env) != 0;
+      }();
+      const int full_size =
+          resp.process_set_id == 0
+              ? t->size()
+              : static_cast<int>(
+                    st->controller->ProcessSetMembers(resp.process_set_id)
+                        .size());
+      int active = (!full_divisor && resp.active_ranks > 0)
+                       ? resp.active_ranks
+                       : full_size;
       ReduceOp ring_op = resp.reduce_op == ReduceOp::kAverage
                              ? ReduceOp::kSum
                              : resp.reduce_op;
@@ -234,7 +312,25 @@ void PerformOperation(GlobalState* st, const Response& resp) {
     case OpType::kAllgather: {
       TensorEntry& e = entries[0];
       st->timeline.Begin(e.name, "RING_ALLGATHER");
-      s = t->Allgather(e.input, e.output, e.count, resp.dtype);
+      if (resp.process_set_id == 0) {
+        s = t->Allgather(e.input, e.output, e.count, resp.dtype);
+      } else {
+        // Subset allgather rides the world ring: gather ALL ranks' chunks
+        // into scratch, then members compact the member chunks (in rank
+        // order) into their output. Non-members discard.
+        std::vector<char> tmp(static_cast<size_t>(t->size()) *
+                              static_cast<size_t>(e.count) * elem);
+        s = t->Allgather(e.input, tmp.data(), e.count, resp.dtype);
+        if (s.ok && is_member) {
+          size_t chunk = static_cast<size_t>(e.count) * elem;
+          size_t off = 0;
+          for (int r : st->controller->ProcessSetMembers(resp.process_set_id)) {
+            std::memcpy(static_cast<char*>(e.output) + off,
+                        tmp.data() + static_cast<size_t>(r) * chunk, chunk);
+            off += chunk;
+          }
+        }
+      }
       st->timeline.End(e.name);
       break;
     }
@@ -297,6 +393,9 @@ bool RunLoopOnce(GlobalState* st) {
       r.root_rank = e.root_rank;
       r.prescale = e.prescale;
       r.postscale = e.postscale;
+      r.process_set_id = e.process_set_id;
+      r.group_key = e.group_key;
+      r.group_size = e.group_size;
       ready.push_back(std::move(r));
     }
   }
@@ -384,8 +483,9 @@ using namespace hvdrt;
 extern "C" {
 
 // Returns 0 on success, -1 on error (hvdrt_last_error() has details).
+// exchange_timeout_s <= 0 defers to HOROVOD_EXCHANGE_TIMEOUT / 600s.
 int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
-               double timeout_s) {
+               double timeout_s, double exchange_timeout_s) {
   std::lock_guard<std::mutex> lock(g_init_mu);
   GlobalState* prev = g.load();
   if (prev != nullptr && prev->initialized.load()) {
@@ -407,7 +507,8 @@ int hvdrt_init(int rank, int size, const char* coord_addr, int coord_port,
   st->mark_cycles = EnvInt("HOROVOD_TIMELINE_MARK_CYCLES", 0) != 0;
 
   Status s = Transport::Create(rank, size, coord_addr ? coord_addr : "127.0.0.1",
-                               coord_port, timeout_s, &st->transport);
+                               coord_port, timeout_s, &st->transport,
+                               exchange_timeout_s);
   if (!s.ok) {
     SetError(s.error);
     delete st;
@@ -451,27 +552,45 @@ int hvdrt_is_initialized() {
   return (st != nullptr && st->initialized.load()) ? 1 : 0;
 }
 
-// Enqueue a collective; returns handle >= 0, or -1 on error.
-// count semantics per op: allreduce/broadcast: elements of the tensor;
-// allgather: input elements (output = size*count); alltoall: input elements
-// (must divide by size); reducescatter: input elements (output = count/size).
-int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
-                  const void* input, void* output, long long count,
-                  int root_rank, double prescale, double postscale) {
+// 1 iff initialized AND the background loop is still serving (a fatal
+// control-plane error leaves the runtime initialized-but-dead; callers
+// caching a world handle must check THIS, not is_initialized, or elastic
+// recovery retries against a corpse forever).
+int hvdrt_is_alive() {
   GlobalState* st = g.load();
-  if (st == nullptr || !st->initialized.load()) {
-    SetError("not initialized");
-    return -1;
-  }
-  if (st->background_dead.load()) {
-    SetError("runtime is dead: " + st->fatal_error);
-    return -1;
-  }
+  return (st != nullptr && st->initialized.load() &&
+          !st->background_dead.load())
+             ? 1
+             : 0;
+}
+
+namespace {
+
+// Shared validation + entry construction. Returns false with tl_last_error
+// set on failure. Caller must hold no locks.
+bool PrepareEntry(GlobalState* st, const char* name, int op, int reduce_op,
+                  int dtype, const void* input, void* output, long long count,
+                  int root_rank, double prescale, double postscale,
+                  int process_set_id, TensorEntry* out) {
   if (static_cast<OpType>(op) == OpType::kBroadcast &&
       (root_rank < 0 || root_rank >= st->size)) {
     SetError("broadcast root_rank " + std::to_string(root_rank) +
              " out of range for world size " + std::to_string(st->size));
-    return -1;
+    return false;
+  }
+  if (process_set_id != 0) {
+    if (!st->controller->IsMember(process_set_id, st->rank)) {
+      SetError("this rank (" + std::to_string(st->rank) + ") is not a "
+               "member of process set " + std::to_string(process_set_id));
+      return false;
+    }
+    if (static_cast<OpType>(op) == OpType::kBroadcast &&
+        !st->controller->IsMember(process_set_id, root_rank)) {
+      SetError("broadcast root_rank " + std::to_string(root_rank) +
+               " is not a member of process set " +
+               std::to_string(process_set_id));
+      return false;
+    }
   }
   TensorEntry e;
   e.name = name;
@@ -484,20 +603,168 @@ int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
   e.postscale = postscale;
   e.input = input;
   e.output = output;
+  e.process_set_id = process_set_id;
   e.enqueue_time_s = NowSeconds();
+  *out = std::move(e);
+  return true;
+}
+
+// Push entries under one lock acquisition (atomicity for groups). Returns
+// the first handle, filling `handles` in order; -1 on any name conflict
+// (no entry enqueued).
+int PushEntries(GlobalState* st, std::vector<TensorEntry>* entries,
+                std::vector<int32_t>* handles) {
   std::lock_guard<std::mutex> lock(st->mu);
-  if (st->pending.count(e.name) ||
-      std::any_of(st->queue.begin(), st->queue.end(),
-                  [&](const TensorEntry& q) { return q.name == e.name; })) {
-    SetError("tensor '" + e.name + "' is already in flight (names must be "
-             "unique per outstanding op, as in the reference)");
+  for (size_t i = 0; i < entries->size(); ++i) {
+    const auto& e = (*entries)[i];
+    // Unique against in-flight names AND within this batch — a duplicated
+    // name inside one group would leave its second handle hanging forever
+    // (the message table is keyed by name).
+    for (size_t j = 0; j < i; ++j) {
+      if ((*entries)[j].name == e.name) {
+        SetError("duplicate tensor name '" + e.name + "' within one "
+                 "grouped enqueue");
+        return -1;
+      }
+    }
+    if (st->pending.count(e.name) ||
+        std::any_of(st->queue.begin(), st->queue.end(),
+                    [&](const TensorEntry& q) { return q.name == e.name; })) {
+      SetError("tensor '" + e.name + "' is already in flight (names must be "
+               "unique per outstanding op, as in the reference)");
+      return -1;
+    }
+  }
+  int32_t first = -1;
+  for (auto& e : *entries) {
+    int32_t handle = st->next_handle++;
+    e.handle = handle;
+    st->handles[handle] = HandleState{};
+    if (first < 0) first = handle;
+    if (handles) handles->push_back(handle);
+    st->queue.push_back(std::move(e));
+  }
+  return first;
+}
+
+bool CheckAlive(GlobalState* st) {
+  if (st == nullptr || !st->initialized.load()) {
+    SetError("not initialized");
+    return false;
+  }
+  if (st->background_dead.load()) {
+    SetError("runtime is dead: " + st->fatal_error);
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+// Enqueue a collective; returns handle >= 0, or -1 on error.
+// count semantics per op: allreduce/broadcast: elements of the tensor;
+// allgather: input elements (output = size*count); alltoall: input elements
+// (must divide by size); reducescatter: input elements (output = count/size).
+int hvdrt_enqueue(const char* name, int op, int reduce_op, int dtype,
+                  const void* input, void* output, long long count,
+                  int root_rank, double prescale, double postscale) {
+  GlobalState* st = g.load();
+  if (!CheckAlive(st)) return -1;
+  std::vector<TensorEntry> entries(1);
+  if (!PrepareEntry(st, name, op, reduce_op, dtype, input, output, count,
+                    root_rank, prescale, postscale, 0, &entries[0])) {
     return -1;
   }
-  int32_t handle = st->next_handle++;
-  e.handle = handle;
-  st->handles[handle] = HandleState{};
-  st->queue.push_back(std::move(e));
-  return handle;
+  return PushEntries(st, &entries, nullptr);
+}
+
+// Process-set variant: the collective runs over the registered subset;
+// count/output semantics are relative to the SET size (e.g. allgather
+// output = set_size * count). Reference: per-op `process_set=` arguments
+// backed by process_set.cc.
+int hvdrt_enqueue_ps(const char* name, int op, int reduce_op, int dtype,
+                     const void* input, void* output, long long count,
+                     int root_rank, double prescale, double postscale,
+                     int process_set_id) {
+  GlobalState* st = g.load();
+  if (!CheckAlive(st)) return -1;
+  std::vector<TensorEntry> entries(1);
+  if (!PrepareEntry(st, name, op, reduce_op, dtype, input, output, count,
+                    root_rank, prescale, postscale, process_set_id,
+                    &entries[0])) {
+    return -1;
+  }
+  return PushEntries(st, &entries, nullptr);
+}
+
+// Atomic grouped enqueue (reference: GroupTable / hvd.grouped_allreduce):
+// all n tensors are registered under ONE queue lock with a shared group
+// key; the controller schedules the group all-or-nothing and the cache
+// fast path is bypassed so partial groups can never fire. handles_out
+// receives n handles. Returns 0 on success, -1 on error (nothing queued).
+int hvdrt_enqueue_group(int n, const char** names, int op, int reduce_op,
+                        int dtype, const void** inputs, void** outputs,
+                        const long long* counts, int process_set_id,
+                        double prescale, double postscale, int* handles_out) {
+  GlobalState* st = g.load();
+  if (!CheckAlive(st)) return -1;
+  if (n <= 0) {
+    SetError("empty group");
+    return -1;
+  }
+  // Rank-identical group key (names are identical across ranks by the
+  // same contract that makes negotiation work).
+  std::string joined;
+  for (int i = 0; i < n; ++i) {
+    joined += names[i];
+    joined += '\x1f';
+  }
+  std::string key = "g" + std::to_string(std::hash<std::string>{}(joined));
+  std::vector<TensorEntry> entries(n);
+  for (int i = 0; i < n; ++i) {
+    if (!PrepareEntry(st, names[i], op, reduce_op, dtype, inputs[i],
+                      outputs[i], counts[i], 0, prescale, postscale,
+                      process_set_id, &entries[i])) {
+      return -1;
+    }
+    entries[i].group_key = key;
+    entries[i].group_size = n;
+  }
+  std::vector<int32_t> handles;
+  if (PushEntries(st, &entries, &handles) < 0) return -1;
+  for (int i = 0; i < n; ++i) handles_out[i] = handles[i];
+  return 0;
+}
+
+// Register a process set (collective contract: every rank registers the
+// same sets in the same order, as in the reference's add_process_set).
+// Returns the set id (> 0), or -1 on error.
+int hvdrt_register_process_set(const int* ranks, int nranks) {
+  GlobalState* st = g.load();
+  if (!CheckAlive(st)) return -1;
+  if (nranks <= 0) {
+    SetError("process set must have at least one rank");
+    return -1;
+  }
+  std::vector<int> v(ranks, ranks + nranks);
+  for (int r : v) {
+    if (r < 0 || r >= st->size) {
+      SetError("process set rank " + std::to_string(r) +
+               " out of range for world size " + std::to_string(st->size));
+      return -1;
+    }
+  }
+  return st->controller->RegisterProcessSet(std::move(v));
+}
+
+// Number of ranks in a set (world when id = 0); -1 if unknown.
+int hvdrt_process_set_size(int process_set_id) {
+  GlobalState* st = g.load();
+  if (st == nullptr || !st->initialized.load()) return -1;
+  if (process_set_id == 0) return st->size;
+  if (!st->controller->KnownProcessSet(process_set_id)) return -1;
+  return static_cast<int>(
+      st->controller->ProcessSetMembers(process_set_id).size());
 }
 
 // 1 = done, 0 = pending, -1 = unknown handle.

@@ -194,9 +194,18 @@ void ScaleBuffer(void* buf, int64_t count, DType dtype, double factor) {
 
 Status Transport::Create(int rank, int size, const std::string& coord_addr,
                          int coord_port, double timeout_s,
-                         std::unique_ptr<Transport>* out) {
+                         std::unique_ptr<Transport>* out,
+                         double exchange_timeout_s) {
   std::unique_ptr<Transport> t(new Transport(rank, size));
-  t->timeout_s_ = timeout_s;
+  // Data-plane inactivity deadline: NOT the connect timeout. Connection
+  // setup failing fast (30s) is right; killing an in-flight collective
+  // because a peer paused 30s is not. Explicit parameter > env > 600s.
+  double exchange_timeout = 600.0;
+  if (const char* env = std::getenv("HOROVOD_EXCHANGE_TIMEOUT")) {
+    exchange_timeout = std::atof(env);
+  }
+  if (exchange_timeout_s > 0.0) exchange_timeout = exchange_timeout_s;
+  t->timeout_s_ = exchange_timeout;
   if (size == 1) {
     *out = std::move(t);
     return Status::OK();

@@ -25,9 +25,12 @@ class Transport {
  public:
   // Collective bootstrap. rank 0 listens on coord_port; everyone ends up
   // with control sockets (star) + ring neighbor sockets (data).
+  // exchange_timeout_s: data-plane inactivity bound (<=0 = env
+  // HOROVOD_EXCHANGE_TIMEOUT, default 600; explicit value wins).
   static Status Create(int rank, int size, const std::string& coord_addr,
                        int coord_port, double timeout_s,
-                       std::unique_ptr<Transport>* out);
+                       std::unique_ptr<Transport>* out,
+                       double exchange_timeout_s = 0.0);
 
   int rank() const { return rank_; }
   int size() const { return size_; }
@@ -64,7 +67,11 @@ class Transport {
                              size_t elem, int owner_shift);
 
   int rank_, size_;
-  // Inactivity bound for ring exchanges (from Create's timeout_s; <=0 =
+  // Inactivity bound for ring exchanges. Deliberately SEPARATE from
+  // Create's connection-setup timeout: a peer paused >30s without moving
+  // bytes (debugger, host GC/swap) is a recoverable wait, not a dead wire.
+  // Default 600s, configurable via HOROVOD_EXCHANGE_TIMEOUT (seconds;
+  // <=0 =
   // block forever).
   double timeout_s_ = 0.0;
   // Control: root holds size-1 worker sockets (index rank-1); workers hold
