@@ -88,6 +88,10 @@ def _maybe_init_distributed() -> None:
     proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "-1") or -1)
     if coord and nprocs > 1 and proc_id >= 0:
         coord = _exchange_coordinator_port(coord, proc_id)
+        # Write the resolved address back so downstream consumers (e.g. the
+        # native host world, which shares the coordinator host) never see
+        # the unresolved 'self' sentinel.
+        os.environ["HOROVOD_COORDINATOR_ADDR"] = coord
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nprocs,
@@ -188,6 +192,17 @@ def shutdown() -> None:
             except Exception as e:  # broken world: still clear the flag
                 get_logger().warning("jax.distributed.shutdown failed: %s", e)
             _state.distributed_initialized = False
+        # The native host world (libhvdrt) is per-epoch too: tear it down
+        # so elastic re-init forms a fresh one instead of retrying against
+        # a dead runtime forever.
+        from .parallel import hierarchical
+
+        if hierarchical._host_world is not None:
+            try:
+                hierarchical._host_world.shutdown()
+            except Exception as e:
+                get_logger().warning("native world shutdown failed: %s", e)
+            hierarchical._host_world = None
         if not _state.initialized:
             return
         from . import process_sets

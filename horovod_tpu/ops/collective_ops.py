@@ -170,8 +170,46 @@ def _reducescatter_traced(x, op, axis_name, prescale_factor, postscale_factor):
 
 # ---------------------------------------------------------------------------
 # Eager-regime dispatch: stacked-rank arrays over the set's sub-mesh,
-# executed via the compiled-executable cache.
+# executed via the compiled-executable cache. In multi-controller worlds, a
+# host tensor WITHOUT the stacking axis takes the native-runtime host path.
 # ---------------------------------------------------------------------------
+
+
+def _native_world_if_per_process(ps, x):
+    """Return the NativeWorld when the reference's per-process scripting
+    idiom applies, else None.
+
+    In a multi-controller world (``hvdrun -np N``), ``hvd.allreduce(t)``
+    on HOST data (numpy array, list, scalar) means "reduce MY tensor
+    across processes" — the reference's most common idiom
+    (``horovod.torch.mpi_ops.allreduce``). That cannot compile as one XLA
+    program (each controller holds only its own value), so it routes
+    through the native C++ runtime's host data plane (negotiation +
+    response cache + fusion + TCP ring — the reference's MPI/Gloo role).
+
+    A ``jax.Array`` keeps the compiled stacked-rank path: device data is
+    the single-controller/global regime, and jax itself requires it to be
+    process-identical. The dispatch is by TYPE, not shape — a shape
+    heuristic would misroute host tensors whose leading dim happens to
+    equal the device-world size.
+    """
+    import os
+
+    nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+    if nprocs <= 1:
+        return None
+    if isinstance(x, jax.Array):
+        return None  # stacked-rank compiled path (global device data)
+    if ps.process_set_id != 0:
+        raise ValueError(
+            "per-process eager collectives on a non-global process set are "
+            "not supported by the native runtime yet; use the stacked-rank "
+            "convention (pass a jax.Array) or a traced (shard_map) "
+            "collective"
+        )
+    from ..parallel.hierarchical import _default_native_world
+
+    return _default_native_world()
 
 
 def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
@@ -267,7 +305,6 @@ def allreduce(
     ``horovod/common/ops/*_operations.cc`` Allreduce classes. On TPU this is
     one AllReduce HLO over the ICI ring of the set's sub-mesh.
     """
-    del name  # names exist for the reference's negotiation; nothing to key here
     op = _resolve_op(op, average)
     ps = _resolve_process_set(process_set)
     traced_axis = _effective_traced_axis(ps)
@@ -275,6 +312,20 @@ def allreduce(
         return _allreduce_traced(
             tensor, op, traced_axis, prescale_factor, postscale_factor
         )
+    world = _native_world_if_per_process(ps, tensor)
+    if world is not None:
+        if op not in (Sum, Average, Min, Max):
+            raise ValueError(
+                f"per-process eager allreduce supports Sum/Average/Min/Max; "
+                f"got {op!r} (use the traced regime for {op})"
+            )
+        import numpy as np
+
+        return world.allreduce(
+            np.ascontiguousarray(tensor), name=name, op=op,
+            prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        )
+    del name  # names exist for runtime negotiation; nothing to key here
     traced = functools.partial(
         _allreduce_traced,
         op=op,
@@ -315,6 +366,26 @@ def grouped_allreduce(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
         )
+    tensors = list(tensors)
+    # Type-based dispatch (see _native_world_if_per_process): a group of
+    # host tensors is per-process; jax.Arrays keep the compiled path. A
+    # mixed group follows its first member — splitting one group across
+    # two data planes would break the atomicity contract.
+    world = _native_world_if_per_process(ps, tensors[0]) if tensors else None
+    if world is not None:
+        if op not in (Sum, Average, Min, Max):
+            raise ValueError(
+                f"per-process eager grouped_allreduce supports "
+                f"Sum/Average/Min/Max; got {op!r} (use the traced regime)"
+            )
+        import numpy as np
+
+        # Atomic enqueue of the whole group (GroupTable semantics); the
+        # native controller schedules and fuses it as one ring collective.
+        return world.grouped_allreduce(
+            [np.ascontiguousarray(t) for t in tensors], op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
     return [
         allreduce(
             t,
@@ -334,11 +405,16 @@ def allgather(tensor, process_set=None, name: str | None = None):
     shapes on TPU); the reference's ragged first dimension is handled at the
     object layer (``allgather_object``) via pad+size-exchange.
     """
-    del name
     ps = _resolve_process_set(process_set)
     traced_axis = _effective_traced_axis(ps)
     if traced_axis is not None:
         return _allgather_traced(tensor, traced_axis)
+    world = _native_world_if_per_process(ps, tensor)
+    if world is not None:
+        import numpy as np
+
+        return world.allgather(np.ascontiguousarray(tensor), name=name)
+    del name
 
     # Eager stacked form: (n, d0, ...) -> (n, n*d0, ...): every row holds the
     # concatenation. all_gather(tiled) inside gives per-shard (n*d0, ...).
@@ -356,7 +432,6 @@ def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None)
     set-relative index. Compiled as a masked psum, which XLA turns into a
     root-sourced transfer over ICI.
     """
-    del name
     ps = _resolve_process_set(process_set)
     try:
         relative_root = ps.ranks.index(root_rank)
@@ -368,6 +443,14 @@ def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None)
     traced_axis = _effective_traced_axis(ps)
     if traced_axis is not None:
         return _broadcast_traced(tensor, relative_root, traced_axis)
+    world = _native_world_if_per_process(ps, tensor)
+    if world is not None:
+        import numpy as np
+
+        # Native world ranks are process ids; the global set maps 1:1.
+        return world.broadcast(np.ascontiguousarray(tensor),
+                               root_rank=relative_root, name=name)
+    del name
 
     def traced(x):
         return _broadcast_traced(x, relative_root, ps.axis_name)
@@ -383,7 +466,6 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
     all-to-all rides ICI directly. Uneven `splits` are not supported in the
     compiled path (XLA static shapes); pad to equal chunks.
     """
-    del name
     if splits is not None:
         raise NotImplementedError(
             "uneven alltoall splits require dynamic shapes, which cannot "
@@ -394,6 +476,12 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
     traced_axis = _effective_traced_axis(ps)
     if traced_axis is not None:
         return _alltoall_traced(tensor, traced_axis)
+    world = _native_world_if_per_process(ps, tensor)
+    if world is not None:
+        import numpy as np
+
+        return world.alltoall(np.ascontiguousarray(tensor), name=name)
+    del name
 
     def traced(x):
         return _alltoall_traced(x, ps.axis_name)
@@ -414,7 +502,6 @@ def reducescatter(
     Parity: ``hvd.reducescatter`` / ``ReducescatterOp``. One ReduceScatter
     HLO; dim 0 must be divisible by the set size (static shapes).
     """
-    del name
     op = _resolve_op(op, None) if op is not None else Average
     ps = _resolve_process_set(process_set)
     traced_axis = _effective_traced_axis(ps)
@@ -422,6 +509,19 @@ def reducescatter(
         return _reducescatter_traced(
             tensor, op, traced_axis, prescale_factor, postscale_factor
         )
+    world = _native_world_if_per_process(ps, tensor)
+    if world is not None:
+        if op not in (Sum, Average) or prescale_factor != 1.0 \
+                or postscale_factor != 1.0:
+            raise ValueError(
+                "per-process eager reducescatter supports Sum/Average "
+                "without scale factors"
+            )
+        import numpy as np
+
+        return world.reducescatter(np.ascontiguousarray(tensor), name=name,
+                                   op=op)
+    del name
 
     def traced(x):
         return _reducescatter_traced(
@@ -445,6 +545,17 @@ def barrier(process_set=None) -> None:
     dataflow order is the synchronization.)
     """
     ps = _resolve_process_set(process_set)
+    import os
+
+    if int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) > 1 \
+            and ps.process_set_id == 0:
+        # Multi-controller: a device-mesh psum only synchronizes devices,
+        # not the controller processes' host threads — the native runtime's
+        # barrier does.
+        from ..parallel.hierarchical import _default_native_world
+
+        _default_native_world().barrier()
+        return
     token = jnp.ones((ps.size(),), dtype=jnp.int32)
     out = _eager_dispatch(
         "barrier",

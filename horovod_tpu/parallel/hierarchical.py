@@ -160,8 +160,23 @@ _host_world = None
 
 
 def _default_native_world():
-    """Process-wide NativeWorld from the launcher's env contract."""
+    """Process-wide NativeWorld from the launcher's env contract.
+
+    The cache is liveness-checked, not just memoized: the native runtime
+    state is process-global, so ANY shutdown path (elastic re-init, test
+    teardown, another NativeWorld instance) can kill it — in which case the
+    next call re-establishes a live world instead of handing back a dead
+    one forever.
+    """
     global _host_world
+    if _host_world is not None and not _host_world.alive:
+        # Initialized-but-dead (fatal control-plane error) or shut down:
+        # tear down so re-init can form a fresh world (elastic recovery).
+        try:
+            _host_world.shutdown()
+        except Exception:
+            pass
+        _host_world = None
     if _host_world is None:
         import os
 

@@ -59,6 +59,7 @@ class RayExecutor:
         kv_port = self._server.start()
         kv_addr = driver_addr([])  # routable address of this driver
         coord_port = free_port()
+        native_port = free_port()
 
         @ray.remote(num_cpus=self.cpus_per_worker,
                     resources=self.resources_per_worker)
@@ -69,11 +70,17 @@ class RayExecutor:
             def run(self, fn, args, kwargs):
                 return fn(*args, **kwargs)
 
+        # Coordinator host is the 'self' sentinel, NOT the driver address:
+        # rank 0 lands on an arbitrary Ray node and must publish its own
+        # routable address through the rendezvous KV
+        # (basics._exchange_coordinator_port); passing the driver's address
+        # would hang multi-node bootstrap waiting on a coordinator that
+        # never binds there.
         self._workers = [
             _Worker.remote(
                 worker_env_for_rank(
-                    r, self.num_workers, kv_addr, kv_port, kv_addr,
-                    coord_port, self.cpu_mode,
+                    r, self.num_workers, kv_addr, kv_port, "self",
+                    coord_port, self.cpu_mode, native_port=native_port,
                 )
             )
             for r in range(self.num_workers)

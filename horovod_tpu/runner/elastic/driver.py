@@ -31,7 +31,7 @@ from ..exec_utils import (
     WorkerProc,
     build_worker_env,
     launch_worker,
-    terminate_worker,
+    terminate_workers,
 )
 from ..hosts import HostInfo, get_host_assignments
 from ..http.kv_server import RendezvousServer
@@ -65,6 +65,7 @@ class ElasticDriver:
         self._workers: dict[str, WorkerProc] = {}
         self._world_hosts: list[HostInfo] = []
         self._coord_port: int = 0
+        self._native_port: int = 0
         self._shutdown = False
         self._min_np = settings.min_np or 1
         self._max_np = settings.max_np
@@ -99,12 +100,14 @@ class ElasticDriver:
         assignments = get_host_assignments(hosts)
         coord = coordinator_addr([h.hostname for h in hosts])
         self._coord_port = free_port()
+        self._native_port = free_port()
         data = {
             a.hostname: json.dumps(
                 {
                     "process_id": a.rank,
                     "num_processes": a.size,
                     "coordinator": f"{coord}:{self._coord_port}",
+                    "native_port": self._native_port,
                     "slots": a.slots,
                     "hosts": [[h.hostname, h.slots] for h in hosts],
                 }
@@ -129,6 +132,7 @@ class ElasticDriver:
                 rendezvous_port=self._server.port,
                 coordinator_addr=coord_addr,
                 coordinator_port=self._coord_port,
+                native_port=self._native_port,
                 cpu_mode=self._settings.cpu_mode,
                 extra_env={
                     **self._settings.env,
@@ -156,9 +160,10 @@ class ElasticDriver:
             )
         keep = {h.hostname for h in hosts}
         # Kill workers on hosts that left the world.
-        for name in [n for n in self._workers if n not in keep]:
+        leaving = [n for n in self._workers if n not in keep]
+        for name in leaving:
             self._log.info("elastic: removing worker on %s", name)
-            terminate_worker(self._workers.pop(name))
+        terminate_workers([self._workers.pop(n) for n in leaving])
         version = self._publish_world(hosts)
         self._launch_missing_workers(version)
 
@@ -174,8 +179,7 @@ class ElasticDriver:
         try:
             return self._monitor()
         finally:
-            for w in self._workers.values():
-                terminate_worker(w)
+            terminate_workers(list(self._workers.values()))
             self._server.stop()
 
     def _monitor(self) -> int:

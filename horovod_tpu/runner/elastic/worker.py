@@ -72,6 +72,8 @@ class ElasticWorkerContext:
         os.environ["HOROVOD_PROCESS_ID"] = str(assignment["process_id"])
         os.environ["HOROVOD_NUM_PROCESSES"] = str(assignment["num_processes"])
         os.environ["HOROVOD_COORDINATOR_ADDR"] = assignment["coordinator"]
+        if assignment.get("native_port"):
+            os.environ["HOROVOD_NATIVE_PORT"] = str(assignment["native_port"])
         os.environ["HOROVOD_RANK"] = str(assignment["process_id"])
         os.environ["HOROVOD_SIZE"] = str(assignment["num_processes"])
         os.environ["HOROVOD_CROSS_RANK"] = str(assignment["process_id"])

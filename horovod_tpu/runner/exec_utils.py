@@ -37,6 +37,7 @@ def build_worker_env(
     coordinator_port: int,
     cpu_mode: bool = False,
     extra_env: dict[str, str] | None = None,
+    native_port: int | None = None,
 ) -> dict[str, str]:
     """The env contract between launcher and worker.
 
@@ -66,6 +67,13 @@ def build_worker_env(
             "HOROVOD_PROCESS_ID": str(a.rank),
         }
     )
+    if native_port is not None:
+        # Port for the native C++ runtime's control plane (libhvdrt star
+        # coordinator on process 0's host) — makes hvd.join() and
+        # host_hierarchical_allreduce reachable under hvdrun without any
+        # hand-set env (the reference launcher's env block likewise makes
+        # its Gloo control plane unconditionally reachable).
+        env["HOROVOD_NATIVE_PORT"] = str(native_port)
     if cpu_mode:
         env["JAX_PLATFORMS"] = "cpu"
         flags = env.get("XLA_FLAGS", "")
@@ -82,6 +90,12 @@ class WorkerProc:
     assignment: ProcessAssignment
     popen: subprocess.Popen
     pump: threading.Thread
+    # Remote-termination facts (ssh-launched workers only): killing the
+    # local ssh client does not kill the remote process tree, so
+    # terminate_worker needs the host and a unique marker to pkill by.
+    remote_host: str | None = None
+    ssh_port: int | None = None
+    kill_marker: str | None = None
 
 
 def _pump_output(
@@ -96,7 +110,8 @@ def _pump_output(
     """
     assert proc.stdout is not None
     for raw in iter(proc.stdout.readline, b""):
-        line = raw.decode(errors="replace").rstrip("\n")
+        # \r\n: ssh -tt allocates a pty, which emits CRLF line endings.
+        line = raw.decode(errors="replace").rstrip("\r\n")
         sink(f"{prefix}{line}")
     proc.stdout.close()
 
@@ -118,18 +133,42 @@ def launch_worker(
             stderr=subprocess.STDOUT,
             start_new_session=True,
         )
+        worker = WorkerProc(assignment, popen, None)  # pump set below
     else:
         # Remote: ssh with the env inlined (the reference does the same —
-        # env vars exported in the remote command line).
+        # env vars exported in the remote command line). The remote shell
+        # records its PID — which (under ssh -tt, making it the session
+        # and group leader) is the process-group id of the whole worker
+        # tree — into a pidfile, so terminate_worker can kill the tree by
+        # group. The pidfile lives in a per-user 0700 directory with an
+        # unpredictable (random-token) name, and an EXIT trap removes it on
+        # normal worker exit so /tmp doesn't accumulate stale files.
+        import secrets
+
+        marker = f"hvd_{assignment.rank}_{secrets.token_hex(8)}"
         exports = " ".join(
             f"export {k}={shlex.quote(v)};"
             for k, v in env.items()
             if k.startswith(("HOROVOD_", "JAX_", "XLA_", "TPU_", "PATH", "PYTHON"))
         )
-        remote_cmd = f"cd {shlex.quote(os.getcwd())} >/dev/null 2>&1; {exports} " + " ".join(
-            shlex.quote(c) for c in command
+        pidfile = _remote_pidfile(marker)
+        # umask scoped to a subshell so worker-written files keep the
+        # user's umask; [ -O ] rejects a pre-planted dir owned by another
+        # local user (sticky /tmp lets anyone create /tmp/hvd-<victim>,
+        # which would let them redirect the group-kill).
+        remote_cmd = (
+            f'(umask 077; mkdir -p "/tmp/hvd-$(id -un)"); '
+            f'[ -O "/tmp/hvd-$(id -un)" ] || '
+            f'{{ echo "hvdrun: /tmp/hvd-$(id -un) not owned by us" >&2; '
+            f"exit 86; }}; "
+            f"echo $$ > {pidfile}; trap 'rm -f {pidfile}' EXIT; "
+            f"cd {shlex.quote(os.getcwd())} >/dev/null 2>&1; {exports} "
+            + " ".join(shlex.quote(c) for c in command)
         )
-        ssh_cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+        # -tt forces a remote pty: when this ssh client dies, the pty closes
+        # and the remote process group gets SIGHUP — so even an unclean
+        # launcher death doesn't leave remote workers running.
+        ssh_cmd = ["ssh", "-tt", "-o", "StrictHostKeyChecking=no"]
         if ssh_port:
             ssh_cmd += ["-p", str(ssh_port)]
         ssh_cmd += [assignment.hostname, remote_cmd]
@@ -139,14 +178,18 @@ def launch_worker(
             stderr=subprocess.STDOUT,
             start_new_session=True,
         )
+        worker = WorkerProc(assignment, popen, None,
+                            remote_host=assignment.hostname,
+                            ssh_port=ssh_port, kill_marker=marker)
     pump = threading.Thread(
         target=_pump_output,
         args=(popen, f"[{assignment.rank}] ", sink),
         name=f"hvd-pump-{assignment.rank}",
         daemon=True,
     )
+    worker.pump = pump
     pump.start()
-    return WorkerProc(assignment, popen, pump)
+    return worker
 
 
 def wait_for_workers(
@@ -171,8 +214,7 @@ def wait_for_workers(
             if rc != 0 and first_rc == 0:
                 first_rc = rc if rc is not None else 1
                 if on_failure == "kill":
-                    for other in pending.values():
-                        terminate_worker(other)
+                    terminate_workers(list(pending.values()))
         if not done:
             time.sleep(poll_interval)
     for w in workers:
@@ -180,10 +222,52 @@ def wait_for_workers(
     return first_rc
 
 
+def _remote_pidfile(marker: str) -> str:
+    # $(id -un) expands REMOTELY: a per-user directory (created 0700 by the
+    # launch shell's umask) so another local user can't pre-plant a symlink
+    # or rewrite the pidfile to aim the group-kill at an arbitrary process.
+    return f'"/tmp/hvd-$(id -un)/{marker}.pid"'
+
+
+def _remote_kill(w: WorkerProc, timeout_s: float = 15.0) -> None:
+    """Kill an ssh-launched worker's REMOTE process tree via its pidfile.
+
+    The local ssh client dying only closes the pty (SIGHUP — which a
+    nohup'ing or signal-ignoring worker survives), so we explicitly signal
+    the remote process group recorded at launch (kill -- -PID falls back to
+    the single PID if the group signal fails). TERM is sent synchronously;
+    the KILL escalation runs as a detached remote background job so this
+    call doesn't block 2s per worker (elastic rescales terminate many).
+    """
+    pidfile = _remote_pidfile(w.kill_marker)
+    script = (
+        f"p=$(cat {pidfile} 2>/dev/null) && "
+        "{ kill -TERM -- -$p 2>/dev/null || kill -TERM $p 2>/dev/null; "
+        "(sleep 2; kill -KILL -- -$p 2>/dev/null || kill -KILL $p 2>/dev/null) "
+        "</dev/null >/dev/null 2>&1 & "
+        f"}}; rm -f {pidfile}"
+    )
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o", "BatchMode=yes"]
+    if w.ssh_port:
+        cmd += ["-p", str(w.ssh_port)]
+    cmd += [w.remote_host, script]
+    try:
+        subprocess.run(cmd, timeout=timeout_s, capture_output=True)
+    except (subprocess.TimeoutExpired, OSError):
+        pass  # host unreachable: nothing more we can do
+
+
 def terminate_worker(w: WorkerProc, grace_s: float = 5.0) -> None:
-    """SIGTERM the worker's process group, escalate to SIGKILL."""
+    """SIGTERM the worker's process group, escalate to SIGKILL.
+
+    For remote (ssh) workers this kills the remote process tree too: first
+    an explicit marker-based pkill on the remote host, then the local ssh
+    client (whose pty teardown SIGHUPs anything left).
+    """
     if w.popen.poll() is not None:
         return
+    if w.remote_host and w.kill_marker:
+        _remote_kill(w)
     try:
         os.killpg(os.getpgid(w.popen.pid), signal.SIGTERM)
     except (ProcessLookupError, PermissionError):
@@ -197,6 +281,31 @@ def terminate_worker(w: WorkerProc, grace_s: float = 5.0) -> None:
         os.killpg(os.getpgid(w.popen.pid), signal.SIGKILL)
     except (ProcessLookupError, PermissionError):
         pass
+
+
+def terminate_workers(workers: Sequence[WorkerProc],
+                      grace_s: float = 5.0) -> None:
+    """Terminate many workers concurrently.
+
+    Remote terminations each pay an ssh round-trip; a serial loop over a
+    large elastic rescale would block the driver (and every surviving rank
+    sitting in a collective) for its sum — fan out instead.
+    """
+    workers = [w for w in workers if w.popen.poll() is None]
+    if not workers:
+        return
+    if len(workers) == 1:
+        terminate_worker(workers[0], grace_s)
+        return
+    threads = [
+        threading.Thread(target=terminate_worker, args=(w, grace_s),
+                         daemon=True)
+        for w in workers
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=grace_s + 20.0)
 
 
 def python_command(script_and_args: Sequence[str]) -> list[str]:

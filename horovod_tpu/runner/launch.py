@@ -216,6 +216,7 @@ def run_static(settings: Settings, sink=None) -> int:
     kv_addr = network.driver_addr(hostnames)
     coord_addr = network.coordinator_addr(hostnames)
     coord_port = network.free_port()
+    native_port = network.free_port()
     try:
         workers = []
         for a in assignments:
@@ -228,6 +229,7 @@ def run_static(settings: Settings, sink=None) -> int:
                 coordinator_port=coord_port,
                 cpu_mode=settings.cpu_mode,
                 extra_env=settings.env,
+                native_port=native_port,
             )
             workers.append(
                 launch_worker(

@@ -8,7 +8,8 @@ from .hosts import HostInfo, get_host_assignments
 
 def task_env(rank: int, size: int, kv_addr: str, kv_port: int,
              coord_addr: str, coord_port: int,
-             cpu_mode: bool = False) -> dict[str, str]:
+             cpu_mode: bool = False,
+             native_port: int | None = None) -> dict[str, str]:
     """The launcher env contract for an externally placed worker (one task
     per host): same keys ``hvdrun`` writes (see exec_utils)."""
     hosts = [HostInfo(f"host-{i}", 1) for i in range(size)]
@@ -21,4 +22,5 @@ def task_env(rank: int, size: int, kv_addr: str, kv_port: int,
         coordinator_addr=coord_addr,
         coordinator_port=coord_port,
         cpu_mode=cpu_mode,
+        native_port=native_port,
     )

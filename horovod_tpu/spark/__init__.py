@@ -50,6 +50,7 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: int | None = None,
     kv_port = server.start()
     kv_addr = driver_addr([])
     coord_port = free_port()
+    native_port = free_port()
     kwargs = kwargs or {}
 
     def task(iterator):
@@ -57,8 +58,12 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: int | None = None,
 
         ctx = BarrierTaskContext.get()
         rank = ctx.partitionId()
+        # 'self' sentinel: rank 0 runs on some executor node, not on the
+        # driver — it must publish its own routable coordinator address via
+        # the rendezvous KV (basics._exchange_coordinator_port).
         os.environ.update(
-            task_env(rank, n, kv_addr, kv_port, kv_addr, coord_port)
+            task_env(rank, n, kv_addr, kv_port, "self", coord_port,
+                     native_port=native_port)
         )
         ctx.barrier()
         yield rank, fn(*args, **kwargs)

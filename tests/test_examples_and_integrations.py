@@ -80,3 +80,47 @@ class TestIntegrations:
         assert env["HOROVOD_NUM_PROCESSES"] == "4"
         assert env["HOROVOD_RENDEZVOUS_ADDR"] == "10.0.0.1"
         assert env["HOROVOD_COORDINATOR_ADDR"] == "10.0.0.1:9999"
+
+    def test_integrations_use_self_coordinator_sentinel(self):
+        # Regression (round-1 advisor, VERDICT r2 item 3a): Ray/Spark must
+        # pass the 'self' sentinel — rank 0 lands on an arbitrary cluster
+        # node, so it must publish its OWN routable coordinator address via
+        # the rendezvous KV, not bind where the driver happens to live.
+        import inspect
+
+        import horovod_tpu.ray as hray
+        import horovod_tpu.spark as hspark
+
+        assert '"self"' in inspect.getsource(hray.RayExecutor.start)
+        assert '"self"' in inspect.getsource(hspark.run)
+
+    def test_self_sentinel_resolves_to_rank0_routable_addr(self, tmp_path):
+        # The sentinel's contract end-to-end: process 0 publishes its own
+        # address to the KV, a peer polls it back.
+        from horovod_tpu.basics import _exchange_coordinator_port
+        from horovod_tpu.runner.http.kv_server import RendezvousServer
+
+        server = RendezvousServer()
+        port = server.start()
+        old = {
+            k: os.environ.get(k)
+            for k in ("HOROVOD_RENDEZVOUS_ADDR", "HOROVOD_RENDEZVOUS_PORT",
+                      "HOROVOD_WORLD_VERSION")
+        }
+        os.environ["HOROVOD_RENDEZVOUS_ADDR"] = "127.0.0.1"
+        os.environ["HOROVOD_RENDEZVOUS_PORT"] = str(port)
+        os.environ["HOROVOD_WORLD_VERSION"] = "selftest"
+        try:
+            chosen = _exchange_coordinator_port("self:9999", 0)
+            host, chosen_port = chosen.rsplit(":", 1)
+            assert host not in ("self", ""), chosen
+            assert int(chosen_port) > 0
+            # A non-zero rank polls the same value back.
+            assert _exchange_coordinator_port("self:9999", 1) == chosen
+        finally:
+            server.stop()
+            for k, v in old.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v

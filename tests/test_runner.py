@@ -216,8 +216,12 @@ class TestStaticLaunch:
             assert hvd.size() == 4, hvd.size()  # 2 procs x 2 virtual devices
             assert hvd.process_count() == 2
             # Stacked-rank eager allreduce across the whole world; each
-            # process reads its addressable rows via to_local.
-            x = np.arange(4, dtype=np.float32).reshape(4, 1) + 1
+            # process reads its addressable rows via to_local. The stacked
+            # form takes a jax.Array (process-identical global data) —
+            # numpy would mean the per-process idiom.
+            import jax.numpy as jnp
+            x = jnp.asarray(
+                np.arange(4, dtype=np.float32).reshape(4, 1) + 1)
             out = hvd.to_local(hvd.allreduce(x, op=hvd.Sum))
             assert np.allclose(out, 10.0), out
             print("e2e rank%s ok sum=%s" % (hvd.process_rank(), out[0, 0]))
@@ -232,3 +236,198 @@ class TestStaticLaunch:
         assert rc == 0, "\n".join(lines)
         assert any("e2e rank0 ok sum=10.0" in l for l in lines), lines
         assert any("e2e rank1 ok sum=10.0" in l for l in lines), lines
+
+
+class TestRemoteWorkerTermination:
+    """Regression (round-1 advisor, VERDICT r2 item 3c): terminate_worker
+    used to kill only the local ssh client; the remote process tree
+    survived. Now launch records a remote pidfile (+ ssh -tt for pty-HUP)
+    and terminate signals the remote process group explicitly."""
+
+    def _launch_fake_remote(self, monkeypatch):
+        from horovod_tpu.runner import exec_utils
+        from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+        captured = {}
+        real_popen = exec_utils.subprocess.Popen
+
+        def fake_popen(cmd, **kw):
+            captured["cmd"] = cmd
+            # Stand-in process so pump/poll/terminate paths work.
+            return real_popen(
+                [sys.executable, "-c", "import time; time.sleep(30)"],
+                stdout=exec_utils.subprocess.PIPE,
+                stderr=exec_utils.subprocess.STDOUT,
+                start_new_session=True,
+            )
+
+        monkeypatch.setattr(exec_utils.subprocess, "Popen", fake_popen)
+        a = get_host_assignments([HostInfo("remote-node-1", 1)])[0]
+        w = exec_utils.launch_worker(
+            a, ["python", "train.py"], {"HOROVOD_RANK": "0"})
+        return exec_utils, captured, w
+
+    def test_remote_launch_uses_tt_and_pidfile(self, monkeypatch):
+        exec_utils, captured, w = self._launch_fake_remote(monkeypatch)
+        try:
+            cmd = captured["cmd"]
+            assert cmd[0] == "ssh" and "-tt" in cmd
+            remote_cmd = cmd[-1]
+            # Pidfile recorded in a per-user 0700 dir, cleaned by EXIT trap.
+            assert "umask 077" in remote_cmd
+            assert "echo $$ >" in remote_cmd
+            assert "trap 'rm -f" in remote_cmd
+            assert w.remote_host == "remote-node-1"
+            assert w.kill_marker and w.kill_marker in remote_cmd
+        finally:
+            w.popen.kill()
+
+    def test_terminate_issues_remote_group_kill(self, monkeypatch):
+        exec_utils, captured, w = self._launch_fake_remote(monkeypatch)
+        kills = []
+        monkeypatch.setattr(
+            exec_utils.subprocess, "run",
+            lambda cmd, **kw: kills.append(cmd))
+        exec_utils.terminate_worker(w, grace_s=0.2)
+        assert kills, "terminate_worker never ssh'd to the remote host"
+        kill_cmd = kills[0]
+        assert kill_cmd[0] == "ssh" and kill_cmd[-2] == "remote-node-1"
+        assert f"{w.kill_marker}.pid" in kill_cmd[-1]
+        assert "kill -TERM -- -$p" in kill_cmd[-1]
+        assert w.popen.poll() is not None  # local ssh stand-in died too
+
+    def test_local_worker_untouched_by_remote_path(self):
+        from horovod_tpu.runner import exec_utils
+        from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+        a = get_host_assignments([HostInfo("localhost", 1)])[0]
+        w = exec_utils.launch_worker(
+            a, [sys.executable, "-c", "import time; time.sleep(30)"],
+            dict(os.environ))
+        try:
+            assert w.remote_host is None and w.kill_marker is None
+            exec_utils.terminate_worker(w, grace_s=0.2)
+            assert w.popen.poll() is not None
+        finally:
+            if w.popen.poll() is None:
+                w.popen.kill()
+
+
+class TestNativePortWiring:
+    """VERDICT r2 item 2: the launcher must make the native C++ runtime
+    reachable with NO hand-set env — build_worker_env carries
+    HOROVOD_NATIVE_PORT, so hvd.join() and host_hierarchical_allreduce
+    come up under a plain `hvdrun -np 2 --cpu-mode`."""
+
+    def test_build_worker_env_sets_native_port(self):
+        from horovod_tpu.runner.exec_utils import build_worker_env
+        from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+        a = get_host_assignments([HostInfo("localhost", 1)])[0]
+        env = build_worker_env(
+            a, base_env={}, rendezvous_addr="127.0.0.1",
+            rendezvous_port=1234, coordinator_addr="127.0.0.1",
+            coordinator_port=5678, native_port=4321)
+        assert env["HOROVOD_NATIVE_PORT"] == "4321"
+
+    @pytest.mark.slow
+    def test_e2e_join_and_host_hierarchical(self, tmp_path):
+        """hvdrun -np 2 --cpu-mode; workers use the native runtime purely
+        from the launcher's env: host_hierarchical_allreduce then an
+        uneven-data hvd.join()."""
+        script = _worker_script(
+            tmp_path,
+            """
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 2)
+            import numpy as np
+            import horovod_tpu as hvd
+            from horovod_tpu.parallel.hierarchical import (
+                host_hierarchical_allreduce,
+            )
+
+            assert "HOROVOD_NATIVE_PORT" in os.environ  # launcher-provided
+            hvd.init()
+            pid = hvd.process_rank()
+            # Host hierarchical allreduce: local XLA leg + native cross leg.
+            local = np.full((2, 4), float(pid + 1), np.float32)
+            out = host_hierarchical_allreduce(local, name="e2e", op="sum")
+            # Global logical world = 2 procs x 2 local shards:
+            # sum over shards = 2*(1) + 2*(2) = 6 per element.
+            assert np.allclose(out, 6.0), out
+            # Uneven data: rank 0 joins after 1 extra allreduce by rank 1.
+            from horovod_tpu.parallel.hierarchical import (
+                _default_native_world,
+            )
+            w = _default_native_world()
+            if pid == 1:
+                r = w.allreduce(np.ones(3, np.float32), name="extra",
+                                op="average")
+                # rank 0 is joined: average over contributing ranks only.
+                assert np.allclose(r, 1.0), r
+            last = hvd.join()
+            assert last in (0, 1)
+            print("join-e2e rank%s ok last=%s" % (pid, last))
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("join-e2e rank0 ok" in l for l in lines), lines
+        assert any("join-e2e rank1 ok" in l for l in lines), lines
+
+
+class TestPerProcessEagerIdiom:
+    """VERDICT r2 item 7: the reference's per-process scripting idiom —
+    plain `hvd.allreduce(np_array)` on each process's OWN tensor — must
+    work verbatim in a multi-controller world (routed through the native
+    runtime host data plane)."""
+
+    @pytest.mark.slow
+    def test_e2e_per_process_allreduce(self, tmp_path):
+        script = _worker_script(
+            tmp_path,
+            """
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 2)
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            pid = hvd.process_rank()
+            # Reference idiom: each process reduces ITS tensor. No stacking
+            # axis -> native host path (device world is 4; shape is (3,)).
+            t = np.full(3, float(pid + 1), np.float32)
+            out = hvd.allreduce(t, op=hvd.Sum, name="mine")
+            assert np.allclose(out, 3.0), out   # 1 + 2
+            avg = hvd.allreduce(t, name="avg")  # default Average
+            assert np.allclose(avg, 1.5), avg
+            # allgather concatenates process tensors along dim 0.
+            g = hvd.allgather(np.full((2, 2), float(pid), np.float32))
+            assert g.shape == (4, 2) and np.allclose(g[:2], 0.0) \
+                and np.allclose(g[2:], 1.0), g
+            # broadcast: process 1's value everywhere.
+            b = hvd.broadcast(t, root_rank=1)
+            assert np.allclose(b, 2.0), b
+            # grouped: one fused native collective.
+            r1, r2 = hvd.grouped_allreduce(
+                [np.ones(4, np.float32) * (pid + 1),
+                 np.ones(2, np.float32) * (pid + 1)], op=hvd.Sum)
+            assert np.allclose(r1, 3.0) and np.allclose(r2, 3.0)
+            hvd.barrier()
+            print("perproc rank%s ok" % pid)
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("perproc rank0 ok" in l for l in lines), lines
+        assert any("perproc rank1 ok" in l for l in lines), lines
