@@ -16,6 +16,7 @@ from functools import partial
 from typing import Any, Callable
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops.attention import blockwise_attention_reference, flash_attention
@@ -126,10 +127,19 @@ class Bert(nn.Module):
 
     config: BertConfig = BERT_BASE
     attention_fn: Callable | None = None
+    # Rematerialize each transformer layer in backward (jax.checkpoint):
+    # activations drop from O(L * tokens * hidden) to O(tokens * hidden),
+    # buying batch size at ~+1/3 forward recompute — the standard TPU
+    # HBM-for-FLOPs trade.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
-                 train: bool = False):
+                 train: bool = False, masked_positions=None):
+        """``masked_positions`` [B, P]: when given, the MLM head runs only
+        on those positions (logits [B, P, V]) — the reference BERT
+        pretraining recipe (``max_predictions_per_seq``); computing the
+        [B, S, V] logits for the ~85% unmasked positions is pure waste."""
         cfg = self.config
         B, S = input_ids.shape
         if attention_mask is None:
@@ -156,17 +166,35 @@ class Bert(nn.Module):
         mask_bias = (1.0 - attention_mask[:, None, None, :].astype(
             jnp.float32)) * -1e30
 
+        layer_cls = (
+            nn.remat(TransformerLayer, static_argnums=(2,))
+            if self.remat
+            else TransformerLayer
+        )
         for i in range(cfg.num_layers):
-            x = TransformerLayer(cfg, self.attention_fn, name=f"layer_{i}")(
+            x = layer_cls(cfg, self.attention_fn, name=f"layer_{i}")(
                 x, mask_bias, deterministic=not train
             )
 
-        # MLM head with tied input embeddings.
+        # MLM head with tied input embeddings. The [tokens, H] @ [H, V]
+        # logits matmul is ~10% of model FLOPs — run it bf16-in/f32-accum
+        # on the MXU (a full-f32 matmul runs at 1/4 rate and would be the
+        # single biggest line in the profile).
+        head_in = x
+        if masked_positions is not None:
+            head_in = jnp.take_along_axis(
+                x, masked_positions[..., None], axis=1
+            )
         h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlm_transform")(x)
+                     param_dtype=jnp.float32, name="mlm_transform")(head_in)
         h = nn.gelu(h)
         h = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(h)
-        logits = tok_emb.attend(h.astype(jnp.float32))
+        logits = jax.lax.dot_general(
+            h.astype(cfg.dtype),
+            tok_emb.embedding.astype(cfg.dtype),
+            (((h.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
         logits = logits + self.param(
             "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32
         )

@@ -168,8 +168,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         empty = l == 0.0
         safe_l = jnp.where(empty, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe_l[:, None]).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(empty, LSE_MASKED,
-                               m_scr[:, 0] + jnp.log(safe_l))
+        # lse block is the FULL row [1, Sq] (TPU tiling requires the last
+        # two block dims be (8,128)-divisible or whole-array); each q-block
+        # writes its slice dynamically.
+        lse = jnp.where(empty, LSE_MASKED, m_scr[:, 0] + jnp.log(safe_l))
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = lse
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -193,8 +196,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     k_tile = k_ref[0].astype(jnp.float32)
     v_tile = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]      # [block_q]
-    delta = delta_ref[0]  # [block_q]
+    # lse/delta blocks are full rows [1, Sq] (TPU tiling); slice our q tile.
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
 
     s = jax.lax.dot_general(
         q, k_tile, (((1,), (1,)), ((), ())),
@@ -241,8 +245,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_tile = k_ref[0].astype(jnp.float32)
     v_tile = v_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+    delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
 
     s = jax.lax.dot_general(
         q, k_tile, (((1,), (1,)), ((), ())),
@@ -299,11 +303,11 @@ def _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset, k_offset,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+            pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),  # running max m
@@ -324,15 +328,15 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
     # cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)  # [BH, Sq]
+                    axis=-1)[:, None, :]  # [BH, 1, Sq]
 
     q_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
-        pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
-        pl.BlockSpec((1, block_q), lambda bh, i, j: (bh, i)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
     ]
     dq = pl.pallas_call(
         functools.partial(
@@ -352,8 +356,8 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
         pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
         pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
-        pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
-        pl.BlockSpec((1, block_q), lambda bh, j, i: (bh, i)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, j, i: (bh, 0, 0)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, j, i: (bh, 0, 0)),
     ]
     dk, dv = pl.pallas_call(
         functools.partial(
