@@ -1,0 +1,156 @@
+"""Keras API surface.
+
+Parity: ``horovod/keras/__init__.py`` + the shared impl in
+``horovod/_keras/`` — a ``DistributedOptimizer`` wrapper that averages
+gradients across processes before ``apply_gradients``, plus the fit()-loop
+callbacks (broadcast-on-start, metric averaging, LR warmup/schedule).
+
+Built on :mod:`horovod_tpu.tensorflow` (native host data plane); works
+with ``tf.keras`` (Keras 3's TF backend included) in eager training loops
+and ``model.fit``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from .. import tensorflow as hvd_tf
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError("horovod_tpu.keras requires tensorflow") from e
+
+Average = hvd_tf.Average
+Sum = hvd_tf.Sum
+
+init = hvd_tf.init
+shutdown = hvd_tf.shutdown
+size = hvd_tf.size
+rank = hvd_tf.rank
+local_rank = hvd_tf.local_rank
+allreduce = hvd_tf.allreduce
+allgather = hvd_tf.allgather
+broadcast = hvd_tf.broadcast
+broadcast_variables = hvd_tf.broadcast_variables
+
+
+def DistributedOptimizer(optimizer, op: str = Average,
+                         backward_passes_per_step: int = 1):
+    """Wrap a Keras optimizer: gradients are allreduce-averaged across
+    processes before the update (reference: ``hvd.DistributedOptimizer``
+    keras flavor). ``backward_passes_per_step > 1`` accumulates that many
+    calls locally before one fused collective + update.
+    """
+
+    base = type(optimizer)
+
+    class _Distributed(base):  # type: ignore[valid-type, misc]
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            gv = list(grads_and_vars)
+            if hvd_tf.size() <= 1 or not gv:
+                return super().apply_gradients(gv, *args, **kwargs)
+            acc = getattr(self, "_hvd_acc", None)
+            self._hvd_count = getattr(self, "_hvd_count", 0) + 1
+            if backward_passes_per_step > 1:
+                grads = [g for g, _ in gv]
+                if acc is None:
+                    acc = [tf.convert_to_tensor(g) for g in grads]
+                else:
+                    acc = [a + tf.convert_to_tensor(g)
+                           for a, g in zip(acc, grads)]
+                if self._hvd_count % backward_passes_per_step != 0:
+                    self._hvd_acc = acc
+                    return None
+                self._hvd_acc = None
+                gv = [(a / backward_passes_per_step, v)
+                      for a, (_, v) in zip(acc, gv)]
+            w = hvd_tf._world()
+            handles = [
+                w.allreduce_async_(hvd_tf._np(g), name=f"keras.grad.{i}",
+                                   op=op)
+                for i, (g, _) in enumerate(gv)
+            ]
+            reduced = [
+                (tf.cast(tf.convert_to_tensor(np.asarray(w.synchronize(h))),
+                         g.dtype), v)
+                for h, (g, v) in zip(handles, gv)
+            ]
+            return super().apply_gradients(reduced, *args, **kwargs)
+
+    _Distributed.__name__ = f"Distributed{base.__name__}"
+    cfg = optimizer.get_config()
+    return _Distributed.from_config(cfg)
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast rank-0 weights to every process when training starts
+    (reference: ``hvd.callbacks.BroadcastGlobalVariablesCallback``)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None):
+        hvd_tf.broadcast_variables(
+            self.model.trainable_variables + self.model.non_trainable_variables,
+            root_rank=self.root_rank,
+        )
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Allreduce-average epoch metrics across processes (reference:
+    ``hvd.callbacks.MetricAverageCallback``)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or hvd_tf.size() <= 1:
+            return
+        for k in sorted(logs):
+            v = logs[k]
+            if isinstance(v, (int, float, np.floating)):
+                out = hvd_tf._world().allreduce(
+                    np.asarray([v], np.float64),
+                    name=f"metric.{epoch}.{k}", op=Average,
+                )
+                logs[k] = float(np.asarray(out)[0])
+
+
+class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
+    """Linearly ramp LR from lr/size to lr over warmup epochs (reference:
+    ``hvd.callbacks.LearningRateWarmupCallback`` — the large-batch recipe's
+    companion to lr scaling)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 verbose: bool = False):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+    def _set_lr(self, lr: float):
+        opt = self.model.optimizer
+        if hasattr(opt, "learning_rate"):
+            opt.learning_rate = lr
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if epoch >= self.warmup_epochs:
+            self._set_lr(self.initial_lr)
+            return
+        n = hvd_tf.size()
+        frac = (epoch + 1) / max(1, self.warmup_epochs)
+        lr = self.initial_lr * (1.0 / n + (1.0 - 1.0 / n) * frac)
+        self._set_lr(lr)
+        if self.verbose:
+            print(f"hvd warmup: epoch {epoch} lr={lr:.6g}")
+
+
+__all__ = [
+    "Average", "Sum", "init", "shutdown", "size", "rank", "local_rank",
+    "allreduce", "allgather", "broadcast", "broadcast_variables",
+    "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
+    "MetricAverageCallback", "LearningRateWarmupCallback",
+]
