@@ -170,6 +170,11 @@ def init(devices: Sequence[Any] | None = None) -> None:
         from . import process_sets
 
         process_sets._reset(topo, _state.mesh)
+        # Honor HOROVOD_PROFILER_LOGDIR (xprof capture; the reference's
+        # NVTX-activation-by-env contract).
+        from . import profiler
+
+        profiler.maybe_start_from_env()
         get_logger().info(
             "horovod_tpu initialized: %d rank(s), %d host(s), backend=%s",
             topo.size,

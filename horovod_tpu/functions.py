@@ -24,6 +24,8 @@ from typing import Any
 import jax
 import numpy as np
 
+_agobj_counter = 0  # unique native tensor names across repeated gathers
+
 
 def broadcast_parameters(params, root_rank: int = 0):
     """Sync a parameter pytree from `root_rank`'s host to all hosts.
@@ -103,8 +105,6 @@ def allgather_object(obj: Any, process_set=None, name: str | None = None) -> lis
     object, so the result is `size()` copies — kept for script parity.
     """
     del name
-    from . import basics
-    from .ops import allgather
     from .process_sets import global_process_set
 
     ps = process_set if process_set is not None else global_process_set
@@ -114,20 +114,41 @@ def allgather_object(obj: Any, process_set=None, name: str | None = None) -> lis
         # One controller: all ranks' objects are this object.
         return [pickle.loads(payload.tobytes()) for _ in range(n)]
 
-    # Multi-host: pad to max size, exchange through the stacked convention.
-    # Size pre-exchange: per-rank tensor (1,) -> stacked (n, 1); allgather
-    # concatenates along dim 0, so each output row is the (n,) size vector.
-    sizes = to_local(
-        allgather(np.full((n, 1), payload.size, dtype=np.int32), process_set=ps)
-    )[0]
-    max_size = int(sizes.max())
-    # Per-rank tensor (1, max) -> stacked (n, 1, max); output rows (n, max).
-    padded = np.zeros((n, 1, max_size), dtype=np.uint8)
-    padded[:, 0, : payload.size] = payload
-    gathered = to_local(allgather(padded, process_set=ps))[0]
-    return [
-        pickle.loads(gathered[r, : int(sizes[r])].tobytes()) for r in range(n)
-    ]
+    # Multi-process: objects are PER-PROCESS host data — exchange through
+    # the native host data plane. (The stacked-convention path cannot
+    # carry per-process-different arrays: jax asserts global arrays are
+    # process-identical.) Each process sends (its local device-rank count,
+    # payload size) then the padded payload; the per-process objects are
+    # expanded so the returned list still has one entry per DEVICE rank,
+    # in rank order — reference semantics where rank == process map 1:1.
+    if ps.process_set_id != 0:
+        raise ValueError(
+            "allgather_object on a non-global process set is not supported "
+            "in multi-process worlds yet (the native runtime would need "
+            "the set's process mapping); gather on the global set instead"
+        )
+    from .parallel.hierarchical import _default_native_world
+
+    global _agobj_counter
+    _agobj_counter += 1
+    tag = _agobj_counter
+    w = _default_native_world()
+    local_n = max(1, n // max(1, jax.process_count()))
+    meta = np.asarray([payload.size, local_n], np.int64)
+    metas = np.asarray(
+        w.allgather(meta, name=f"agobj.meta.{tag}")
+    ).reshape(w.size, 2)
+    max_size = int(metas[:, 0].max())
+    padded = np.zeros(max_size, np.uint8)
+    padded[: payload.size] = payload
+    gathered = np.asarray(
+        w.allgather(padded, name=f"agobj.data.{tag}")
+    ).reshape(w.size, max_size)
+    out: list = []
+    for p in range(w.size):
+        o = pickle.loads(gathered[p, : int(metas[p, 0])].tobytes())
+        out.extend(o for _ in range(int(metas[p, 1])))
+    return out
 
 
 def join(timeout_s: float = 600.0) -> int:

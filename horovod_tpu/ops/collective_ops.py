@@ -243,8 +243,9 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
         return jax.jit(fn)
 
     from ..stall import get_inspector
-    from ..timeline import activity
+    from ..timeline import activity, mark_cycle
 
+    mark_cycle()
     cache = global_cache()
     misses_before = cache.misses
     compiled = cache.get_or_build(key, build)
@@ -547,11 +548,18 @@ def barrier(process_set=None) -> None:
     ps = _resolve_process_set(process_set)
     import os
 
-    if int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) > 1 \
-            and ps.process_set_id == 0:
-        # Multi-controller: a device-mesh psum only synchronizes devices,
-        # not the controller processes' host threads — the native runtime's
-        # barrier does.
+    if int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) > 1:
+        if ps.process_set_id != 0:
+            # A device-mesh psum would only synchronize devices, not the
+            # controller processes' host threads — refusing beats silently
+            # handing back a weaker primitive.
+            raise ValueError(
+                "barrier on a non-global process set is not supported in "
+                "multi-process worlds yet; use the global barrier or a "
+                "traced collective"
+            )
+        # Multi-controller: the native runtime's barrier synchronizes the
+        # controller processes themselves.
         from ..parallel.hierarchical import _default_native_world
 
         _default_native_world().barrier()

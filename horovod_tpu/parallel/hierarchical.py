@@ -187,6 +187,8 @@ def _default_native_world():
         addr = os.environ.get("HOROVOD_COORDINATOR_ADDR", "127.0.0.1")
         addr = addr.rsplit(":", 1)[0]
         port = int(os.environ.get("HOROVOD_NATIVE_PORT", "0") or 0)
+        if nprocs > 1:
+            addr, port = _exchange_native_endpoint(proc_id, port)
         if nprocs > 1 and not port:
             raise RuntimeError(
                 "host_hierarchical_allreduce needs HOROVOD_NATIVE_PORT (the "
@@ -194,6 +196,48 @@ def _default_native_world():
             )
         _host_world = NativeWorld(proc_id, nprocs, addr, port or 29500)
     return _host_world
+
+
+def _exchange_native_endpoint(proc_id: int, fallback_port: int):
+    """Rank 0 picks the native coordinator endpoint ON ITS OWN HOST and
+    publishes it via the rendezvous KV; peers poll it.
+
+    The launcher's HOROVOD_NATIVE_PORT is probed free on the LAUNCHER
+    host — rank 0 may live elsewhere (Ray/Spark placement, remote -H
+    hosts), the same cross-machine TOCTOU the coordinator port solves in
+    ``basics._exchange_coordinator_port``. No KV (manual launch) → trust
+    the env as given.
+    """
+    import os
+    import time
+
+    kv_addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    kv_port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "-1") or -1)
+    coord_host = os.environ.get(
+        "HOROVOD_COORDINATOR_ADDR", "127.0.0.1").rsplit(":", 1)[0]
+    if not kv_addr or kv_port < 0:
+        return coord_host, fallback_port
+    from ..runner.http.kv_server import KVClient
+    from ..runner.network import free_port, routable_addr
+
+    version = os.environ.get("HOROVOD_WORLD_VERSION", "static")
+    scope = f"native/{version}"
+    kv = KVClient(kv_addr, kv_port)
+    if proc_id == 0:
+        host = routable_addr()
+        port = free_port()  # free on rank 0's host, where the bind happens
+        kv.put(scope, "addr", f"{host}:{port}".encode())
+        return host, port
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        val = kv.get(scope, "addr")
+        if val is not None:
+            host, port = val.decode().rsplit(":", 1)
+            return host, int(port)
+        time.sleep(0.05)
+    raise TimeoutError(
+        f"native endpoint not published to rendezvous KV scope {scope!r}"
+    )
 
 
 def host_hierarchical_allreduce(

@@ -1,0 +1,77 @@
+"""Device-profiler integration (the reference's NVTX/Nsight role → xprof).
+
+Parity surface: the reference emits NVTX ranges per op
+(``horovod/common/ops/nvtx_op_range.*``) so Nsight shows framework
+activities against GPU kernels. Here the same role is played by
+``jax.profiler``: timeline activities dual-emit ``TraceAnnotation`` ranges
+(see :mod:`horovod_tpu.timeline`), and this module owns trace capture:
+
+- ``HOROVOD_PROFILER_LOGDIR=/path`` (env contract, like
+  ``HOROVOD_TIMELINE``): ``hvd.init()`` starts a trace there; call
+  :func:`stop` (or exit) to finalize. View in TensorBoard/xprof, where
+  framework annotations appear above the TPU op stream — one merged view.
+- Programmatic: ``hvd.profiler.start(logdir)`` / ``hvd.profiler.stop()``,
+  and :func:`trace` as a with-block for scoped capture.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_lock = threading.Lock()
+_active_logdir: str | None = None
+
+
+def start(logdir: str) -> None:
+    """Begin a device trace into ``logdir`` (idempotent per process)."""
+    global _active_logdir
+    import jax.profiler
+
+    with _lock:
+        if _active_logdir is not None:
+            return
+        jax.profiler.start_trace(logdir)
+        _active_logdir = logdir
+
+
+def stop() -> None:
+    global _active_logdir
+    import jax.profiler
+
+    with _lock:
+        if _active_logdir is None:
+            return
+        jax.profiler.stop_trace()
+        _active_logdir = None
+
+
+def active() -> bool:
+    return _active_logdir is not None
+
+
+def maybe_start_from_env() -> None:
+    """Called by ``hvd.init()``: honor HOROVOD_PROFILER_LOGDIR."""
+    logdir = os.environ.get("HOROVOD_PROFILER_LOGDIR", "")
+    if logdir:
+        try:
+            start(logdir)
+        except Exception:
+            # Profiler not supported on this backend (e.g. some tunneled
+            # dev setups) — never fail init over observability.
+            pass
+
+
+class trace:
+    """Scoped capture: ``with hvd.profiler.trace('/tmp/prof'): step()``."""
+
+    def __init__(self, logdir: str):
+        self.logdir = logdir
+
+    def __enter__(self):
+        start(self.logdir)
+        return self
+
+    def __exit__(self, *exc):
+        stop()
+        return False

@@ -260,14 +260,18 @@ def _remote_kill(w: WorkerProc, timeout_s: float = 15.0) -> None:
 def terminate_worker(w: WorkerProc, grace_s: float = 5.0) -> None:
     """SIGTERM the worker's process group, escalate to SIGKILL.
 
-    For remote (ssh) workers this kills the remote process tree too: first
-    an explicit marker-based pkill on the remote host, then the local ssh
-    client (whose pty teardown SIGHUPs anything left).
+    For remote (ssh) workers this kills the remote process tree too: the
+    explicit pidfile-based group kill runs even when the local ssh client
+    already exited — a dropped connection leaves the remote worker running
+    (SIGHUP-ignoring/nohup'd processes survive pty teardown), which is
+    exactly the leak this path exists to close.
     """
+    if w.remote_host and w.kill_marker and not getattr(w, "_remote_killed",
+                                                      False):
+        w._remote_killed = True
+        _remote_kill(w)
     if w.popen.poll() is not None:
         return
-    if w.remote_host and w.kill_marker:
-        _remote_kill(w)
     try:
         os.killpg(os.getpgid(w.popen.pid), signal.SIGTERM)
     except (ProcessLookupError, PermissionError):
@@ -289,9 +293,13 @@ def terminate_workers(workers: Sequence[WorkerProc],
 
     Remote terminations each pay an ssh round-trip; a serial loop over a
     large elastic rescale would block the driver (and every surviving rank
-    sitting in a collective) for its sum — fan out instead.
+    sitting in a collective) for its sum — fan out instead. Remote workers
+    whose local ssh client already exited still need the remote kill.
     """
-    workers = [w for w in workers if w.popen.poll() is None]
+    workers = [
+        w for w in workers
+        if w.popen.poll() is None or (w.remote_host and w.kill_marker)
+    ]
     if not workers:
         return
     if len(workers) == 1:

@@ -111,7 +111,13 @@ def get_timeline() -> Timeline | None:
 
 
 class activity:
-    """Context manager: ``with activity('allreduce.dense_1', 'collective')``."""
+    """Context manager: ``with activity('allreduce.dense_1', 'collective')``.
+
+    Dual-emits: a Chrome-trace event on the host timeline AND a
+    ``jax.profiler.TraceAnnotation`` range, so the same activity name shows
+    up inside an xprof/TPU-profiler capture of the run (the reference's
+    NVTX-range role — one merged view of host scheduling and device work).
+    """
 
     def __init__(self, name: str, category: str = "collective", args=None):
         self.name = name
@@ -119,13 +125,50 @@ class activity:
         self.args = args
         self._tl = get_timeline()
         self._start = 0.0
+        self._annotation = None
 
     def __enter__(self):
         if self._tl is not None:
             self._start = self._tl.now_us()
+        try:
+            import jax.profiler
+
+            self._annotation = jax.profiler.TraceAnnotation(self.name)
+            self._annotation.__enter__()
+        except Exception:  # profiler unavailable: host timeline only
+            self._annotation = None
         return self
 
     def __exit__(self, *exc):
+        if self._annotation is not None:
+            self._annotation.__exit__(*exc)
         if self._tl is not None:
             self._tl.complete(self.name, self.category, self._start, self.args)
         return False
+
+
+_mark_cycles = None
+_cycle_count = 0
+
+
+def mark_cycles_enabled() -> bool:
+    """HOROVOD_TIMELINE_MARK_CYCLES=1 (reference contract): emit an instant
+    marker per background/step cycle on the timeline."""
+    global _mark_cycles
+    if _mark_cycles is None:
+        _mark_cycles = os.environ.get(
+            "HOROVOD_TIMELINE_MARK_CYCLES", "") == "1"
+    return _mark_cycles
+
+
+def mark_cycle(label: str = "cycle") -> None:
+    """Emit a cycle marker if enabled. In the compiled regime a "cycle" is
+    a dispatched step/collective (there is no background negotiation loop
+    to tick); the native C++ runtime marks its own cycles in-core."""
+    global _cycle_count
+    if not mark_cycles_enabled():
+        return
+    tl = get_timeline()
+    if tl is not None:
+        _cycle_count += 1
+        tl.instant(f"{label}.{_cycle_count}", category="cycle")

@@ -420,6 +420,10 @@ class TestPerProcessEagerIdiom:
                 [np.ones(4, np.float32) * (pid + 1),
                  np.ones(2, np.float32) * (pid + 1)], op=hvd.Sum)
             assert np.allclose(r1, 3.0) and np.allclose(r2, 3.0)
+            # allgather_object: per-process objects, expanded per device
+            # rank (2 procs x 2 devices -> 4 entries).
+            objs = hvd.allgather_object({"pid": pid})
+            assert [o["pid"] for o in objs] == [0, 0, 1, 1], objs
             hvd.barrier()
             print("perproc rank%s ok" % pid)
             """,
