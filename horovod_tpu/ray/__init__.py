@@ -40,29 +40,90 @@ class RayExecutor:
     ``start()``, ``run(fn, args)``, ``execute(fn)``, ``shutdown()``.
     """
 
-    def __init__(self, num_workers: int, use_current_placement_group=False,
+    def __init__(self, num_workers: int | None = None,
+                 use_current_placement_group=False,
                  cpus_per_worker: int = 1, resources_per_worker=None,
-                 cpu_mode: bool = False):
+                 cpu_mode: bool = False, num_hosts: int | None = None,
+                 num_workers_per_host: int = 1, gpus_per_worker: int = 0,
+                 placement: str | None = None):
+        """``num_workers`` (PACK placement) or ``num_hosts`` ×
+        ``num_workers_per_host`` (colocated bundles, STRICT_SPREAD across
+        hosts) — the reference's two placement modes; ``placement``
+        overrides ('pack'/'colocated'/None = no placement group)."""
         self._ray = _require_ray()
-        self.num_workers = num_workers
+        if num_workers is None and num_hosts is None:
+            raise ValueError("specify num_workers or num_hosts")
+        if (num_workers is not None and num_hosts is not None
+                and num_workers != num_hosts * num_workers_per_host):
+            raise ValueError(
+                f"num_workers={num_workers} disagrees with num_hosts="
+                f"{num_hosts} x num_workers_per_host={num_workers_per_host};"
+                " a colocated placement group sized from the host spec"
+                " could never fit the actors"
+            )
+        self.num_hosts = num_hosts
+        self.num_workers_per_host = num_workers_per_host
+        self.num_workers = (
+            num_workers if num_workers is not None
+            else num_hosts * num_workers_per_host
+        )
         self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
         self.resources_per_worker = resources_per_worker or {}
         self.cpu_mode = cpu_mode
+        if placement is None and num_hosts is not None:
+            placement = "colocated"
+        self.placement = placement
+        self.use_current_placement_group = use_current_placement_group
         self._workers: list[Any] = []
         self._server: RendezvousServer | None = None
+        self._pg = None
+
+    def _strategy(self):
+        from .strategy import ColocatedStrategy, PackStrategy
+
+        if self.placement == "colocated":
+            return ColocatedStrategy(
+                self.num_hosts or 1, self.num_workers_per_host,
+                self.cpus_per_worker, self.gpus_per_worker,
+                self.resources_per_worker,
+            )
+        if self.placement == "pack":
+            return PackStrategy(
+                self.num_workers, self.cpus_per_worker,
+                self.gpus_per_worker, self.resources_per_worker,
+            )
+        return None
 
     def start(self):
         ray = self._ray
         if not ray.is_initialized():
             ray.init()
+        from ..runner import secret as _secret
+
+        os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
         self._server = RendezvousServer()
         kv_port = self._server.start()
         kv_addr = driver_addr([])  # routable address of this driver
         coord_port = free_port()
         native_port = free_port()
 
-        @ray.remote(num_cpus=self.cpus_per_worker,
-                    resources=self.resources_per_worker)
+        actor_opts: dict = dict(
+            num_cpus=self.cpus_per_worker,
+            resources=self.resources_per_worker,
+        )
+        if self.gpus_per_worker:
+            actor_opts["num_gpus"] = self.gpus_per_worker
+        strategy = None if self.use_current_placement_group \
+            else self._strategy()
+        if strategy is not None:
+            self._pg = strategy.create_placement_group(ray)
+            actor_opts["scheduling_strategy"] = (
+                ray.util.scheduling_strategies
+                .PlacementGroupSchedulingStrategy(placement_group=self._pg)
+            )
+
+        @ray.remote(**actor_opts)
         class _Worker:
             def __init__(self, env: dict):
                 os.environ.update(env)
@@ -104,6 +165,12 @@ class RayExecutor:
         for w in self._workers:
             ray.kill(w)
         self._workers = []
+        if self._pg is not None:
+            try:
+                ray.util.remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
         if self._server is not None:
             self._server.stop()
             self._server = None

@@ -1,0 +1,82 @@
+"""Worker placement strategies for Ray.
+
+Parity: ``horovod/ray/strategy.py`` — decide how worker actors map onto
+Ray nodes. Two strategies, as in the reference:
+
+- :class:`ColocatedStrategy` (``num_hosts`` × ``num_workers_per_host``):
+  one placement-group bundle per host with all of that host's worker
+  resources, ``STRICT_SPREAD`` across hosts — workers on the same host
+  share ICI/locality, hosts are distinct failure domains.
+- :class:`PackStrategy` (``num_workers`` total): one bundle per worker,
+  ``PACK`` — fill nodes before spilling, the scheduler chooses hosts.
+
+The bundle math is pure Python (unit-testable without ray); only
+``create_placement_group`` touches the ray API.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class PlacementStrategy:
+    def bundles(self) -> list[dict[str, float]]:
+        raise NotImplementedError
+
+    @property
+    def ray_strategy(self) -> str:
+        raise NotImplementedError
+
+    def create_placement_group(self, ray, timeout_s: float = 100.0):
+        """Reserve the bundles; returns the ready placement group."""
+        pg = ray.util.placement_group(
+            self.bundles(), strategy=self.ray_strategy
+        )
+        ray.get(pg.ready(), timeout=timeout_s)
+        return pg
+
+
+class ColocatedStrategy(PlacementStrategy):
+    def __init__(self, num_hosts: int, num_workers_per_host: int,
+                 cpus_per_worker: int = 1, gpus_per_worker: int = 0,
+                 resources_per_worker: dict[str, float] | None = None):
+        self.num_hosts = num_hosts
+        self.num_workers_per_host = num_workers_per_host
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+        self.resources_per_worker = resources_per_worker or {}
+
+    def bundles(self) -> list[dict[str, float]]:
+        per_host: dict[str, float] = {
+            "CPU": self.cpus_per_worker * self.num_workers_per_host,
+        }
+        if self.gpus_per_worker:
+            per_host["GPU"] = self.gpus_per_worker * self.num_workers_per_host
+        for k, v in self.resources_per_worker.items():
+            per_host[k] = v * self.num_workers_per_host
+        return [dict(per_host) for _ in range(self.num_hosts)]
+
+    @property
+    def ray_strategy(self) -> str:
+        return "STRICT_SPREAD"
+
+
+class PackStrategy(PlacementStrategy):
+    def __init__(self, num_workers: int, cpus_per_worker: int = 1,
+                 gpus_per_worker: int = 0,
+                 resources_per_worker: dict[str, float] | None = None):
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+        self.resources_per_worker = resources_per_worker or {}
+
+    def bundles(self) -> list[dict[str, float]]:
+        per_worker: dict[str, float] = {"CPU": float(self.cpus_per_worker)}
+        if self.gpus_per_worker:
+            per_worker["GPU"] = float(self.gpus_per_worker)
+        per_worker.update(self.resources_per_worker)
+        return [dict(per_worker) for _ in range(self.num_workers)]
+
+    @property
+    def ray_strategy(self) -> str:
+        return "PACK"

@@ -61,6 +61,11 @@ class ElasticDriver:
             else:
                 discovery = FixedHostDiscovery(settings.hosts)
         self._manager = HostManager(discovery)
+        # Secret before server construction: the server snapshots its HMAC
+        # key at __init__ (a later setdefault would leave it open-mode).
+        from .. import secret as _secret
+
+        os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
         self._server = RendezvousServer()
         self._workers: dict[str, WorkerProc] = {}
         self._world_hosts: list[HostInfo] = []

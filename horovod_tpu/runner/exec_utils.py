@@ -67,6 +67,12 @@ def build_worker_env(
             "HOROVOD_PROCESS_ID": str(a.rank),
         }
     )
+    # The per-job HMAC secret rides the env block even when base_env is
+    # empty (Ray/Spark task envs) — without it workers can't talk to an
+    # authenticated rendezvous KV.
+    job_secret = os.environ.get("HOROVOD_SECRET_KEY", "")
+    if job_secret and "HOROVOD_SECRET_KEY" not in env:
+        env["HOROVOD_SECRET_KEY"] = job_secret
     if native_port is not None:
         # Port for the native C++ runtime's control plane (libhvdrt star
         # coordinator on process 0's host) — makes hvd.join() and

@@ -14,6 +14,14 @@ Protocol: ``PUT /scope/key`` (body = value bytes), ``GET /scope/key``
 ``GET /_scope/scope`` (list keys, newline separated). A monotonically
 increasing ``version`` is bumped by ``reset()`` on elastic reconfiguration;
 workers read it at ``GET /_version``.
+
+Authentication (parity: ``horovod/runner/common/util/secret.py`` — the
+reference HMAC-signs driver↔task traffic): when ``HOROVOD_SECRET_KEY`` is
+set (the launcher generates one per job and ships it in the worker env
+block), every request carries ``X-Hvd-Auth: HMAC-SHA256(method\\npath\\n
+body)`` and the server rejects missing/invalid tags with 403 — a port
+scanner on the cluster network cannot read or poison the rendezvous state.
+No key set = open dev mode.
 """
 
 from __future__ import annotations
@@ -23,6 +31,14 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
+from .. import secret as _secret
+
+AUTH_HEADER = "X-Hvd-Auth"
+
+
+def _auth_payload(method: str, path: str, body: bytes) -> bytes:
+    return method.encode() + b"\n" + path.encode() + b"\n" + body
+
 
 class _KVHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
@@ -31,6 +47,15 @@ class _KVHandler(BaseHTTPRequestHandler):
     # output; interleaved request logs would corrupt it).
     def log_message(self, fmt, *args):  # noqa: D102
         pass
+
+    def _authenticate(self, body: bytes = b"") -> bool:
+        tag = self.headers.get(AUTH_HEADER, "")
+        key = self.server.secret  # type: ignore[attr-defined]
+        if _secret.verify(_auth_payload(self.command, self.path, body), tag,
+                          key=key):
+            return True
+        self._reply(403, b"bad auth tag")
+        return False
 
     def _split(self):
         # Key = last path component; scope = everything before it (scopes may
@@ -44,6 +69,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         return scope, key
 
     def do_GET(self):  # noqa: N802
+        if not self._authenticate():
+            return
         store = self.server.store  # type: ignore[attr-defined]
         scope, key = self._split()
         if scope == "_version":
@@ -65,11 +92,15 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._reply(400, b"missing key")
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
+        if not self._authenticate(body):
+            return
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authenticate():
+            return
         scope = self.path.strip("/")
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store.pop(scope, None)  # type: ignore[attr-defined]
@@ -90,6 +121,9 @@ class RendezvousServer:
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.version = 0  # type: ignore[attr-defined]
+        # Key snapshot at construction: the job's secret must not drift
+        # under a live server (and env edits elsewhere must not rekey it).
+        self._httpd.secret = _secret.current_key()  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
 
     @property
@@ -137,24 +171,27 @@ class RendezvousServer:
 
 
 class KVClient:
-    """Worker-side client for the rendezvous KV server."""
+    """Worker-side client for the rendezvous KV server. Signs every
+    request with the job secret when HOROVOD_SECRET_KEY is set."""
 
     def __init__(self, addr: str, port: int, timeout: float = 10.0):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
 
+    def _request(self, method: str, path: str, body: bytes | None = None):
+        req = Request(f"{self._base}{path}", data=body, method=method)
+        tag = _secret.sign(_auth_payload(method, path, body or b""))
+        if tag:
+            req.add_header(AUTH_HEADER, tag)
+        return urlopen(req, timeout=self._timeout)
+
     def put(self, scope: str, key: str, value: bytes) -> None:
-        req = Request(
-            f"{self._base}/{scope}/{key}", data=value, method="PUT"
-        )
-        with urlopen(req, timeout=self._timeout):
+        with self._request("PUT", f"/{scope}/{key}", value):
             pass
 
     def get(self, scope: str, key: str) -> bytes | None:
         try:
-            with urlopen(
-                f"{self._base}/{scope}/{key}", timeout=self._timeout
-            ) as r:
+            with self._request("GET", f"/{scope}/{key}") as r:
                 return r.read()
         except HTTPError as e:
             if e.code == 404:
@@ -162,15 +199,14 @@ class KVClient:
             raise
 
     def keys(self, scope: str) -> list[str]:
-        with urlopen(f"{self._base}/_scope/{scope}", timeout=self._timeout) as r:
+        with self._request("GET", f"/_scope/{scope}") as r:
             body = r.read().decode()
         return [k for k in body.split("\n") if k]
 
     def delete_scope(self, scope: str) -> None:
-        req = Request(f"{self._base}/{scope}", method="DELETE")
-        with urlopen(req, timeout=self._timeout):
+        with self._request("DELETE", f"/{scope}"):
             pass
 
     def world_version(self) -> int:
-        with urlopen(f"{self._base}/_version", timeout=self._timeout) as r:
+        with self._request("GET", "/_version") as r:
             return int(r.read())

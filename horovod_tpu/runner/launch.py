@@ -59,6 +59,7 @@ class Settings:
     start_timeout: float = 30.0
     verbose: bool = False
     env: dict[str, str] = dataclasses.field(default_factory=dict)
+    network_probe: bool = False
     # Elastic:
     elastic: bool = False
     min_np: int | None = None
@@ -84,6 +85,10 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                    help="run on virtual CPU devices (dev/CI mode); slots = "
                         "virtual devices per process")
     p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--network-probe", action="store_true",
+                   help="pre-flight NIC probe: start a task service per "
+                        "host, intersect interfaces, and advertise "
+                        "addresses on the common network (multi-NIC hosts)")
     p.add_argument("--start-timeout", type=float,
                    default=float(os.environ.get("HOROVOD_START_TIMEOUT", 30)))
     p.add_argument("--verbose", action="store_true")
@@ -110,9 +115,54 @@ def parse_args(argv: list[str] | None = None) -> argparse.Namespace:
                         "elastic mode")
     p.add_argument("--elastic-timeout", type=float,
                    default=float(os.environ.get("HOROVOD_ELASTIC_TIMEOUT", 600)))
+    p.add_argument("--config-file", default=None,
+                   help="YAML of long-form flag defaults (CLI wins); "
+                        "parity: horovodrun --config-file")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command (python train.py ...)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.config_file:
+        _apply_config_file(p, args, argv)
+    return args
+
+
+def _apply_config_file(parser: argparse.ArgumentParser,
+                       args: argparse.Namespace,
+                       argv: list[str] | None) -> None:
+    """YAML keys are long flag names (dashes or underscores); values fill
+    any flag the command line did NOT set explicitly — the reference's
+    config-file precedence (CLI > config file > defaults)."""
+    import yaml
+
+    with open(args.config_file) as f:
+        config = yaml.safe_load(f) or {}
+    if not isinstance(config, dict):
+        raise SystemExit(f"--config-file {args.config_file}: expected a "
+                         "mapping of flag: value")
+    # Map EVERY option string (short and long) to its argparse dest so
+    # explicit CLI flags always win, e.g. -H -> hosts, -np -> num_proc.
+    opt_to_dest = {
+        opt: a.dest
+        for a in parser._actions
+        for opt in a.option_strings
+    }
+    given = set()
+    for tok in (argv if argv is not None else sys.argv[1:]):
+        if tok.startswith("-") and not tok[1:2].isdigit():
+            flag = tok.split("=", 1)[0]
+            if flag in opt_to_dest:
+                given.add(opt_to_dest[flag])
+    valid = {a.dest for a in parser._actions}
+    for key, value in config.items():
+        dest = key.replace("-", "_")
+        if dest not in valid:
+            raise SystemExit(
+                f"--config-file: unknown option {key!r}; valid: "
+                + ", ".join(sorted(v for v in valid if v != "help"))
+            )
+        if dest in given:
+            continue  # explicit CLI wins
+        setattr(args, dest, value)
 
 
 def args_to_env(args: argparse.Namespace) -> dict[str, str]:
@@ -186,6 +236,7 @@ def settings_from_args(args: argparse.Namespace) -> Settings:
         command=command,
         cpu_mode=args.cpu_mode,
         ssh_port=args.ssh_port,
+        network_probe=args.network_probe,
         start_timeout=args.start_timeout,
         verbose=args.verbose,
         env=args_to_env(args),
@@ -195,6 +246,64 @@ def settings_from_args(args: argparse.Namespace) -> Settings:
         discovery_script=args.host_discovery_script,
         elastic_timeout=args.elastic_timeout,
     )
+
+
+def _network_probe(hosts, ssh_port, sink) -> dict[str, str] | None:
+    """Pre-flight NIC probe (parity: driver_service._driver_fn): start a
+    task service per host, read its port from the muxed output, intersect
+    interfaces. Returns {hostname: address-on-common-network} or None.
+    """
+    import re
+    import time
+
+    from .driver_service import probe_cluster
+    from .exec_utils import launch_worker, terminate_workers
+    from .hosts import get_host_assignments as _assign
+
+    ports: dict[str, int] = {}
+    lines: list[str] = []
+
+    def capture(line: str) -> None:
+        lines.append(line)
+        if sink:
+            sink(line)
+
+    # One task service per UNIQUE host (duplicate hostnames — local
+    # cpu-mode — would make the port wait unsatisfiable).
+    unique = []
+    seen = set()
+    for h in hosts:
+        if h.hostname not in seen:
+            seen.add(h.hostname)
+            unique.append(type(h)(h.hostname, 1))
+    assignments = _assign(unique)
+    workers = [
+        launch_worker(
+            a, [sys.executable, "-m", "horovod_tpu.runner.task_fn"],
+            dict(os.environ), ssh_port=ssh_port, sink=capture,
+        )
+        for a in assignments
+    ]
+    try:
+        deadline = time.time() + 30.0
+        while len(ports) < len(assignments) and time.time() < deadline:
+            for line in list(lines):
+                m = re.search(r"\[(\d+)\] HVD_TASK_SERVICE_PORT=(\d+)", line)
+                if m:
+                    rank = int(m.group(1))
+                    ports[assignments[rank].hostname] = int(m.group(2))
+            time.sleep(0.05)
+        if len(ports) < len(assignments):
+            return None  # probe inconclusive: fall back to defaults
+        _, addrs = probe_cluster({
+            h: (h if h != "localhost" else "127.0.0.1", p)
+            for h, p in ports.items()
+        })
+        return addrs
+    except Exception:
+        return None
+    finally:
+        terminate_workers(workers)
 
 
 def run_static(settings: Settings, sink=None) -> int:
@@ -210,11 +319,26 @@ def run_static(settings: Settings, sink=None) -> int:
         hosts = settings.hosts
     assignments = get_host_assignments(hosts, settings.num_proc)
 
+    # Per-job HMAC secret FIRST: the probe's task services and the KV
+    # server snapshot their key at construction, and workers inherit it
+    # through the env block (parity: the reference's secret-authenticated
+    # driver/task services).
+    from . import secret as _secret
+
+    os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
+    probed = None
+    if settings.network_probe:
+        probed = _network_probe(hosts, settings.ssh_port, sink)
     server = RendezvousServer()
     port = server.start()
     hostnames = [h.hostname for h in hosts]
     kv_addr = network.driver_addr(hostnames)
     coord_addr = network.coordinator_addr(hostnames)
+    if probed and hostnames and hostnames[0] in probed:
+        # The probe's answer IS the coordinator address (rank 0's address
+        # on the network every host shares) — hostnames[0] may resolve to
+        # an unreachable management NIC on multi-NIC TPU VMs.
+        coord_addr = probed[hostnames[0]]
     coord_port = network.free_port()
     native_port = network.free_port()
     try:

@@ -1,0 +1,32 @@
+"""Per-host task-service entry point (parity: ``horovod/runner/task_fn.py``).
+
+The driver launches ``python -m horovod_tpu.runner.task_fn`` on every host
+during the pre-flight probe; the process prints its service port (the
+driver reads it from the muxed output), serves NIC queries, and exits when
+the driver is done (or after ``--ttl`` seconds as a safety net).
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+from .driver_service import TaskService
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--ttl", type=float, default=120.0,
+                   help="exit after this many seconds (orphan safety net)")
+    args = p.parse_args()
+    svc = TaskService(port=args.port)
+    port = svc.start()
+    print(f"HVD_TASK_SERVICE_PORT={port}", flush=True)
+    time.sleep(args.ttl)
+    svc.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -149,6 +149,11 @@ def load_library() -> ctypes.CDLL:
         lib.hvdrt_wait.restype = ctypes.c_int
         lib.hvdrt_join.argtypes = [ctypes.c_double]
         lib.hvdrt_join.restype = ctypes.c_int
+        lib.hvdrt_autotune_state.argtypes = [
+            ctypes.POINTER(ctypes.c_longlong), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.hvdrt_autotune_state.restype = ctypes.c_int
         lib.hvdrt_cache_hits.restype = ctypes.c_longlong
         lib.hvdrt_cache_misses.restype = ctypes.c_longlong
         lib.hvdrt_cycles.restype = ctypes.c_longlong
@@ -221,6 +226,21 @@ class NativeWorld:
     @property
     def cycles(self) -> int:
         return int(self._lib.hvdrt_cycles())
+
+    def autotune_state(self) -> dict:
+        """Live autotuner view: {active, fusion_threshold, cycle_time_ms,
+        samples}."""
+        thr = ctypes.c_longlong(0)
+        cyc = ctypes.c_double(0.0)
+        n = ctypes.c_int(0)
+        rc = self._lib.hvdrt_autotune_state(
+            ctypes.byref(thr), ctypes.byref(cyc), ctypes.byref(n))
+        return {
+            "active": rc == 1,
+            "fusion_threshold": int(thr.value),
+            "cycle_time_ms": float(cyc.value),
+            "samples": int(n.value),
+        }
 
     # -- async API (reference: allreduce_async_ / synchronize / poll) --------
 

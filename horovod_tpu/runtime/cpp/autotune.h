@@ -74,6 +74,7 @@ class ParameterManager {
   // After convergence (no improvement for `patience` suggestions) the
   // manager pins the best point and stops exploring.
   bool converged() const { return converged_; }
+  int num_samples() const { return bo_.num_samples(); }
 
  private:
   void ApplyPoint(const std::vector<double>& p);

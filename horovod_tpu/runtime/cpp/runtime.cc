@@ -736,6 +736,25 @@ int hvdrt_enqueue_group(int n, const char** names, int op, int reduce_op,
   return 0;
 }
 
+// Autotune introspection: live knob values + samples taken. Returns 1
+// when the autotuner is active, 0 when HOROVOD_AUTOTUNE is off, -1 when
+// uninitialized. (The proof that the Bayesian tuner actually moves the
+// knobs — see tests — needs to observe them from outside.)
+int hvdrt_autotune_state(long long* fusion_threshold, double* cycle_time_ms,
+                         int* samples) {
+  GlobalState* st = g.load();
+  if (st == nullptr || !st->initialized.load()) return -1;
+  if (fusion_threshold != nullptr) {
+    *fusion_threshold = st->autotune ? st->autotune->fusion_threshold()
+                                     : st->config.fusion_threshold_bytes;
+  }
+  if (cycle_time_ms != nullptr) *cycle_time_ms = st->config.cycle_time_ms;
+  if (samples != nullptr) {
+    *samples = st->autotune ? st->autotune->num_samples() : 0;
+  }
+  return st->autotune ? 1 : 0;
+}
+
 // Register a process set (collective contract: every rank registers the
 // same sets in the same order, as in the reference's add_process_set).
 // Returns the set id (> 0), or -1 on error.

@@ -2,9 +2,12 @@
 
 Parity: ``horovod.spark.run()`` (SURVEY.md §3.5) — launch one framework
 worker per Spark task in a barrier stage, driver hosting the rendezvous KV.
-The Estimator API (KerasEstimator/TorchEstimator) is out of scope for the
-JAX-native build; ``run()`` covers the launch substrate the estimators sit
-on. pyspark is optional — calling without it raises with guidance.
+``run()`` is the launch substrate; the Estimator API lives in
+``horovod_tpu.spark.jax`` (JaxEstimator — the TPU-native flavor),
+``horovod_tpu.spark.keras`` (KerasEstimator), with the Store/params/
+materialization machinery in ``horovod_tpu.spark.common``. pyspark is
+optional — the estimators also fit pandas DataFrames (dev/CI path);
+``run()`` without pyspark raises with guidance.
 """
 
 from __future__ import annotations
@@ -46,6 +49,9 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: int | None = None,
     n = num_proc or int(sc.defaultParallelism)
     from ..runner.http.kv_server import RendezvousServer
 
+    from ..runner import secret as _secret
+
+    os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
     server = RendezvousServer()
     kv_port = server.start()
     kv_addr = driver_addr([])
@@ -53,11 +59,16 @@ def run(fn: Callable, args=(), kwargs=None, num_proc: int | None = None,
     native_port = free_port()
     kwargs = kwargs or {}
 
+    # Captured by the task closure: executors have their own env, so the
+    # job secret must ride the closure, not the driver's os.environ.
+    job_secret = os.environ[_secret.ENV_KEY]
+
     def task(iterator):
         from pyspark import BarrierTaskContext
 
         ctx = BarrierTaskContext.get()
         rank = ctx.partitionId()
+        os.environ["HOROVOD_SECRET_KEY"] = job_secret
         # 'self' sentinel: rank 0 runs on some executor node, not on the
         # driver — it must publish its own routable coordinator address via
         # the rendezvous KV (basics._exchange_coordinator_port).

@@ -1,0 +1,199 @@
+"""Estimator/Model base classes — the Spark-ML-style ``.fit(df)`` flow.
+
+Parity: ``horovod/spark/common/estimator.py`` (``HorovodEstimator`` /
+``HorovodModel``). The reference's flow: validate params → materialize the
+DataFrame to Parquet in the Store (Petastorm) → launch one training
+process per executor with ``horovod.spark.run`` → collect the trained
+model → return a Transformer. This re-design keeps that flow with two
+substrates:
+
+- **pyspark DataFrame** → Parquet via Spark writers, training launched as
+  a barrier stage (``horovod_tpu.spark.run``), one process per executor.
+- **pandas DataFrame** (dev/CI — no Spark needed) → Parquet shards via
+  pyarrow, training runs in-process over the local device mesh (the same
+  step function; DP over devices instead of processes).
+
+Workers read their Parquet shard(s) round-robin by process id — the
+Petastorm role, played by pyarrow.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Sequence
+
+import numpy as np
+
+from .params import EstimatorParams, merge_params
+from .store import Store
+
+
+# -- data materialization (Petastorm role) -----------------------------------
+
+
+def materialize_pandas(df, path: str, store: Store, num_shards: int) -> int:
+    """Write a pandas DataFrame as ``num_shards`` Parquet shards. Returns
+    the row count."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    store.makedirs(path)
+    n = len(df)
+    rows_per = max(1, (n + num_shards - 1) // num_shards)
+    for i in range(num_shards):
+        part = df.iloc[i * rows_per: (i + 1) * rows_per]
+        table = pa.Table.from_pandas(part, preserve_index=False)
+        pq.write_table(table, f"{path}/part-{i:05d}.parquet")
+    return n
+
+
+def materialize_spark(df, path: str, num_shards: int) -> int:
+    """Write a Spark DataFrame as Parquet with ``num_shards`` partitions."""
+    df = df.repartition(num_shards)
+    df.write.mode("overwrite").parquet(path)
+    return df.count()
+
+
+def read_shard(path: str, store: Store, shard: int, num_shards: int,
+               columns: Sequence[str]):
+    """Read this worker's shard rows (files striped round-robin) as a dict
+    of column -> stacked numpy array."""
+    import pyarrow.parquet as pq
+
+    files = [
+        f for f in store.listdir(path)
+        if f.endswith(".parquet") or f.startswith("part-")
+    ]
+    mine = [f for i, f in enumerate(sorted(files)) if i % num_shards == shard]
+    cols: dict[str, list] = {c: [] for c in columns}
+    for f in mine:
+        table = pq.read_table(f"{path}/{f}", columns=list(columns))
+        for c in columns:
+            cols[c].extend(table.column(c).to_pylist())
+    return {
+        c: np.asarray(v) for c, v in cols.items()
+    }
+
+
+def batches(data: dict, batch_size: int, shuffle: bool, seed: int,
+            drop_last: bool = True):
+    """Minibatch iterator over a column dict (epoch order reshuffled by
+    caller via seed)."""
+    cols = list(data)
+    n = len(data[cols[0]])
+    idx = np.arange(n)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    stop = (n // batch_size) * batch_size if drop_last else n
+    for s in range(0, stop, batch_size):
+        take = idx[s: s + batch_size]
+        yield {c: data[c][take] for c in cols}
+
+
+# -- estimator / model -------------------------------------------------------
+
+
+class Estimator:
+    """Base estimator: ``.fit(df) -> Model`` (parity: HorovodEstimator).
+
+    Subclasses implement ``_train(shard_fn, params) -> state`` and
+    ``_make_model(state) -> Model``.
+    """
+
+    def __init__(self, store: Store | str, params: EstimatorParams | None
+                 = None, **overrides: Any):
+        self.store = Store.create(store) if isinstance(store, str) else store
+        self.params = merge_params(params or EstimatorParams(), **overrides)
+
+    # Spark-ML-style setters (parity: setEpochs/setBatchSize/...).
+    def set(self, **overrides: Any) -> "Estimator":
+        self.params = merge_params(self.params, **overrides)
+        return self
+
+    def fit(self, df) -> "Model":
+        p = self.params
+        p.validate()
+        run_id = p.run_id or self.store.new_run_id()
+        train_path = self.store.train_data_path(run_id)
+        columns = list(p.feature_cols) + list(p.label_cols)
+
+        is_spark = hasattr(df, "rdd")  # duck-type: pyspark DataFrame
+        if is_spark:
+            from .. import run as spark_run
+
+            num_proc = p.num_proc or df.rdd.getNumPartitions()
+            materialize_spark(df.select(*columns), train_path, num_proc)
+            store, params = self.store, p
+            train_fn = self._worker_fn()
+
+            def task():
+                import horovod_tpu as hvd
+
+                hvd.init()
+                shard = hvd.process_rank()
+                data = read_shard(train_path, store, shard, num_proc,
+                                  columns)
+                return train_fn(data, params, shard)
+
+            results = spark_run(task, num_proc=num_proc)
+            state = results[0]
+        else:
+            # pandas path: shard only for IO symmetry; train in-process
+            # over the local device mesh.
+            import horovod_tpu as hvd
+
+            hvd.init()
+            materialize_pandas(df[columns], train_path, self.store, 1)
+            data = read_shard(train_path, self.store, 0, 1, columns)
+            state = self._worker_fn()(data, p, 0)
+
+        # Persist the trained state in the store (parity: checkpoint dir).
+        ckpt = f"{self.store.checkpoint_path(run_id)}/final.pkl"
+        self.store.write_bytes(ckpt, pickle.dumps(state))
+        return self._make_model(state, run_id)
+
+    # -- subclass surface ----------------------------------------------------
+
+    def _worker_fn(self):
+        """Return a picklable fn(data_dict, params, shard) -> state."""
+        raise NotImplementedError
+
+    def _make_model(self, state, run_id: str) -> "Model":
+        raise NotImplementedError
+
+
+class Model:
+    """Trained-model transformer: ``.transform(df)`` adds predictions
+    (parity: HorovodModel)."""
+
+    def __init__(self, run_id: str, params: EstimatorParams):
+        self.run_id = run_id
+        self.params = params
+
+    def predict(self, features: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform(self, df, output_col: str = "prediction"):
+        p = self.params
+        feature_col = p.feature_cols[0]
+        if hasattr(df, "rdd"):  # pyspark
+            predict = self.predict
+
+            def map_partition(rows):
+                import numpy as _np
+
+                rows = list(rows)
+                if not rows:
+                    return
+                feats = _np.asarray([r[feature_col] for r in rows])
+                preds = predict(feats)
+                for r, pr in zip(rows, preds):
+                    d = r.asDict()
+                    d[output_col] = pr.tolist() if hasattr(pr, "tolist") else pr
+                    yield d
+            return df.rdd.mapPartitions(map_partition).toDF()
+        out = df.copy()
+        feats = np.asarray(list(df[feature_col]))
+        preds = np.asarray(self.predict(feats))
+        out[output_col] = list(preds)
+        return out

@@ -1,0 +1,60 @@
+"""Estimator parameter surface.
+
+Parity: ``horovod/spark/common/params.py`` — the reference mirrors
+Spark-ML's ``Params`` mixins (getters/setters per param). Re-designed as a
+validated dataclass: the same knob set, without requiring pyspark to
+import (the estimator must be constructible and unit-testable on a dev
+box; pyspark only matters at ``fit(spark_df)`` time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+
+@dataclasses.dataclass
+class EstimatorParams:
+    # Data columns (parity: setFeatureCols/setLabelCols).
+    feature_cols: Sequence[str] = ("features",)
+    label_cols: Sequence[str] = ("label",)
+    # Training loop.
+    batch_size: int = 32
+    epochs: int = 1
+    shuffle: bool = True
+    seed: int = 0
+    # Validation: a float in (0,1) = split fraction, or a column name whose
+    # truthy rows are validation (parity: setValidation).
+    validation: float | str | None = None
+    # Launch.
+    num_proc: int | None = None
+    verbose: int = 1
+    run_id: str | None = None
+    # Callbacks invoked with (epoch, metrics dict) on rank 0.
+    callbacks: Sequence[Callable[[int, dict], None]] = ()
+
+    def validate(self) -> None:
+        if self.batch_size <= 0:
+            raise ValueError(f"batch_size must be positive, got {self.batch_size}")
+        if self.epochs <= 0:
+            raise ValueError(f"epochs must be positive, got {self.epochs}")
+        if isinstance(self.validation, float) and not (
+            0.0 < self.validation < 1.0
+        ):
+            raise ValueError(
+                f"validation fraction must be in (0,1), got {self.validation}"
+            )
+        if not self.feature_cols:
+            raise ValueError("feature_cols must name at least one column")
+        if not self.label_cols:
+            raise ValueError("label_cols must name at least one column")
+
+
+def merge_params(base: EstimatorParams, **overrides: Any) -> EstimatorParams:
+    known = {f.name for f in dataclasses.fields(EstimatorParams)}
+    bad = set(overrides) - known
+    if bad:
+        raise TypeError(
+            f"unknown estimator param(s) {sorted(bad)}; valid: {sorted(known)}"
+        )
+    return dataclasses.replace(base, **overrides)

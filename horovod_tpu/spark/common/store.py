@@ -1,0 +1,126 @@
+"""Storage abstraction for Spark Estimators.
+
+Parity: ``horovod/spark/common/store.py`` — the Store owns the directory
+layout (train data, validation data, checkpoints, logs) that the estimator
+materializes DataFrames into and workers read shards from. Re-designed on
+``fsspec`` so one implementation covers local paths, ``hdfs://``,
+``s3://``, ``gs://`` — instead of the reference's per-filesystem classes
+(LocalStore/HDFSStore/S3Store remain as thin aliases for API parity).
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any
+
+
+class Store:
+    """Directory layout + filesystem access for one training run-root."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = prefix_path.rstrip("/")
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Pick a Store for the path scheme (parity: ``Store.create``)."""
+        if "://" in prefix_path and not prefix_path.startswith("file://"):
+            return FilesystemStore(prefix_path)
+        return LocalStore(prefix_path)
+
+    # -- layout (parity: the reference's *_path accessors) -------------------
+
+    def run_path(self, run_id: str) -> str:
+        return f"{self.prefix_path}/runs/{run_id}"
+
+    def train_data_path(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/train_data"
+
+    def val_data_path(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/val_data"
+
+    def checkpoint_path(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/checkpoints"
+
+    def logs_path(self, run_id: str) -> str:
+        return f"{self.run_path(run_id)}/logs"
+
+    def new_run_id(self) -> str:
+        return uuid.uuid4().hex[:16]
+
+    # -- filesystem ops ------------------------------------------------------
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> list[str]:
+        raise NotImplementedError
+
+
+class LocalStore(Store):
+    """Plain local filesystem (parity: ``LocalStore``)."""
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def listdir(self, path: str) -> list[str]:
+        return sorted(os.listdir(path)) if os.path.isdir(path) else []
+
+
+class FilesystemStore(Store):
+    """fsspec-backed store: hdfs://, s3://, gs://, ... one implementation
+    where the reference ships one class per filesystem."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(prefix_path)
+        import fsspec
+
+        self._fs, _ = fsspec.core.url_to_fs(prefix_path)
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(path)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._fs.open(path, "wb") as f:
+            f.write(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open(path, "rb") as f:
+            return f.read()
+
+    def listdir(self, path: str) -> list[str]:
+        if not self._fs.exists(path):
+            return []
+        return sorted(os.path.basename(p) for p in self._fs.ls(path))
+
+
+# Reference-name aliases (the scheme-dispatch lives in Store.create).
+HDFSStore = FilesystemStore
+S3Store = FilesystemStore
+GCSStore = FilesystemStore

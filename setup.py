@@ -1,0 +1,44 @@
+"""Build hooks: compile the native runtime (libhvdrt.so) into wheels.
+
+Parity role: the reference's ``setup.py`` custom ``build_ext`` delegating
+to CMake (``horovod/CMakeLists.txt``). Here the native core is a small
+make-built shared library; ``build_py`` compiles it and ships it inside
+the ``horovod_tpu/runtime`` package so installed wheels never need a
+compiler at import time (the import-time rebuild in
+``runtime/__init__.py`` remains the dev-tree fallback).
+
+Declarative metadata lives in ``pyproject.toml``; this file only adds the
+native build step.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNativeRuntime(build_py):
+    def run(self):
+        here = os.path.dirname(os.path.abspath(__file__))
+        cpp = os.path.join(here, "horovod_tpu", "runtime", "cpp")
+        so = os.path.join(here, "horovod_tpu", "runtime", "libhvdrt.so")
+        if os.path.isdir(cpp):
+            subprocess.run(["make", "-s", "-C", cpp], check=True)
+        super().run()
+        # Place the .so inside the build tree (package_data covers sdists;
+        # an explicit copy survives every build-backend path).
+        if os.path.exists(so) and self.build_lib:
+            dest = os.path.join(self.build_lib, "horovod_tpu", "runtime")
+            os.makedirs(dest, exist_ok=True)
+            shutil.copy2(so, os.path.join(dest, "libhvdrt.so"))
+
+
+setup(
+    cmdclass={"build_py": BuildNativeRuntime},
+    package_data={
+        "horovod_tpu.runtime": ["libhvdrt.so", "cpp/*.cc", "cpp/*.h",
+                                "cpp/Makefile"],
+    },
+)

@@ -1,0 +1,138 @@
+"""Driver pre-flight probe + HMAC-authenticated services (parity:
+horovod/runner/driver/driver_service.py NIC intersection +
+common/util/secret.py message signing)."""
+
+import os
+
+import pytest
+
+from horovod_tpu.runner import secret
+from horovod_tpu.runner.driver_service import (
+    TaskService,
+    common_routable_interfaces,
+    list_interfaces,
+    probe_cluster,
+    probe_host,
+)
+from horovod_tpu.runner.http.kv_server import KVClient, RendezvousServer
+
+
+class TestSecret:
+    def test_sign_verify_roundtrip(self):
+        key = secret.make_secret_key().encode()
+        tag = secret.sign(b"payload", key)
+        assert secret.verify(b"payload", tag, key)
+        assert not secret.verify(b"tampered", tag, key)
+        assert not secret.verify(b"payload", "", key)
+
+    def test_open_mode_without_key(self):
+        assert secret.sign(b"x", None) in ("",) or secret.current_key()
+        # Explicit no-key: everything verifies (dev mode).
+        assert secret.verify(b"x", "", key=None) or secret.current_key()
+
+
+class TestAuthenticatedKV:
+    def test_signed_roundtrip_and_rejection(self, monkeypatch):
+        monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+        server = RendezvousServer()
+        port = server.start()
+        try:
+            c = KVClient("127.0.0.1", port)
+            c.put("s", "k", b"v")
+            assert c.get("s", "k") == b"v"
+            # A client WITHOUT the key is rejected.
+            from urllib.error import HTTPError
+            from urllib.request import Request, urlopen
+
+            req = Request(f"http://127.0.0.1:{port}/s/k2", data=b"evil",
+                          method="PUT")
+            with pytest.raises(HTTPError) as e:
+                urlopen(req, timeout=5)
+            assert e.value.code == 403
+            # And unauthenticated reads are rejected too.
+            with pytest.raises(HTTPError) as e:
+                urlopen(f"http://127.0.0.1:{port}/s/k", timeout=5)
+            assert e.value.code == 403
+            # Wrong key loses as well.
+            monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+            bad = KVClient("127.0.0.1", port)
+            with pytest.raises(HTTPError) as e:
+                bad.get("s", "k")
+            assert e.value.code == 403
+        finally:
+            server.stop()
+
+
+class TestNICProbe:
+    def test_list_interfaces_local(self):
+        ifaces = list_interfaces()
+        assert ifaces, "no interfaces found"
+        assert all({"name", "address", "prefixlen"} <= set(i) for i in ifaces)
+
+    def test_intersection_math(self):
+        per_host = {
+            "h1": [
+                {"name": "eth0", "address": "10.0.0.1", "prefixlen": 24},
+                {"name": "dcn0", "address": "192.168.5.1", "prefixlen": 16},
+            ],
+            "h2": [
+                {"name": "eth0", "address": "10.0.0.2", "prefixlen": 24},
+                {"name": "mgmt", "address": "172.16.0.2", "prefixlen": 12},
+            ],
+        }
+        nets, addrs = common_routable_interfaces(per_host)
+        assert nets == ["10.0.0.0/24"]
+        assert addrs == {"h1": "10.0.0.1", "h2": "10.0.0.2"}
+
+    def test_no_common_network_raises(self):
+        per_host = {
+            "h1": [{"name": "a", "address": "10.0.0.1", "prefixlen": 24}],
+            "h2": [{"name": "b", "address": "10.1.0.1", "prefixlen": 24}],
+        }
+        with pytest.raises(RuntimeError, match="no common network"):
+            common_routable_interfaces(per_host)
+
+    def test_probe_live_services(self, monkeypatch):
+        monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+        s1, s2 = TaskService("127.0.0.1"), TaskService("127.0.0.1")
+        p1, p2 = s1.start(), s2.start()
+        try:
+            view = probe_host("127.0.0.1", p1)
+            assert view == list_interfaces()
+            nets, addrs = probe_cluster({
+                "hostA": ("127.0.0.1", p1),
+                "hostB": ("127.0.0.1", p2),
+            })
+            assert nets and set(addrs) == {"hostA", "hostB"}
+            # Unauthenticated probe is rejected.
+            from urllib.error import HTTPError
+            from urllib.request import urlopen
+
+            monkeypatch.delenv(secret.ENV_KEY)
+            with pytest.raises(HTTPError):
+                probe_host("127.0.0.1", p1)
+        finally:
+            monkeypatch.setenv(secret.ENV_KEY, "")
+            s1._httpd.shutdown = s1._httpd.shutdown  # no-op guard
+            os.environ.pop(secret.ENV_KEY, None)
+            s1.stop()
+            s2.stop()
+
+
+class TestLauncherProbeIntegration:
+    def test_probe_flag_parsed(self):
+        from horovod_tpu.runner.launch import parse_args, settings_from_args
+
+        args = parse_args(["-np", "1", "--network-probe", "python", "t.py"])
+        s = settings_from_args(args)
+        assert s.network_probe is True
+
+    @pytest.mark.slow
+    def test_local_probe_finds_common_network(self, monkeypatch):
+        monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+        from horovod_tpu.runner.hosts import HostInfo
+        from horovod_tpu.runner.launch import _network_probe
+
+        addrs = _network_probe(
+            [HostInfo("localhost", 1)], ssh_port=None, sink=None)
+        assert addrs is not None and "localhost" in addrs

@@ -435,3 +435,29 @@ class TestPerProcessEagerIdiom:
         assert rc == 0, "\n".join(lines)
         assert any("perproc rank0 ok" in l for l in lines), lines
         assert any("perproc rank1 ok" in l for l in lines), lines
+
+
+class TestConfigFile:
+    def test_yaml_defaults_cli_wins(self, tmp_path):
+        cfg = tmp_path / "hvd.yml"
+        cfg.write_text(
+            "cpu-mode: true\n"
+            "fusion-threshold-mb: 16\n"
+            "log-level: debug\n"
+            "num_proc: 4\n"
+        )
+        # CLI -np 2 beats the file's num_proc; file fills the rest.
+        args = parse_args(["-np", "2", "--config-file", str(cfg),
+                           "python", "t.py"])
+        assert args.num_proc == 2
+        assert args.cpu_mode is True
+        assert args.fusion_threshold_mb == 16
+        assert args.log_level == "debug"
+        env = args_to_env(args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(16 * 1024 * 1024)
+
+    def test_unknown_key_rejected(self, tmp_path):
+        cfg = tmp_path / "hvd.yml"
+        cfg.write_text("bogus-flag: 1\n")
+        with pytest.raises(SystemExit, match="unknown option"):
+            parse_args(["--config-file", str(cfg), "python", "t.py"])
