@@ -1,0 +1,56 @@
+"""MNIST with ``horovod_tpu.tensorflow`` — the reference's
+``examples/tensorflow2/tensorflow2_mnist.py`` (DistributedGradientTape)
+ported to this framework's TF surface. Synthetic data; run::
+
+    hvdrun -np 2 --cpu-mode python examples/tf2_mnist.py --steps 8
+"""
+
+import argparse
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--lr", type=float, default=0.001)
+    args = p.parse_args()
+
+    hvd.init()
+    tf.random.set_seed(0)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(8, 3, activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_fn = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.keras.optimizers.Adam(args.lr * hvd.size())
+
+    rng = np.random.RandomState(42 + hvd.rank())
+    first = True
+    for step in range(args.steps):
+        x = tf.constant(rng.rand(args.batch_size, 28, 28, 1), tf.float32)
+        y = tf.constant(rng.randint(0, 10, size=(args.batch_size,)))
+        with tf.GradientTape() as tape:
+            loss = loss_fn(y, model(x, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first:
+            # Sync initial state after the first step builds variables
+            # (reference: broadcast after step 0).
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            first = False
+    if hvd.rank() == 0:
+        print(f"final loss={float(loss):.4f}")
+        print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

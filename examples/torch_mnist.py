@@ -1,0 +1,78 @@
+"""MNIST with ``horovod_tpu.torch`` — the reference's
+``examples/pytorch/pytorch_mnist.py`` (BASELINE config #1) ported to this
+framework's torch surface. Synthetic MNIST-shaped data (no downloads);
+run single-process or::
+
+    hvdrun -np 2 --cpu-mode python examples/torch_mnist.py --epochs 1
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = torch.nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--steps-per-epoch", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+
+    model = Net()
+    # Scale LR by world size (the reference recipe), wrap the optimizer,
+    # sync initial weights.
+    optimizer = torch.optim.SGD(
+        model.parameters(), lr=args.lr * hvd.size(), momentum=0.5)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    rng = np.random.RandomState(42 + hvd.rank())  # per-rank data shard
+    for epoch in range(args.epochs):
+        model.train()
+        for step in range(args.steps_per_epoch):
+            x = torch.from_numpy(
+                rng.rand(args.batch_size, 1, 28, 28).astype(np.float32))
+            y = torch.from_numpy(
+                rng.randint(0, 10, size=(args.batch_size,)))
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(x), y)
+            loss.backward()
+            optimizer.step()
+        # Average the epoch loss across workers for logging (metric
+        # allreduce, reference idiom).
+        avg = hvd.allreduce(loss.detach()[None], name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss={float(avg[0]):.4f}")
+    if hvd.rank() == 0:
+        print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

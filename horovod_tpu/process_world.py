@@ -1,0 +1,37 @@
+"""Process-level world facts shared by the host-framework surfaces.
+
+``horovod_tpu.torch`` / ``.tensorflow`` / ``.keras`` all describe the same
+world — one controller process per host, facts from the launcher env
+contract (reference: one rank per accelerator process). One implementation
+here so the env-var contract and teardown logic cannot drift between
+surfaces.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def size() -> int:
+    return int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+
+
+def rank() -> int:
+    return int(os.environ.get("HOROVOD_PROCESS_ID", "0") or 0)
+
+
+def local_rank() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_RANK", "0") or 0)
+
+
+def local_size() -> int:
+    return int(os.environ.get("HOROVOD_LOCAL_SIZE", "1") or 1)
+
+
+def shutdown_native_world() -> None:
+    """Tear down the cached native host world (if any)."""
+    from .parallel import hierarchical
+
+    if hierarchical._host_world is not None:
+        hierarchical._host_world.shutdown()
+        hierarchical._host_world = None

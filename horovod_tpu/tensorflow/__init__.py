@@ -52,11 +52,9 @@ def init() -> None:
 
 def shutdown() -> None:
     global _initialized
-    from ..parallel import hierarchical
+    from ..process_world import shutdown_native_world
 
-    if hierarchical._host_world is not None:
-        hierarchical._host_world.shutdown()
-        hierarchical._host_world = None
+    shutdown_native_world()
     _initialized = False
 
 
@@ -64,21 +62,14 @@ def is_initialized() -> bool:
     return _initialized
 
 
-def size() -> int:
-    """Number of worker processes (reference: one process per accelerator)."""
-    return int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
-
-
-def rank() -> int:
-    return int(os.environ.get("HOROVOD_PROCESS_ID", "0") or 0)
-
-
-def local_rank() -> int:
-    return int(os.environ.get("HOROVOD_LOCAL_RANK", "0") or 0)
-
-
-def local_size() -> int:
-    return int(os.environ.get("HOROVOD_LOCAL_SIZE", "1") or 1)
+# World facts shared across host-framework surfaces (one process per
+# accelerator host — reference: one rank per accelerator process).
+from ..process_world import (  # noqa: E402
+    local_rank,
+    local_size,
+    rank,
+    size,
+)
 
 
 def _world():

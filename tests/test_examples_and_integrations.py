@@ -124,3 +124,33 @@ class TestIntegrations:
                     os.environ.pop(k, None)
                 else:
                     os.environ[k] = v
+
+
+@pytest.mark.slow
+class TestFrameworkExamples:
+    """BASELINE configs #1/#3 examples run under the real launcher."""
+
+    def _hvdrun(self, example, *args, np_=2):
+        env = dict(
+            os.environ,
+            PALLAS_AXON_POOL_IPS="",
+            PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.launch",
+             "-np", str(np_), "--cpu-mode",
+             os.path.join(REPO_ROOT, "examples", example), *args],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+
+    def test_torch_mnist_two_procs(self):
+        pytest.importorskip("torch")
+        r = self._hvdrun("torch_mnist.py", "--steps-per-epoch", "3")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "done" in r.stdout
+
+    def test_tf2_mnist_two_procs(self):
+        pytest.importorskip("tensorflow")
+        r = self._hvdrun("tf2_mnist.py", "--steps", "3")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "done" in r.stdout
