@@ -1,0 +1,78 @@
+"""TF/Keras elastic state.
+
+Parity: ``horovod/tensorflow/elastic.py — TensorFlowKerasState``: model
+weights + optimizer variables + user objects snapshot to host on
+``commit()``, roll back on ``restore()``, broadcast from rank 0 on
+``sync()`` — driving the same ``@hvd.elastic.run`` retry loop as the JAX
+and torch flavors.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any
+
+import numpy as np
+
+from ..elastic.state import State
+from . import broadcast_variables, size
+from ..functions import broadcast_object
+
+
+class TensorFlowKerasState(State):
+    def __init__(self, model=None, optimizer=None, **extras: Any):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._extras = dict(extras)
+        self._saved_weights = None
+        self._saved_opt = None
+        self._saved_extras = copy.deepcopy(self._extras)
+        self.commit()
+
+    def __getattr__(self, item):
+        extras = self.__dict__.get("_extras", {})
+        if item in extras:
+            return extras[item]
+        raise AttributeError(item)
+
+    def __setattr__(self, key, value):
+        if key.startswith("_") or key in ("model", "optimizer"):
+            super().__setattr__(key, value)
+        elif "_extras" in self.__dict__ and key in self._extras:
+            self._extras[key] = value
+        else:
+            super().__setattr__(key, value)
+
+    def _opt_vars(self):
+        if self.optimizer is None:
+            return []
+        return list(getattr(self.optimizer, "variables", lambda: [])()) \
+            if callable(getattr(self.optimizer, "variables", None)) \
+            else list(getattr(self.optimizer, "variables", []))
+
+    def commit(self) -> None:
+        if self.model is not None:
+            self._saved_weights = [np.asarray(w)
+                                   for w in self.model.get_weights()]
+        self._saved_opt = [np.asarray(v) for v in self._opt_vars()]
+        self._saved_extras = copy.deepcopy(self._extras)
+        self.check_host_updates()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_weights is not None:
+            self.model.set_weights(self._saved_weights)
+        for v, saved in zip(self._opt_vars(), self._saved_opt or []):
+            v.assign(saved)
+        self._extras = copy.deepcopy(self._saved_extras)
+
+    def sync(self) -> None:
+        if size() <= 1:
+            return
+        if self.model is not None:
+            broadcast_variables(self.model.variables, root_rank=0)
+        opt_vars = self._opt_vars()
+        if opt_vars:
+            broadcast_variables(opt_vars, root_rank=0)
+        self._extras = broadcast_object(self._extras, root_rank=0)
+        self.commit()

@@ -1,0 +1,133 @@
+"""Torch elastic state + sampler.
+
+Parity: ``horovod/torch/elastic/state.py — TorchState`` and
+``horovod/torch/elastic/sampler.py — ElasticSampler``. Plugs the torch
+surface into the framework-agnostic elastic machine
+(:mod:`horovod_tpu.elastic`): the same ``@hvd.elastic.run`` retry loop,
+with torch tensors snapshotted to host memory on ``commit()`` and
+broadcast from rank 0 on ``sync()``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterator
+
+import numpy as np
+import torch
+
+from ..elastic.state import State
+from . import (
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    rank,
+    size,
+)
+
+
+class TorchState(State):
+    """Elastic state for a torch model + optimizer + user objects.
+
+    ``TorchState(model=model, optimizer=opt, epoch=0, batch=0)`` — tensor
+    attributes commit/restore as host copies; plain attributes as python
+    objects; ``sync()`` broadcasts everything from rank 0.
+    """
+
+    def __init__(self, model=None, optimizer=None, **extras: Any):
+        super().__init__()
+        self.model = model
+        self.optimizer = optimizer
+        self._extras = dict(extras)
+        self._saved_model = None
+        self._saved_opt = None
+        self._saved_extras = copy.deepcopy(self._extras)
+        self.commit()
+
+    def __getattr__(self, item):
+        extras = self.__dict__.get("_extras", {})
+        if item in extras:
+            return extras[item]
+        raise AttributeError(item)
+
+    def __setattr__(self, key, value):
+        if key.startswith("_") or key in ("model", "optimizer"):
+            super().__setattr__(key, value)
+        elif "_extras" in self.__dict__ and key in self._extras:
+            self._extras[key] = value
+        else:
+            super().__setattr__(key, value)
+
+    def commit(self) -> None:
+        if self.model is not None:
+            self._saved_model = {
+                k: v.detach().cpu().clone()
+                for k, v in self.model.state_dict().items()
+            }
+        if self.optimizer is not None:
+            self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
+        self._saved_extras = copy.deepcopy(self._extras)
+        self.check_host_updates()
+
+    def restore(self) -> None:
+        if self.model is not None and self._saved_model is not None:
+            self.model.load_state_dict(self._saved_model)
+        if self.optimizer is not None and self._saved_opt is not None:
+            self.optimizer.load_state_dict(self._saved_opt)
+        self._extras = copy.deepcopy(self._saved_extras)
+
+    def sync(self) -> None:
+        if size() <= 1:
+            return
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        self._extras = broadcast_object(self._extras, root_rank=0,
+                                        name="torch_state_extras")
+        self.commit()
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Shards a dataset by the CURRENT world and records progress.
+
+    Parity: ``hvd.elastic.ElasticSampler`` — on world change
+    (``set_epoch``/reset callback) the shard is recomputed for the new
+    rank/size, and ``record_batch`` tracks processed indices so a restored
+    epoch resumes where it left off instead of replaying data.
+    """
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: set[int] = set()
+        self.reset()
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices.clear()
+        self.reset()
+
+    def reset(self) -> None:
+        """Recompute this rank's shard for the current world size."""
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        remaining = [i for i in order.tolist()
+                     if i not in self.processed_indices]
+        self.indices = remaining[rank()::max(1, size())]
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark a processed batch (call after each step, before commit)."""
+        start = batch_idx * batch_size
+        chunk = self.indices[start:start + batch_size]
+        self.processed_indices.update(chunk)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)

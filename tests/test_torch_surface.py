@@ -150,3 +150,70 @@ class TestMultiProcess:
         )
         assert len(digests) == 2 and digests[0] == digests[1], lines
         assert any("torch-bpps rank0 ok" in l for l in lines), lines
+
+
+class TestTorchElastic:
+    def test_state_commit_restore(self):
+        from horovod_tpu.torch.elastic import TorchState
+
+        model = torch.nn.Linear(2, 1)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = TorchState(model=model, optimizer=opt, epoch=3, batch=7)
+        w0 = model.weight.detach().clone()
+        # Mutate everything, then roll back.
+        with torch.no_grad():
+            model.weight += 1.0
+        state.epoch = 9
+        state.restore()
+        assert torch.allclose(model.weight, w0)
+        assert state.epoch == 3 and state.batch == 7
+        # Commit pins the new values.
+        with torch.no_grad():
+            model.weight += 2.0
+        state.epoch = 5
+        state.commit()
+        state.restore()
+        assert torch.allclose(model.weight, w0 + 2.0)
+        assert state.epoch == 5
+
+    def test_elastic_sampler_shards_and_resumes(self, monkeypatch):
+        from horovod_tpu.torch.elastic import ElasticSampler
+
+        data = list(range(20))
+        monkeypatch.setenv("HOROVOD_NUM_PROCESSES", "2")
+        monkeypatch.setenv("HOROVOD_PROCESS_ID", "0")
+        s0 = ElasticSampler(data, shuffle=False)
+        monkeypatch.setenv("HOROVOD_PROCESS_ID", "1")
+        s1 = ElasticSampler(data, shuffle=False)
+        # Disjoint shards covering the dataset.
+        assert set(s0.indices) | set(s1.indices) == set(range(20))
+        assert not set(s0.indices) & set(s1.indices)
+        # Record progress, then "world shrinks to 1": remaining excludes
+        # processed items.
+        monkeypatch.setenv("HOROVOD_PROCESS_ID", "0")
+        s0.record_batch(0, 4)
+        processed = set(list(s0.processed_indices))
+        assert len(processed) == 4
+        monkeypatch.setenv("HOROVOD_NUM_PROCESSES", "1")
+        s0.reset()
+        assert set(s0.indices) == set(range(20)) - processed
+        # New epoch replays everything.
+        s0.set_epoch(1)
+        assert len(s0) == 20
+
+
+class TestTFElastic:
+    def test_state_commit_restore(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model(np.zeros((1, 2), np.float32))
+        state = TensorFlowKerasState(model=model, epoch=1)
+        w0 = [np.asarray(w) for w in model.get_weights()]
+        model.set_weights([w + 1.0 for w in w0])
+        state.epoch = 4
+        state.restore()
+        for a, b in zip(model.get_weights(), w0):
+            np.testing.assert_allclose(np.asarray(a), b)
+        assert state.epoch == 1
