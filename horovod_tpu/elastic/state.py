@@ -138,3 +138,49 @@ class TpuState(State):
         for k, v in extras.items():
             setattr(self, k, v)
         self.commit()
+
+
+class ExtrasState(State):
+    """Shared user-object tracking for the framework State flavors.
+
+    EVERY public attribute assigned on the state — in __init__ kwargs or
+    at any later point (``state.epoch = 0`` after construction) — is
+    tracked: snapshotted by ``commit()``, rolled back by ``restore()``,
+    broadcast by ``sync()``. Untracked attributes silently surviving a
+    rollback is precisely the divergence elastic state exists to prevent,
+    so there is no untracked flavor; underscore names and the framework
+    handles (``model``/``optimizer``) are the only exceptions.
+    """
+
+    _SPECIAL = ("model", "optimizer")
+
+    def __init__(self, **extras):
+        super().__init__()
+        self._extras = dict(extras)
+        self._saved_extras = {}
+
+    def __getattr__(self, item):
+        extras = self.__dict__.get("_extras", {})
+        if item in extras:
+            return extras[item]
+        raise AttributeError(item)
+
+    def __setattr__(self, key, value):
+        if key.startswith("_") or key in self._SPECIAL \
+                or "_extras" not in self.__dict__:
+            super().__setattr__(key, value)
+        else:
+            self._extras[key] = value
+
+    def commit_extras(self) -> None:
+        import copy
+
+        self._saved_extras = copy.deepcopy(self._extras)
+
+    def restore_extras(self) -> None:
+        import copy
+
+        self._extras = copy.deepcopy(self._saved_extras)
+
+    def sync_extras(self, broadcast_object_fn) -> None:
+        self._extras = broadcast_object_fn(self._extras)

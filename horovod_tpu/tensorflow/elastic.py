@@ -9,62 +9,56 @@ and torch flavors.
 
 from __future__ import annotations
 
-import copy
 from typing import Any
 
 import numpy as np
 
-from ..elastic.state import State
-from . import broadcast_variables, size
+from ..elastic.state import ExtrasState
 from ..functions import broadcast_object
+from . import broadcast_variables, size
 
 
-class TensorFlowKerasState(State):
+def _var_key(v) -> str:
+    # Keras 3 variables expose a unique `.path`; fall back to `.name`.
+    return getattr(v, "path", None) or v.name
+
+
+class TensorFlowKerasState(ExtrasState):
     def __init__(self, model=None, optimizer=None, **extras: Any):
-        super().__init__()
+        super().__init__(**extras)
         self.model = model
         self.optimizer = optimizer
-        self._extras = dict(extras)
         self._saved_weights = None
-        self._saved_opt = None
-        self._saved_extras = copy.deepcopy(self._extras)
+        self._saved_opt: dict[str, np.ndarray] = {}
         self.commit()
-
-    def __getattr__(self, item):
-        extras = self.__dict__.get("_extras", {})
-        if item in extras:
-            return extras[item]
-        raise AttributeError(item)
-
-    def __setattr__(self, key, value):
-        if key.startswith("_") or key in ("model", "optimizer"):
-            super().__setattr__(key, value)
-        elif "_extras" in self.__dict__ and key in self._extras:
-            self._extras[key] = value
-        else:
-            super().__setattr__(key, value)
 
     def _opt_vars(self):
         if self.optimizer is None:
             return []
-        return list(getattr(self.optimizer, "variables", lambda: [])()) \
-            if callable(getattr(self.optimizer, "variables", None)) \
-            else list(getattr(self.optimizer, "variables", []))
+        vars_attr = getattr(self.optimizer, "variables", [])
+        return list(vars_attr() if callable(vars_attr) else vars_attr)
 
     def commit(self) -> None:
         if self.model is not None:
             self._saved_weights = [np.asarray(w)
                                    for w in self.model.get_weights()]
-        self._saved_opt = [np.asarray(v) for v in self._opt_vars()]
-        self._saved_extras = copy.deepcopy(self._extras)
+        # BY NAME, not position: Keras creates slot variables lazily at the
+        # first apply_gradients — a positional zip against a pre-step
+        # snapshot would silently roll back only a prefix.
+        self._saved_opt = {
+            _var_key(v): np.asarray(v) for v in self._opt_vars()
+        }
+        self.commit_extras()
         self.check_host_updates()
 
     def restore(self) -> None:
         if self.model is not None and self._saved_weights is not None:
             self.model.set_weights(self._saved_weights)
-        for v, saved in zip(self._opt_vars(), self._saved_opt or []):
-            v.assign(saved)
-        self._extras = copy.deepcopy(self._saved_extras)
+        for v in self._opt_vars():
+            saved = self._saved_opt.get(_var_key(v))
+            if saved is not None:
+                v.assign(saved)
+        self.restore_extras()
 
     def sync(self) -> None:
         if size() <= 1:
@@ -74,5 +68,5 @@ class TensorFlowKerasState(State):
         opt_vars = self._opt_vars()
         if opt_vars:
             broadcast_variables(opt_vars, root_rank=0)
-        self._extras = broadcast_object(self._extras, root_rank=0)
+        self.sync_extras(lambda o: broadcast_object(o, root_rank=0))
         self.commit()

@@ -16,7 +16,7 @@ from typing import Any, Iterator
 import numpy as np
 import torch
 
-from ..elastic.state import State
+from ..elastic.state import ExtrasState
 from . import (
     broadcast_object,
     broadcast_optimizer_state,
@@ -26,37 +26,22 @@ from . import (
 )
 
 
-class TorchState(State):
+class TorchState(ExtrasState):
     """Elastic state for a torch model + optimizer + user objects.
 
     ``TorchState(model=model, optimizer=opt, epoch=0, batch=0)`` — tensor
-    attributes commit/restore as host copies; plain attributes as python
-    objects; ``sync()`` broadcasts everything from rank 0.
+    attributes commit/restore as host copies; EVERY plain attribute (incl.
+    ones assigned after construction) is tracked; ``sync()`` broadcasts
+    everything from rank 0.
     """
 
     def __init__(self, model=None, optimizer=None, **extras: Any):
-        super().__init__()
+        super().__init__(**extras)
         self.model = model
         self.optimizer = optimizer
-        self._extras = dict(extras)
         self._saved_model = None
         self._saved_opt = None
-        self._saved_extras = copy.deepcopy(self._extras)
         self.commit()
-
-    def __getattr__(self, item):
-        extras = self.__dict__.get("_extras", {})
-        if item in extras:
-            return extras[item]
-        raise AttributeError(item)
-
-    def __setattr__(self, key, value):
-        if key.startswith("_") or key in ("model", "optimizer"):
-            super().__setattr__(key, value)
-        elif "_extras" in self.__dict__ and key in self._extras:
-            self._extras[key] = value
-        else:
-            super().__setattr__(key, value)
 
     def commit(self) -> None:
         if self.model is not None:
@@ -66,7 +51,7 @@ class TorchState(State):
             }
         if self.optimizer is not None:
             self._saved_opt = copy.deepcopy(self.optimizer.state_dict())
-        self._saved_extras = copy.deepcopy(self._extras)
+        self.commit_extras()
         self.check_host_updates()
 
     def restore(self) -> None:
@@ -74,7 +59,7 @@ class TorchState(State):
             self.model.load_state_dict(self._saved_model)
         if self.optimizer is not None and self._saved_opt is not None:
             self.optimizer.load_state_dict(self._saved_opt)
-        self._extras = copy.deepcopy(self._saved_extras)
+        self.restore_extras()
 
     def sync(self) -> None:
         if size() <= 1:
@@ -83,8 +68,9 @@ class TorchState(State):
             broadcast_parameters(self.model.state_dict(), root_rank=0)
         if self.optimizer is not None:
             broadcast_optimizer_state(self.optimizer, root_rank=0)
-        self._extras = broadcast_object(self._extras, root_rank=0,
-                                        name="torch_state_extras")
+        self.sync_extras(
+            lambda o: broadcast_object(o, root_rank=0,
+                                       name="torch_state_extras"))
         self.commit()
 
 
@@ -118,7 +104,16 @@ class ElasticSampler(torch.utils.data.Sampler):
             np.random.RandomState(self.seed + self.epoch).shuffle(order)
         remaining = [i for i in order.tolist()
                      if i not in self.processed_indices]
-        self.indices = remaining[rank()::max(1, size())]
+        n_ranks = max(1, size())
+        # Pad by wrapping so every rank gets the SAME batch count — an
+        # uneven split would leave one rank issuing a collective nobody
+        # else joins (the reference sampler pads for exactly this reason).
+        total = ((len(remaining) + n_ranks - 1) // n_ranks) * n_ranks
+        if remaining:
+            padded = remaining + remaining[: total - len(remaining)]
+        else:
+            padded = []
+        self.indices = padded[rank()::n_ranks]
 
     def record_batch(self, batch_idx: int, batch_size: int) -> None:
         """Mark a processed batch (call after each step, before commit)."""

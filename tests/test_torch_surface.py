@@ -159,14 +159,18 @@ class TestTorchElastic:
         model = torch.nn.Linear(2, 1)
         opt = torch.optim.SGD(model.parameters(), lr=0.1)
         state = TorchState(model=model, optimizer=opt, epoch=3, batch=7)
+        state.late_attr = "x"  # assigned AFTER construction: still tracked
+        state.commit()
         w0 = model.weight.detach().clone()
         # Mutate everything, then roll back.
         with torch.no_grad():
             model.weight += 1.0
         state.epoch = 9
+        state.late_attr = "mutated"
         state.restore()
         assert torch.allclose(model.weight, w0)
         assert state.epoch == 3 and state.batch == 7
+        assert state.late_attr == "x"  # post-init attrs roll back too
         # Commit pins the new values.
         with torch.no_grad():
             model.weight += 2.0
@@ -185,9 +189,9 @@ class TestTorchElastic:
         s0 = ElasticSampler(data, shuffle=False)
         monkeypatch.setenv("HOROVOD_PROCESS_ID", "1")
         s1 = ElasticSampler(data, shuffle=False)
-        # Disjoint shards covering the dataset.
+        # Shards cover the dataset with EQUAL lengths (padded by wrap).
         assert set(s0.indices) | set(s1.indices) == set(range(20))
-        assert not set(s0.indices) & set(s1.indices)
+        assert len(s0) == len(s1)
         # Record progress, then "world shrinks to 1": remaining excludes
         # processed items.
         monkeypatch.setenv("HOROVOD_PROCESS_ID", "0")
@@ -217,3 +221,25 @@ class TestTFElastic:
         for a, b in zip(model.get_weights(), w0):
             np.testing.assert_allclose(np.asarray(a), b)
         assert state.epoch == 1
+
+    def test_lazy_optimizer_slots_restore_by_name(self):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        model(np.zeros((1, 2), np.float32))
+        opt = tf.keras.optimizers.Adam(0.1)
+        # Commit BEFORE the first step: slot variables don't exist yet.
+        state = TensorFlowKerasState(model=model, optimizer=opt, epoch=0)
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(model(np.ones((2, 2), np.float32)) ** 2)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        state.commit()  # now slots exist; snapshot by name
+        it_committed = int(np.asarray(opt.iterations))
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(model(np.ones((2, 2), np.float32)) ** 2)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        state.restore()
+        assert int(np.asarray(opt.iterations)) == it_committed
