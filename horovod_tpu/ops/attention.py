@@ -175,14 +175,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = lse
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                     dq_scr, *, causal: bool, scale: float, block_q: int,
-                     block_k: int, q_offset: int, k_offset: int):
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     glse_ref, dq_ref, dq_scr, *, causal: bool,
+                     scale: float, block_q: int, block_k: int,
+                     q_offset: int, k_offset: int):
     """dQ pass. Grid (BH, num_q_blocks, num_k_blocks), K innermost;
     accumulates dq for one Q tile across all K tiles.
 
-    P_ij = exp(s_ij - lse_i); dS = P * (dO @ V^T - delta_i);
-    dQ_i = scale * sum_j dS_ij K_j.
+    P_ij = exp(s_ij - lse_i); dS = P * (dO @ V^T - delta_i + g_lse_i);
+    dQ_i = scale * sum_j dS_ij K_j. The g_lse term is the cotangent of the
+    logsumexp output (dlse_i/ds_ij = P_ij) — ring attention's partial
+    merge weights differentiate through lse, so it is NOT discardable.
     """
     qi = pl.program_id(1)
     j = pl.program_id(2)
@@ -199,6 +202,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     # lse/delta blocks are full rows [1, Sq] (TPU tiling); slice our q tile.
     lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
     delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    glse = glse_ref[0, 0, pl.ds(qi * block_q, block_q)]
 
     s = jax.lax.dot_general(
         q, k_tile, (((1,), (1,)), ((), ())),
@@ -212,7 +216,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         do, v_tile, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta[:, None] + glse[:, None])
     dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
         ds, k_tile, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -224,9 +228,9 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                      scale: float, block_q: int, block_k: int,
-                      q_offset: int, k_offset: int):
+                      glse_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                      causal: bool, scale: float, block_q: int,
+                      block_k: int, q_offset: int, k_offset: int):
     """dK/dV pass. Grid (BH, num_k_blocks, num_q_blocks), Q innermost;
     accumulates dk, dv for one K/V tile across all Q tiles.
 
@@ -247,6 +251,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
     delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+    glse = glse_ref[0, 0, pl.ds(i * block_q, block_q)]
 
     s = jax.lax.dot_general(
         q, k_tile, (((1,), (1,)), ((), ())),
@@ -265,7 +270,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do, v_tile, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    ds = p * (dp - delta[:, None])
+    ds = p * (dp - delta[:, None] + glse[:, None])
     # dK_j += scale * dS^T @ Q
     dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
@@ -319,12 +324,16 @@ def _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset, k_offset,
 
 
 def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
-               res, g):
+               res, g, g_lse=None):
     qr, kr, vr, out, lse = res
     BH, Sq, D = qr.shape
     Sk = kr.shape[1]
     scale = 1.0 / (D ** 0.5)
     do = g
+    if g_lse is None:
+        g_lse = jnp.zeros_like(lse)
+    else:
+        g_lse = jnp.asarray(g_lse, jnp.float32).reshape(lse.shape)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
     # cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
@@ -335,6 +344,7 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
         pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, i, j: (bh, j, 0)),
         pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
         pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
         pl.BlockSpec((1, 1, Sq), lambda bh, i, j: (bh, 0, 0)),
     ]
@@ -349,13 +359,14 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(qr, kr, vr, do, lse, delta, g_lse)
 
     kv_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
         pl.BlockSpec((1, block_k, D), lambda bh, j, i: (bh, j, 0)),
         pl.BlockSpec((1, block_q, D), lambda bh, j, i: (bh, i, 0)),
+        pl.BlockSpec((1, 1, Sq), lambda bh, j, i: (bh, 0, 0)),
         pl.BlockSpec((1, 1, Sq), lambda bh, j, i: (bh, 0, 0)),
         pl.BlockSpec((1, 1, Sq), lambda bh, j, i: (bh, 0, 0)),
     ]
@@ -379,7 +390,7 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(qr, kr, vr, do, lse, delta, g_lse)
     return dq, dk, dv
 
 
@@ -400,9 +411,12 @@ def _flash_with_lse_fwd(qr, kr, vr, causal, block_q, block_k, q_offset,
 
 def _flash_with_lse_bwd(causal, block_q, block_k, q_offset, k_offset,
                         interpret, res, gs):
-    g, _g_lse = gs  # gradient w.r.t. lse is not supported (internal detail)
+    g, g_lse = gs
+    # float0 cotangent (lse unused downstream) -> zeros.
+    if g_lse is None or g_lse.dtype == jax.dtypes.float0:
+        g_lse = None
     return _flash_bwd(causal, block_q, block_k, q_offset, k_offset,
-                      interpret, res, g)
+                      interpret, res, g, g_lse)
 
 
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
@@ -452,3 +466,31 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     out, _lse = _flash_with_lse(qr, kr, vr, causal, block_q, block_k,
                                 q_offset, k_offset, interpret)
     return out.reshape(B, H, Sq, D)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "q_offset", "k_offset",
+                     "interpret"),
+)
+def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 128,
+                        block_k: int = 128, q_offset: int = 0,
+                        k_offset: int = 0, interpret: bool = False):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp ``[B, H, Sq]`` (fp32) — the hook ring attention uses to
+    merge per-shard partial attentions exactly:
+    ``out = Σ_t exp(lse_t - lse_total) * out_t``. Fully-masked rows carry
+    the ``LSE_MASKED`` sentinel (treat as -inf when merging).
+    Differentiable (the lse output has no defined cotangent)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
+            f"({block_q}, {block_k}); pad to a multiple"
+        )
+    out, lse = _flash_with_lse(
+        q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
+        v.reshape(B * H, Sk, D), causal, block_q, block_k, q_offset,
+        k_offset, interpret)
+    return out.reshape(B, H, Sq, D), lse.reshape(B, H, Sq)

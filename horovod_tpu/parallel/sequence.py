@@ -29,11 +29,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.attention import (
+    LSE_MASKED,
     NEG_INF,
     _attend_block,
     _finalize,
     blockwise_attention_reference,
     flash_attention,
+    flash_attention_lse,
 )
 
 
@@ -55,7 +57,8 @@ def _local_attend(q, k, v, m, l, o, scale, causal, q_offset, k_offset):
     return jax.vmap(jax.vmap(per_head))(q, k, v, m, l, o)
 
 
-def ring_attention(q, k, v, axis_name: str = "hvd", causal: bool = False):
+def ring_attention(q, k, v, axis_name: str = "hvd", causal: bool = False,
+                   use_flash: bool = False, interpret: bool = False):
     """Ring (context-parallel) attention inside shard_map.
 
     Args: q, k, v ``[B, H, S_local, D]`` — the sequence dimension is the
@@ -67,17 +70,25 @@ def ring_attention(q, k, v, axis_name: str = "hvd", causal: bool = False):
     that originated on rank ``(idx - t) % n``, while ppermute-ing K/V one
     hop forward for step t+1 — compute and ICI transfer overlap (XLA
     schedules the independent ops concurrently).
+
+    ``use_flash=True`` runs each step through the Pallas flash kernel and
+    merges the per-shard partials by logsumexp — the MXU-tiled hot path
+    for long sequences (trainable: the kernel has a custom_vjp backward).
     """
     n = lax.psum(1, axis_name)  # mesh axis size: a static Python int
     idx = lax.axis_index(axis_name)
     B, H, S, D = q.shape
     scale = 1.0 / (D ** 0.5)
-    q32 = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
 
+    if use_flash:
+        return _ring_attention_flash(q, k, v, n, idx, perm, axis_name,
+                                     causal, interpret)
+
+    q32 = q.astype(jnp.float32)
     m = jnp.full((B, H, S), NEG_INF, jnp.float32)
     l = jnp.zeros((B, H, S), jnp.float32)
     o = jnp.zeros((B, H, S, D), jnp.float32)
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
     # Static unroll over the (static) ring size: rotate for the NEXT step
     # before computing, so the ICI transfer overlaps the compute — and skip
@@ -98,6 +109,55 @@ def ring_attention(q, k, v, axis_name: str = "hvd", causal: bool = False):
 
     out = jax.vmap(jax.vmap(_finalize))(l, o)
     return out.astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, n, idx, perm, axis_name, causal,
+                          interpret):
+    """Flash-kernel ring: per-step (out_t, lse_t) from the Pallas kernel,
+    merged online by logsumexp.
+
+    Causality without traced kernel offsets (Pallas mask offsets are
+    static): step t==0 is the diagonal block (causal kernel, Sq==Sk);
+    later steps are block-wise all-or-nothing — the K/V shard originated
+    on ``src = (idx - t) % n``, entirely in the past (visible, non-causal
+    kernel) or entirely in the future (contribution erased by setting its
+    lse to -inf, a traced select on the merge weights).
+    """
+    B, H, S, D = q.shape
+    m_run = jnp.full((B, H, S), NEG_INF, jnp.float32)
+    l_run = jnp.zeros((B, H, S), jnp.float32)
+    acc = jnp.zeros((B, H, S, D), jnp.float32)
+
+    kt, vt = k, v
+    for t in range(n):
+        src = (idx - t) % n
+        if t < n - 1:
+            k_next = lax.ppermute(kt, axis_name, perm)
+            v_next = lax.ppermute(vt, axis_name, perm)
+        blk = S if S < 128 else 128  # small dev shards: one block
+        o_t, lse_t = flash_attention_lse(
+            q, kt, vt, causal=(causal and t == 0), block_q=blk,
+            block_k=blk, interpret=interpret)
+        # Fully-masked-row sentinel (+BIG) means "no keys": merge as -inf.
+        lse_t = jnp.where(lse_t >= LSE_MASKED * 0.5, NEG_INF, lse_t)
+        if causal and t > 0:
+            visible = (src < idx)  # whole-block causality, traced scalar
+            lse_t = jnp.where(visible, lse_t, NEG_INF)
+        # Online logsumexp merge of the partial attention.
+        m_new = jnp.maximum(m_run, lse_t)
+        # Clamp so untouched rows (both -inf) stay a no-op.
+        corr = jnp.exp(jnp.minimum(m_run - m_new, 0.0))
+        w = jnp.exp(jnp.minimum(lse_t - m_new, 0.0))
+        w = jnp.where(lse_t <= NEG_INF * 0.5, 0.0, w)
+        corr = jnp.where(m_run <= NEG_INF * 0.5, 0.0, corr)
+        acc = acc * corr[..., None] + w[..., None] * o_t.astype(jnp.float32)
+        l_run = l_run * corr + w
+        m_run = m_new
+        if t < n - 1:
+            kt, vt = k_next, v_next
+
+    safe = jnp.where(l_run == 0.0, 1.0, l_run)
+    return (acc / safe[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "hvd", causal: bool = False,
@@ -162,11 +222,19 @@ def make_sp_attention_step(axis_name: str = "hvd", scheme: str = "ring",
     if scheme == "ring":
         inner = functools.partial(ring_attention, axis_name=axis_name,
                                   causal=causal)
+    elif scheme == "ring-flash":
+        inner = functools.partial(
+            ring_attention, axis_name=axis_name, causal=causal,
+            use_flash=True,
+            interpret=jax.default_backend() != "tpu",
+        )
     elif scheme == "ulysses":
         inner = functools.partial(ulysses_attention, axis_name=axis_name,
                                   causal=causal)
     else:
-        raise ValueError(f"unknown scheme {scheme!r}; use 'ring' or 'ulysses'")
+        raise ValueError(
+            f"unknown scheme {scheme!r}; use 'ring', 'ring-flash' or "
+            "'ulysses'")
 
     spec = P(None, None, axis_name, None)
     sharded = jax.shard_map(
