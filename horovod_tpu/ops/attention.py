@@ -113,6 +113,17 @@ def blockwise_attention_reference(q, k, v, causal=False, block_size=128,
 # ---------------------------------------------------------------------------
 
 
+def _auto_block(seq_len: int) -> int:
+    """Largest MXU-friendly block that divides the sequence. Bigger blocks
+    amortize grid/revisit overhead (measured on v5e at BERT-Large shapes:
+    512-blocks are ~33% faster than 128-blocks fwd+bwd); 512x512 f32
+    scores (1 MB) sit comfortably in VMEM."""
+    for cand in (512, 256, 128):
+        if seq_len % cand == 0:
+            return cand
+    return seq_len  # small/odd sequences: a single block
+
+
 def _causal_mask(qi, j, block_q, block_k, q_offset, k_offset):
     qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
@@ -427,9 +438,9 @@ _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
     static_argnames=("causal", "block_q", "block_k", "q_offset", "k_offset",
                      "interpret"),
 )
-def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
-                    block_k: int = 128, q_offset: int = 0, k_offset: int = 0,
-                    interpret: bool = False):
+def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
+                    block_k: int | None = None, q_offset: int = 0,
+                    k_offset: int = 0, interpret: bool = False):
     """Pallas flash attention. q, k, v: [B, H, S, D] → [B, H, S, D].
 
     Forward grid: (B*H, Sq/block_q, Sk/block_k); each program streams K/V
@@ -447,6 +458,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    block_q = block_q if block_q is not None else _auto_block(Sq)
+    block_k = block_k if block_k is not None else _auto_block(Sk)
     if Sq % block_q or Sk % block_k:
         raise ValueError(
             f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
@@ -473,8 +486,9 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int = 128,
     static_argnames=("causal", "block_q", "block_k", "q_offset", "k_offset",
                      "interpret"),
 )
-def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 128,
-                        block_k: int = 128, q_offset: int = 0,
+def flash_attention_lse(q, k, v, causal: bool = False,
+                        block_q: int | None = None,
+                        block_k: int | None = None, q_offset: int = 0,
                         k_offset: int = 0, interpret: bool = False):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp ``[B, H, Sq]`` (fp32) — the hook ring attention uses to
@@ -484,6 +498,8 @@ def flash_attention_lse(q, k, v, causal: bool = False, block_q: int = 128,
     Differentiable (the lse output has no defined cotangent)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
+    block_q = block_q if block_q is not None else _auto_block(Sq)
+    block_k = block_k if block_k is not None else _auto_block(Sk)
     if Sq % block_q or Sk % block_k:
         raise ValueError(
             f"sequence lengths ({Sq}, {Sk}) must divide block sizes "

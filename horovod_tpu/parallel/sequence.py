@@ -134,10 +134,8 @@ def _ring_attention_flash(q, k, v, n, idx, perm, axis_name, causal,
         if t < n - 1:
             k_next = lax.ppermute(kt, axis_name, perm)
             v_next = lax.ppermute(vt, axis_name, perm)
-        blk = S if S < 128 else 128  # small dev shards: one block
         o_t, lse_t = flash_attention_lse(
-            q, kt, vt, causal=(causal and t == 0), block_q=blk,
-            block_k=blk, interpret=interpret)
+            q, kt, vt, causal=(causal and t == 0), interpret=interpret)
         # Fully-masked-row sentinel (+BIG) means "no keys": merge as -inf.
         lse_t = jnp.where(lse_t >= LSE_MASKED * 0.5, NEG_INF, lse_t)
         if causal and t > 0:
