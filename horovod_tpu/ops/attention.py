@@ -117,11 +117,14 @@ def _auto_block(seq_len: int) -> int:
     """Largest MXU-friendly block that divides the sequence. Bigger blocks
     amortize grid/revisit overhead (measured on v5e at BERT-Large shapes:
     512-blocks are ~33% faster than 128-blocks fwd+bwd); 512x512 f32
-    scores (1 MB) sit comfortably in VMEM."""
+    scores (1 MB) sit comfortably in VMEM. Short sequences (< 128, the
+    dev/interpret regime) run as one block; longer non-multiple-of-128
+    sequences fall back to 128 so the divisibility check still raises
+    with its pad-upstream guidance instead of a VMEM blowup."""
     for cand in (512, 256, 128):
         if seq_len % cand == 0:
             return cand
-    return seq_len  # small/odd sequences: a single block
+    return seq_len if seq_len < 128 else 128
 
 
 def _causal_mask(qi, j, block_q, block_k, q_offset, k_offset):
@@ -433,6 +436,28 @@ def _flash_with_lse_bwd(causal, block_q, block_k, q_offset, k_offset,
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
+def _prepare_flash(q, k, causal, block_q, block_k, q_offset, k_offset):
+    """Shared validation + block selection for the flash entry points —
+    one implementation so the guards cannot drift between them."""
+    Sq, Sk = q.shape[2], k.shape[2]
+    block_q = block_q if block_q is not None else _auto_block(Sq)
+    block_k = block_k if block_k is not None else _auto_block(Sk)
+    if Sq % block_q or Sk % block_k:
+        raise ValueError(
+            f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
+            f"({block_q}, {block_k}); pad to a multiple"
+        )
+    if causal and Sq != Sk and q_offset == 0 and k_offset == 0:
+        raise ValueError(
+            f"causal flash attention with Sq={Sq} != Sk={Sk} is ambiguous "
+            "without explicit offsets: pass q_offset/k_offset (e.g. "
+            f"q_offset={Sk - Sq} for bottom-right/decode alignment, or "
+            "q_offset=0, k_offset=0 is top-left — use "
+            "blockwise_attention_reference if that is what you want)"
+        )
+    return block_q, block_k
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "q_offset", "k_offset",
@@ -458,21 +483,8 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q = block_q if block_q is not None else _auto_block(Sq)
-    block_k = block_k if block_k is not None else _auto_block(Sk)
-    if Sq % block_q or Sk % block_k:
-        raise ValueError(
-            f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
-            f"({block_q}, {block_k}); pad to a multiple"
-        )
-    if causal and Sq != Sk and q_offset == 0 and k_offset == 0:
-        raise ValueError(
-            f"causal flash_attention with Sq={Sq} != Sk={Sk} is ambiguous "
-            "without explicit offsets: pass q_offset/k_offset (e.g. "
-            f"q_offset={Sk - Sq} for bottom-right/decode alignment, or "
-            "q_offset=0, k_offset=0 is top-left — use "
-            "blockwise_attention_reference if that is what you want)"
-        )
+    block_q, block_k = _prepare_flash(q, k, causal, block_q, block_k,
+                                      q_offset, k_offset)
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
@@ -495,16 +507,13 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     merge per-shard partial attentions exactly:
     ``out = Σ_t exp(lse_t - lse_total) * out_t``. Fully-masked rows carry
     the ``LSE_MASKED`` sentinel (treat as -inf when merging).
-    Differentiable (the lse output has no defined cotangent)."""
+    Fully differentiable — INCLUDING through lse: its cotangent
+    propagates into the backward kernels (dS += P * g_lse), which is what
+    makes logsumexp-merged schemes like ring-flash train exactly."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q = block_q if block_q is not None else _auto_block(Sq)
-    block_k = block_k if block_k is not None else _auto_block(Sk)
-    if Sq % block_q or Sk % block_k:
-        raise ValueError(
-            f"sequence lengths ({Sq}, {Sk}) must divide block sizes "
-            f"({block_q}, {block_k}); pad to a multiple"
-        )
+    block_q, block_k = _prepare_flash(q, k, causal, block_q, block_k,
+                                      q_offset, k_offset)
     out, lse = _flash_with_lse(
         q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),
         v.reshape(B * H, Sk, D), causal, block_q, block_k, q_offset,
