@@ -51,6 +51,25 @@ WORKER = textwrap.dedent(
         # fp16
         xh = np.full((4,), 0.5, np.float16)
         check(w.allreduce(xh, "ar.f16", op="sum"), 0.5 * size, "fp16 sum")
+        # bf16 (ml_dtypes mapping; host ring reduces via float)
+        import ml_dtypes
+        xb = np.full((6,), 1.5, ml_dtypes.bfloat16)
+        got = np.asarray(w.allreduce(xb, "ar.bf16", op="sum"),
+                         dtype=np.float32)
+        check(got, 1.5 * size, "bf16 sum")
+        # int64: EXACT equality — rtol would swallow exactly the
+        # low-order rank contributions a 2**33-magnitude test exists to
+        # catch (a float32-reducing path loses them).
+        xi64 = np.full((3,), 2**33, np.int64) + rank
+        got64 = np.asarray(w.allreduce(xi64, "ar.i64", op="sum"))
+        want64 = np.full(3, 2**33 * size + R.sum(), np.int64)
+        if not np.array_equal(got64.astype(np.int64), want64):
+            print(f"MISMATCH i64 rank{rank}: {got64} != {want64}", flush=True)
+            sys.exit(10)
+        # uint8 max with rank-DEPENDENT inputs (identical inputs would let
+        # a no-op path pass).
+        xu8 = np.full((4,), 100 + rank, np.uint8)
+        check(w.allreduce(xu8, "ar.u8", op="max"), 100 + size - 1, "u8 max")
         # out-of-order enqueue across ranks: negotiation must line them up
         if rank % 2 == 0:
             h1 = w.allreduce_async_(np.full(3, 1.0, np.float32), "ooo.a", op="sum")
