@@ -95,9 +95,25 @@ def _eager_allreduce_np(x: np.ndarray, name, op) -> np.ndarray:
     return np.asarray(_world().allreduce(x, name=name, op=op))
 
 
+def _in_graph(tensor) -> bool:
+    """True when called under tf.function tracing (symbolic tensor — no
+    .numpy); the collective then runs as a py_function host op."""
+    return tf.is_tensor(tensor) and not hasattr(tensor, "numpy") \
+        and not isinstance(tensor, tf.IndexedSlices)
+
+
 def allreduce(tensor, op: str = Average, name: str | None = None):
     """Reduce a TF tensor across all processes; every process gets the
-    result. Parity: ``hvd.allreduce`` (tensorflow flavor)."""
+    result. Parity: ``hvd.allreduce`` (tensorflow flavor). Works eagerly
+    and under ``tf.function`` (the collective becomes a py_function host
+    op — it is a host-side exchange either way)."""
+    if _in_graph(tensor):
+        out = tf.py_function(
+            lambda t: allreduce(t, op=op, name=name), [tensor],
+            Tout=tensor.dtype,
+        )
+        out.set_shape(tensor.shape)
+        return out
     x = _np(tensor)
     out = _eager_allreduce_np(x, name, op)
     return tf.convert_to_tensor(out)
@@ -124,6 +140,13 @@ def allgather(tensor, name: str | None = None):
 
 def broadcast(tensor, root_rank: int, name: str | None = None):
     """Broadcast ``root_rank``'s tensor to every process."""
+    if _in_graph(tensor):
+        out = tf.py_function(
+            lambda t: broadcast(t, root_rank, name=name), [tensor],
+            Tout=tensor.dtype,
+        )
+        out.set_shape(tensor.shape)
+        return out
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)

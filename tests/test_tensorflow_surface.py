@@ -33,6 +33,16 @@ class TestSingleProcess:
         b = hvd_tf.broadcast(t, root_rank=0)
         np.testing.assert_allclose(b.numpy(), [1.0, 2.0])
 
+    def test_allreduce_under_tf_function(self):
+        hvd_tf.init()
+
+        @tf.function
+        def step(x):
+            return hvd_tf.allreduce(x, op=hvd_tf.Sum)
+
+        out = step(tf.constant([2.0, 4.0]))
+        np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+
     def test_distributed_gradient_tape_passthrough(self):
         v = tf.Variable([2.0, 3.0])
         with tf.GradientTape() as tape:
@@ -101,6 +111,12 @@ class TestMultiProcess:
             # broadcast_variables: everyone gets rank 0's weights.
             hvd.broadcast_variables([v], root_rank=0)
             assert np.allclose(v.numpy(), 1.0), v.numpy()
+            # tf.function (graph) collectives run as py_function host ops.
+            @tf.function
+            def graph_sum(x):
+                return hvd.allreduce(x, op=hvd.Sum, name="graph.ar")
+            gsum = graph_sum(tf.constant([float(r + 1)] * 2))
+            assert np.allclose(gsum.numpy(), 3.0), gsum.numpy()
             # Keras optimizer wrapper trains in lockstep.
             import horovod_tpu.keras as hvdk
             opt = hvdk.DistributedOptimizer(
