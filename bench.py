@@ -305,7 +305,7 @@ def main() -> int:
     # CPU-mesh runs exist to exercise the fusion machinery and produce
     # vs_baseline, not absolute speed — keep the loop short there.
     timing = (
-        dict(warmup=5, iters=20, repeats=3)
+        dict(warmup=5, iters=20, repeats=5)
         if on_tpu
         else dict(warmup=2, iters=5, repeats=2)
     )
