@@ -200,16 +200,56 @@ def _native_world_if_per_process(ps, x):
         return None
     if isinstance(x, jax.Array):
         return None  # stacked-rank compiled path (global device data)
-    if ps.process_set_id != 0:
-        raise ValueError(
-            "per-process eager collectives on a non-global process set are "
-            "not supported by the native runtime yet; use the stacked-rank "
-            "convention (pass a jax.Array) or a traced (shard_map) "
-            "collective"
-        )
     from ..parallel.hierarchical import _default_native_world
 
     return _default_native_world()
+
+
+def _native_set_for(ps, world) -> int:
+    """Map a Python process set to a native-runtime set id.
+
+    Valid when the world runs one device per process (the standard TPU
+    deployment shape), where device rank == process id. Registration
+    happens for ALL known sets in Python-id order: ids are assigned
+    identically on every process (``add_process_set`` /
+    ``remove_process_set`` are collective and SPMD programs touch the
+    native path at the same program point, as in the reference), so the
+    native ids agree without extra coordination — regardless of which set
+    each process happens to touch first.
+    """
+    if ps.process_set_id == 0:
+        return 0
+    if ps.process_set_id < 0:
+        raise ValueError(
+            f"process set {ps.ranks} is not registered (removed, or "
+            "add_process_set was never called)"
+        )
+    cache = getattr(world, "_py_ps_map", None)
+    if cache is None:
+        cache = world._py_ps_map = {}
+    mapped = cache.get(ps.process_set_id)
+    if mapped is not None:
+        return mapped
+    import os
+
+    from .. import basics
+
+    nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+    if basics.size() != nprocs:
+        raise ValueError(
+            "per-process eager collectives on a non-global process set "
+            "need one device per process (device rank == process id); "
+            f"this world has {basics.size()} device ranks across {nprocs} "
+            "processes — use the stacked-rank convention or a traced "
+            "(shard_map) collective"
+        )
+    from ..process_sets import _table
+
+    for psid in sorted(_table):
+        if psid == 0 or psid in cache:
+            continue
+        cache[psid] = world.register_process_set(_table[psid].ranks)
+    return cache[ps.process_set_id]
 
 
 def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
@@ -325,6 +365,7 @@ def allreduce(
         return world.allreduce(
             np.ascontiguousarray(tensor), name=name, op=op,
             prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+            process_set_id=_native_set_for(ps, world),
         )
     del name  # names exist for runtime negotiation; nothing to key here
     traced = functools.partial(
@@ -386,7 +427,8 @@ def grouped_allreduce(
         return world.grouped_allreduce(
             [np.ascontiguousarray(t) for t in tensors], op=op,
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor,
+            process_set_id=_native_set_for(ps, world))
     return [
         allreduce(
             t,
@@ -414,7 +456,8 @@ def allgather(tensor, process_set=None, name: str | None = None):
     if world is not None:
         import numpy as np
 
-        return world.allgather(np.ascontiguousarray(tensor), name=name)
+        return world.allgather(np.ascontiguousarray(tensor), name=name,
+                               process_set_id=_native_set_for(ps, world))
     del name
 
     # Eager stacked form: (n, d0, ...) -> (n, n*d0, ...): every row holds the
@@ -448,9 +491,11 @@ def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None)
     if world is not None:
         import numpy as np
 
-        # Native world ranks are process ids; the global set maps 1:1.
+        # Native world ranks are process ids. The native runtime expects a
+        # WORLD rank for broadcast roots; ps.ranks holds global ranks.
         return world.broadcast(np.ascontiguousarray(tensor),
-                               root_rank=relative_root, name=name)
+                               root_rank=root_rank, name=name,
+                               process_set_id=_native_set_for(ps, world))
     del name
 
     def traced(x):
@@ -479,6 +524,12 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
         return _alltoall_traced(tensor, traced_axis)
     world = _native_world_if_per_process(ps, tensor)
     if world is not None:
+        if ps.process_set_id != 0:
+            raise ValueError(
+                "per-process eager alltoall on a non-global process set is "
+                "not supported by the native data plane; use the traced "
+                "(shard_map) path"
+            )
         import numpy as np
 
         return world.alltoall(np.ascontiguousarray(tensor), name=name)
@@ -512,6 +563,12 @@ def reducescatter(
         )
     world = _native_world_if_per_process(ps, tensor)
     if world is not None:
+        if ps.process_set_id != 0:
+            raise ValueError(
+                "per-process eager reducescatter on a non-global process "
+                "set is not supported by the native data plane; use the "
+                "traced (shard_map) path"
+            )
         if op not in (Sum, Average) or prescale_factor != 1.0 \
                 or postscale_factor != 1.0:
             raise ValueError(

@@ -200,7 +200,7 @@ class NativeWorld:
         # Keep (input, output) arrays alive until their handle completes.
         self._inflight: dict[int, tuple[Any, Any]] = {}
         self._inflight_lock = threading.Lock()
-        self._name_counter = 0
+        self._name_counters: dict[int, int] = {}
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -244,9 +244,14 @@ class NativeWorld:
 
     # -- async API (reference: allreduce_async_ / synchronize / poll) --------
 
-    def _auto_name(self, prefix: str) -> str:
-        self._name_counter += 1
-        return f"{prefix}.{self._name_counter}"
+    def _auto_name(self, prefix: str, process_set_id: int = 0) -> str:
+        # Counters are PER SET: co-members of a set must generate matching
+        # auto-names even when their activity on OTHER sets differs (a
+        # shared counter diverges the moment rank A does an op on a set
+        # rank B is not in).
+        n = self._name_counters.get(process_set_id, 0) + 1
+        self._name_counters[process_set_id] = n
+        return f"{prefix}.{n}"
 
     def _enqueue(self, op: int, x: np.ndarray, out: np.ndarray,
                  name: str | None, reduce_op: str = "sum", root_rank: int = 0,
@@ -255,8 +260,16 @@ class NativeWorld:
         if x.dtype not in _DTYPE_MAP:
             raise TypeError(f"unsupported dtype {x.dtype} for native runtime")
         x = np.ascontiguousarray(x)
+        name = name or self._auto_name("op", process_set_id)
+        if process_set_id:
+            # Names are per-set in the reference (each set has its own
+            # controller); this runtime's single controller keys state by
+            # name, so subset tensors are namespaced — without this, two
+            # disjoint sets auto-naming 'op.1' in the same cycle collide
+            # as a cross-rank signature mismatch.
+            name = f"ps{process_set_id}/{name}"
         args = (
-            (name or self._auto_name("op")).encode(),
+            name.encode(),
             op,
             _REDUCE_MAP[reduce_op],
             _DTYPE_MAP[x.dtype],
@@ -419,7 +432,9 @@ class NativeWorld:
         ``hvd.grouped_allreduce`` backed by ``group_table.cc``'s
         GroupTable — here the registration IS atomic, one C call under one
         queue lock, not same-cycle-arrival luck)."""
-        base = name or self._auto_name("group")
+        base = name or self._auto_name("group", process_set_id)
+        if process_set_id:
+            base = f"ps{process_set_id}/{base}"  # per-set name scope
         xs = [np.ascontiguousarray(t) for t in tensors]
         for x in xs:
             if x.dtype != xs[0].dtype:

@@ -461,3 +461,49 @@ class TestConfigFile:
         cfg.write_text("bogus-flag: 1\n")
         with pytest.raises(SystemExit, match="unknown option"):
             parse_args(["--config-file", str(cfg), "python", "t.py"])
+
+
+class TestPerProcessSubsetCollectives:
+    """Python process sets map onto native-runtime sets in one-device-per-
+    process worlds: subset eager collectives work verbatim across
+    processes (two disjoint sets reducing concurrently)."""
+
+    @pytest.mark.slow
+    def test_e2e_subset_eager(self, tmp_path):
+        script = _worker_script(
+            tmp_path,
+            """
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+            import numpy as np
+            import horovod_tpu as hvd
+
+            hvd.init()
+            pid = hvd.process_rank()
+            assert hvd.size() == 4 and hvd.process_count() == 4
+            evens = hvd.add_process_set([0, 2])
+            odds = hvd.add_process_set([1, 3])
+            mine = evens if pid % 2 == 0 else odds
+            peers = [0, 2] if pid % 2 == 0 else [1, 3]
+            t = np.full(3, float(pid + 1), np.float32)
+            out = hvd.allreduce(t, op=hvd.Sum, process_set=mine,
+                                name=f"sub.{pid % 2}")
+            assert np.allclose(out, sum(p + 1 for p in peers)), out
+            g = hvd.allgather(np.full((1, 2), float(pid), np.float32),
+                              process_set=mine)
+            assert np.asarray(g).shape == (2, 2)
+            assert np.allclose(np.asarray(g)[:, 0], peers), g
+            b = hvd.broadcast(t, root_rank=peers[1], process_set=mine)
+            assert np.allclose(b, peers[1] + 1.0), b
+            print("subset rank%s ok" % pid, flush=True)
+            """,
+        )
+        args = parse_args(["-np", "4", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        for r in range(4):
+            assert any(f"subset rank{r} ok" in l for l in lines), lines
