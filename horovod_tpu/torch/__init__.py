@@ -421,8 +421,10 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     )
 
 
+from .sync_batch_norm import SyncBatchNorm  # noqa: E402
+
 __all__ = [
-    "Average", "Sum", "Min", "Max", "Compression",
+    "Average", "Sum", "Min", "Max", "Compression", "SyncBatchNorm",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size",
     "allreduce", "allreduce_", "allreduce_async_", "synchronize", "poll",

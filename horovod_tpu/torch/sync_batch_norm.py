@@ -1,0 +1,119 @@
+"""Cross-process synchronized BatchNorm for the torch surface.
+
+Parity: ``horovod/torch/sync_batch_norm.py — SyncBatchNorm``. Batch-norm
+statistics are computed over the GLOBAL batch (all processes), not each
+worker's shard — the difference matters at small per-worker batch sizes.
+Forward allreduces count-weighted (sum, sum-of-squares); backward is a
+custom autograd Function that allreduces (sum_dy, sum_dy_xmu) so
+gradients match single-process BN over the concatenated batch exactly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import torch
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import Sum, _world, size
+
+
+def _allreduce_t(t: "torch.Tensor", name: str) -> "torch.Tensor":
+    out = np.asarray(
+        _world().allreduce(t.detach().cpu().numpy().copy(), name=name,
+                           op=Sum)
+    )
+    return torch.from_numpy(out.reshape(tuple(t.shape))).to(t.dtype)
+
+
+class _SyncBatchNormFn(torch.autograd.Function):
+    @staticmethod
+    def forward(ctx, x, weight, bias, running_mean, running_var,
+                momentum, eps, tag):
+        # Stats over (N, spatial): channel dim 1.
+        dims = [0] + list(range(2, x.dim()))
+        count = torch.tensor([x.numel() // x.size(1)], dtype=torch.float32)
+        local_sum = x.sum(dim=dims)
+        local_sqsum = (x * x).sum(dim=dims)
+        if size() > 1:
+            packed = torch.cat([local_sum, local_sqsum, count])
+            packed = _allreduce_t(packed, f"syncbn.fwd.{tag}")
+            c = x.size(1)
+            local_sum, local_sqsum = packed[:c], packed[c:2 * c]
+            count = packed[2 * c:]
+        total = count.item()
+        mean = local_sum / total
+        var = local_sqsum / total - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        if running_mean is not None:
+            with torch.no_grad():
+                unbiased = var * (total / max(1.0, total - 1))
+                running_mean.mul_(1 - momentum).add_(momentum * mean)
+                running_var.mul_(1 - momentum).add_(momentum * unbiased)
+
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.view(shape)) * invstd.view(shape)
+        ctx.save_for_backward(xhat, weight, invstd, count)
+        ctx.tag = tag
+        out = xhat * weight.view(shape) + bias.view(shape)
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        xhat, weight, invstd, count = ctx.saved_tensors
+        dims = [0] + list(range(2, grad_out.dim()))
+        sum_dy = grad_out.sum(dim=dims)
+        sum_dy_xhat = (grad_out * xhat).sum(dim=dims)
+        grad_weight = sum_dy_xhat
+        grad_bias = sum_dy
+        if size() > 1:
+            c = grad_out.size(1)
+            packed = torch.cat([sum_dy, sum_dy_xhat])
+            packed = _allreduce_t(packed, f"syncbn.bwd.{ctx.tag}")
+            sum_dy, sum_dy_xhat = packed[:c], packed[c:]
+        total = count.item()
+        shape = [1, -1] + [1] * (grad_out.dim() - 2)
+        # d/dx of BN over the GLOBAL batch.
+        grad_input = (
+            grad_out
+            - (sum_dy / total).view(shape)
+            - xhat * (sum_dy_xhat / total).view(shape)
+        ) * (invstd * weight).view(shape)
+        return grad_input, grad_weight, grad_bias, None, None, None, None, None
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Drop-in BatchNorm whose training statistics span all processes.
+
+    ``SyncBatchNorm(num_features)`` matches ``nn.BatchNorm1d/2d/3d``
+    construction; eval mode uses running stats locally (no communication).
+    """
+
+    _instance_count = 0
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        SyncBatchNorm._instance_count += 1
+        self._tag = SyncBatchNorm._instance_count
+        self._step = 0
+
+    def _check_input_dim(self, x):
+        if x.dim() < 2:
+            raise ValueError(f"expected at least 2D input, got {x.dim()}D")
+
+    def forward(self, x):
+        self._check_input_dim(x)
+        if not self.training:
+            return super().forward(x)  # eval: running stats, no comm
+        self._step += 1
+        weight = self.weight if self.weight is not None else torch.ones(
+            x.size(1), dtype=x.dtype)
+        bias = self.bias if self.bias is not None else torch.zeros(
+            x.size(1), dtype=x.dtype)
+        return _SyncBatchNormFn.apply(
+            x, weight, bias,
+            self.running_mean if self.track_running_stats else None,
+            self.running_var if self.track_running_stats else None,
+            self.momentum if self.momentum is not None else 0.1,
+            self.eps, f"{self._tag}.{self._step}",
+        )

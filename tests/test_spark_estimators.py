@@ -231,3 +231,32 @@ class TestEstimatorMultiProcess:
         assert len(digests) == 2, lines
         # Averaged gradients -> identical final weights on both ranks.
         assert digests[0] == digests[1], digests
+
+
+class TestTorchEstimatorE2E:
+    def test_fit_transform_pandas(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.torch import TorchEstimator, TorchModel
+
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(
+            torch.nn.Linear(3, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+
+        est = TorchEstimator(
+            str(tmp_path), model,
+            lambda params: torch.optim.Adam(params, lr=0.05),
+            epochs=5, batch_size=16, verbose=0,
+        )
+        fitted = est.fit(df)
+        assert isinstance(fitted, TorchModel)
+        losses = [h["loss"] for h in fitted.history]
+        assert losses[-1] < losses[0]
+        out = fitted.transform(df)
+        preds = np.asarray([p[0] for p in out["prediction"]])
+        mse = float(np.mean((preds - y[:, 0]) ** 2))
+        assert mse < np.var(y), mse
