@@ -1,0 +1,81 @@
+"""Synthetic throughput benchmark on the torch surface — the reference's
+``examples/pytorch/pytorch_synthetic_benchmark.py`` shape: random data,
+timed iterations, per-worker and total img/sec with stddev.
+
+    python examples/torch_synthetic_benchmark.py --model resnet18
+    hvdrun -np 2 --cpu-mode python examples/torch_synthetic_benchmark.py
+"""
+
+import argparse
+import timeit
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def build_model(name: str, num_classes: int = 10):
+    if name == "mlp":
+        return torch.nn.Sequential(
+            torch.nn.Flatten(), torch.nn.Linear(3 * 32 * 32, 256),
+            torch.nn.ReLU(), torch.nn.Linear(256, num_classes))
+    try:
+        import torchvision.models as tvm
+
+        return getattr(tvm, name)(num_classes=num_classes)
+    except (ImportError, AttributeError):
+        raise SystemExit(
+            f"model {name!r} needs torchvision; use --model mlp without it")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="mlp")
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=2)
+    p.add_argument("--num-batches-per-iter", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=3)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = build_model(args.model)
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size()),
+        named_parameters=model.named_parameters(),
+        compression=hvd.Compression.fp16,
+    )
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 32, 32)
+    target = torch.randint(0, 10, (args.batch_size,))
+    loss_fn = torch.nn.CrossEntropyLoss()
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t = timeit.timeit(benchmark_step, number=args.num_batches_per_iter)
+        img_secs.append(args.batch_size * args.num_batches_per_iter / t)
+
+    img_sec_mean = float(np.mean(img_secs))
+    img_sec_conf = 1.96 * float(np.std(img_secs))
+    if hvd.rank() == 0:
+        print(f"Model: {args.model}, batch size {args.batch_size}, "
+              f"{hvd.size()} worker(s)")
+        print(f"Img/sec per worker: {img_sec_mean:.1f} +- {img_sec_conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} worker(s): "
+              f"{img_sec_mean * hvd.size():.1f}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

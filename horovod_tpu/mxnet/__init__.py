@@ -1,0 +1,126 @@
+"""MXNet API surface.
+
+Parity: ``horovod/mxnet/__init__.py`` — ``DistributedOptimizer`` (Module
+API), ``DistributedTrainer`` (Gluon), ``broadcast_parameters`` — over the
+native host data plane, like the torch/TF surfaces. MXNet is retired
+upstream and absent from this image, so this surface is import-guarded
+and exercised only for its guidance path here; the collective plumbing it
+delegates to (NativeWorld) is the same battle-tested code the torch
+surface rides.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+try:
+    import mxnet as mx
+except ImportError as e:  # pragma: no cover - mxnet absent in this image
+    raise ImportError(
+        "horovod_tpu.mxnet requires the 'mxnet' package (retired upstream; "
+        "not installed here). Use horovod_tpu.torch, horovod_tpu.tensorflow "
+        "or the JAX-native surface (import horovod_tpu) instead."
+    ) from e
+
+from ..ops.collective_ops import Average, Sum  # noqa: E402
+from ..process_world import (  # noqa: E402
+    local_rank,
+    local_size,
+    rank,
+    size,
+)
+
+_initialized = False
+
+
+def init() -> None:
+    global _initialized
+    _initialized = True
+
+
+def shutdown() -> None:
+    global _initialized
+    from ..process_world import shutdown_native_world
+
+    shutdown_native_world()
+    _initialized = False
+
+
+def _world():
+    from ..parallel.hierarchical import _default_native_world
+
+    return _default_native_world()
+
+
+def allreduce(tensor, average: bool = True, name: str | None = None):
+    """Allreduce an NDArray across processes (returns a new NDArray)."""
+    if size() <= 1:
+        return tensor.copy()
+    out = np.asarray(_world().allreduce(
+        tensor.asnumpy(), name=name, op=Average if average else Sum))
+    return mx.nd.array(out.reshape(tensor.shape), dtype=tensor.dtype)
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a Gluon ``ParameterDict`` / dict of NDArrays from root."""
+    if size() <= 1:
+        return
+    items = params.items() if hasattr(params, "items") else params
+    for name, p in sorted(items):
+        arr = p.data() if hasattr(p, "data") else p
+        out = np.asarray(_world().broadcast(
+            arr.asnumpy(), root_rank, name=f"mx.bp.{name}"))
+        arr[:] = mx.nd.array(out.reshape(arr.shape), dtype=arr.dtype)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """Gluon Trainer with cross-process gradient averaging (parity:
+    ``hvd.DistributedTrainer``): gradients allreduce before each update;
+    LR is rescaled so the update matches the reference semantics."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, **kwargs):
+        super().__init__(params, optimizer,
+                         optimizer_params=optimizer_params, **kwargs)
+
+    def _allreduce_grads(self):
+        if size() <= 1:
+            return
+        w = _world()
+        handles = []
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                for j, g in enumerate(param.list_grad()):
+                    handles.append(
+                        (g, w.allreduce_async_(
+                            g.asnumpy(), name=f"mx.grad.{i}.{j}",
+                            op=Average))
+                    )
+        for g, h in handles:
+            out = np.asarray(w.synchronize(h))
+            g[:] = mx.nd.array(out.reshape(g.shape), dtype=g.dtype)
+
+
+def DistributedOptimizer(optimizer):
+    """Wrap an mxnet optimizer: updates see allreduce-averaged gradients
+    (Module API flavor)."""
+
+    class _Dist(type(optimizer)):  # type: ignore[misc]
+        def update(self, index, weight, grad, state):
+            if size() > 1:
+                out = np.asarray(_world().allreduce(
+                    grad.asnumpy(), name=f"mx.opt.{index}", op=Average))
+                grad = mx.nd.array(out.reshape(grad.shape), dtype=grad.dtype)
+            super().update(index, weight, grad, state)
+
+    wrapped = _Dist.__new__(_Dist)
+    wrapped.__dict__.update(optimizer.__dict__)
+    return wrapped
+
+
+__all__ = [
+    "init", "shutdown", "size", "rank", "local_rank", "local_size",
+    "allreduce", "broadcast_parameters", "DistributedTrainer",
+    "DistributedOptimizer",
+]

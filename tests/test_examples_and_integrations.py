@@ -58,6 +58,16 @@ class TestIntegrations:
         with pytest.raises(ImportError, match="hvdrun"):
             RayExecutor(num_workers=2)
 
+    def test_mxnet_requires_mxnet(self):
+        try:
+            import mxnet  # noqa: F401
+
+            pytest.skip("mxnet installed; guidance path not reachable")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="horovod_tpu.torch"):
+            import horovod_tpu.mxnet  # noqa: F401
+
     def test_spark_requires_pyspark(self):
         try:
             import pyspark  # noqa: F401
@@ -148,6 +158,13 @@ class TestFrameworkExamples:
         r = self._hvdrun("torch_mnist.py", "--steps-per-epoch", "3")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "done" in r.stdout
+
+    def test_torch_synthetic_benchmark_two_procs(self):
+        pytest.importorskip("torch")
+        r = self._hvdrun("torch_synthetic_benchmark.py",
+                         "--num-iters", "2", "--batch-size", "8")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "Total img/sec on 2 worker(s)" in r.stdout
 
     def test_tf2_mnist_two_procs(self):
         pytest.importorskip("tensorflow")
