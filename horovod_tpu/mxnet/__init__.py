@@ -77,12 +77,9 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
 
 class DistributedTrainer(mx.gluon.Trainer):
     """Gluon Trainer with cross-process gradient averaging (parity:
-    ``hvd.DistributedTrainer``): gradients allreduce before each update;
-    LR is rescaled so the update matches the reference semantics."""
-
-    def __init__(self, params, optimizer, optimizer_params=None, **kwargs):
-        super().__init__(params, optimizer,
-                         optimizer_params=optimizer_params, **kwargs)
+    ``hvd.DistributedTrainer``): gradients are allreduce-AVERAGED before
+    each update (op=Average plays the role of the reference's
+    grad-rescale + Sum)."""
 
     def _allreduce_grads(self):
         if size() <= 1:
@@ -106,13 +103,22 @@ def DistributedOptimizer(optimizer):
     """Wrap an mxnet optimizer: updates see allreduce-averaged gradients
     (Module API flavor)."""
 
+    def _reduced(index, grad):
+        if size() <= 1:
+            return grad
+        out = np.asarray(_world().allreduce(
+            grad.asnumpy(), name=f"mx.opt.{index}", op=Average))
+        return mx.nd.array(out.reshape(grad.shape), dtype=grad.dtype)
+
     class _Dist(type(optimizer)):  # type: ignore[misc]
         def update(self, index, weight, grad, state):
-            if size() > 1:
-                out = np.asarray(_world().allreduce(
-                    grad.asnumpy(), name=f"mx.opt.{index}", op=Average))
-                grad = mx.nd.array(out.reshape(grad.shape), dtype=grad.dtype)
-            super().update(index, weight, grad, state)
+            super().update(index, weight, _reduced(index, grad), state)
+
+        # fp16 training dispatches here WITHOUT calling update(); both
+        # entry points must reduce (the reference wraps both).
+        def update_multi_precision(self, index, weight, grad, state):
+            super().update_multi_precision(
+                index, weight, _reduced(index, grad), state)
 
     wrapped = _Dist.__new__(_Dist)
     wrapped.__dict__.update(optimizer.__dict__)

@@ -75,6 +75,27 @@ def read_shard(path: str, store: Store, shard: int, num_shards: int,
     }
 
 
+def train_val_split(data: dict, validation, seed: int):
+    """Apply EstimatorParams.validation: a float in (0,1) splits rows off
+    for validation (deterministic shuffle by seed); a string names a
+    0/1 column whose truthy rows are validation; None -> no split."""
+    cols = list(data)
+    n = len(data[cols[0]])
+    if validation is None:
+        return data, None
+    if isinstance(validation, str):
+        mask = np.asarray(data[validation]).astype(bool)
+        train = {c: data[c][~mask] for c in cols if c != validation}
+        val = {c: data[c][mask] for c in cols if c != validation}
+        return train, val
+    idx = np.arange(n)
+    np.random.RandomState(seed).shuffle(idx)
+    n_val = max(1, int(n * float(validation)))
+    val_idx, train_idx = idx[:n_val], idx[n_val:]
+    return ({c: data[c][train_idx] for c in cols},
+            {c: data[c][val_idx] for c in cols})
+
+
 def batches(data: dict, batch_size: int, shuffle: bool, seed: int,
             drop_last: bool = True):
     """Minibatch iterator over a column dict (epoch order reshuffled by

@@ -15,7 +15,12 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
-from ..common.estimator import Estimator, Model, batches
+from ..common.estimator import (
+    Estimator,
+    Model,
+    batches,
+    train_val_split,
+)
 from ..common.params import EstimatorParams
 
 
@@ -52,6 +57,9 @@ def _train_worker(model, optimizer, loss_fn, data, p: EstimatorParams,
     label_col = p.label_cols[0]
     x_all = np.asarray(list(data[feature_col]), np.float32)
     y_all = np.asarray(list(data[label_col]))
+    train, val = train_val_split({"x": x_all, "y": y_all}, p.validation,
+                                 p.seed)
+    x_all, y_all = train["x"], train["y"]
 
     rng = jax.random.PRNGKey(p.seed)
     params = model.init(rng, jnp.asarray(x_all[:1]))["params"]
@@ -102,7 +110,13 @@ def _train_worker(model, optimizer, loss_fn, data, p: EstimatorParams,
             params, opt_state = apply_step(params, opt_state, grads)
             losses.append(float(loss))
         epoch_loss = float(np.mean(losses)) if losses else float("nan")
-        history.append({"epoch": epoch, "loss": epoch_loss})
+        entry = {"epoch": epoch, "loss": epoch_loss}
+        if val is not None:
+            vloss = loss_fn(
+                model.apply({"params": params}, jnp.asarray(val["x"])),
+                jnp.asarray(val["y"]))
+            entry["val_loss"] = float(vloss)
+        history.append(entry)
         if shard == 0:
             for cb in p.callbacks:
                 cb(epoch, history[-1])

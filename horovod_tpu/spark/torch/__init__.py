@@ -14,7 +14,12 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ..common.estimator import Estimator, Model, batches
+from ..common.estimator import (
+    Estimator,
+    Model,
+    batches,
+    train_val_split,
+)
 from ..common.params import EstimatorParams
 
 
@@ -69,6 +74,9 @@ class TorchEstimator(Estimator):
 
             x_all = np.asarray(list(data[p.feature_cols[0]]), np.float32)
             y_all = np.asarray(list(data[p.label_cols[0]]))
+            train, val = train_val_split({"x": x_all, "y": y_all},
+                                         p.validation, p.seed)
+            x_all, y_all = train["x"], train["y"]
             y_dtype = (torch.long if np.issubdtype(y_all.dtype, np.integer)
                        else torch.float32)
             history = []
@@ -85,7 +93,15 @@ class TorchEstimator(Estimator):
                     opt.step()
                     losses.append(float(out.detach()))
                 epoch_loss = float(np.mean(losses)) if losses else float("nan")
-                history.append({"epoch": epoch, "loss": epoch_loss})
+                entry = {"epoch": epoch, "loss": epoch_loss}
+                if val is not None:
+                    net.eval()
+                    with torch.no_grad():
+                        vout = loss(
+                            net(torch.from_numpy(val["x"])),
+                            torch.as_tensor(val["y"], dtype=y_dtype))
+                    entry["val_loss"] = float(vout)
+                history.append(entry)
                 if shard == 0:
                     for cb in p.callbacks:
                         cb(epoch, history[-1])

@@ -29,6 +29,8 @@ class _SyncBatchNormFn(torch.autograd.Function):
     @staticmethod
     def forward(ctx, x, weight, bias, running_mean, running_var,
                 momentum, eps, tag):
+        # momentum is the resolved EMA factor (the module handles
+        # momentum=None cumulative averaging before calling in).
         # Stats over (N, spatial): channel dim 1.
         dims = [0] + list(range(2, x.dim()))
         count = torch.tensor([x.numel() // x.size(1)], dtype=torch.float32)
@@ -105,7 +107,20 @@ class SyncBatchNorm(_BatchNorm):
         self._check_input_dim(x)
         if not self.training:
             return super().forward(x)  # eval: running stats, no comm
-        self._step += 1
+        # Torch BN semantics: momentum=None means CUMULATIVE moving
+        # average, factor 1/num_batches_tracked.
+        if self.track_running_stats and self.num_batches_tracked is not None:
+            self.num_batches_tracked.add_(1)
+        if self.momentum is None:
+            momentum = 1.0 / float(self.num_batches_tracked.item()) \
+                if self.track_running_stats else 0.0
+        else:
+            momentum = self.momentum
+        # Stable collective names: the step counter cycles 1-2 only to
+        # disambiguate forward-vs-pending reuse within one iteration —
+        # unbounded unique names would permanently fill the native
+        # response cache (no eviction) and kill its fast path.
+        self._step = (self._step % 2) + 1
         weight = self.weight if self.weight is not None else torch.ones(
             x.size(1), dtype=x.dtype)
         bias = self.bias if self.bias is not None else torch.zeros(
@@ -114,6 +129,5 @@ class SyncBatchNorm(_BatchNorm):
             x, weight, bias,
             self.running_mean if self.track_running_stats else None,
             self.running_var if self.track_running_stats else None,
-            self.momentum if self.momentum is not None else 0.1,
-            self.eps, f"{self._tag}.{self._step}",
+            momentum, self.eps, f"{self._tag}.{self._step}",
         )

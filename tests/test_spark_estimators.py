@@ -260,3 +260,38 @@ class TestTorchEstimatorE2E:
         preds = np.asarray([p[0] for p in out["prediction"]])
         mse = float(np.mean((preds - y[:, 0]) ** 2))
         assert mse < np.var(y), mse
+
+
+class TestValidation:
+    def test_fraction_split(self):
+        from horovod_tpu.spark.common.estimator import train_val_split
+
+        data = {"x": np.arange(20), "y": np.arange(20) * 2}
+        train, val = train_val_split(data, 0.25, seed=0)
+        assert len(val["x"]) == 5 and len(train["x"]) == 15
+        assert not set(train["x"]) & set(val["x"])
+        none_train, none_val = train_val_split(data, None, seed=0)
+        assert none_val is None and len(none_train["x"]) == 20
+
+    def test_column_split(self):
+        from horovod_tpu.spark.common.estimator import train_val_split
+
+        data = {"x": np.arange(10), "is_val": np.array([0, 1] * 5)}
+        train, val = train_val_split(data, "is_val", seed=0)
+        assert list(val["x"]) == [1, 3, 5, 7, 9]
+        assert "is_val" not in train
+
+    def test_jax_estimator_val_loss_in_history(self, hvd, tmp_path):
+        import flax.linen as nn
+        import optax
+
+        from horovod_tpu.spark.jax import JaxEstimator
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 3).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int64)
+        df = pd.DataFrame({"features": list(x), "label": y})
+        est = JaxEstimator(str(tmp_path), nn.Dense(2), optax.adam(1e-2),
+                           epochs=2, batch_size=8, validation=0.2, verbose=0)
+        model = est.fit(df)
+        assert all("val_loss" in h for h in model.history), model.history
