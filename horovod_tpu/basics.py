@@ -86,6 +86,20 @@ def _maybe_init_distributed() -> None:
     coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
     nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)
     proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "-1") or -1)
+    if (os.environ.get("HOROVOD_ELASTIC", "") == "1"
+            and os.environ.get("HOROVOD_ELASTIC_JAX_DISTRIBUTED", "") != "1"):
+        # Elastic default: NO jax.distributed. Its coordination client
+        # FATALLY ABORTS the surviving processes when a peer dies (C++
+        # terminate, uncatchable) — the exact event elastic exists to
+        # survive. Cross-process collectives ride the native host plane,
+        # which re-forms in-process (tested); each process keeps a local
+        # jax device world. Opt back in with
+        # HOROVOD_ELASTIC_JAX_DISTRIBUTED=1 if you accept that any peer
+        # death restarts every worker (the driver relaunches them).
+        get_logger().info(
+            "elastic: skipping jax.distributed (in-process recovery); set "
+            "HOROVOD_ELASTIC_JAX_DISTRIBUTED=1 for a global jax world")
+        return
     if coord and nprocs > 1 and proc_id >= 0:
         coord = _exchange_coordinator_port(coord, proc_id)
         # Write the resolved address back so downstream consumers (e.g. the

@@ -334,14 +334,25 @@ class _DistributedOptimizer:
         self._opt.add_param_group(group)
         self._register_hooks()  # new params need allreduce hooks too
 
+    def _hvd_reset(self) -> None:
+        """Drop in-flight collective state after a failure (elastic
+        restore): handles from a dead world are unsynchronizable, and a
+        backward that died mid-flight leaves entries that would trip the
+        double-backward guard on the retry."""
+        self._handles.clear()
+        self._acc.clear()
+        self._pass_count = 0
+
     def _param_name(self, p) -> str:
         if p not in self._names:
             self._names[p] = f"param.{len(self._names)}"
         return self._names[p]
 
     def _register_hooks(self):
-        if size() <= 1:
-            return
+        # Hooks register UNCONDITIONALLY (even at size()==1): elastic
+        # worlds grow, and an optimizer constructed in a 1-process world
+        # would otherwise never allreduce after new peers join. The hook
+        # itself no-ops while the world has one process.
         for group in self._opt.param_groups:
             for p in group["params"]:
                 if not p.requires_grad or id(p) in self._hooked:
@@ -359,6 +370,12 @@ class _DistributedOptimizer:
         return hook
 
     def _enqueue(self, p):
+        if size() <= 1:
+            # World shrank to one process (elastic): hooks stay registered
+            # but there is nothing to reduce — and step()'s synchronize
+            # block is skipped, so an enqueue here would leak a handle
+            # that trips the double-backward guard next pass.
+            return
         if p in self._handles:
             raise RuntimeError(
                 f"gradient for parameter '{self._param_name(p)}' was "
@@ -381,6 +398,10 @@ class _DistributedOptimizer:
         self._handles[p] = (h, ctx, wire.dtype)
 
     def step(self, closure=None):
+        if size() <= 1 and (self._handles or self._acc):
+            # State from before an elastic shrink is unsynchronizable
+            # (handles) or belongs to a dead world (accumulators).
+            self._hvd_reset()
         if size() > 1:
             if self._bpps > 1:
                 self._pass_count += 1

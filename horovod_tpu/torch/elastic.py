@@ -59,6 +59,8 @@ class TorchState(ExtrasState):
             self.model.load_state_dict(self._saved_model)
         if self.optimizer is not None and self._saved_opt is not None:
             self.optimizer.load_state_dict(self._saved_opt)
+        if hasattr(self.optimizer, "_hvd_reset"):
+            self.optimizer._hvd_reset()  # drop dead-world in-flight state
         self.restore_extras()
 
     def sync(self) -> None:

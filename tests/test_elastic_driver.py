@@ -201,3 +201,84 @@ class TestElasticDriver:
         settings.elastic_timeout = 1.0
         with pytest.raises(TimeoutError):
             run_elastic(settings, sink=lambda s: None)
+
+
+class TestTorchElasticE2E:
+    """Full-stack elastic recovery on the torch surface: a worker dies
+    mid-training; the survivor takes a HorovodInternalError in its next
+    collective, restores the last TorchState commit, re-forms the world
+    (new epoch, new native port from the KV), and finishes alone."""
+
+    @pytest.mark.slow
+    def test_worker_death_recovery_torch_state(self, tmp_path):
+        worker = tmp_path / "torch_elastic_worker.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO_ROOT!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+            import numpy as np
+            import torch
+            import horovod_tpu as hvd_core
+            import horovod_tpu.torch as hvd
+            from horovod_tpu.elastic import run as elastic_run
+            from horovod_tpu.torch.elastic import TorchState
+
+            host = os.environ["HOROVOD_HOSTNAME"]
+            tmp = os.environ["TEST_TMP"]
+
+            torch.manual_seed(0)
+            model = torch.nn.Linear(4, 1)
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.05),
+                named_parameters=model.named_parameters())
+            state = TorchState(model=model, optimizer=opt, epoch=0)
+
+            @elastic_run
+            def train(state):
+                while state.epoch < 5:
+                    if (host == "localhost" and state.epoch == 2
+                            and not os.path.exists(tmp + "/died")):
+                        open(tmp + "/died", "w").close()
+                        print("worker %s dying at epoch %d" % (
+                            host, state.epoch), flush=True)
+                        os._exit(1)
+                    x = torch.from_numpy(np.random.RandomState(
+                        state.epoch).randn(8, 4).astype(np.float32))
+                    opt.zero_grad()
+                    loss = (model(x) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    state.epoch += 1
+                    state.commit()
+                    print("host=%s epoch=%d np=%d loss=%.4f" % (
+                        host, state.epoch, hvd.size(), float(loss)),
+                        flush=True)
+                return state.epoch
+
+            done = train(state)
+            print("host=%s finished at epoch %d" % (host, done), flush=True)
+        """))
+        script, _ = _write_discovery(tmp_path, LOCAL_ALIASES)
+        settings = Settings(
+            num_proc=2,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=True,
+            elastic=True,
+            min_np=1,
+            max_np=2,
+            discovery_script=script,
+            elastic_timeout=30.0,
+            env={"TEST_TMP": str(tmp_path)},
+        )
+        lines: list[str] = []
+        rc = run_elastic(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("dying at epoch 2" in l for l in lines), lines
+        assert any("finished at epoch 5" in l for l in lines), lines
+        # The survivor ran some epochs in a 2-process world, then alone.
+        assert any("np=2" in l for l in lines), lines
+        assert any("host=127.0.0.1 epoch=5 np=1" in l for l in lines), lines
