@@ -141,3 +141,115 @@ def replicate(tree, mesh=None):
         return jax.device_put(leaf, sharding)
 
     return jax.tree.map(_copy_put, tree)
+
+
+def make_elastic_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh=None,
+    axis_name: str | None = None,
+):
+    """Build a train step for ELASTIC multi-process worlds.
+
+    Elastic workers run without ``jax.distributed`` (its coordination
+    client aborts survivors on peer death — see docs/elastic.md), so the
+    world is two-level: each process's LOCAL devices form a compiled DP
+    mesh, and gradients cross processes on the native host data plane
+    (which re-forms in-process after failures). This factory compiles the
+    local leg (shard_map + local pmean) and performs the cross leg with a
+    fused host allreduce each step — the two-level composition of
+    ``host_hierarchical_allreduce`` specialized for training.
+
+    Returns ``step(params, opt_state, batch) -> (params, opt_state,
+    loss)`` where ``batch`` is this PROCESS's shard (leading dim divisible
+    by the local device count). The world size may change between calls
+    (the native world re-forms lazily); gradients always average over the
+    processes currently in the world.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    from .. import basics
+
+    mesh = mesh or basics.global_mesh()
+    axis = axis_name or basics.global_axis_name()
+
+    def local_grads(params, batch):
+        def loss_of(p):
+            return loss_fn(p, batch)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        # Local-device mean: the ICI-compiled leg.
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, axis), grads)
+        return jax.lax.pmean(loss, axis), grads
+
+    grad_step = jax.jit(
+        jax.shard_map(
+            local_grads,
+            mesh=mesh,
+            in_specs=(P(), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+    @jax.jit
+    def apply_step(params, opt_state, grads):
+        updates, new_opt = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt
+
+    def step(params, opt_state, batch):
+        import os
+
+        loss, grads = grad_step(params, batch)
+        nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+        if nprocs > 1 and jax.process_count() == 1:
+            # Cross-process leg: fused host allreduce through the native
+            # runtime (negotiation + response cache + ring). Failures
+            # surface as HorovodInternalError for the elastic retry loop.
+            # Skipped when jax.distributed spans the processes — the
+            # compiled pmean is already global there.
+            #
+            # Weighted by each process's LOCAL device count: unequal hosts
+            # (4-chip next to 8-chip) must not get equal votes — the cross
+            # result is sum(local_mean * n_local) / sum(n_local), the true
+            # mean over every device. The loss rides the same fused
+            # reduction so every process sees the GLOBAL loss (divergent
+            # local losses driving control flow would desynchronize the
+            # next collective). Accumulation dtype per leaf: f64 stays
+            # f64; f32/bf16/f16 accumulate in f32 and cast back.
+            from ..ops.collective_ops import Sum, grouped_allreduce
+
+            n_local = float(mesh.size)
+            leaves, treedef = jax.tree.flatten(grads)
+            acc = [np.float64 if np.asarray(l).dtype == np.float64
+                   else np.float32 for l in leaves]
+            f32_idx = [i for i, a in enumerate(acc) if a == np.float32]
+            f64_idx = [i for i, a in enumerate(acc) if a == np.float64]
+            # count + loss join the f32 group.
+            f32_payload = [np.asarray(leaves[i], np.float32) * n_local
+                           for i in f32_idx]
+            f32_payload.append(np.asarray([float(loss)], np.float32)
+                               * n_local)
+            f32_payload.append(np.asarray([n_local], np.float32))
+            red32 = grouped_allreduce(f32_payload, op=Sum)
+            total_n = float(np.asarray(red32[-1])[0])
+            global_loss = float(np.asarray(red32[-2])[0]) / total_n
+            out = list(leaves)
+            for i, r in zip(f32_idx, red32[:-2]):
+                out[i] = jnp.asarray(
+                    np.asarray(r) / total_n).astype(leaves[i].dtype)
+            if f64_idx:
+                red64 = grouped_allreduce(
+                    [np.asarray(leaves[i], np.float64) * n_local
+                     for i in f64_idx], op=Sum)
+                for i, r in zip(f64_idx, red64):
+                    out[i] = jnp.asarray(
+                        np.asarray(r) / total_n).astype(leaves[i].dtype)
+            grads = jax.tree.unflatten(treedef, out)
+            loss = jnp.asarray(global_loss, jnp.float32)
+        params, opt_state = apply_step(params, opt_state, grads)
+        return params, opt_state, loss
+
+    return step

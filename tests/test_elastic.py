@@ -102,3 +102,41 @@ def test_reset_callbacks_fire_on_recovery():
 
     assert train(state) == "done"
     assert resets == [1]
+
+
+class TestElasticTrainStep:
+    def test_single_process_matches_plain_step(self, hvd):
+        """The elastic step's local leg is plain DP: with one process it
+        must match make_train_step numerically."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+
+        from horovod_tpu.parallel import data_parallel as dp
+
+        n = hvd.size()
+        rng = np.random.RandomState(0)
+        w0 = jnp.asarray(rng.randn(3, 2).astype(np.float32))
+        x = rng.randn(2 * n, 3).astype(np.float32)
+        y = rng.randn(2 * n, 2).astype(np.float32)
+
+        def loss_fn(params, batch):
+            bx, by = batch
+            return jnp.mean((bx @ params - by) ** 2)
+
+        opt = optax.sgd(0.1)
+        estep = dp.make_elastic_train_step(loss_fn, opt)
+        batch = dp.shard_batch((x, y))
+        p1, _, l1 = estep(w0, opt.init(w0), batch)
+
+        import horovod_tpu as hvd_mod
+
+        dopt = hvd_mod.DistributedOptimizer(optax.sgd(0.1))
+        tstep = dp.make_train_step(loss_fn, dopt, donate=False)
+        p2, _, l2 = tstep(
+            dp.replicate(w0), dp.replicate(dopt.init(w0)), batch)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                   rtol=1e-5, atol=1e-6)
