@@ -16,6 +16,16 @@ to XLA HLOs over the device mesh (replacing L2b/L1's NCCL/MPI data plane).
 from .version import __version__  # noqa: F401
 
 from .basics import (  # noqa: F401
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
     config,
     cross_rank,
     cross_size,

@@ -343,3 +343,64 @@ def is_homogeneous() -> bool:
     """True if every host has the same number of local ranks."""
     topo = _state.require_init().topology
     return topo.size == topo.local_size * topo.cross_size
+
+
+# -- build/capability introspection (parity: HorovodBasics' *_built/*_enabled
+# surface — scripts use these to pick code paths; each answer names the
+# TPU-native subsystem playing the reference role) -------------------------
+
+
+def mpi_enabled() -> bool:
+    """False: there is no MPI path — the control plane is the rendezvous
+    KV + TCP star (reference's Gloo role); the data plane is XLA/ICI."""
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    """True: the native TCP runtime (libhvdrt) plays Gloo's role — the
+    CPU/host data plane and the elastic substrate."""
+    return True
+
+
+def gloo_built() -> bool:
+    try:
+        from .runtime import load_library
+
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+def nccl_built() -> bool:
+    """True: XLA collectives over ICI play NCCL's role (AllReduce/
+    AllGather/AllToAll/ReduceScatter HLOs compiled into the step)."""
+    return True
+
+
+def ddl_built() -> bool:
+    return False  # removed upstream too
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    """False — and intentionally so: this framework targets TPUs; the
+    accelerator data plane is ICI, not CUDA."""
+    return False
+
+
+def rocm_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """Parity shim: the native runtime's enqueue API is thread-safe (the
+    property this reference check actually gates on)."""
+    return True

@@ -70,3 +70,18 @@ def test_uninitialized_error():
     st = basics._GlobalState()
     with pytest.raises(NotInitializedError):
         st.require_init()
+
+
+class TestBuildIntrospection:
+    """Parity: the reference's *_built/*_enabled checks scripts branch on."""
+
+    def test_capability_answers(self, hvd):
+        assert hvd.mpi_enabled() is False
+        assert hvd.mpi_built() is False
+        assert hvd.gloo_enabled() is True      # native TCP runtime role
+        assert hvd.gloo_built() is True        # libhvdrt loads
+        assert hvd.nccl_built() is True        # XLA/ICI collectives role
+        assert hvd.cuda_built() is False
+        assert hvd.rocm_built() is False
+        assert hvd.ddl_built() is False and hvd.ccl_built() is False
+        assert hvd.mpi_threads_supported() is True
