@@ -17,20 +17,65 @@ int ResponseCache::Lookup(const Request& req) const {
   return it->second;
 }
 
+void ResponseCache::Touch(const Request& req) {
+  auto it = by_name_.find(req.name);
+  if (it != by_name_.end()) last_use_[it->second] = ++clock_;
+}
+
 void ResponseCache::Put(const Request& req) {
-  if (static_cast<int>(entries_.size()) >= capacity_) return;  // cache full
+  if (capacity_ <= 0) return;  // cache disabled (HOROVOD_CACHE_CAPACITY=0)
   auto it = by_name_.find(req.name);
   if (it != by_name_.end()) {
     entries_[it->second] = req;  // re-keyed signature (e.g. re-used name)
+    last_use_[it->second] = ++clock_;
     return;
   }
-  by_name_[req.name] = static_cast<int>(entries_.size());
-  entries_.push_back(req);
+  int id;
+  if (live_count_ >= capacity_) {
+    // Evict the least-recently-mirrored entry. Deterministic across
+    // ranks: recency comes only from the identical broadcast stream.
+    int victim = -1;
+    uint64_t oldest = ~0ull;
+    for (int i = 0; i < static_cast<int>(entries_.size()); ++i) {
+      if (live_[i] && last_use_[i] < oldest) {
+        oldest = last_use_[i];
+        victim = i;
+      }
+    }
+    by_name_.erase(entries_[victim].name);
+    live_[victim] = false;
+    live_count_--;
+    id = victim;
+  } else {
+    // Prefer reusing a freed slot (keeps the bitvector narrow).
+    id = -1;
+    for (int i = 0; i < static_cast<int>(entries_.size()); ++i) {
+      if (!live_[i]) {
+        id = i;
+        break;
+      }
+    }
+    if (id < 0) {
+      id = static_cast<int>(entries_.size());
+      entries_.emplace_back();
+      live_.push_back(false);
+      last_use_.push_back(0);
+    }
+  }
+  entries_[id] = req;
+  live_[id] = true;
+  live_count_++;
+  last_use_[id] = ++clock_;
+  by_name_[req.name] = id;
 }
 
 void ResponseCache::Clear() {
   entries_.clear();
+  live_.clear();
+  last_use_.clear();
   by_name_.clear();
+  clock_ = 0;
+  live_count_ = 0;
 }
 
 // -- StallInspector ----------------------------------------------------------
@@ -83,13 +128,15 @@ Controller::Controller(Transport* transport, const Config& config)
 
 int Controller::RegisterProcessSet(std::vector<int> ranks) {
   std::sort(ranks.begin(), ranks.end());
+  // Dedup BEFORE the identity check, or a duplicate-containing list never
+  // matches its previously-registered deduped twin.
+  ranks.erase(std::unique(ranks.begin(), ranks.end()), ranks.end());
   std::lock_guard<std::mutex> lock(ps_mu_);
   // Identical registration already present -> same id (idempotent, like
   // the reference's add_process_set of an existing set).
   for (size_t i = 0; i < process_sets_.size(); ++i) {
     if (process_sets_[i] == ranks) return static_cast<int>(i) + 1;
   }
-  ranks.erase(std::unique(ranks.begin(), ranks.end()), ranks.end());
   process_sets_.push_back(std::move(ranks));
   return static_cast<int>(process_sets_.size());
 }
@@ -175,7 +222,11 @@ Status Controller::ComputeResponseList(const std::vector<Request>& ready,
       sig.prescale = resp.prescale;
       sig.postscale = resp.postscale;
       sig.process_set_id = resp.process_set_id;
-      if (cache_.Lookup(sig) < 0) cache_.Put(sig);
+      if (cache_.Lookup(sig) < 0) {
+        cache_.Put(sig);  // may evict the LRU entry (rank-identical)
+      } else {
+        cache_.Touch(sig);  // refresh recency on reuse
+      }
     }
   }
   return Status::OK();
@@ -272,6 +323,7 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
           ((any_bits[id / 64] >> (id % 64)) & 1ull))) {
       continue;  // nobody announced this id: not in flight this cycle
     }
+    if (!cache_.Valid(id)) continue;  // evicted slot
     const Request& sig = cache_.Get(id);
     const std::vector<int>& members = members_of(sig.process_set_id);
     int contributors = 0;

@@ -23,6 +23,13 @@ namespace hvdrt {
 
 // Signature -> stable id cache, consistent across ranks because ids are
 // assigned in Response broadcast order (every rank sees the same stream).
+//
+// Eviction (reference: response_cache.cc's LRU): recency is keyed on the
+// MIRROR stream — Put/Touch run while applying the broadcast
+// ResponseList, which is identical on every rank, so evictions pick the
+// same victim everywhere without extra coordination. (Per-rank Lookup
+// must NOT touch recency: announce order differs across ranks.) Evicted
+// id slots are reused by later Puts; live ids never move.
 class ResponseCache {
  public:
   explicit ResponseCache(int capacity) : capacity_(capacity) {}
@@ -31,7 +38,14 @@ class ResponseCache {
   int Lookup(const Request& req) const;
   // Record a negotiated single-tensor response (called on ALL ranks while
   // applying the broadcast ResponseList, keeping id assignment identical).
+  // Evicts the least-recently-mirrored entry when at capacity.
   void Put(const Request& req);
+  // Refresh recency for an existing signature (mirror stream only).
+  void Touch(const Request& req);
+  bool Valid(int cache_id) const {
+    return cache_id >= 0 && cache_id < static_cast<int>(entries_.size()) &&
+           live_[cache_id];
+  }
   const Request& Get(int cache_id) const { return entries_[cache_id]; }
   int size() const { return static_cast<int>(entries_.size()); }
   int64_t hits() const { return hits_; }
@@ -42,7 +56,11 @@ class ResponseCache {
 
  private:
   int capacity_;
-  std::vector<Request> entries_;  // id -> signature
+  std::vector<Request> entries_;  // id -> signature (slots reusable)
+  std::vector<bool> live_;        // id -> occupied?
+  int live_count_ = 0;            // occupied slots (== live_ popcount)
+  std::vector<uint64_t> last_use_; // id -> mirror-stream clock at last use
+  uint64_t clock_ = 0;
   std::unordered_map<std::string, int> by_name_;
   int64_t hits_ = 0, misses_ = 0;
 };

@@ -295,6 +295,29 @@ WORKER = textwrap.dedent(
             sys.exit(20)
         print(f"rank{rank} autotune ok init={init_thr} now={st1}", flush=True)
         w.shutdown()
+    elif mode == "cache_evict":
+        # LRU eviction (reference: response_cache.cc): capacity 3, but 6
+        # distinct hot tensors — the cache must evict deterministically on
+        # every rank (recency keyed on the identical mirror stream) and
+        # every collective must stay numerically right through the churn.
+        for rnd in range(4):
+            for t in range(6):
+                got = w.allreduce(
+                    np.full(4, float(t + 1), np.float32),
+                    f"ev.{t}", op="sum")
+                check(got, (t + 1) * size, f"evict.r{rnd}.t{t}")
+        # A small working set within capacity still gets steady hits.
+        before = w.cache_hits
+        for rnd in range(5):
+            for t in range(2):
+                w.allreduce(np.full(4, 1.0, np.float32),
+                            f"hot.{t}", op="sum")
+        if w.cache_hits - before < 6:
+            print(f"rank{rank} EVICT-HITS {w.cache_hits - before}",
+                  flush=True)
+            sys.exit(21)
+        print(f"rank{rank} cache_evict ok (hits={w.cache_hits})", flush=True)
+        w.shutdown()
     elif mode == "peerdeath":
         if rank == size - 1:
             w.allreduce(np.ones(4, np.float32), "pd.warmup", op="sum")
@@ -415,6 +438,18 @@ class TestNativeRuntime:
         scores = [float(r[2]) for r in rows]
         # Steady state beats the first (tiny-threshold) sample.
         assert max(scores[1:]) > scores[0] * 1.1, scores
+
+    def test_cache_lru_eviction(self, tmp_path):
+        """More distinct tensors than cache capacity: rank-identical LRU
+        eviction keeps negotiation correct through churn, and a working
+        set within capacity still rides the fast path."""
+        results = _run_world(
+            tmp_path, 2, "cache_evict",
+            extra_env={"HOROVOD_CACHE_CAPACITY": "3"},
+        )
+        for r, (rc, out, err) in enumerate(results):
+            assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
+            assert f"rank{r} cache_evict ok" in out
 
     def test_grouped_enqueue_atomicity(self, tmp_path):
         results = _run_world(tmp_path, 2, "group_atomic")
