@@ -456,8 +456,10 @@ def allgather(tensor, process_set=None, name: str | None = None):
     if world is not None:
         import numpy as np
 
-        return world.allgather(np.ascontiguousarray(tensor), name=name,
-                               process_set_id=_native_set_for(ps, world))
+        # allgather_v: ranks may contribute different dim-0 sizes (the
+        # reference's ragged-first-dim contract).
+        return world.allgather_v(np.ascontiguousarray(tensor), name=name,
+                                 process_set_id=_native_set_for(ps, world))
     del name
 
     # Eager stacked form: (n, d0, ...) -> (n, n*d0, ...): every row holds the

@@ -389,6 +389,30 @@ class NativeWorld:
     def allgather(self, x, name=None, **kw) -> np.ndarray:
         return self.synchronize(self.allgather_async(x, name, **kw))
 
+    def allgather_v(self, x, name=None, process_set_id: int = 0) -> np.ndarray:
+        """Ragged allgather: ranks may contribute DIFFERENT dim-0 sizes
+        (the reference's ``hvd.allgather`` contract — trailing dims must
+        still agree). Implemented as a size pre-exchange + pad-to-max
+        gather + compact: two collectives, both through the normal
+        negotiation path.
+        """
+        x = np.ascontiguousarray(x)
+        if x.ndim == 0:
+            x = x[None]
+        base = name or self._auto_name("agv", process_set_id)
+        n = self.process_set_size(process_set_id)
+        sizes = np.asarray(self.allgather(
+            np.asarray([x.shape[0]], np.int64), name=f"{base}.sz",
+            process_set_id=process_set_id)).reshape(n)
+        max_d0 = int(sizes.max())
+        padded = np.zeros((max_d0,) + x.shape[1:], dtype=x.dtype)
+        padded[: x.shape[0]] = x
+        gathered = np.asarray(self.allgather(
+            padded, name=f"{base}.data", process_set_id=process_set_id))
+        gathered = gathered.reshape((n, max_d0) + x.shape[1:])
+        return np.concatenate(
+            [gathered[r, : int(sizes[r])] for r in range(n)], axis=0)
+
     def broadcast(self, x, root_rank: int, name=None, **kw) -> np.ndarray:
         return self.synchronize(self.broadcast_async(x, root_rank, name, **kw))
 

@@ -131,11 +131,13 @@ def grouped_allreduce(tensors: Sequence[Any], op: str = Average,
 
 
 def allgather(tensor, name: str | None = None):
-    """Concatenate each process's tensor along axis 0 on every process."""
+    """Concatenate each process's tensor along axis 0 on every process;
+    per-rank dim-0 sizes may differ (reference contract)."""
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
-    return tf.convert_to_tensor(np.asarray(_world().allgather(x, name=name)))
+    return tf.convert_to_tensor(
+        np.asarray(_world().allgather_v(x, name=name)))
 
 
 def broadcast(tensor, root_rank: int, name: str | None = None):

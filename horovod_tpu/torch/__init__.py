@@ -195,9 +195,11 @@ def grouped_allreduce(tensors: Sequence[Any], name: str | None = None,
 
 
 def allgather(tensor, name: str | None = None):
+    """Concatenate ranks' tensors along dim 0; per-rank dim-0 sizes may
+    DIFFER (reference contract — trailing dims must agree)."""
     if size() <= 1:
         return tensor.clone()
-    out = np.asarray(_world().allgather(_np_of(tensor), name=name))
+    out = np.asarray(_world().allgather_v(_np_of(tensor), name=name))
     return torch.from_numpy(
         out.reshape((-1,) + tuple(tensor.shape[1:]))
     ).to(tensor.dtype)

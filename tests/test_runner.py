@@ -408,10 +408,12 @@ class TestPerProcessEagerIdiom:
             assert np.allclose(out, 3.0), out   # 1 + 2
             avg = hvd.allreduce(t, name="avg")  # default Average
             assert np.allclose(avg, 1.5), avg
-            # allgather concatenates process tensors along dim 0.
-            g = hvd.allgather(np.full((2, 2), float(pid), np.float32))
-            assert g.shape == (4, 2) and np.allclose(g[:2], 0.0) \
-                and np.allclose(g[2:], 1.0), g
+            # allgather concatenates process tensors along dim 0 — with
+            # per-rank DIFFERENT sizes (the reference's ragged contract).
+            rows = 2 + pid  # rank 0: 2 rows, rank 1: 3 rows
+            g = hvd.allgather(np.full((rows, 2), float(pid), np.float32))
+            assert g.shape == (5, 2), g.shape
+            assert np.allclose(g[:2], 0.0) and np.allclose(g[2:], 1.0), g
             # broadcast: process 1's value everywhere.
             b = hvd.broadcast(t, root_rank=1)
             assert np.allclose(b, 2.0), b

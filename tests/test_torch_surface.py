@@ -96,8 +96,9 @@ class TestMultiProcess:
             t = torch.full((3,), float(r + 1))
             out = hvd.allreduce(t, op=hvd.Sum)
             assert np.allclose(out.numpy(), 3.0), out
-            g = hvd.allgather(torch.full((2, 2), float(r)))
-            assert g.shape == (4, 2) and np.allclose(g[2:].numpy(), 1.0)
+            g = hvd.allgather(torch.full((2 + r, 2), float(r)))
+            assert g.shape == (5, 2), g.shape  # ragged: 2 + 3 rows
+            assert np.allclose(g[2:].numpy(), 1.0)
 
             # DistributedOptimizer: hooks fire during backward; both ranks
             # end with identical weights from averaged gradients.
