@@ -138,15 +138,14 @@ def allgather_object(obj: Any, process_set=None, name: str | None = None) -> lis
     metas = np.asarray(
         w.allgather(meta, name=f"agobj.meta.{tag}")
     ).reshape(w.size, 2)
-    max_size = int(metas[:, 0].max())
-    padded = np.zeros(max_size, np.uint8)
-    padded[: payload.size] = payload
-    gathered = np.asarray(
-        w.allgather(padded, name=f"agobj.data.{tag}")
-    ).reshape(w.size, max_size)
+    # Ragged data leg: allgather_v handles the pad/compact protocol.
+    gathered = np.asarray(w.allgather_v(payload, name=f"agobj.data.{tag}"))
     out: list = []
+    offset = 0
     for p in range(w.size):
-        o = pickle.loads(gathered[p, : int(metas[p, 0])].tobytes())
+        sz = int(metas[p, 0])
+        o = pickle.loads(gathered[offset:offset + sz].tobytes())
+        offset += sz
         out.extend(o for _ in range(int(metas[p, 1])))
     return out
 

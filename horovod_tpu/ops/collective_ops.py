@@ -444,9 +444,11 @@ def grouped_allreduce(
 def allgather(tensor, process_set=None, name: str | None = None):
     """Concatenate each rank's tensor along axis 0 on every rank.
 
-    Parity: ``hvd.allgather``. XLA requires equal shapes per rank (static
-    shapes on TPU); the reference's ragged first dimension is handled at the
-    object layer (``allgather_object``) via pad+size-exchange.
+    Parity: ``hvd.allgather``. Ragged first dims (per-rank-different
+    dim-0 sizes — the reference contract) are supported on the
+    per-process native path (``allgather_v``: size exchange + pad +
+    compact). The COMPILED stacked-rank regime requires equal shapes (XLA
+    static shapes); pad upstream there or gather eagerly.
     """
     ps = _resolve_process_set(process_set)
     traced_axis = _effective_traced_axis(ps)

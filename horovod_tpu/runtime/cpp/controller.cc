@@ -31,7 +31,8 @@ void ResponseCache::Put(const Request& req) {
     return;
   }
   int id;
-  if (live_count_ >= capacity_) {
+  // Occupancy == by_name_.size(): every live slot has exactly one name.
+  if (static_cast<int>(by_name_.size()) >= capacity_) {
     // Evict the least-recently-mirrored entry. Deterministic across
     // ranks: recency comes only from the identical broadcast stream.
     int victim = -1;
@@ -44,7 +45,6 @@ void ResponseCache::Put(const Request& req) {
     }
     by_name_.erase(entries_[victim].name);
     live_[victim] = false;
-    live_count_--;
     id = victim;
   } else {
     // Prefer reusing a freed slot (keeps the bitvector narrow).
@@ -64,7 +64,6 @@ void ResponseCache::Put(const Request& req) {
   }
   entries_[id] = req;
   live_[id] = true;
-  live_count_++;
   last_use_[id] = ++clock_;
   by_name_[req.name] = id;
 }
@@ -75,7 +74,6 @@ void ResponseCache::Clear() {
   last_use_.clear();
   by_name_.clear();
   clock_ = 0;
-  live_count_ = 0;
 }
 
 // -- StallInspector ----------------------------------------------------------

@@ -58,7 +58,6 @@ class ResponseCache {
   int capacity_;
   std::vector<Request> entries_;  // id -> signature (slots reusable)
   std::vector<bool> live_;        // id -> occupied?
-  int live_count_ = 0;            // occupied slots (== live_ popcount)
   std::vector<uint64_t> last_use_; // id -> mirror-stream clock at last use
   uint64_t clock_ = 0;
   std::unordered_map<std::string, int> by_name_;
