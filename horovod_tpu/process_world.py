@@ -28,6 +28,20 @@ def local_size() -> int:
     return int(os.environ.get("HOROVOD_LOCAL_SIZE", "1") or 1)
 
 
+def cross_rank() -> int:
+    return int(os.environ.get("HOROVOD_CROSS_RANK", "0") or 0)
+
+
+def cross_size() -> int:
+    return int(os.environ.get("HOROVOD_CROSS_SIZE", "1") or 1)
+
+
+def is_homogeneous() -> bool:
+    """True when every host contributes the same local size (parity:
+    ``hvd.is_homogeneous``)."""
+    return size() == local_size() * cross_size()
+
+
 def shutdown_native_world() -> None:
     """Tear down the cached native host world (if any)."""
     from .parallel import hierarchical

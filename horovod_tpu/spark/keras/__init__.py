@@ -12,7 +12,12 @@ from typing import Any, Callable
 
 import numpy as np
 
-from ..common.estimator import Estimator, Model, batches
+from ..common.estimator import (
+    Estimator,
+    Model,
+    batches,
+    train_val_split,
+)
 from ..common.params import EstimatorParams
 
 
@@ -57,15 +62,18 @@ class KerasEstimator(Estimator):
             model.compile(optimizer=opt, loss=loss)
             x = np.asarray(list(data[p.feature_cols[0]]), np.float32)
             y = np.asarray(list(data[p.label_cols[0]]))
+            train, val = train_val_split({"x": x, "y": y}, p.validation,
+                                         p.seed)
+            x, y = train["x"], train["y"]
             # Build + broadcast initial weights so all workers align.
             model(x[:1])
             if hvdk.size() > 1:
                 hvdk.broadcast_variables(model.weights, root_rank=0)
-            cbs = []
             history = model.fit(
                 x, y, batch_size=p.batch_size, epochs=p.epochs,
                 shuffle=p.shuffle, verbose=p.verbose if shard == 0 else 0,
-                callbacks=cbs,
+                validation_data=((val["x"], val["y"])
+                                 if val is not None else None),
             )
             return {
                 "weights": [np.asarray(w) for w in model.get_weights()],

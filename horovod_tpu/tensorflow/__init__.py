@@ -65,6 +65,9 @@ def is_initialized() -> bool:
 # World facts shared across host-framework surfaces (one process per
 # accelerator host — reference: one rank per accelerator process).
 from ..process_world import (  # noqa: E402
+    cross_rank,
+    cross_size,
+    is_homogeneous,
     local_rank,
     local_size,
     rank,
@@ -228,7 +231,7 @@ class DistributedGradientTape:
 __all__ = [
     "Average", "Sum", "Min", "Max",
     "init", "shutdown", "is_initialized",
-    "size", "rank", "local_rank", "local_size",
+    "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "grouped_allreduce", "allgather", "broadcast", "join",
     "broadcast_variables", "DistributedGradientTape",
 ]

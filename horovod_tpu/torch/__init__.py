@@ -61,6 +61,9 @@ def is_initialized() -> bool:
 
 # World facts shared across host-framework surfaces.
 from ..process_world import (  # noqa: E402
+    cross_rank,
+    cross_size,
+    is_homogeneous,
     local_rank,
     local_size,
     rank,
@@ -449,7 +452,7 @@ from .sync_batch_norm import SyncBatchNorm  # noqa: E402
 __all__ = [
     "Average", "Sum", "Min", "Max", "Compression", "SyncBatchNorm",
     "init", "shutdown", "is_initialized",
-    "size", "rank", "local_rank", "local_size",
+    "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "allreduce_", "allreduce_async_", "synchronize", "poll",
     "grouped_allreduce", "allgather", "broadcast", "broadcast_", "alltoall",
     "reducescatter", "barrier", "join",
