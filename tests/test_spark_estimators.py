@@ -295,3 +295,104 @@ class TestValidation:
                            epochs=2, batch_size=8, validation=0.2, verbose=0)
         model = est.fit(df)
         assert all("val_loss" in h for h in model.history), model.history
+
+
+class TestSparkBranchOfFit:
+    """Execute fit()'s SPARK code path without pyspark: a duck-typed
+    DataFrame (rdd/select/repartition/write.parquet/count) backed by
+    pandas + a stubbed barrier runner that runs each task sequentially
+    with the launcher env — every estimator line of the spark branch runs
+    except pyspark's own scheduler."""
+
+    def _fake_spark_df(self, pdf, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        class _Rdd:
+            def getNumPartitions(self):
+                return 2
+
+        class _Writer:
+            def __init__(self, df):
+                self._df = df
+
+            def mode(self, _):
+                return self
+
+            def parquet(self, path):
+                import os
+
+                os.makedirs(path, exist_ok=True)
+                n = len(self._df._pdf)
+                half = (n + 1) // 2
+                for i, part in enumerate(
+                        (self._df._pdf.iloc[:half], self._df._pdf.iloc[half:])):
+                    pq.write_table(
+                        pa.Table.from_pandas(part, preserve_index=False),
+                        f"{path}/part-{i:05d}.parquet")
+
+        class _FakeDF:
+            def __init__(self, pdf):
+                self._pdf = pdf
+                self.rdd = _Rdd()
+                self.write = _Writer(self)
+
+            def select(self, *cols):
+                return _FakeDF(self._pdf[list(cols)])
+
+            def repartition(self, n):
+                return self
+
+            def count(self):
+                return len(self._pdf)
+
+        return _FakeDF(pdf)
+
+    def test_fit_spark_branch(self, tmp_path, monkeypatch):
+        import flax.linen as nn
+        import optax
+
+        import horovod_tpu.spark as hspark
+        from horovod_tpu.spark.jax import JaxEstimator
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 3).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int64)
+        pdf = pd.DataFrame({"features": list(x), "label": y})
+        df = self._fake_spark_df(pdf, tmp_path)
+
+        # Stubbed barrier substrate: run each "executor task" sequentially
+        # in-process with the per-rank env (single-process native world).
+        def fake_run(fn, args=(), kwargs=None, num_proc=None,
+                     spark_context=None):
+            import os
+
+            results = []
+            for r in range(num_proc):
+                os.environ["HOROVOD_PROCESS_ID"] = str(r)
+                os.environ["HOROVOD_NUM_PROCESSES"] = "1"  # isolated task
+                try:
+                    results.append(fn(*args, **(kwargs or {})))
+                finally:
+                    os.environ.pop("HOROVOD_PROCESS_ID", None)
+                    os.environ.pop("HOROVOD_NUM_PROCESSES", None)
+            return results
+
+        monkeypatch.setattr(hspark, "run", fake_run)
+
+        est = JaxEstimator(
+            str(tmp_path), nn.Dense(2), optax.adam(5e-2),
+            epochs=6, batch_size=8, verbose=0,
+        )
+        model = est.fit(df)
+        assert len(model.history) == 6
+        assert model.history[-1]["loss"] < model.history[0]["loss"]
+        # Both shards were materialized and readable.
+        files = est.store.listdir(est.store.train_data_path(model.run_id))
+        assert len([f for f in files if f.endswith(".parquet")]) == 2
+        # The stubbed tasks are isolated single-process worlds training on
+        # HALF the data each; the assertion targets the code path, not
+        # model quality — clearly better than chance is enough.
+        out = model.transform(pdf)
+        preds = np.asarray([np.argmax(p) for p in out["prediction"]])
+        assert (preds == y).mean() > 0.7
