@@ -1,0 +1,188 @@
+"""LightningEstimator — fit a LightningModule-style model on a DataFrame.
+
+Parity: ``horovod/spark/lightning/TorchEstimator`` (+ ``remote.py``). The
+reference trains a ``pytorch_lightning.LightningModule`` on Spark
+executors by handing pl.Trainer an HorovodStrategy; here the trainer loop
+is ours (the same worker loop as :mod:`horovod_tpu.spark.torch`, driven
+through :mod:`horovod_tpu.torch`'s native-runtime gradient averaging), and
+the model contract is the LightningModule *protocol*, duck-typed:
+
+- ``training_step(batch, batch_idx) -> loss``  (required)
+- ``configure_optimizers() -> optimizer | (opts, scheds) | {"optimizer":
+  ..., "lr_scheduler": ...}``  (required)
+- ``validation_step(batch, batch_idx) -> loss | {"val_loss": ...}``
+  (optional — drives the validation history column)
+- ``forward(x)`` for inference in the returned transformer
+- ``on_train_epoch_end()`` hook (optional)
+
+Because the contract is a protocol, an installed ``pytorch_lightning``
+LightningModule satisfies it unmodified, and environments without
+lightning (like CI here) can train any ``nn.Module`` subclass that
+implements the three methods.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..common.estimator import Estimator
+from ..common.params import EstimatorParams
+from ..torch import TorchModel, _require_torch, run_torch_epochs
+
+
+def _unwrap_scheduler(sched):
+    """A scheduler slot may hold the scheduler itself or Lightning's
+    lr_scheduler config dict ({"scheduler": ..., "interval": ...});
+    returns (scheduler, interval) with interval defaulting to Lightning's
+    default of per-epoch stepping."""
+    if isinstance(sched, dict):
+        interval = sched.get("interval", "epoch")
+        if interval not in ("step", "epoch"):
+            raise ValueError(
+                f"lr_scheduler interval must be 'step' or 'epoch', got "
+                f"{interval!r}"
+            )
+        return sched.get("scheduler"), interval
+    return sched, "epoch"
+
+
+def _split_optimizers(configured):
+    """Normalize configure_optimizers()'s documented return forms to
+    (optimizer, scheduler_or_None, interval): a bare optimizer, a dict
+    ({"optimizer": ..., "lr_scheduler": ...}), a list/tuple of either, or
+    the two-list form ([optimizers], [schedulers]). Multi-optimizer
+    setups (GAN-style lists) take the first of each, matching the
+    reference's single-optimizer Horovod strategy. ``None``/empty (the
+    manual-optimization form) is rejected up front — this trainer loop
+    drives the optimizer itself."""
+    if configured is None or (
+        isinstance(configured, (tuple, list)) and not configured
+    ):
+        raise TypeError(
+            "configure_optimizers() returned nothing — Lightning's "
+            "manual-optimization form is not supported by "
+            "LightningEstimator, which drives the optimizer itself; "
+            "return an optimizer (or dict/two-list form)"
+        )
+    if isinstance(configured, (tuple, list)):
+        first = configured[0]
+        if isinstance(first, (tuple, list)):  # ([opts], [scheds])
+            sched, interval = None, "epoch"
+            if len(configured) > 1 and configured[1]:
+                sched, interval = _unwrap_scheduler(configured[1][0])
+            return first[0], sched, interval
+        # list of optimizers or list of config dicts
+        configured = first
+    if isinstance(configured, dict):
+        if "optimizer" not in configured:
+            raise TypeError(
+                "configure_optimizers() returned a dict without an "
+                f"'optimizer' key (got keys {sorted(configured)}); "
+                "supported forms: optimizer, {'optimizer': ..., "
+                "'lr_scheduler': ...}, or the two-list form"
+            )
+        sched, interval = _unwrap_scheduler(configured.get("lr_scheduler"))
+        return configured["optimizer"], sched, interval
+    return configured, None, "epoch"
+
+
+def _scalar_loss(out):
+    """training_step/validation_step may return a loss tensor or a dict
+    with 'loss'/'val_loss'."""
+    if isinstance(out, dict):
+        for key in ("loss", "val_loss"):
+            if key in out:
+                return out[key]
+        raise ValueError(
+            f"step returned a dict without 'loss'/'val_loss': {list(out)}"
+        )
+    return out
+
+
+class LightningEstimator(Estimator):
+    """Args: ``model`` (LightningModule-protocol nn.Module — deep-copied
+    per worker), plus :class:`EstimatorParams` knobs. The optimizer comes
+    from the model's own ``configure_optimizers`` (the lightning
+    contract), wrapped in :func:`horovod_tpu.torch.DistributedOptimizer`.
+    """
+
+    def __init__(self, store, model, **overrides: Any):
+        _require_torch()
+        super().__init__(store, **overrides)
+        if not callable(getattr(model, "training_step", None)):
+            raise TypeError(
+                "LightningEstimator needs a model with training_step(batch,"
+                " batch_idx); for plain nn.Module + external loss use "
+                "horovod_tpu.spark.torch.TorchEstimator"
+            )
+        if not callable(getattr(model, "configure_optimizers", None)):
+            raise TypeError(
+                "LightningEstimator model must implement "
+                "configure_optimizers()"
+            )
+        self.model = model
+
+    def _worker_fn(self):
+        model = self.model
+
+        def fn(data, p: EstimatorParams, shard: int):
+            import copy
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            net = copy.deepcopy(model)
+            opt, sched, interval = _split_optimizers(
+                net.configure_optimizers()
+            )
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=net.named_parameters()
+            )
+            hvd.broadcast_parameters(net.state_dict(), root_rank=0)
+
+            def val_step(batch):
+                if not callable(getattr(net, "validation_step", None)):
+                    return None
+                # Lightning permits validation_step -> None (the base
+                # class's no-op hook does exactly that): skip the history
+                # column rather than crash mid-fit.
+                vout = net.validation_step(batch, 0)
+                return None if vout is None else _scalar_loss(vout)
+
+            hook = getattr(net, "on_train_epoch_end", None)
+            history = run_torch_epochs(
+                net, opt, data, p, shard,
+                train_step=lambda batch, i: _scalar_loss(
+                    net.training_step(batch, i)
+                ),
+                val_step=val_step,
+                on_epoch_end=hook if callable(hook) else None,
+                sched=sched,
+                sched_interval=interval,
+                tag="lightning-estimator",
+            )
+            return {
+                "state_dict": {
+                    k: v.detach().cpu().numpy()
+                    for k, v in net.state_dict().items()
+                },
+                "history": history,
+            }
+
+        return fn
+
+    def _make_model(self, state, run_id: str) -> "LightningModel":
+        return LightningModel(
+            self.model,
+            state["state_dict"],
+            run_id,
+            self.params,
+            history=state["history"],
+        )
+
+
+class LightningModel(TorchModel):
+    """Transformer returned by :meth:`LightningEstimator.fit` — inference
+    through the module's ``forward``, state handling shared with
+    :class:`horovod_tpu.spark.torch.TorchModel` (parity: TorchModel in
+    ``horovod/spark/lightning``)."""

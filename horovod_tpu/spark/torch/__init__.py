@@ -35,6 +35,72 @@ def _require_torch():
         ) from e
 
 
+def run_torch_epochs(net, opt, data, p: EstimatorParams, shard: int,
+                     train_step, val_step=None, on_epoch_end=None,
+                     sched=None, sched_interval: str = "epoch",
+                     tag: str = "torch-estimator"):
+    """Shared per-worker epoch scaffold for the torch-family estimators
+    (plain torch and lightning): column extraction, train/val split,
+    label-dtype inference, the minibatch loop with optional LR-scheduler
+    stepping (per-``step`` or per-``epoch``), and history/callback/verbose
+    bookkeeping on shard 0.
+
+    ``train_step(batch, batch_idx) -> loss tensor`` runs between
+    ``opt.zero_grad()`` and ``loss.backward(); opt.step()``;
+    ``val_step(batch) -> loss tensor | None`` runs under ``no_grad`` (None
+    skips the history column). Returns the history list.
+    """
+    import torch
+
+    x_all = np.asarray(list(data[p.feature_cols[0]]), np.float32)
+    y_all = np.asarray(list(data[p.label_cols[0]]))
+    train, val = train_val_split({"x": x_all, "y": y_all},
+                                 p.validation, p.seed)
+    x_all, y_all = train["x"], train["y"]
+    y_dtype = (torch.long if np.issubdtype(y_all.dtype, np.integer)
+               else torch.float32)
+
+    def to_batch(cols):
+        return (torch.from_numpy(cols["x"]),
+                torch.as_tensor(cols["y"], dtype=y_dtype))
+
+    history = []
+    for epoch in range(p.epochs):
+        losses = []
+        net.train()
+        for i, cols in enumerate(
+            batches({"x": x_all, "y": y_all}, p.batch_size,
+                    p.shuffle, p.seed + epoch)
+        ):
+            opt.zero_grad()
+            loss = train_step(to_batch(cols), i)
+            loss.backward()
+            opt.step()
+            if sched is not None and sched_interval == "step":
+                sched.step()
+            losses.append(float(loss.detach()))
+        if sched is not None and sched_interval != "step":
+            sched.step()
+        if on_epoch_end is not None:
+            on_epoch_end()
+        epoch_loss = float(np.mean(losses)) if losses else float("nan")
+        entry = {"epoch": epoch, "loss": epoch_loss}
+        if val is not None and val_step is not None:
+            net.eval()
+            with torch.no_grad():
+                vout = val_step(to_batch(val))
+            if vout is not None:
+                entry["val_loss"] = float(vout)
+        history.append(entry)
+        if shard == 0:
+            for cb in p.callbacks:
+                cb(epoch, history[-1])
+            if p.verbose:
+                print(f"[{tag}] epoch {epoch}: loss={epoch_loss:.4f}",
+                      flush=True)
+    return history
+
+
 class TorchEstimator(Estimator):
     """Args: ``model`` (nn.Module — deep-copied per worker),
     ``optimizer_fn`` (params -> torch optimizer), ``loss`` (fn(outputs,
@@ -72,42 +138,12 @@ class TorchEstimator(Estimator):
             )
             hvd.broadcast_parameters(net.state_dict(), root_rank=0)
 
-            x_all = np.asarray(list(data[p.feature_cols[0]]), np.float32)
-            y_all = np.asarray(list(data[p.label_cols[0]]))
-            train, val = train_val_split({"x": x_all, "y": y_all},
-                                         p.validation, p.seed)
-            x_all, y_all = train["x"], train["y"]
-            y_dtype = (torch.long if np.issubdtype(y_all.dtype, np.integer)
-                       else torch.float32)
-            history = []
-            for epoch in range(p.epochs):
-                losses = []
-                net.train()
-                for batch in batches({"x": x_all, "y": y_all}, p.batch_size,
-                                     p.shuffle, p.seed + epoch):
-                    bx = torch.from_numpy(batch["x"])
-                    by = torch.as_tensor(batch["y"], dtype=y_dtype)
-                    opt.zero_grad()
-                    out = loss(net(bx), by)
-                    out.backward()
-                    opt.step()
-                    losses.append(float(out.detach()))
-                epoch_loss = float(np.mean(losses)) if losses else float("nan")
-                entry = {"epoch": epoch, "loss": epoch_loss}
-                if val is not None:
-                    net.eval()
-                    with torch.no_grad():
-                        vout = loss(
-                            net(torch.from_numpy(val["x"])),
-                            torch.as_tensor(val["y"], dtype=y_dtype))
-                    entry["val_loss"] = float(vout)
-                history.append(entry)
-                if shard == 0:
-                    for cb in p.callbacks:
-                        cb(epoch, history[-1])
-                    if p.verbose:
-                        print(f"[torch-estimator] epoch {epoch}: "
-                              f"loss={epoch_loss:.4f}", flush=True)
+            history = run_torch_epochs(
+                net, opt, data, p, shard,
+                train_step=lambda batch, i: loss(net(batch[0]), batch[1]),
+                val_step=lambda batch: loss(net(batch[0]), batch[1]),
+                tag="torch-estimator",
+            )
             return {
                 "state_dict": {
                     k: v.detach().cpu().numpy()

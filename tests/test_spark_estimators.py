@@ -262,6 +262,165 @@ class TestTorchEstimatorE2E:
         assert mse < np.var(y), mse
 
 
+class TestLightningEstimatorE2E:
+    """LightningModule-protocol estimator (parity: horovod/spark/lightning).
+    pytorch_lightning isn't installed here; the protocol is duck-typed, so
+    a plain nn.Module with training_step/configure_optimizers exercises
+    the identical code path a real LightningModule would."""
+
+    def _module(self, torch):
+        class LitRegressor(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.net = torch.nn.Sequential(
+                    torch.nn.Linear(3, 8), torch.nn.ReLU(),
+                    torch.nn.Linear(8, 1))
+                self.epoch_end_calls = 0
+
+            def forward(self, x):
+                return self.net(x)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                return torch.nn.functional.mse_loss(self(x), y)
+
+            def validation_step(self, batch, batch_idx):
+                x, y = batch
+                return {"val_loss":
+                        torch.nn.functional.mse_loss(self(x), y)}
+
+            def configure_optimizers(self):
+                return {"optimizer":
+                        torch.optim.Adam(self.parameters(), lr=0.05)}
+
+            def on_train_epoch_end(self):
+                self.epoch_end_calls += 1
+
+        return LitRegressor()
+
+    def test_fit_transform_pandas(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import (
+            LightningEstimator,
+            LightningModel,
+        )
+
+        torch.manual_seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.randn(128, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+
+        est = LightningEstimator(
+            str(tmp_path), self._module(torch),
+            epochs=5, batch_size=16, validation=0.2, verbose=0,
+        )
+        fitted = est.fit(df)
+        assert isinstance(fitted, LightningModel)
+        losses = [h["loss"] for h in fitted.history]
+        assert losses[-1] < losses[0]
+        assert all("val_loss" in h for h in fitted.history)
+        out = fitted.transform(df)
+        preds = np.asarray([p[0] for p in out["prediction"]])
+        mse = float(np.mean((preds - y[:, 0]) ** 2))
+        assert mse < np.var(y), mse
+
+    def test_protocol_enforced(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        with pytest.raises(TypeError, match="training_step"):
+            LightningEstimator(str(tmp_path), torch.nn.Linear(3, 1))
+
+    def test_configure_optimizers_forms(self):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import _split_optimizers
+
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=0.1)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1)
+        assert _split_optimizers(opt) == (opt, None, "epoch")
+        assert _split_optimizers(([opt], [sched])) == (opt, sched, "epoch")
+        assert _split_optimizers(
+            {"optimizer": opt, "lr_scheduler": {"scheduler": sched}}
+        ) == (opt, sched, "epoch")
+        # two-list form with a scheduler CONFIG dict (Lightning docs);
+        # interval='step' must survive the unwrap
+        assert _split_optimizers(
+            ([opt], [{"scheduler": sched, "interval": "step"}])
+        ) == (opt, sched, "step")
+        # list-of-config-dicts form
+        assert _split_optimizers(
+            [{"optimizer": opt,
+              "lr_scheduler": {"scheduler": sched, "interval": "step"}}]
+        ) == (opt, sched, "step")
+        # bare list of optimizers
+        assert _split_optimizers([opt]) == (opt, None, "epoch")
+        # manual-optimization forms are rejected with a clear error
+        for bad in (None, [], ()):
+            with pytest.raises(TypeError, match="manual-optimization"):
+                _split_optimizers(bad)
+
+    def test_step_interval_scheduler_steps_per_batch(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        lr_seen = []
+
+        class LitStepSched(torch.nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.lin = torch.nn.Linear(3, 1)
+
+            def forward(self, x):
+                return self.lin(x)
+
+            def training_step(self, batch, batch_idx):
+                x, y = batch
+                lr_seen.append(self.opt.param_groups[0]["lr"])
+                return torch.nn.functional.mse_loss(self(x), y)
+
+            def configure_optimizers(self):
+                self.opt = torch.optim.SGD(self.parameters(), lr=1.0)
+                sched = torch.optim.lr_scheduler.StepLR(
+                    self.opt, step_size=1, gamma=0.5)
+                return ([self.opt],
+                        [{"scheduler": sched, "interval": "step"}])
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+        LightningEstimator(
+            str(tmp_path), LitStepSched(), epochs=1, batch_size=16,
+            verbose=0,
+        ).fit(df)
+        # 64 rows / batch 16 = 4 steps; LR halves after every BATCH, so
+        # the training_step sees 1.0, 0.5, 0.25, 0.125 — not a constant.
+        assert lr_seen == [1.0, 0.5, 0.25, 0.125], lr_seen
+
+    def test_validation_step_returning_none_skips_column(self, tmp_path):
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        mod = self._module(torch)
+        mod.validation_step = lambda batch, batch_idx: None
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+        fitted = LightningEstimator(
+            str(tmp_path), mod, epochs=2, batch_size=16,
+            validation=0.25, verbose=0,
+        ).fit(df)
+        assert all("val_loss" not in h for h in fitted.history)
+
+
 class TestValidation:
     def test_fraction_split(self):
         from horovod_tpu.spark.common.estimator import train_val_split
