@@ -44,6 +44,7 @@ from .hosts import (
     parse_hosts,
 )
 from .http.kv_server import RendezvousServer
+from .schedulers import detect_scheduler_hosts
 
 
 @dataclasses.dataclass
@@ -214,6 +215,16 @@ def settings_from_args(args: argparse.Namespace) -> Settings:
             hosts = parse_hosts(args.hosts)
         elif args.hostfile:
             hosts = parse_hostfile(args.hostfile)
+        elif (
+            not args.cpu_mode
+            and (scheduler_hosts := detect_scheduler_hosts()) is not None
+        ):
+            # Inside an LSF/Slurm allocation with no -H/--hostfile: use the
+            # allocation's hosts (parity: horovod/runner/util/lsf.py
+            # auto-detection). Detection only runs when no explicit hosts
+            # were given (explicit flags must win even over a malformed
+            # allocation env), and --cpu-mode keeps its local fan-out.
+            hosts = scheduler_hosts
         else:
             n = args.num_proc or 1
             hosts = [HostInfo("localhost", 1)]

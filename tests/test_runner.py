@@ -123,6 +123,81 @@ class TestArgs:
         assert s.command == [sys.executable, "train.py", "--epochs", "3"]
 
 
+class TestSchedulerDetection:
+    """LSF/Slurm allocation parsing (parity: horovod/runner/util/lsf.py
+    auto-detection; Slurm handled natively instead of via mpirun)."""
+
+    def test_lsf_mcpu_hosts(self):
+        from horovod_tpu.runner.schedulers import in_lsf, lsf_hosts
+
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "batch1 1 n1 4 n2 4"}
+        assert in_lsf(env)
+        hosts = lsf_hosts(env)
+        assert [(h.hostname, h.slots) for h in hosts] == [
+            ("batch1", 1), ("n1", 4), ("n2", 4)]
+
+    def test_lsf_hosts_repetition(self):
+        from horovod_tpu.runner.schedulers import lsf_hosts
+
+        env = {"LSB_JOBID": "1", "LSB_HOSTS": "n1 n1 n2"}
+        assert [(h.hostname, h.slots) for h in lsf_hosts(env)] == [
+            ("n1", 2), ("n2", 1)]
+
+    def test_lsf_malformed(self):
+        from horovod_tpu.runner.hosts import HostParseError
+        from horovod_tpu.runner.schedulers import lsf_hosts
+
+        with pytest.raises(HostParseError):
+            lsf_hosts({"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "n1 4 n2"})
+        with pytest.raises(HostParseError):
+            lsf_hosts({"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "n1 zero"})
+
+    def test_slurm_nodelist_expansion(self):
+        from horovod_tpu.runner.schedulers import expand_nodelist
+
+        assert expand_nodelist("tpu[001-003,007],login1") == [
+            "tpu001", "tpu002", "tpu003", "tpu007", "login1"]
+        assert expand_nodelist("a,b") == ["a", "b"]
+        assert expand_nodelist("n[9-11]") == ["n9", "n10", "n11"]
+
+    def test_slurm_hosts_with_tasks_per_node(self):
+        from horovod_tpu.runner.schedulers import in_slurm, slurm_hosts
+
+        env = {
+            "SLURM_JOB_ID": "7",
+            "SLURM_JOB_NODELIST": "n[1-4]",
+            "SLURM_TASKS_PER_NODE": "2(x3),1",
+        }
+        assert in_slurm(env)
+        assert [(h.hostname, h.slots) for h in slurm_hosts(env)] == [
+            ("n1", 2), ("n2", 2), ("n3", 2), ("n4", 1)]
+
+    def test_launcher_uses_allocation_when_no_hosts_flag(self, monkeypatch):
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "n1 1 n2 1 n3 1")
+        args = parse_args(["-np", "3", "python", "t.py"])
+        s = settings_from_args(args)
+        assert [h.hostname for h in s.hosts] == ["n1", "n2", "n3"]
+        assert s.num_proc == 3
+
+    def test_explicit_hosts_beat_allocation(self, monkeypatch):
+        # even a MALFORMED allocation env must not break explicit -H
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "n1 4 n2")
+        args = parse_args(["-np", "1", "-H", "other:1", "python", "t.py"])
+        s = settings_from_args(args)
+        assert [h.hostname for h in s.hosts] == ["other"]
+
+    def test_cpu_mode_beats_allocation(self, monkeypatch):
+        # dev-mode fan-out keeps working inside a 1-node allocation
+        monkeypatch.setenv("SLURM_JOB_ID", "5")
+        monkeypatch.setenv("SLURM_JOB_NODELIST", "n1")
+        args = parse_args(["-np", "4", "--cpu-mode", "python", "t.py"])
+        s = settings_from_args(args)
+        assert s.num_proc == 4 and len(s.hosts) == 4
+        assert all(h.hostname == "localhost" for h in s.hosts)
+
+
 class TestKVServer:
     def test_put_get_roundtrip(self):
         server = RendezvousServer()
