@@ -1,0 +1,83 @@
+"""Elastic MNIST on the torch surface — parity with the reference's
+``examples/elastic/pytorch/pytorch_mnist_elastic.py``::
+
+    hvdrun --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover.sh \
+        python examples/torch_mnist_elastic.py
+
+``@hvd.elastic.run`` + ``TorchState`` survive worker addition/removal:
+model/optimizer snapshot to host memory on ``state.commit()``; a peer
+failure rolls back to the last commit; a host update re-syncs from rank 0
+and continues. Synthetic MNIST-shaped data (no downloads).
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+from horovod_tpu.torch.elastic import TorchState, run
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = torch.nn.Linear(784, 64)
+        self.fc2 = torch.nn.Linear(64, 10)
+
+    def forward(self, x):
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x.flatten(1)))), dim=1)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--steps-per-epoch", type=int, default=10)
+    p.add_argument("--batch-size", type=int, default=32)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+    model = Net()
+    optimizer = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size()),
+        named_parameters=model.named_parameters(),
+    )
+
+    @run
+    def train(state):
+        rng = np.random.RandomState(1234)
+        while state.epoch < args.epochs:
+            for b in range(state.batch, args.steps_per_epoch):
+                x = torch.from_numpy(
+                    rng.rand(args.batch_size, 784).astype(np.float32))
+                y = torch.from_numpy(
+                    rng.randint(0, 10, size=(args.batch_size,)))
+                optimizer.zero_grad()
+                loss = F.nll_loss(model(x), y)
+                loss.backward()
+                optimizer.step()
+                state.batch = b + 1
+                if b % 5 == 0:
+                    # commit() checkpoints AND polls for host updates
+                    # (HostsUpdatedInterrupt -> re-rendezvous + sync()).
+                    state.commit()
+                    if hvd.rank() == 0:
+                        print(f"epoch {state.epoch} batch {b} "
+                              f"loss {float(loss):.4f} world {hvd.size()}",
+                              flush=True)
+            state.epoch += 1
+            state.batch = 0
+            state.commit()
+
+    state = TorchState(model=model, optimizer=optimizer, epoch=0, batch=0)
+    train(state)
+    if hvd.rank() == 0:
+        print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

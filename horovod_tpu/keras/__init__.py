@@ -89,17 +89,30 @@ def DistributedOptimizer(optimizer, op: str = Average,
 
 class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
     """Broadcast rank-0 weights to every process when training starts
-    (reference: ``hvd.callbacks.BroadcastGlobalVariablesCallback``)."""
+    (reference: ``hvd.callbacks.BroadcastGlobalVariablesCallback``). An
+    unbuilt model (e.g. a Sequential with no input shape) has no
+    variables at ``on_train_begin`` — Keras builds it at the first train
+    step — so the broadcast defers to the end of the first batch, the
+    reference's own strategy for this case."""
 
     def __init__(self, root_rank: int = 0):
         super().__init__()
         self.root_rank = root_rank
+        self._done = False
+
+    def _broadcast(self):
+        variables = (self.model.trainable_variables
+                     + self.model.non_trainable_variables)
+        if variables:
+            hvd_tf.broadcast_variables(variables, root_rank=self.root_rank)
+            self._done = True
 
     def on_train_begin(self, logs=None):
-        hvd_tf.broadcast_variables(
-            self.model.trainable_variables + self.model.non_trainable_variables,
-            root_rank=self.root_rank,
-        )
+        self._broadcast()
+
+    def on_train_batch_end(self, batch, logs=None):
+        if not self._done:
+            self._broadcast()
 
 
 class MetricAverageCallback(tf.keras.callbacks.Callback):
@@ -148,9 +161,11 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
             print(f"hvd warmup: epoch {epoch} lr={lr:.6g}")
 
 
+from . import callbacks  # noqa: E402,F401  (reference: hvd.callbacks.*)
+
 __all__ = [
     "Average", "Sum", "init", "shutdown", "size", "rank", "local_rank",
     "allreduce", "allgather", "broadcast", "broadcast_variables",
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
-    "MetricAverageCallback", "LearningRateWarmupCallback",
+    "MetricAverageCallback", "LearningRateWarmupCallback", "callbacks",
 ]

@@ -1,0 +1,14 @@
+"""``hvd.callbacks.*`` namespace parity for the Keras surface.
+
+The reference exposes its Keras callbacks as ``horovod.keras.callbacks``
+(impl in ``horovod/_keras/callbacks.py``); here they live in the package
+``__init__`` and this module re-exports them under the reference's
+canonical path so ``hvd.callbacks.BroadcastGlobalVariablesCallback(0)``
+works verbatim.
+"""
+
+from . import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)

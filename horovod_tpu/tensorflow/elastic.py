@@ -13,6 +13,7 @@ from typing import Any
 
 import numpy as np
 
+from ..elastic.runner import run  # noqa: F401  (reference: hvd.elastic.run)
 from ..elastic.state import ExtrasState
 from ..functions import broadcast_object
 from . import broadcast_variables, size

@@ -16,6 +16,7 @@ from typing import Any, Iterator
 import numpy as np
 import torch
 
+from ..elastic.runner import run  # noqa: F401  (reference: hvd.elastic.run)
 from ..elastic.state import ExtrasState
 from . import (
     broadcast_object,

@@ -138,3 +138,60 @@ class TestMultiProcess:
         assert rc == 0, "\n".join(lines)
         assert any("tf-e2e rank0 ok" in l for l in lines), lines
         assert any("tf-e2e rank1 ok" in l for l in lines), lines
+
+    def test_broadcast_callback_syncs_unbuilt_model(self, tmp_path):
+        """An input-shape-less Sequential has no variables at
+        on_train_begin; the callback must defer to first-batch-end and
+        still converge every rank onto rank 0's weights (per-rank seeds
+        prove it's the broadcast, not shared init)."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = _worker_script(
+            tmp_path,
+            """
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.keras as hvdk
+
+            hvdk.init()
+            r = hvdk.rank()
+            tf.random.set_seed(100 + r)  # deliberately rank-divergent
+            model = tf.keras.Sequential([
+                tf.keras.layers.Dense(4, activation="relu"),
+                tf.keras.layers.Dense(1),
+            ])  # unbuilt: no input shape
+            assert not model.trainable_variables
+            model.compile(
+                optimizer=hvdk.DistributedOptimizer(
+                    tf.keras.optimizers.SGD(learning_rate=0.0)),
+                loss="mse", run_eagerly=True)
+            rng = np.random.RandomState(0)  # same data on all ranks
+            x = rng.rand(8, 3).astype(np.float32)
+            y = rng.rand(8, 1).astype(np.float32)
+            model.fit(
+                x, y, batch_size=8, epochs=1, verbose=0,
+                callbacks=[
+                    hvdk.callbacks.BroadcastGlobalVariablesCallback(0)])
+            # lr=0 and identical data: any weight difference now could
+            # only come from divergent init -> broadcast must have run.
+            digest = float(sum(
+                np.abs(v.numpy()).sum()
+                for v in model.trainable_variables))
+            print("kerascb rank%d digest %.6f" % (r, digest))
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        digests = {}
+        for line in lines:
+            if "kerascb rank" in line:
+                part = line.split("kerascb rank", 1)[1]
+                rank, dig = part.split(" digest ")
+                digests[int(rank)] = float(dig)
+        assert set(digests) == {0, 1}, lines
+        assert digests[0] == pytest.approx(digests[1], abs=1e-6), digests
