@@ -101,11 +101,23 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         self._done = False
 
     def _broadcast(self):
-        variables = (self.model.trainable_variables
-                     + self.model.non_trainable_variables)
-        if variables:
-            hvd_tf.broadcast_variables(variables, root_rank=self.root_rank)
-            self._done = True
+        model_vars = list(self.model.trainable_variables
+                          + self.model.non_trainable_variables)
+        if not model_vars:
+            # Unbuilt model. The optimizer may already own variables
+            # (keras 3 creates `iterations` at construction), but
+            # broadcasting those alone would mark the job done before the
+            # model exists — keep deferring until the model has weights.
+            return
+        # Reference parity: optimizer slot variables (momentum, Adam m/v)
+        # broadcast too — rank 0 may carry restored state the others lack.
+        opt = getattr(self.model, "optimizer", None)
+        opt_vars = getattr(opt, "variables", None)
+        if callable(opt_vars):  # keras 2 exposed it as a method
+            opt_vars = opt_vars()
+        hvd_tf.broadcast_variables(model_vars + list(opt_vars or []),
+                                   root_rank=self.root_rank)
+        self._done = True
 
     def on_train_begin(self, logs=None):
         self._broadcast()

@@ -32,6 +32,7 @@ except ImportError as e:  # pragma: no cover
         "JAX-native surface (import horovod_tpu) has no such dependency"
     ) from e
 
+import ml_dtypes
 import numpy as np
 
 # Reduce-op names: the same objects the core dispatch compares against.
@@ -85,9 +86,12 @@ def _np(tensor) -> np.ndarray:
     if isinstance(tensor, np.ndarray):
         return tensor
     if isinstance(tensor, tf.IndexedSlices):
-        # Sparse gradients (Embedding layers): densify before the
-        # collective — the reference's `sparse_as_dense=True` behavior,
-        # which is the only sound default for an allreduce data plane.
+        # Densify sparse tensors: the host ring reduces dense buffers.
+        # For GRADIENTS the explicit opt-in lives in
+        # DistributedGradientTape(sparse_as_dense=...), which rejects
+        # IndexedSlices before they reach this helper unless the user
+        # opted in; direct ops (allreduce/broadcast_variables) densify
+        # here unconditionally.
         tensor = tf.convert_to_tensor(tensor)
     return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
 
@@ -181,6 +185,51 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(tf.convert_to_tensor(np.asarray(out).reshape(v.shape)))
 
 
+class _NoneCompressor:
+    @staticmethod
+    def compress(arr: np.ndarray):
+        return arr, None
+
+    @staticmethod
+    def decompress(arr: np.ndarray, ctx):
+        return arr
+
+
+class _CastCompressor:
+    wire_dtype: type = None
+
+    @classmethod
+    def compress(cls, arr: np.ndarray):
+        if np.issubdtype(arr.dtype, np.floating) and arr.dtype != cls.wire_dtype:
+            return arr.astype(cls.wire_dtype), arr.dtype
+        return arr, None
+
+    @classmethod
+    def decompress(cls, arr: np.ndarray, ctx):
+        return arr.astype(ctx) if ctx is not None else arr
+
+
+class _FP16Compressor(_CastCompressor):
+    wire_dtype = np.float16
+
+
+class _BF16Compressor(_CastCompressor):
+    wire_dtype = ml_dtypes.bfloat16
+
+
+class Compression:
+    """Parity: ``horovod/tensorflow/compression.py`` — halve the wire
+    bytes of the host data plane by reducing in half precision (lossy,
+    like the reference). Per-surface compressor modules mirror the
+    reference's layout (each framework ships its own compression.py);
+    the compiled JAX path's analog is :mod:`horovod_tpu.compression`.
+    ``bf16`` is the TPU-native choice (no fp16 range cliffs)."""
+
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+    bf16 = _BF16Compressor
+
+
 class DistributedGradientTape:
     """Wrap a ``tf.GradientTape`` so ``.gradient()`` returns
     allreduce-averaged gradients.
@@ -193,13 +242,22 @@ class DistributedGradientTape:
         tape = hvd.DistributedGradientTape(tape)
         grads = tape.gradient(loss, model.trainable_variables)
         opt.apply_gradients(zip(grads, model.trainable_variables))
+
+    ``compression=Compression.fp16`` reduces on a half-precision wire;
+    ``sparse_as_dense=True`` densifies ``tf.IndexedSlices`` gradients
+    (embedding layers) before the collective — without it sparse
+    gradients are rejected with guidance, since the host ring reduces
+    dense buffers.
     """
 
     def __init__(self, tape: "tf.GradientTape", op: str = Average,
-                 num_groups: int = 0):
+                 num_groups: int = 0, compression=Compression.none,
+                 sparse_as_dense: bool = False):
         self._tape = tape
         self._op = op
         self._num_groups = num_groups
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
         self._step = 0
 
     def gradient(self, target, sources, output_gradients=None):
@@ -209,18 +267,30 @@ class DistributedGradientTape:
             return grads
         self._step += 1
         w = _world()
+        out = list(grads)
+        for i, g in enumerate(out):
+            if isinstance(g, tf.IndexedSlices):
+                if not self._sparse_as_dense:
+                    raise ValueError(
+                        f"gradient {i} is tf.IndexedSlices (sparse); pass "
+                        "DistributedGradientTape(..., sparse_as_dense=True) "
+                        "to densify it for the dense ring collective"
+                    )
+                out[i] = tf.convert_to_tensor(g)
         # Stable per-gradient names + async enqueue: same-cycle arrival
         # fuses the step's gradients into ring collectives, and from step 2
         # on the signatures ride the response-cache bitvector fast path
         # (the reference's steady-state design).
-        flat = [(i, g) for i, g in enumerate(grads) if g is not None]
+        flat = [(i, g) for i, g in enumerate(out) if g is not None]
+        wires = [self._compression.compress(_np(g)) for _, g in flat]
         handles = [
-            w.allreduce_async_(_np(g), name=f"dgt.grad.{i}", op=self._op)
-            for i, g in flat
+            w.allreduce_async_(arr, name=f"dgt.grad.{i}", op=self._op)
+            for (i, _), (arr, _) in zip(flat, wires)
         ]
-        out = list(grads)
-        for (i, g), h in zip(flat, handles):
-            r = tf.convert_to_tensor(np.asarray(w.synchronize(h)))
+        for (i, g), h, (_, ctx) in zip(flat, handles, wires):
+            r = self._compression.decompress(
+                np.asarray(w.synchronize(h)), ctx)
+            r = tf.convert_to_tensor(r)
             out[i] = tf.cast(r, g.dtype) if r.dtype != g.dtype else r
         return out
 
@@ -233,5 +303,5 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "grouped_allreduce", "allgather", "broadcast", "join",
-    "broadcast_variables", "DistributedGradientTape",
+    "broadcast_variables", "DistributedGradientTape", "Compression",
 ]

@@ -117,6 +117,36 @@ class TestMultiProcess:
                 return hvd.allreduce(x, op=hvd.Sum, name="graph.ar")
             gsum = graph_sum(tf.constant([float(r + 1)] * 2))
             assert np.allclose(gsum.numpy(), 3.0), gsum.numpy()
+            # fp16-compressed tape: wire is half precision, result comes
+            # back f32 and still averages correctly.
+            with tf.GradientTape() as t4:
+                l4 = tf.reduce_sum(v * float(r + 1))
+            t4 = hvd.DistributedGradientTape(
+                t4, compression=hvd.Compression.fp16)
+            (g4,) = t4.gradient(l4, [v])
+            assert g4.dtype == tf.float32
+            assert np.allclose(g4.numpy(), 1.5), g4.numpy()
+            # sparse gradients: rejected without sparse_as_dense, dense
+            # allreduce with it (embedding-style gather).
+            emb = tf.Variable(np.full((4, 2), float(r + 1), np.float32))
+            with tf.GradientTape() as t5:
+                rows = tf.gather(emb, [0, 2])
+                l5 = tf.reduce_sum(rows)
+            t5w = hvd.DistributedGradientTape(t5)
+            try:
+                t5w.gradient(l5, [emb])
+                raise AssertionError("sparse grad should be rejected")
+            except ValueError as e:
+                assert "sparse_as_dense" in str(e)
+            with tf.GradientTape() as t6:
+                rows = tf.gather(emb, [0, 2])
+                l6 = tf.reduce_sum(rows * float(r + 1))
+            t6w = hvd.DistributedGradientTape(t6, sparse_as_dense=True)
+            (g6,) = t6w.gradient(l6, [emb])
+            # rank grads: rows 0,2 are r+1 -> avg 1.5; rows 1,3 zero
+            g6 = np.asarray(g6)
+            assert np.allclose(g6[[0, 2]], 1.5), g6
+            assert np.allclose(g6[[1, 3]], 0.0), g6
             # Keras optimizer wrapper trains in lockstep.
             import horovod_tpu.keras as hvdk
             opt = hvdk.DistributedOptimizer(
@@ -163,9 +193,12 @@ class TestMultiProcess:
                 tf.keras.layers.Dense(1),
             ])  # unbuilt: no input shape
             assert not model.trainable_variables
+            # momentum creates optimizer slot variables: the deferred
+            # broadcast must handle them (plus the int iterations var).
             model.compile(
                 optimizer=hvdk.DistributedOptimizer(
-                    tf.keras.optimizers.SGD(learning_rate=0.0)),
+                    tf.keras.optimizers.SGD(
+                        learning_rate=0.0, momentum=0.9)),
                 loss="mse", run_eagerly=True)
             rng = np.random.RandomState(0)  # same data on all ranks
             x = rng.rand(8, 3).astype(np.float32)
