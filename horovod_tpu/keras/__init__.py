@@ -112,10 +112,43 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         # Reference parity: optimizer slot variables (momentum, Adam m/v)
         # broadcast too — rank 0 may carry restored state the others lack.
         opt = getattr(self.model, "optimizer", None)
+        if opt is not None and callable(getattr(opt, "build", None)) \
+                and not getattr(opt, "built", True):
+            # keras 3: force slot creation so every rank owns the same
+            # variable set before the symmetric collectives below.
+            try:
+                opt.build(self.model.trainable_variables)
+            except Exception:
+                pass
         opt_vars = getattr(opt, "variables", None)
         if callable(opt_vars):  # keras 2 exposed it as a method
             opt_vars = opt_vars()
-        hvd_tf.broadcast_variables(model_vars + list(opt_vars or []),
+        opt_vars = list(opt_vars or [])
+        if opt_vars and hvd_tf.size() > 1:
+            # Ranks may still disagree (e.g. rank 0 restored extra slots).
+            # Broadcast is symmetric — every rank must enqueue the SAME
+            # ops — so agree on the intersection first, ordered by rank
+            # 0's listing. Keys disambiguate duplicate names by
+            # occurrence.
+            seen: dict = {}
+            keys = []
+            for v in opt_vars:
+                base = getattr(v, "path", None) or getattr(v, "name", "var")
+                n = seen.get(base, 0)
+                seen[base] = n + 1
+                keys.append((base, n))
+            all_keys = hvd_tf._allgather_object_host(keys)
+            common = set(all_keys[0])
+            for ks in all_keys[1:]:
+                common &= set(ks)
+            order = {k: i for i, k in enumerate(all_keys[0])}
+            opt_vars = [
+                v for _, v in sorted(
+                    (order[k], v)
+                    for k, v in zip(keys, opt_vars) if k in common
+                )
+            ]
+        hvd_tf.broadcast_variables(model_vars + opt_vars,
                                    root_rank=self.root_rank)
         self._done = True
 

@@ -109,18 +109,50 @@ def _in_graph(tensor) -> bool:
         and not isinstance(tensor, tf.IndexedSlices)
 
 
+def _graph_wrap(tensor, fn, keep_shape: bool = True):
+    """Run `fn` as a py_function host op inside a tf.function graph (the
+    collectives are host-side exchanges either way)."""
+    out = tf.py_function(fn, [tensor], Tout=tensor.dtype)
+    if keep_shape:
+        out.set_shape(tensor.shape)
+    return out
+
+
+def _allgather_object_host(obj):
+    """Gather one picklable object per process through the host data
+    plane (used to make variable sets agree before symmetric
+    collectives)."""
+    import pickle
+
+    if size() <= 1:
+        return [obj]
+    global _agobj_counter
+    _agobj_counter += 1
+    tag = f"tf.agobj.{_agobj_counter}"
+    w = _world()
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    sizes = np.asarray(
+        w.allgather(np.array([payload.size], np.int64), name=f"{tag}.sz")
+    ).reshape(-1)
+    data = np.asarray(w.allgather_v(payload, name=f"{tag}.data"))
+    out, off = [], 0
+    for sz in sizes:
+        out.append(pickle.loads(data[off:off + int(sz)].tobytes()))
+        off += int(sz)
+    return out
+
+
+_agobj_counter = 0
+
+
 def allreduce(tensor, op: str = Average, name: str | None = None):
     """Reduce a TF tensor across all processes; every process gets the
     result. Parity: ``hvd.allreduce`` (tensorflow flavor). Works eagerly
     and under ``tf.function`` (the collective becomes a py_function host
     op — it is a host-side exchange either way)."""
     if _in_graph(tensor):
-        out = tf.py_function(
-            lambda t: allreduce(t, op=op, name=name), [tensor],
-            Tout=tensor.dtype,
-        )
-        out.set_shape(tensor.shape)
-        return out
+        return _graph_wrap(tensor,
+                           lambda t: allreduce(t, op=op, name=name))
     x = _np(tensor)
     out = _eager_allreduce_np(x, name, op)
     return tf.convert_to_tensor(out)
@@ -150,18 +182,41 @@ def allgather(tensor, name: str | None = None):
 def broadcast(tensor, root_rank: int, name: str | None = None):
     """Broadcast ``root_rank``'s tensor to every process."""
     if _in_graph(tensor):
-        out = tf.py_function(
-            lambda t: broadcast(t, root_rank, name=name), [tensor],
-            Tout=tensor.dtype,
-        )
-        out.set_shape(tensor.shape)
-        return out
+        return _graph_wrap(tensor,
+                           lambda t: broadcast(t, root_rank, name=name))
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
     return tf.convert_to_tensor(
         np.asarray(_world().broadcast(x, root_rank, name=name))
     )
+
+
+def alltoall(tensor, name: str | None = None):
+    """Scatter dim-0 splits of ``tensor`` to every rank and gather theirs
+    (even splits; parity: ``hvd.alltoall`` tensorflow flavor)."""
+    if _in_graph(tensor):
+        return _graph_wrap(tensor, lambda t: alltoall(t, name=name))
+    x = _np(tensor)
+    if size() <= 1:
+        return tf.convert_to_tensor(x)
+    out = np.asarray(_world().alltoall(x, name=name))
+    return tf.convert_to_tensor(out.reshape(x.shape))
+
+
+def reducescatter(tensor, op: str = Average, name: str | None = None):
+    """Reduce across ranks (default Average — reference parity, same as
+    the JAX surface), return this rank's dim-0 shard."""
+    if _in_graph(tensor):
+        return _graph_wrap(
+            tensor, lambda t: reducescatter(t, op=op, name=name),
+            keep_shape=False,  # output is the dim-0 shard, not input-shaped
+        )
+    x = _np(tensor)
+    if size() <= 1:
+        return tf.convert_to_tensor(x)
+    out = np.asarray(_world().reducescatter(x, name=name, op=op))
+    return tf.convert_to_tensor(out)
 
 
 def join(timeout_s: float = 600.0) -> int:
@@ -267,7 +322,11 @@ class DistributedGradientTape:
             return grads
         self._step += 1
         w = _world()
-        out = list(grads)
+        # tf contract: gradient() mirrors the structure of `sources` — a
+        # single (non-sequence) source yields a single gradient, which
+        # must not be unstacked by list().
+        single = not isinstance(grads, (list, tuple))
+        out = [grads] if single else list(grads)
         for i, g in enumerate(out):
             if isinstance(g, tf.IndexedSlices):
                 if not self._sparse_as_dense:
@@ -292,7 +351,7 @@ class DistributedGradientTape:
                 np.asarray(w.synchronize(h)), ctx)
             r = tf.convert_to_tensor(r)
             out[i] = tf.cast(r, g.dtype) if r.dtype != g.dtype else r
-        return out
+        return out[0] if single else out
 
     def __getattr__(self, item):  # watch(), stop_recording(), ...
         return getattr(self._tape, item)
@@ -302,6 +361,7 @@ __all__ = [
     "Average", "Sum", "Min", "Max",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
-    "allreduce", "grouped_allreduce", "allgather", "broadcast", "join",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "join",
     "broadcast_variables", "DistributedGradientTape", "Compression",
 ]

@@ -229,10 +229,11 @@ def alltoall(tensor, name: str | None = None):
 
 
 def reducescatter(tensor, name: str | None = None, op: str | None = None):
+    # default Average: reference parity (and the JAX surface's default)
     if size() <= 1:
         return tensor.clone()
     out = np.asarray(
-        _world().reducescatter(_np_of(tensor), name=name, op=op or Sum)
+        _world().reducescatter(_np_of(tensor), name=name, op=op or Average)
     )
     return torch.from_numpy(out).to(tensor.dtype)
 

@@ -117,6 +117,29 @@ class TestMultiProcess:
                 return hvd.allreduce(x, op=hvd.Sum, name="graph.ar")
             gsum = graph_sum(tf.constant([float(r + 1)] * 2))
             assert np.allclose(gsum.numpy(), 3.0), gsum.numpy()
+            # alltoall: rank r sends [r*10, r*10+1]; rank k receives
+            # row k of every rank.
+            a2a = hvd.alltoall(
+                tf.constant([[10.0 * r + 0], [10.0 * r + 1]]))
+            expect = np.array([[0.0 + r], [10.0 + r]])
+            assert np.allclose(np.asarray(a2a), expect), a2a
+            # reducescatter: reduce then shard dim 0 (default Average,
+            # reference parity).
+            rs = hvd.reducescatter(
+                tf.constant([[1.0 + r, 2.0], [3.0, 4.0]]), op=hvd.Sum)
+            # summed: [[3,4],[6,8]]; rank r gets row r
+            expect_rs = np.array([[3.0, 4.0], [6.0, 8.0]])[r]
+            assert np.allclose(np.asarray(rs), expect_rs), rs
+            rs_avg = hvd.reducescatter(
+                tf.constant([[1.0 + r, 2.0], [3.0, 4.0]]))
+            assert np.allclose(np.asarray(rs_avg), expect_rs / 2.0), rs_avg
+            # single (non-list) source keeps its structure: one tensor
+            # back, not a list of rows.
+            with tf.GradientTape() as ts:
+                lss = tf.reduce_sum(v * float(r + 1))
+            gs = hvd.DistributedGradientTape(ts).gradient(lss, v)
+            assert tf.is_tensor(gs) and gs.shape == v.shape, gs
+            assert np.allclose(gs.numpy(), 1.5), gs.numpy()
             # fp16-compressed tape: wire is half precision, result comes
             # back f32 and still averages correctly.
             with tf.GradientTape() as t4:
