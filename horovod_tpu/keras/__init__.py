@@ -124,8 +124,11 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
         if callable(opt_vars):  # keras 2 exposed it as a method
             opt_vars = opt_vars()
         opt_vars = list(opt_vars or [])
-        if opt_vars and hvd_tf.size() > 1:
-            # Ranks may still disagree (e.g. rank 0 restored extra slots).
+        if hvd_tf.size() > 1:
+            # Ranks may disagree on the slot set (e.g. rank 0 restored
+            # extra slots) — or on whether ANY optimizer variables exist
+            # yet, so EVERY rank must join this exchange, empty list or
+            # not (a local-emptiness gate would deadlock the others).
             # Broadcast is symmetric — every rank must enqueue the SAME
             # ops — so agree on the intersection first, ordered by rank
             # 0's listing. Keys disambiguate duplicate names by

@@ -357,6 +357,8 @@ class DistributedGradientTape:
         return getattr(self._tape, item)
 
 
+from .sync_batch_norm import SyncBatchNormalization  # noqa: E402
+
 __all__ = [
     "Average", "Sum", "Min", "Max",
     "init", "shutdown", "is_initialized",
@@ -364,4 +366,5 @@ __all__ = [
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "join",
     "broadcast_variables", "DistributedGradientTape", "Compression",
+    "SyncBatchNormalization",
 ]

@@ -1,0 +1,104 @@
+"""Cross-process synchronized BatchNormalization for the TF surface.
+
+Parity: ``horovod/tensorflow/sync_batch_norm.py — SyncBatchNormalization``.
+Batch-norm statistics are computed over the GLOBAL batch (all processes'
+shards), not each worker's slice — the difference matters at small
+per-worker batch sizes. The layer overrides keras BatchNormalization's
+``_moments`` to allreduce count-weighted (sum, sum-of-squares, count);
+the exchange is differentiable via ``tf.custom_gradient`` whose backward
+is the reference's registered gradient for a Sum allreduce — another Sum
+allreduce of the upstream cotangent.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import tensorflow as tf
+
+from . import Sum, _world, size
+
+
+def _allreduce_sum_diff(x: "tf.Tensor", tag: str) -> "tf.Tensor":
+    """Differentiable host-plane Sum allreduce.
+
+    Forward: every rank gets the element-wise sum over ranks. Backward:
+    d out_r / d x_local = identity for every rank r, so the cotangent is
+    the Sum allreduce of the upstream gradient (the reference registers
+    exactly this for HorovodAllreduce(Sum))."""
+
+    @tf.custom_gradient
+    def fn(t):
+        def host_sum(arr, name):
+            out = np.asarray(
+                _world().allreduce(arr.numpy().copy(), name=name, op=Sum))
+            return out.reshape(arr.shape)
+
+        y = tf.py_function(
+            lambda a: host_sum(a, f"{tag}.fwd"), [t], Tout=t.dtype)
+        y.set_shape(t.shape)
+
+        def grad(dy):
+            g = tf.py_function(
+                lambda a: host_sum(a, f"{tag}.bwd"), [dy], Tout=dy.dtype)
+            g.set_shape(dy.shape)
+            return g
+
+        return y, grad
+
+    return fn(x)
+
+
+class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+    """Drop-in ``tf.keras.layers.BatchNormalization`` whose training-time
+    batch statistics are synchronized across all processes.
+
+    Usage (reference-identical)::
+
+        import horovod_tpu.tensorflow as hvd
+        layer = hvd.SyncBatchNormalization(axis=-1)
+    """
+
+    def __init__(self, *args, **kwargs):
+        if kwargs.pop("synchronized", False):
+            # keras 3's own `synchronized=True` rides tf.distribute, which
+            # is not this framework's data plane.
+            raise ValueError(
+                "SyncBatchNormalization is already synchronized; do not "
+                "pass synchronized=True (that flag selects keras's "
+                "tf.distribute path)")
+        super().__init__(*args, **kwargs)
+
+    def _moments(self, inputs, mask=None, *legacy_args, **legacy_kwargs):
+        if legacy_args or legacy_kwargs or isinstance(mask, (list, tuple)):
+            # keras 2 (TF <= 2.15) calls _moments(inputs, reduction_axes,
+            # keep_dims) — a different private contract this layer does
+            # not implement.
+            raise RuntimeError(
+                "horovod_tpu SyncBatchNormalization requires keras 3 "
+                "(TF >= 2.16); this keras calls the keras-2 _moments "
+                "contract"
+            )
+        if size() <= 1 or mask is not None:
+            # Single process (nothing to sync) or masked BN (keras's
+            # weighted path; rare, and the reference does not sync it
+            # either) — defer to the stock implementation.
+            return super()._moments(inputs, mask)
+        axes = list(self._reduction_axes)
+        x = tf.cast(inputs, tf.float32)
+        # Per-shard count of reduced elements (batch may be uneven).
+        shape = tf.shape(x)
+        count = tf.cast(
+            tf.reduce_prod(tf.gather(shape, axes)), tf.float32)
+        local_sum = tf.reduce_sum(x, axis=axes)
+        local_sqsum = tf.reduce_sum(tf.square(x), axis=axes)
+        packed = tf.concat(
+            [local_sum, local_sqsum, tf.reshape(count, [1])], axis=0)
+        packed = _allreduce_sum_diff(packed, f"syncbn.{self.name}")
+        c = tf.shape(local_sum)[0]
+        total = packed[-1]
+        g_sum = packed[:c]
+        g_sqsum = packed[c:2 * c]
+        mean = g_sum / total
+        variance = g_sqsum / total - tf.square(mean)
+        return (tf.cast(mean, inputs.dtype),
+                tf.cast(variance, inputs.dtype))

@@ -192,6 +192,71 @@ class TestMultiProcess:
         assert any("tf-e2e rank0 ok" in l for l in lines), lines
         assert any("tf-e2e rank1 ok" in l for l in lines), lines
 
+    def test_sync_batch_norm_matches_full_batch(self, tmp_path):
+        """Each rank holds half the batch; SyncBatchNormalization's
+        training output and gradients must equal stock BatchNormalization
+        over the CONCATENATED batch (computed locally as the oracle)."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = _worker_script(
+            tmp_path,
+            """
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.tensorflow as hvd
+
+            hvd.init()
+            r = hvd.rank()
+            rng = np.random.RandomState(7)
+            full = rng.randn(8, 3).astype(np.float32) * 2.0 + 1.0
+            mine = full[r * 4:(r + 1) * 4]
+
+            sbn = hvd.SyncBatchNormalization(axis=-1, momentum=0.5)
+            sbn.build((None, 3))
+            ref = tf.keras.layers.BatchNormalization(axis=-1, momentum=0.5)
+            ref.build((None, 3))
+
+            with tf.GradientTape() as tape:
+                out = sbn(tf.constant(mine), training=True)
+                loss = tf.reduce_sum(tf.square(out) * 0.5)
+            g_gamma, g_beta = tape.gradient(
+                loss, [sbn.gamma, sbn.beta])
+            # cross-process grads must then be summed (each rank saw its
+            # shard only) to compare against the full-batch oracle.
+            g_gamma = hvd.allreduce(g_gamma, op=hvd.Sum)
+            g_beta = hvd.allreduce(g_beta, op=hvd.Sum)
+
+            with tf.GradientTape() as rtape:
+                rout = ref(tf.constant(full), training=True)
+                rloss = tf.reduce_sum(tf.square(rout) * 0.5)
+            rg_gamma, rg_beta = rtape.gradient(
+                rloss, [ref.gamma, ref.beta])
+
+            assert np.allclose(out.numpy(),
+                               rout.numpy()[r * 4:(r + 1) * 4],
+                               atol=1e-4), (out.numpy(), rout.numpy())
+            assert np.allclose(g_gamma.numpy(), rg_gamma.numpy(),
+                               atol=1e-3), (g_gamma, rg_gamma)
+            assert np.allclose(g_beta.numpy(), rg_beta.numpy(),
+                               atol=1e-3), (g_beta, rg_beta)
+            # moving stats updated from the GLOBAL moments
+            assert np.allclose(sbn.moving_mean.numpy(),
+                               ref.moving_mean.numpy(), atol=1e-4)
+            assert np.allclose(sbn.moving_variance.numpy(),
+                               ref.moving_variance.numpy(), atol=1e-3)
+            print("syncbn rank%d ok" % r)
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("syncbn rank0 ok" in l for l in lines), lines
+        assert any("syncbn rank1 ok" in l for l in lines), lines
+
     def test_broadcast_callback_syncs_unbuilt_model(self, tmp_path):
         """An input-shape-less Sequential has no variables at
         on_train_begin; the callback must defer to first-batch-end and
