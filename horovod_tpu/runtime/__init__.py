@@ -447,11 +447,12 @@ class NativeWorld:
             _raise_last(self._lib, "join failed")
         return rc
 
-    def grouped_allreduce(self, tensors, name=None, op="average",
-                          process_set_id: int = 0,
-                          prescale_factor: float = 1.0,
-                          postscale_factor: float = 1.0) -> list:
-        """Atomically enqueue a list; the controller schedules the group
+    def grouped_allreduce_async(self, tensors, name=None, op="average",
+                                process_set_id: int = 0,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0) -> list:
+        """Atomically enqueue a list; returns one native handle per
+        tensor (synchronize each). The controller schedules the group
         all-or-nothing and fuses it into one ring collective (reference:
         ``hvd.grouped_allreduce`` backed by ``group_table.cc``'s
         GroupTable — here the registration IS atomic, one C call under one
@@ -489,4 +490,16 @@ class NativeWorld:
         with self._inflight_lock:
             for h, x, o in zip(handles, xs, outs):
                 self._inflight[h] = (x, o)
-        return [self.synchronize(h) for h in handles]
+        return handles
+
+    def grouped_allreduce(self, tensors, name=None, op="average",
+                          process_set_id: int = 0,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0) -> list:
+        return [
+            self.synchronize(h)
+            for h in self.grouped_allreduce_async(
+                tensors, name=name, op=op, process_set_id=process_set_id,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+        ]

@@ -112,6 +112,7 @@ class Compression:
 # handle -> (compression ctx, original dtype restore info)
 _handle_ctx: dict[int, Any] = {}
 _bobj_counter = 0
+_agv_counter = 0
 _local_handle = 0  # unique negative handles for 1-process worlds
 
 
@@ -128,6 +129,18 @@ def _np_of(t: "torch.Tensor") -> np.ndarray:
     return t.detach().contiguous().cpu().numpy().copy()
 
 
+def _register_async(native_handle_or_none, kind, payload):
+    """Register a handle in the ctx table. Single-process worlds (and
+    composite ops) get a synthetic negative handle that completes
+    immediately / is resolved entirely at synchronize()."""
+    if native_handle_or_none is None:
+        h = _next_local_handle()
+    else:
+        h = native_handle_or_none
+    _handle_ctx[h] = (kind, payload)
+    return h
+
+
 def allreduce_async_(tensor, average: bool | None = None,
                      name: str | None = None, op: str | None = None) -> int:
     """In-place-style async allreduce; returns a handle (reference:
@@ -135,28 +148,145 @@ def allreduce_async_(tensor, average: bool | None = None,
     immediately with a synthetic handle."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
-        h = _next_local_handle()
-        _handle_ctx[h] = ("identity", tensor)
-        return h
+        return _register_async(None, "identity", tensor)
     h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op)
-    _handle_ctx[h] = ("allreduce", tensor)
-    return h
+    return _register_async(h, "allreduce", tensor)
+
+
+def allreduce_async(tensor, average: bool | None = None,
+                    name: str | None = None, op: str | None = None) -> int:
+    """Out-of-place async allreduce (reference: ``hvd.allreduce_async``);
+    ``synchronize`` returns a NEW tensor."""
+    reduce_op = op or (Sum if average is False else Average)
+    if size() <= 1:
+        return _register_async(None, "identity", tensor.clone())
+    h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op)
+    return _register_async(h, "out", tensor)
+
+
+def _async_pool():
+    """Worker threads for composite async ops (the ragged allgather
+    protocol is two chained collectives — it cannot be one native
+    handle). Submission returns immediately and the worker posts to the
+    runtime right away, so cross-rank submission-order mixes cannot
+    deadlock (the controller negotiates arrival order, reference
+    semantics). The C enqueue path is designed for framework threads."""
+    global _pool
+    if _pool is None:
+        import concurrent.futures
+
+        _pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="hvd-torch-async")
+    return _pool
+
+
+_pool = None
+
+
+def allgather_async(tensor, name: str | None = None) -> int:
+    """Async ragged allgather (reference: ``hvd.allgather_async``) —
+    rides the same ``allgather_v`` protocol as the sync flavor, on a
+    worker thread."""
+    if size() <= 1:
+        return _register_async(None, "identity", tensor.clone())
+    global _agv_counter
+    _agv_counter += 1
+    base = name or f"torch.agv.{_agv_counter}"
+    w = _world()
+    fut = _async_pool().submit(w.allgather_v, _np_of(tensor), name=base)
+    return _register_async(None, "allgather_future", (tensor, fut))
+
+
+def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
+    """Out-of-place async broadcast (reference: ``hvd.broadcast_async``)."""
+    if size() <= 1:
+        return _register_async(None, "identity", tensor.clone())
+    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name)
+    return _register_async(h, "out", tensor)
+
+
+def broadcast_async_(tensor, root_rank: int, name: str | None = None) -> int:
+    """In-place async broadcast (reference: ``hvd.broadcast_async_``)."""
+    if size() <= 1:
+        return _register_async(None, "identity", tensor)
+    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name)
+    return _register_async(h, "allreduce", tensor)  # in-place copy-back
+
+
+def alltoall_async(tensor, name: str | None = None) -> int:
+    if size() <= 1:
+        return _register_async(None, "identity", tensor.clone())
+    h = _world().alltoall_async(_np_of(tensor), name=name)
+    return _register_async(h, "out", tensor)
+
+
+def reducescatter_async(tensor, name: str | None = None,
+                        op: str | None = None) -> int:
+    if size() <= 1:
+        return _register_async(None, "identity", tensor.clone())
+    h = _world().reducescatter_async(_np_of(tensor), name=name,
+                                     op=op or Average)
+    return _register_async(h, "reducescatter", tensor)
+
+
+def grouped_allreduce_async(tensors: Sequence[Any],
+                            name: str | None = None,
+                            op: str | None = None) -> int:
+    """Atomic grouped allreduce; ONE handle for the whole group
+    (reference contract) — ``synchronize`` returns the list of results."""
+    reduce_op = op or Average
+    if size() <= 1:
+        return _register_async(
+            None, "group_identity", [t.clone() for t in tensors])
+    native = _world().grouped_allreduce_async(
+        [_np_of(t) for t in tensors], name=name, op=reduce_op)
+    return _register_async(None, "group", (list(tensors), native))
 
 
 def synchronize(handle: int):
-    """Block until an async op completes; returns the result tensor and
-    (for the in-place flavors) copies it back into the input."""
-    kind, tensor = _handle_ctx.pop(handle, (None, None))
-    if handle < 0 or kind == "identity":
-        return tensor
+    """Block until an async op completes. In-place flavors copy back into
+    (and return) the input; out-of-place flavors return a new tensor;
+    group handles return the list of results."""
+    kind, payload = _handle_ctx.pop(handle, (None, None))
+    if kind is None:
+        raise ValueError(f"unknown handle {handle}")
+    if kind in ("identity", "group_identity"):
+        return payload
+    if kind == "group":
+        tensors, native = payload
+        w = _world()
+        return [
+            torch.from_numpy(
+                np.asarray(w.synchronize(h)).reshape(tuple(t.shape))
+            ).to(t.dtype)
+            for h, t in zip(native, tensors)
+        ]
+    if kind == "allgather_future":
+        tensor, fut = payload
+        out = np.asarray(fut.result())
+        return torch.from_numpy(
+            out.reshape((-1,) + tuple(tensor.shape[1:]))
+        ).to(tensor.dtype)
     out = np.asarray(_world().synchronize(handle))
-    result = torch.from_numpy(out.reshape(tuple(tensor.shape))).to(
-        tensor.dtype)
-    tensor.data.copy_(result)
-    return tensor
+    if kind == "reducescatter":
+        return torch.from_numpy(out).to(payload.dtype)
+    result = torch.from_numpy(out.reshape(tuple(payload.shape))).to(
+        payload.dtype)
+    if kind == "allreduce":  # in-place contract
+        payload.data.copy_(result)
+        return payload
+    return result  # "out": out-of-place
 
 
 def poll(handle: int) -> bool:
+    kind, payload = _handle_ctx.get(handle, (None, None))
+    if kind in ("identity", "group_identity"):
+        return True
+    if kind == "allgather_future":
+        return payload[1].done()
+    if kind == "group":
+        w = _world()
+        return all(w.poll(h) for h in payload[1])
     if handle < 0:
         return True
     return _world().poll(handle)
@@ -186,15 +316,7 @@ def allreduce_(tensor, average: bool | None = None,
 
 def grouped_allreduce(tensors: Sequence[Any], name: str | None = None,
                       op: str | None = None) -> list:
-    reduce_op = op or Average
-    if size() <= 1:
-        return [t.clone() for t in tensors]
-    outs = _world().grouped_allreduce(
-        [_np_of(t) for t in tensors], name=name, op=reduce_op)
-    return [
-        torch.from_numpy(np.asarray(o).reshape(tuple(t.shape))).to(t.dtype)
-        for o, t in zip(outs, tensors)
-    ]
+    return synchronize(grouped_allreduce_async(tensors, name=name, op=op))
 
 
 def allgather(tensor, name: str | None = None):
@@ -454,9 +576,13 @@ __all__ = [
     "Average", "Sum", "Min", "Max", "Compression", "SyncBatchNorm",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
-    "allreduce", "allreduce_", "allreduce_async_", "synchronize", "poll",
-    "grouped_allreduce", "allgather", "broadcast", "broadcast_", "alltoall",
-    "reducescatter", "barrier", "join",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "synchronize", "poll",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "reducescatter", "reducescatter_async", "barrier", "join",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "DistributedOptimizer",
 ]

@@ -30,6 +30,23 @@ class TestSingleProcess:
         assert hvd_torch.poll(h)
         r = hvd_torch.synchronize(h)
         np.testing.assert_allclose(r.numpy(), [1.0, 2.0])
+        # single-process identity paths of every async flavor
+        for make in (
+            lambda: hvd_torch.allreduce_async(t),
+            lambda: hvd_torch.allgather_async(t),
+            lambda: hvd_torch.broadcast_async(t, 0),
+            lambda: hvd_torch.broadcast_async_(t, 0),
+            lambda: hvd_torch.alltoall_async(t),
+            lambda: hvd_torch.reducescatter_async(t),
+        ):
+            h = make()
+            assert hvd_torch.poll(h)
+            np.testing.assert_allclose(
+                hvd_torch.synchronize(h).numpy(), [1.0, 2.0])
+        g = hvd_torch.grouped_allreduce_async([t, t * 2])
+        assert hvd_torch.poll(g)
+        res = hvd_torch.synchronize(g)
+        np.testing.assert_allclose(res[1].numpy(), [2.0, 4.0])
 
     def test_distributed_optimizer_single(self):
         model = torch.nn.Linear(3, 1)
@@ -74,6 +91,100 @@ class TestSingleProcess:
 
 @pytest.mark.slow
 class TestMultiProcess:
+    def test_e2e_async_variants(self, tmp_path):
+        """Async flavor of every collective (reference mpi_ops contract):
+        out-of-place allreduce_async, ragged allgather_async, broadcast
+        async in/out-of-place, alltoall_async, reducescatter_async, and
+        the single-handle grouped_allreduce_async."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = tmp_path / "torch_async_worker.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            + textwrap.dedent("""
+            import numpy as np
+            import torch
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            r = hvd.rank()
+            assert hvd.size() == 2
+
+            # out-of-place async allreduce: input untouched
+            t = torch.tensor([1.0 + r, 2.0 + r])
+            h = hvd.allreduce_async(t, name="a.out")
+            res = hvd.synchronize(h)
+            assert torch.allclose(res, torch.tensor([1.5, 2.5])), res
+            assert torch.allclose(t, torch.tensor([1.0 + r, 2.0 + r]))
+
+            # ragged allgather_async: rank r contributes r+1 rows
+            mine = torch.full((r + 1, 2), float(r))
+            h = hvd.allgather_async(mine, name="a.ag")
+            while not hvd.poll(h):
+                pass
+            ag = hvd.synchronize(h)
+            expect = torch.tensor([[0.0, 0.0], [1.0, 1.0], [1.0, 1.0]])
+            assert torch.allclose(ag, expect), ag
+
+            # broadcast_async (out-of-place) + broadcast_async_ (in-place)
+            src = torch.tensor([float(r + 7)])
+            out = hvd.synchronize(hvd.broadcast_async(src, 1, name="a.b"))
+            assert float(out[0]) == 8.0, out
+            assert float(src[0]) == float(r + 7)
+            hvd.synchronize(hvd.broadcast_async_(src, 0, name="a.b_"))
+            assert float(src[0]) == 7.0, src
+
+            # alltoall_async
+            a2a = hvd.synchronize(hvd.alltoall_async(
+                torch.tensor([10.0 * r, 10.0 * r + 1]), name="a.a2a"))
+            assert torch.allclose(a2a, torch.tensor([0.0 + r, 10.0 + r]))
+
+            # reducescatter_async (default Average)
+            rs = hvd.synchronize(hvd.reducescatter_async(
+                torch.tensor([[2.0 + 2 * r], [6.0 + 2 * r]]), name="a.rs"))
+            assert torch.allclose(rs, torch.tensor([[3.0, 7.0][r]])), rs
+
+            # grouped async: one handle, list of results
+            g = hvd.grouped_allreduce_async(
+                [torch.tensor([float(r)]), torch.tensor([float(2 * r)])],
+                name="a.grp")
+            res = hvd.synchronize(g)
+            assert torch.allclose(res[0], torch.tensor([0.5])), res
+            assert torch.allclose(res[1], torch.tensor([1.0])), res
+
+            # mixed submission order across ranks must not deadlock:
+            # allgather_async posts from a worker thread immediately, so
+            # the controller can negotiate regardless of local order.
+            y = torch.tensor([float(r)])
+            if r == 0:
+                h = hvd.allgather_async(torch.tensor([[1.0]]), name="mix")
+                b = hvd.broadcast(y, 0, name="mix.b")
+            else:
+                b = hvd.broadcast(y, 0, name="mix.b")
+                h = hvd.allgather_async(torch.tensor([[1.0]]), name="mix")
+            assert float(b[0]) == 0.0
+            assert hvd.synchronize(h).shape == (2, 1)
+
+            # unknown handle raises
+            try:
+                hvd.synchronize(12345)
+                raise AssertionError("expected ValueError")
+            except ValueError:
+                pass
+            print("torch-async rank%d ok" % r)
+            """)
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", str(script)])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("torch-async rank0 ok" in l for l in lines), lines
+        assert any("torch-async rank1 ok" in l for l in lines), lines
+
     def test_e2e_hooks_and_lockstep(self, tmp_path):
         from horovod_tpu.runner.launch import (
             parse_args, run_static, settings_from_args,
