@@ -77,6 +77,99 @@ def _world():
     return _default_native_world()
 
 
+# -- Process sets (parity: horovod/common/process_sets.py, torch flavor) -----
+
+
+class ProcessSet:
+    """A named subset of process ranks; collectives accept
+    ``process_set=`` to run inside it (members only call — reference
+    contract). ``process_set_id`` 0 is the global set; subset ids are
+    resolved lazily PER NATIVE WORLD (an elastic restart recreates the
+    world — ids must not dangle across it)."""
+
+    def __init__(self, ranks, process_set_id: int = -1):
+        self.ranks = sorted({int(r) for r in ranks})
+        self.process_set_id = process_set_id
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank WITHIN the set (raises for non-members)."""
+        me = rank()
+        if me not in self.ranks:
+            raise ValueError(
+                f"process {me} is not a member of set {self.ranks}")
+        return self.ranks.index(me)
+
+    def included(self) -> bool:
+        return rank() in self.ranks
+
+    def __repr__(self):
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class _GlobalProcessSet(ProcessSet):
+    """Lazy world set: rank list materializes from the live world size."""
+
+    def __init__(self):
+        self.process_set_id = 0
+
+    @property
+    def ranks(self):
+        return list(range(size()))
+
+
+global_process_set = _GlobalProcessSet()
+
+_ps_registry: list = []  # creation order (the collective contract)
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Create a subset of ranks (collective: every process must call
+    with the same sets in the same order; idempotent per rank list).
+    Parity: ``hvd.add_process_set``."""
+    ranks = sorted({int(r) for r in ranks})
+    bad = [r for r in ranks if r < 0 or r >= size()]
+    if bad:
+        raise ValueError(f"ranks {bad} out of range for world size {size()}")
+    ps = ProcessSet(ranks)
+    _ps_registry.append(ps)
+    if size() > 1:
+        _ps_id(ps)  # resolve against the live world now
+    return ps
+
+
+def _ps_id(process_set) -> int:
+    """Native set id of `process_set` in the CURRENT world.
+
+    Registration happens lazily per world, for ALL created sets in
+    creation order — add_process_set is collective and ordered, so the
+    native ids agree across ranks no matter which set a rank touches
+    first, and a recreated (elastic) world re-registers cleanly instead
+    of dangling old ids."""
+    if process_set is None or process_set.process_set_id == 0:
+        return 0
+    w = _world()
+    cache = getattr(w, "_torch_ps_map", None)
+    if cache is None:
+        cache = w._torch_ps_map = {}
+    key = tuple(process_set.ranks)
+    if key in cache:
+        process_set.process_set_id = cache[key]
+        return cache[key]
+    for ps in _ps_registry:
+        k = tuple(ps.ranks)
+        if k not in cache:
+            cache[k] = w.register_process_set(ps.ranks)
+        ps.process_set_id = cache[k]
+    if key not in cache:
+        raise ValueError(
+            f"process set {process_set.ranks} was not created via "
+            "add_process_set")
+    return cache[key]
+
+
 # -- Compression (parity: horovod/torch/compression.py) ----------------------
 
 
@@ -142,48 +235,59 @@ def _register_async(native_handle_or_none, kind, payload):
 
 
 def allreduce_async_(tensor, average: bool | None = None,
-                     name: str | None = None, op: str | None = None) -> int:
+                     name: str | None = None, op: str | None = None,
+                     process_set: ProcessSet | None = None) -> int:
     """In-place-style async allreduce; returns a handle (reference:
     ``hvd.allreduce_async_``). In a single-process world completes
     immediately with a synthetic handle."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
         return _register_async(None, "identity", tensor)
-    h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op)
+    h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op,
+                                  process_set_id=_ps_id(process_set))
     return _register_async(h, "allreduce", tensor)
 
 
 def allreduce_async(tensor, average: bool | None = None,
-                    name: str | None = None, op: str | None = None) -> int:
+                    name: str | None = None, op: str | None = None,
+                    process_set: ProcessSet | None = None) -> int:
     """Out-of-place async allreduce (reference: ``hvd.allreduce_async``);
     ``synchronize`` returns a NEW tensor."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
-    h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op)
+    h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op,
+                                  process_set_id=_ps_id(process_set))
     return _register_async(h, "out", tensor)
 
 
-def _async_pool():
-    """Worker threads for composite async ops (the ragged allgather
+def _spawn_future(fn, *args, **kwargs):
+    """One daemon thread per composite async op (the ragged allgather
     protocol is two chained collectives — it cannot be one native
     handle). Submission returns immediately and the worker posts to the
     runtime right away, so cross-rank submission-order mixes cannot
     deadlock (the controller negotiates arrival order, reference
-    semantics). The C enqueue path is designed for framework threads."""
-    global _pool
-    if _pool is None:
-        import concurrent.futures
+    semantics) — a bounded pool would reintroduce the hazard once its
+    queue backed up. The C enqueue path is designed for framework
+    threads."""
+    import concurrent.futures
+    import threading
 
-        _pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="hvd-torch-async")
-    return _pool
+    fut = concurrent.futures.Future()
+
+    def run():
+        try:
+            fut.set_result(fn(*args, **kwargs))
+        except BaseException as e:  # surface at synchronize()
+            fut.set_exception(e)
+
+    threading.Thread(target=run, daemon=True,
+                     name="hvd-torch-async").start()
+    return fut
 
 
-_pool = None
-
-
-def allgather_async(tensor, name: str | None = None) -> int:
+def allgather_async(tensor, name: str | None = None,
+                    process_set: ProcessSet | None = None) -> int:
     """Async ragged allgather (reference: ``hvd.allgather_async``) —
     rides the same ``allgather_v`` protocol as the sync flavor, on a
     worker thread."""
@@ -193,35 +297,49 @@ def allgather_async(tensor, name: str | None = None) -> int:
     _agv_counter += 1
     base = name or f"torch.agv.{_agv_counter}"
     w = _world()
-    fut = _async_pool().submit(w.allgather_v, _np_of(tensor), name=base)
+    fut = _spawn_future(w.allgather_v, _np_of(tensor), name=base,
+                        process_set_id=_ps_id(process_set))
     return _register_async(None, "allgather_future", (tensor, fut))
 
 
-def broadcast_async(tensor, root_rank: int, name: str | None = None) -> int:
-    """Out-of-place async broadcast (reference: ``hvd.broadcast_async``)."""
+def broadcast_async(tensor, root_rank: int, name: str | None = None,
+                    process_set: ProcessSet | None = None) -> int:
+    """Out-of-place async broadcast (reference: ``hvd.broadcast_async``).
+    ``root_rank`` is GLOBAL (reference contract, also on subsets)."""
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
-    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name)
+    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name,
+                                 process_set_id=_ps_id(process_set))
     return _register_async(h, "out", tensor)
 
 
-def broadcast_async_(tensor, root_rank: int, name: str | None = None) -> int:
+def broadcast_async_(tensor, root_rank: int, name: str | None = None,
+                     process_set: ProcessSet | None = None) -> int:
     """In-place async broadcast (reference: ``hvd.broadcast_async_``)."""
     if size() <= 1:
         return _register_async(None, "identity", tensor)
-    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name)
+    h = _world().broadcast_async(_np_of(tensor), root_rank, name=name,
+                                 process_set_id=_ps_id(process_set))
     return _register_async(h, "allreduce", tensor)  # in-place copy-back
 
 
-def alltoall_async(tensor, name: str | None = None) -> int:
+def alltoall_async(tensor, name: str | None = None,
+                   process_set: ProcessSet | None = None) -> int:
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
-    h = _world().alltoall_async(_np_of(tensor), name=name)
+    h = _world().alltoall_async(_np_of(tensor), name=name,
+                                process_set_id=_ps_id(process_set))
     return _register_async(h, "out", tensor)
 
 
 def reducescatter_async(tensor, name: str | None = None,
-                        op: str | None = None) -> int:
+                        op: str | None = None,
+                        process_set: ProcessSet | None = None) -> int:
+    if _ps_id(process_set) != 0:
+        raise ValueError(
+            "reducescatter on a non-global process set is not supported "
+            "by the native runtime; reduce on the global set or use "
+            "allreduce + local slice")
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
     h = _world().reducescatter_async(_np_of(tensor), name=name,
@@ -231,7 +349,8 @@ def reducescatter_async(tensor, name: str | None = None,
 
 def grouped_allreduce_async(tensors: Sequence[Any],
                             name: str | None = None,
-                            op: str | None = None) -> int:
+                            op: str | None = None,
+                            process_set: ProcessSet | None = None) -> int:
     """Atomic grouped allreduce; ONE handle for the whole group
     (reference contract) — ``synchronize`` returns the list of results."""
     reduce_op = op or Average
@@ -239,7 +358,8 @@ def grouped_allreduce_async(tensors: Sequence[Any],
         return _register_async(
             None, "group_identity", [t.clone() for t in tensors])
     native = _world().grouped_allreduce_async(
-        [_np_of(t) for t in tensors], name=name, op=reduce_op)
+        [_np_of(t) for t in tensors], name=name, op=reduce_op,
+        process_set_id=_ps_id(process_set))
     return _register_async(None, "group", (list(tensors), native))
 
 
@@ -294,7 +414,8 @@ def poll(handle: int) -> bool:
 
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
               op: str | None = None,
-              compression: Any = Compression.none):
+              compression: Any = Compression.none,
+              process_set: ProcessSet | None = None):
     """Synchronous allreduce returning a NEW tensor (reference semantics:
     ``hvd.allreduce`` is out-of-place; ``allreduce_`` is in-place)."""
     reduce_op = op or (Sum if average is False else Average)
@@ -302,62 +423,71 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
         return tensor.clone()
     wire, ctx = compression.compress(tensor)
     out = np.asarray(
-        _world().allreduce(_np_of(wire), name=name, op=reduce_op)
+        _world().allreduce(_np_of(wire), name=name, op=reduce_op,
+                           process_set_id=_ps_id(process_set))
     )
     result = torch.from_numpy(out.reshape(tuple(wire.shape))).to(wire.dtype)
     return compression.decompress(result, ctx)
 
 
 def allreduce_(tensor, average: bool | None = None,
-               name: str | None = None, op: str | None = None):
-    h = allreduce_async_(tensor, average=average, name=name, op=op)
+               name: str | None = None, op: str | None = None,
+               process_set: ProcessSet | None = None):
+    h = allreduce_async_(tensor, average=average, name=name, op=op,
+                         process_set=process_set)
     return synchronize(h)
 
 
 def grouped_allreduce(tensors: Sequence[Any], name: str | None = None,
-                      op: str | None = None) -> list:
-    return synchronize(grouped_allreduce_async(tensors, name=name, op=op))
+                      op: str | None = None,
+                      process_set: ProcessSet | None = None) -> list:
+    return synchronize(grouped_allreduce_async(
+        tensors, name=name, op=op, process_set=process_set))
 
 
-def allgather(tensor, name: str | None = None):
+def allgather(tensor, name: str | None = None,
+              process_set: ProcessSet | None = None):
     """Concatenate ranks' tensors along dim 0; per-rank dim-0 sizes may
     DIFFER (reference contract — trailing dims must agree)."""
     if size() <= 1:
         return tensor.clone()
-    out = np.asarray(_world().allgather_v(_np_of(tensor), name=name))
+    out = np.asarray(_world().allgather_v(
+        _np_of(tensor), name=name, process_set_id=_ps_id(process_set)))
     return torch.from_numpy(
         out.reshape((-1,) + tuple(tensor.shape[1:]))
     ).to(tensor.dtype)
 
 
-def broadcast(tensor, root_rank: int, name: str | None = None):
+def broadcast(tensor, root_rank: int, name: str | None = None,
+              process_set: ProcessSet | None = None):
     if size() <= 1:
         return tensor.clone()
-    out = np.asarray(_world().broadcast(_np_of(tensor), root_rank, name=name))
+    out = np.asarray(_world().broadcast(
+        _np_of(tensor), root_rank, name=name,
+        process_set_id=_ps_id(process_set)))
     return torch.from_numpy(out.reshape(tuple(tensor.shape))).to(tensor.dtype)
 
 
-def broadcast_(tensor, root_rank: int, name: str | None = None):
-    result = broadcast(tensor, root_rank, name)
+def broadcast_(tensor, root_rank: int, name: str | None = None,
+               process_set: ProcessSet | None = None):
+    result = broadcast(tensor, root_rank, name, process_set=process_set)
     tensor.data.copy_(result)
     return tensor
 
 
-def alltoall(tensor, name: str | None = None):
+def alltoall(tensor, name: str | None = None,
+             process_set: ProcessSet | None = None):
     if size() <= 1:
         return tensor.clone()
-    out = np.asarray(_world().alltoall(_np_of(tensor), name=name))
+    out = np.asarray(_world().alltoall(
+        _np_of(tensor), name=name, process_set_id=_ps_id(process_set)))
     return torch.from_numpy(out.reshape(tuple(tensor.shape))).to(tensor.dtype)
 
 
-def reducescatter(tensor, name: str | None = None, op: str | None = None):
-    # default Average: reference parity (and the JAX surface's default)
-    if size() <= 1:
-        return tensor.clone()
-    out = np.asarray(
-        _world().reducescatter(_np_of(tensor), name=name, op=op or Average)
-    )
-    return torch.from_numpy(out).to(tensor.dtype)
+def reducescatter(tensor, name: str | None = None, op: str | None = None,
+                  process_set: ProcessSet | None = None):
+    return synchronize(reducescatter_async(
+        tensor, name=name, op=op, process_set=process_set))
 
 
 def barrier() -> None:
@@ -437,11 +567,13 @@ class _DistributedOptimizer:
 
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none,
-                 backward_passes_per_step: int = 1, op: str = Average):
+                 backward_passes_per_step: int = 1, op: str = Average,
+                 process_set: ProcessSet | None = None):
         self._opt = optimizer
         self._compression = compression
         self._bpps = max(1, backward_passes_per_step)
         self._op = op
+        self._ps = process_set
         self._pass_count = 0
         self._handles: dict[Any, int] = {}
         self._acc: dict[Any, "torch.Tensor"] = {}
@@ -461,6 +593,17 @@ class _DistributedOptimizer:
     def add_param_group(self, group) -> None:
         self._opt.add_param_group(group)
         self._register_hooks()  # new params need allreduce hooks too
+
+    def _eff_size(self) -> int:
+        """Communicator size: the process set's when one is given. An
+        elastic shrink that removed ANY set member makes the set
+        unreducible — take the identity/reset path (1), don't enqueue
+        toward a peer that no longer exists."""
+        if self._ps is None:
+            return size()
+        if max(self._ps.ranks, default=0) >= size():
+            return 1
+        return self._ps.size()
 
     def _hvd_reset(self) -> None:
         """Drop in-flight collective state after a failure (elastic
@@ -498,7 +641,7 @@ class _DistributedOptimizer:
         return hook
 
     def _enqueue(self, p):
-        if size() <= 1:
+        if self._eff_size() <= 1:
             # World shrank to one process (elastic): hooks stay registered
             # but there is nothing to reduce — and step()'s synchronize
             # block is skipped, so an enqueue here would leak a handle
@@ -522,15 +665,16 @@ class _DistributedOptimizer:
             return
         wire, ctx = self._compression.compress(grad)
         h = _world().allreduce_async_(
-            _np_of(wire), name=f"grad.{self._param_name(p)}", op=self._op)
+            _np_of(wire), name=f"grad.{self._param_name(p)}", op=self._op,
+            process_set_id=_ps_id(self._ps))
         self._handles[p] = (h, ctx, wire.dtype)
 
     def step(self, closure=None):
-        if size() <= 1 and (self._handles or self._acc):
+        if self._eff_size() <= 1 and (self._handles or self._acc):
             # State from before an elastic shrink is unsynchronizable
             # (handles) or belongs to a dead world (accumulators).
             self._hvd_reset()
-        if size() > 1:
+        if self._eff_size() > 1:
             if self._bpps > 1:
                 self._pass_count += 1
                 if self._pass_count % self._bpps != 0:
@@ -544,7 +688,8 @@ class _DistributedOptimizer:
                             acc / self._bpps)
                         h = _world().allreduce_async_(
                             _np_of(wire),
-                            name=f"grad.{self._param_name(p)}", op=self._op)
+                            name=f"grad.{self._param_name(p)}", op=self._op,
+                            process_set_id=_ps_id(self._ps))
                         self._handles[p] = (h, ctx, wire.dtype)
             for p, (h, ctx, wire_dtype) in list(self._handles.items()):
                 out = np.asarray(_world().synchronize(h))
@@ -560,13 +705,16 @@ class _DistributedOptimizer:
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
-                         op: str = Average):
+                         op: str = Average,
+                         process_set: ProcessSet | None = None):
     """Wrap a torch optimizer with gradient allreduce hooks (reference:
-    ``hvd.DistributedOptimizer``)."""
+    ``hvd.DistributedOptimizer``). ``process_set`` scopes the gradient
+    averaging to a subset of processes (members only construct/step)."""
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
+        process_set=process_set,
     )
 
 
@@ -585,4 +733,5 @@ __all__ = [
     "reducescatter", "reducescatter_async", "barrier", "join",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "DistributedOptimizer",
+    "ProcessSet", "add_process_set", "global_process_set",
 ]

@@ -185,6 +185,79 @@ class TestMultiProcess:
         assert any("torch-async rank0 ok" in l for l in lines), lines
         assert any("torch-async rank1 ok" in l for l in lines), lines
 
+    def test_e2e_process_sets(self, tmp_path):
+        """process_set= scoping (reference contract): two disjoint 2-rank
+        sets reduce concurrently in a 4-process world; a subset-scoped
+        DistributedOptimizer averages gradients only within the set."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = tmp_path / "torch_ps_worker.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            + textwrap.dedent("""
+            import numpy as np
+            import torch
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            r = hvd.rank()
+            assert hvd.size() == 4
+            evens = hvd.add_process_set([0, 2])
+            odds = hvd.add_process_set([1, 3])
+            mine = evens if r % 2 == 0 else odds
+            assert mine.included() and mine.size() == 2
+            assert mine.rank() == r // 2
+
+            # scoped allreduce: averages within my set only
+            out = hvd.allreduce(torch.tensor([float(r)]), op=hvd.Sum,
+                                name="ps.ar", process_set=mine)
+            expect = {0: 2.0, 2: 2.0, 1: 4.0, 3: 4.0}[r]
+            assert float(out[0]) == expect, (r, out)
+
+            # scoped ragged allgather
+            ag = hvd.allgather(torch.full((r + 1, 1), float(r)),
+                               name="ps.ag", process_set=mine)
+            rows = {0: 4, 2: 4, 1: 6, 3: 6}[r]  # (0+1)+(2+1) / (1+1)+(3+1)
+            assert ag.shape == (rows, 1), ag.shape
+
+            # scoped broadcast (root_rank is GLOBAL)
+            root = 0 if r % 2 == 0 else 1
+            b = hvd.broadcast(torch.tensor([float(r + 10)]), root,
+                              name="ps.b", process_set=mine)
+            assert float(b[0]) == float(root + 10), b
+
+            # subset-scoped optimizer: grads averaged within the set
+            w = torch.nn.Parameter(torch.tensor([0.0]))
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD([w], lr=1.0),
+                named_parameters=[("w", w)], process_set=mine)
+            loss = w * float(r + 1)   # grad = r+1
+            loss.backward()
+            opt.step()
+            # evens: avg(1,3)=2 -> w=-2 ; odds: avg(2,4)=3 -> w=-3
+            expect_w = -2.0 if r % 2 == 0 else -3.0
+            assert abs(float(w) - expect_w) < 1e-6, (r, float(w))
+
+            # reducescatter on a subset: clear rejection
+            try:
+                hvd.reducescatter(torch.ones(2, 2), process_set=mine)
+                raise AssertionError("expected ValueError")
+            except ValueError as e:
+                assert "non-global" in str(e)
+            print("torch-ps rank%d ok" % r)
+            """)
+        )
+        args = parse_args(["-np", "4", "--cpu-mode", str(script)])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        for i in range(4):
+            assert any(f"torch-ps rank{i} ok" in l for l in lines), lines
+
     def test_e2e_hooks_and_lockstep(self, tmp_path):
         from horovod_tpu.runner.launch import (
             parse_args, run_static, settings_from_args,
