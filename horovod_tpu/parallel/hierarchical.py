@@ -195,7 +195,33 @@ def _default_native_world():
                 "native runtime's coordinator port) in a multi-process world"
             )
         _host_world = NativeWorld(proc_id, nprocs, addr, port or 29500)
+        _register_atexit_shutdown()
     return _host_world
+
+
+_atexit_registered = False
+
+
+def _register_atexit_shutdown() -> None:
+    """Shut the native world down gracefully at interpreter exit: the C
+    runtime's shutdown is NEGOTIATED (all ranks agree before the loop
+    exits), so an early-exiting process drains cleanly instead of peers
+    logging 'Connection reset by peer' at teardown."""
+    global _atexit_registered
+    if _atexit_registered:
+        return
+    _atexit_registered = True
+    import atexit
+
+    def _shutdown():
+        w = _host_world
+        if w is not None and w.alive:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+
+    atexit.register(_shutdown)
 
 
 def _exchange_native_endpoint(proc_id: int, fallback_port: int):

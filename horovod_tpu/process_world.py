@@ -49,3 +49,100 @@ def shutdown_native_world() -> None:
     if hierarchical._host_world is not None:
         hierarchical._host_world.shutdown()
         hierarchical._host_world = None
+
+
+# -- Process sets shared by the host-framework surfaces ----------------------
+# (parity: horovod/common/process_sets.py; torch/TF/keras all see the same
+# sets — the reference's sets are likewise framework-agnostic)
+
+
+class ProcessSet:
+    """A named subset of process ranks; host-surface collectives accept
+    ``process_set=`` to run inside it (members only call — reference
+    contract). ``process_set_id`` 0 is the global set; subset ids are
+    resolved lazily PER NATIVE WORLD (an elastic restart recreates the
+    world — ids must not dangle across it)."""
+
+    def __init__(self, ranks, process_set_id: int = -1):
+        self.ranks = sorted({int(r) for r in ranks})
+        self.process_set_id = process_set_id
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank WITHIN the set (raises for non-members)."""
+        me = rank()
+        if me not in self.ranks:
+            raise ValueError(
+                f"process {me} is not a member of set {self.ranks}")
+        return self.ranks.index(me)
+
+    def included(self) -> bool:
+        return rank() in self.ranks
+
+    def __repr__(self):
+        return f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})"
+
+
+class _GlobalProcessSet(ProcessSet):
+    """Lazy world set: rank list materializes from the live world size."""
+
+    def __init__(self):
+        self.process_set_id = 0
+
+    @property
+    def ranks(self):
+        return list(range(size()))
+
+
+global_process_set = _GlobalProcessSet()
+
+_ps_registry: list = []  # creation order (the collective contract)
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Create a subset of ranks (collective: every process must call
+    with the same sets in the same order; idempotent per rank list).
+    Parity: ``hvd.add_process_set`` on the host surfaces."""
+    ranks = sorted({int(r) for r in ranks})
+    bad = [r for r in ranks if r < 0 or r >= size()]
+    if bad:
+        raise ValueError(f"ranks {bad} out of range for world size {size()}")
+    ps = ProcessSet(ranks)
+    _ps_registry.append(ps)
+    if size() > 1:
+        resolve_ps_id(ps)  # resolve against the live world now
+    return ps
+
+
+def resolve_ps_id(process_set) -> int:
+    """Native set id of ``process_set`` in the CURRENT world.
+
+    Registration happens lazily per world, for ALL created sets in
+    creation order — add_process_set is collective and ordered, so the
+    native ids agree across ranks no matter which set a rank touches
+    first, and a recreated (elastic) world re-registers cleanly instead
+    of dangling old ids."""
+    if process_set is None or process_set.process_set_id == 0:
+        return 0
+    from .parallel.hierarchical import _default_native_world
+
+    w = _default_native_world()
+    cache = getattr(w, "_host_ps_map", None)
+    if cache is None:
+        cache = w._host_ps_map = {}
+    key = tuple(process_set.ranks)
+    if key in cache:
+        process_set.process_set_id = cache[key]
+        return cache[key]
+    for ps in _ps_registry:
+        k = tuple(ps.ranks)
+        if k not in cache:
+            cache[k] = w.register_process_set(ps.ranks)
+        ps.process_set_id = cache[k]
+    if key not in cache:
+        raise ValueError(
+            f"process set {process_set.ranks} was not created via "
+            "add_process_set")
+    return cache[key]

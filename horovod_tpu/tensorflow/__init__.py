@@ -82,6 +82,16 @@ def _world():
     return _default_native_world()
 
 
+# Process sets: shared host-surface implementation (same sets as the
+# torch surface — the reference's sets are framework-agnostic too).
+from ..process_world import (  # noqa: E402
+    ProcessSet,
+    add_process_set,
+    global_process_set,
+)
+from ..process_world import resolve_ps_id as _ps_id  # noqa: E402
+
+
 def _np(tensor) -> np.ndarray:
     if isinstance(tensor, np.ndarray):
         return tensor
@@ -94,12 +104,6 @@ def _np(tensor) -> np.ndarray:
         # here unconditionally.
         tensor = tf.convert_to_tensor(tensor)
     return tensor.numpy() if hasattr(tensor, "numpy") else np.asarray(tensor)
-
-
-def _eager_allreduce_np(x: np.ndarray, name, op) -> np.ndarray:
-    if size() <= 1:
-        return x
-    return np.asarray(_world().allreduce(x, name=name, op=op))
 
 
 def _in_graph(tensor) -> bool:
@@ -145,68 +149,95 @@ def _allgather_object_host(obj):
 _agobj_counter = 0
 
 
-def allreduce(tensor, op: str = Average, name: str | None = None):
-    """Reduce a TF tensor across all processes; every process gets the
+def allreduce(tensor, op: str = Average, name: str | None = None,
+              process_set: ProcessSet | None = None):
+    """Reduce a TF tensor across the process set; every member gets the
     result. Parity: ``hvd.allreduce`` (tensorflow flavor). Works eagerly
     and under ``tf.function`` (the collective becomes a py_function host
     op — it is a host-side exchange either way)."""
     if _in_graph(tensor):
-        return _graph_wrap(tensor,
-                           lambda t: allreduce(t, op=op, name=name))
+        return _graph_wrap(
+            tensor,
+            lambda t: allreduce(t, op=op, name=name,
+                                process_set=process_set))
     x = _np(tensor)
-    out = _eager_allreduce_np(x, name, op)
+    if size() <= 1:
+        return tf.convert_to_tensor(x)
+    out = np.asarray(_world().allreduce(
+        x, name=name, op=op, process_set_id=_ps_id(process_set)))
     return tf.convert_to_tensor(out)
 
 
 def grouped_allreduce(tensors: Sequence[Any], op: str = Average,
-                      name: str | None = None):
+                      name: str | None = None,
+                      process_set: ProcessSet | None = None):
     """Allreduce a list as one atomic fused native collective."""
     if size() <= 1:
         return [tf.identity(t) for t in tensors]
     outs = _world().grouped_allreduce(
-        [_np(t) for t in tensors], name=name, op=op
+        [_np(t) for t in tensors], name=name, op=op,
+        process_set_id=_ps_id(process_set)
     )
     return [tf.convert_to_tensor(o) for o in outs]
 
 
-def allgather(tensor, name: str | None = None):
-    """Concatenate each process's tensor along axis 0 on every process;
+def allgather(tensor, name: str | None = None,
+              process_set: ProcessSet | None = None):
+    """Concatenate each member's tensor along axis 0 on every member;
     per-rank dim-0 sizes may differ (reference contract)."""
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
     return tf.convert_to_tensor(
-        np.asarray(_world().allgather_v(x, name=name)))
+        np.asarray(_world().allgather_v(
+            x, name=name, process_set_id=_ps_id(process_set))))
 
 
-def broadcast(tensor, root_rank: int, name: str | None = None):
-    """Broadcast ``root_rank``'s tensor to every process."""
+def broadcast(tensor, root_rank: int, name: str | None = None,
+              process_set: ProcessSet | None = None):
+    """Broadcast ``root_rank``'s tensor to every member (``root_rank`` is
+    GLOBAL, also on subsets — reference contract)."""
     if _in_graph(tensor):
-        return _graph_wrap(tensor,
-                           lambda t: broadcast(t, root_rank, name=name))
+        return _graph_wrap(
+            tensor,
+            lambda t: broadcast(t, root_rank, name=name,
+                                process_set=process_set))
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
     return tf.convert_to_tensor(
-        np.asarray(_world().broadcast(x, root_rank, name=name))
+        np.asarray(_world().broadcast(
+            x, root_rank, name=name, process_set_id=_ps_id(process_set)))
     )
 
 
-def alltoall(tensor, name: str | None = None):
+def alltoall(tensor, name: str | None = None,
+             process_set: ProcessSet | None = None):
     """Scatter dim-0 splits of ``tensor`` to every rank and gather theirs
     (even splits; parity: ``hvd.alltoall`` tensorflow flavor)."""
     if _in_graph(tensor):
-        return _graph_wrap(tensor, lambda t: alltoall(t, name=name))
+        return _graph_wrap(
+            tensor,
+            lambda t: alltoall(t, name=name, process_set=process_set))
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
-    out = np.asarray(_world().alltoall(x, name=name))
+    out = np.asarray(_world().alltoall(
+        x, name=name, process_set_id=_ps_id(process_set)))
     return tf.convert_to_tensor(out.reshape(x.shape))
 
 
-def reducescatter(tensor, op: str = Average, name: str | None = None):
+def reducescatter(tensor, op: str = Average, name: str | None = None,
+                  process_set: ProcessSet | None = None):
     """Reduce across ranks (default Average — reference parity, same as
     the JAX surface), return this rank's dim-0 shard."""
+    if process_set is not None and process_set.process_set_id != 0:
+        # checked WITHOUT resolving: _ps_id would spin up the native
+        # runtime as a side effect just to raise
+        raise ValueError(
+            "reducescatter on a non-global process set is not supported "
+            "by the native runtime; reduce on the global set or use "
+            "allreduce + local slice")
     if _in_graph(tensor):
         return _graph_wrap(
             tensor, lambda t: reducescatter(t, op=op, name=name),
@@ -217,6 +248,15 @@ def reducescatter(tensor, op: str = Average, name: str | None = None):
         return tf.convert_to_tensor(x)
     out = np.asarray(_world().reducescatter(x, name=name, op=op))
     return tf.convert_to_tensor(out)
+
+
+def barrier() -> None:
+    """Block until every process reaches the barrier (parity:
+    ``hvd.barrier``). Call before exiting when ranks finish uneven work —
+    a rank's exit shuts the shared world down (reference semantics), so
+    peers mid-collective would otherwise see 'runtime shut down'."""
+    if size() > 1:
+        _world().barrier()
 
 
 def join(timeout_s: float = 600.0) -> int:
@@ -307,18 +347,21 @@ class DistributedGradientTape:
 
     def __init__(self, tape: "tf.GradientTape", op: str = Average,
                  num_groups: int = 0, compression=Compression.none,
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False,
+                 process_set: ProcessSet | None = None):
         self._tape = tape
         self._op = op
         self._num_groups = num_groups
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
+        self._ps = process_set
         self._step = 0
 
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources,
                                     output_gradients=output_gradients)
-        if size() <= 1:
+        eff = self._ps.size() if self._ps is not None else size()
+        if size() <= 1 or eff <= 1:
             return grads
         self._step += 1
         w = _world()
@@ -342,8 +385,10 @@ class DistributedGradientTape:
         # (the reference's steady-state design).
         flat = [(i, g) for i, g in enumerate(out) if g is not None]
         wires = [self._compression.compress(_np(g)) for _, g in flat]
+        psid = _ps_id(self._ps)
         handles = [
-            w.allreduce_async_(arr, name=f"dgt.grad.{i}", op=self._op)
+            w.allreduce_async_(arr, name=f"dgt.grad.{i}", op=self._op,
+                               process_set_id=psid)
             for (i, _), (arr, _) in zip(flat, wires)
         ]
         for (i, g), h, (_, ctx) in zip(flat, handles, wires):
@@ -364,7 +409,8 @@ __all__ = [
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
-    "alltoall", "reducescatter", "join",
+    "alltoall", "reducescatter", "barrier", "join",
     "broadcast_variables", "DistributedGradientTape", "Compression",
     "SyncBatchNormalization",
+    "ProcessSet", "add_process_set", "global_process_set",
 ]
