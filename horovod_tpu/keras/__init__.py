@@ -35,16 +35,24 @@ allreduce = hvd_tf.allreduce
 allgather = hvd_tf.allgather
 broadcast = hvd_tf.broadcast
 broadcast_variables = hvd_tf.broadcast_variables
+Compression = hvd_tf.Compression
+ProcessSet = hvd_tf.ProcessSet
+add_process_set = hvd_tf.add_process_set
+global_process_set = hvd_tf.global_process_set
 
 
 def DistributedOptimizer(optimizer, op: str = Average,
-                         backward_passes_per_step: int = 1):
+                         backward_passes_per_step: int = 1,
+                         compression=None,
+                         process_set=None):
     """Wrap a Keras optimizer: gradients are allreduce-averaged across
     processes before the update (reference: ``hvd.DistributedOptimizer``
     keras flavor). ``backward_passes_per_step > 1`` accumulates that many
-    calls locally before one fused collective + update.
+    calls locally before one fused collective + update;
+    ``compression=hvd.Compression.fp16/bf16`` halves the wire;
+    ``process_set=`` scopes the averaging to a subset of processes.
     """
-
+    compression = compression or hvd_tf.Compression.none
     base = type(optimizer)
 
     class _Distributed(base):  # type: ignore[valid-type, misc]
@@ -52,7 +60,9 @@ def DistributedOptimizer(optimizer, op: str = Average,
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
-            if hvd_tf.size() <= 1 or not gv:
+            eff = (process_set.size() if process_set is not None
+                   else hvd_tf.size())
+            if hvd_tf.size() <= 1 or eff <= 1 or not gv:
                 return super().apply_gradients(gv, *args, **kwargs)
             acc = getattr(self, "_hvd_acc", None)
             self._hvd_count = getattr(self, "_hvd_count", 0) + 1
@@ -69,16 +79,12 @@ def DistributedOptimizer(optimizer, op: str = Average,
                 self._hvd_acc = None
                 gv = [(a / backward_passes_per_step, v)
                       for a, (_, v) in zip(acc, gv)]
-            w = hvd_tf._world()
-            handles = [
-                w.allreduce_async_(hvd_tf._np(g), name=f"keras.grad.{i}",
-                                   op=op)
-                for i, (g, _) in enumerate(gv)
-            ]
+            reduced_arrays = hvd_tf._reduce_arrays(
+                [hvd_tf._np(g) for g, _ in gv], op,
+                hvd_tf._ps_id(process_set), compression, "keras.grad")
             reduced = [
-                (tf.cast(tf.convert_to_tensor(np.asarray(w.synchronize(h))),
-                         g.dtype), v)
-                for h, (g, v) in zip(handles, gv)
+                (tf.cast(tf.convert_to_tensor(a), g.dtype), v)
+                for a, (g, v) in zip(reduced_arrays, gv)
             ]
             return super().apply_gradients(reduced, *args, **kwargs)
 
@@ -214,6 +220,7 @@ from . import callbacks  # noqa: E402,F401  (reference: hvd.callbacks.*)
 __all__ = [
     "Average", "Sum", "init", "shutdown", "size", "rank", "local_rank",
     "allreduce", "allgather", "broadcast", "broadcast_variables",
+    "Compression", "ProcessSet", "add_process_set", "global_process_set",
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
     "MetricAverageCallback", "LearningRateWarmupCallback", "callbacks",
 ]

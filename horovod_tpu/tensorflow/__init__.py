@@ -280,6 +280,24 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(tf.convert_to_tensor(np.asarray(out).reshape(v.shape)))
 
 
+def _reduce_arrays(arrays, op, process_set_id, compression, name_prefix):
+    """Shared wire protocol for gradient reduction on the host plane:
+    compress -> async enqueue (stable names; same-cycle arrival fuses,
+    steady state rides the response cache) -> synchronize -> decompress.
+    Used by DistributedGradientTape and the Keras optimizer wrapper."""
+    w = _world()
+    wires = [compression.compress(a) for a in arrays]
+    handles = [
+        w.allreduce_async_(arr, name=f"{name_prefix}.{i}", op=op,
+                           process_set_id=process_set_id)
+        for i, (arr, _) in enumerate(wires)
+    ]
+    return [
+        compression.decompress(np.asarray(w.synchronize(h)), ctx)
+        for h, (_, ctx) in zip(handles, wires)
+    ]
+
+
 class _NoneCompressor:
     @staticmethod
     def compress(arr: np.ndarray):
@@ -384,16 +402,10 @@ class DistributedGradientTape:
         # on the signatures ride the response-cache bitvector fast path
         # (the reference's steady-state design).
         flat = [(i, g) for i, g in enumerate(out) if g is not None]
-        wires = [self._compression.compress(_np(g)) for _, g in flat]
-        psid = _ps_id(self._ps)
-        handles = [
-            w.allreduce_async_(arr, name=f"dgt.grad.{i}", op=self._op,
-                               process_set_id=psid)
-            for (i, _), (arr, _) in zip(flat, wires)
-        ]
-        for (i, g), h, (_, ctx) in zip(flat, handles, wires):
-            r = self._compression.decompress(
-                np.asarray(w.synchronize(h)), ctx)
+        reduced = _reduce_arrays(
+            [_np(g) for _, g in flat], self._op, _ps_id(self._ps),
+            self._compression, "dgt.grad")
+        for (i, g), r in zip(flat, reduced):
             r = tf.convert_to_tensor(r)
             out[i] = tf.cast(r, g.dtype) if r.dtype != g.dtype else r
         return out[0] if single else out

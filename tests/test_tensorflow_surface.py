@@ -181,6 +181,15 @@ class TestMultiProcess:
             opt.apply_gradients(zip(grads, [w]))
             # grad = 3 on both ranks -> averaged 3 -> w -= 0.3
             assert np.allclose(w.numpy(), float(r) - 0.3), w.numpy()
+            # keras wrapper with bf16 wire compression: same averaged step
+            opt2 = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=0.1),
+                compression=hvdk.Compression.bf16)
+            w2 = tf.Variable([float(r)])
+            with tf.GradientTape() as t7:
+                l7 = tf.reduce_sum(w2 * 3.0)
+            opt2.apply_gradients(zip(t7.gradient(l7, [w2]), [w2]))
+            assert np.allclose(w2.numpy(), float(r) - 0.3, atol=1e-2)
             print("tf-e2e rank%d ok" % r)
             """,
         )
@@ -232,6 +241,20 @@ class TestMultiProcess:
                               0 if r % 2 == 0 else 1,
                               name="tfps.b", process_set=mine)
             assert float(b[0]) == (20.0 if r % 2 == 0 else 21.0), b
+
+            # keras optimizer wrapper scoped to the subset: grads average
+            # within the set (evens avg(1,3)=2; odds avg(2,4)=3), lr=1.
+            import horovod_tpu.keras as hvdk
+            opt = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0),
+                process_set=mine)
+            w = tf.Variable([0.0])
+            with tf.GradientTape() as kt:
+                kl = tf.reduce_sum(w * float(r + 1))
+            opt.apply_gradients(zip(kt.gradient(kl, [w]), [w]))
+            expect_w = -2.0 if r % 2 == 0 else -3.0
+            assert np.allclose(w.numpy(), expect_w), (r, w.numpy())
+
             # subset work is uneven across sets: a global barrier keeps
             # the earliest-finishing rank from shutting the world down
             # under a peer's in-flight subset op (reference usage).
