@@ -150,21 +150,35 @@ _agobj_counter = 0
 
 
 def allreduce(tensor, op: str = Average, name: str | None = None,
-              process_set: ProcessSet | None = None):
+              process_set: ProcessSet | None = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Reduce a TF tensor across the process set; every member gets the
-    result. Parity: ``hvd.allreduce`` (tensorflow flavor). Works eagerly
-    and under ``tf.function`` (the collective becomes a py_function host
-    op — it is a host-side exchange either way)."""
+    result. Parity: ``hvd.allreduce`` (tensorflow flavor), incl. the
+    pre/post scale factors (applied inside the fused native op). Works
+    eagerly and under ``tf.function`` (the collective becomes a
+    py_function host op — it is a host-side exchange either way)."""
     if _in_graph(tensor):
         return _graph_wrap(
             tensor,
             lambda t: allreduce(t, op=op, name=name,
-                                process_set=process_set))
+                                process_set=process_set,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor))
     x = _np(tensor)
     if size() <= 1:
+        scale = prescale_factor * postscale_factor
+        if scale != 1.0:
+            # Single-process analog of the native ScaleBuffer: floats
+            # scale in dtype, integers scale in double/round/cast back.
+            if np.issubdtype(x.dtype, np.floating):
+                x = (x * scale).astype(x.dtype)
+            else:
+                x = np.rint(x.astype(np.float64) * scale).astype(x.dtype)
         return tf.convert_to_tensor(x)
     out = np.asarray(_world().allreduce(
-        x, name=name, op=op, process_set_id=_ps_id(process_set)))
+        x, name=name, op=op, process_set_id=_ps_id(process_set),
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
     return tf.convert_to_tensor(out)
 
 

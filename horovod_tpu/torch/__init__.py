@@ -139,6 +139,16 @@ def _np_of(t: "torch.Tensor") -> np.ndarray:
     return t.detach().contiguous().cpu().numpy().copy()
 
 
+def _scaled(t: "torch.Tensor", scale: float) -> "torch.Tensor":
+    """Single-process analog of the native op's ScaleBuffer: floats scale
+    in dtype; integers scale in double, round, cast back."""
+    if scale == 1.0:
+        return t
+    if t.is_floating_point():
+        return (t * scale).to(t.dtype)
+    return torch.round(t.double() * scale).to(t.dtype)
+
+
 def _register_async(native_handle_or_none, kind, payload):
     """Register a handle in the ctx table. Single-process worlds (and
     composite ops) get a synthetic negative handle that completes
@@ -153,28 +163,43 @@ def _register_async(native_handle_or_none, kind, payload):
 
 def allreduce_async_(tensor, average: bool | None = None,
                      name: str | None = None, op: str | None = None,
-                     process_set: ProcessSet | None = None) -> int:
+                     process_set: ProcessSet | None = None,
+                     prescale_factor: float = 1.0,
+                     postscale_factor: float = 1.0) -> int:
     """In-place-style async allreduce; returns a handle (reference:
-    ``hvd.allreduce_async_``). In a single-process world completes
-    immediately with a synthetic handle."""
+    ``hvd.allreduce_async_``). ``prescale_factor``/``postscale_factor``
+    scale the tensor before/after the reduction (reference contract —
+    the native runtime applies them inside the fused op). In a
+    single-process world completes immediately with a synthetic handle."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
+        scale = prescale_factor * postscale_factor
+        if scale != 1.0:
+            tensor.data.copy_(_scaled(tensor, scale))
         return _register_async(None, "identity", tensor)
     h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op,
-                                  process_set_id=_ps_id(process_set))
+                                  process_set_id=_ps_id(process_set),
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor)
     return _register_async(h, "allreduce", tensor)
 
 
 def allreduce_async(tensor, average: bool | None = None,
                     name: str | None = None, op: str | None = None,
-                    process_set: ProcessSet | None = None) -> int:
+                    process_set: ProcessSet | None = None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> int:
     """Out-of-place async allreduce (reference: ``hvd.allreduce_async``);
     ``synchronize`` returns a NEW tensor."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
-        return _register_async(None, "identity", tensor.clone())
+        return _register_async(
+            None, "identity",
+            _scaled(tensor.clone(), prescale_factor * postscale_factor))
     h = _world().allreduce_async_(_np_of(tensor), name=name, op=reduce_op,
-                                  process_set_id=_ps_id(process_set))
+                                  process_set_id=_ps_id(process_set),
+                                  prescale_factor=prescale_factor,
+                                  postscale_factor=postscale_factor)
     return _register_async(h, "out", tensor)
 
 
@@ -269,16 +294,22 @@ def reducescatter_async(tensor, name: str | None = None,
 def grouped_allreduce_async(tensors: Sequence[Any],
                             name: str | None = None,
                             op: str | None = None,
-                            process_set: ProcessSet | None = None) -> int:
+                            process_set: ProcessSet | None = None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0) -> int:
     """Atomic grouped allreduce; ONE handle for the whole group
     (reference contract) — ``synchronize`` returns the list of results."""
     reduce_op = op or Average
     if size() <= 1:
+        scale = prescale_factor * postscale_factor
         return _register_async(
-            None, "group_identity", [t.clone() for t in tensors])
+            None, "group_identity",
+            [_scaled(t.clone(), scale) for t in tensors])
     native = _world().grouped_allreduce_async(
         [_np_of(t) for t in tensors], name=name, op=reduce_op,
-        process_set_id=_ps_id(process_set))
+        process_set_id=_ps_id(process_set),
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
     return _register_async(None, "group", (list(tensors), native))
 
 
@@ -334,16 +365,19 @@ def poll(handle: int) -> bool:
 def allreduce(tensor, average: bool | None = None, name: str | None = None,
               op: str | None = None,
               compression: Any = Compression.none,
-              process_set: ProcessSet | None = None):
+              process_set: ProcessSet | None = None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Synchronous allreduce returning a NEW tensor (reference semantics:
     ``hvd.allreduce`` is out-of-place; ``allreduce_`` is in-place)."""
     reduce_op = op or (Sum if average is False else Average)
     if size() <= 1:
-        return tensor.clone()
+        return _scaled(tensor.clone(), prescale_factor * postscale_factor)
     wire, ctx = compression.compress(tensor)
     out = np.asarray(
         _world().allreduce(_np_of(wire), name=name, op=reduce_op,
-                           process_set_id=_ps_id(process_set))
+                           process_set_id=_ps_id(process_set),
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
     )
     result = torch.from_numpy(out.reshape(tuple(wire.shape))).to(wire.dtype)
     return compression.decompress(result, ctx)
@@ -351,17 +385,24 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
 
 def allreduce_(tensor, average: bool | None = None,
                name: str | None = None, op: str | None = None,
-               process_set: ProcessSet | None = None):
+               process_set: ProcessSet | None = None,
+               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     h = allreduce_async_(tensor, average=average, name=name, op=op,
-                         process_set=process_set)
+                         process_set=process_set,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
     return synchronize(h)
 
 
 def grouped_allreduce(tensors: Sequence[Any], name: str | None = None,
                       op: str | None = None,
-                      process_set: ProcessSet | None = None) -> list:
+                      process_set: ProcessSet | None = None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0) -> list:
     return synchronize(grouped_allreduce_async(
-        tensors, name=name, op=op, process_set=process_set))
+        tensors, name=name, op=op, process_set=process_set,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
 
 
 def allgather(tensor, name: str | None = None,
@@ -487,10 +528,16 @@ class _DistributedOptimizer:
     def __init__(self, optimizer, named_parameters=None,
                  compression=Compression.none,
                  backward_passes_per_step: int = 1, op: str = Average,
-                 process_set: ProcessSet | None = None):
+                 process_set: ProcessSet | None = None,
+                 gradient_predivide_factor: float = 1.0):
         self._opt = optimizer
         self._compression = compression
         self._bpps = max(1, backward_passes_per_step)
+        if gradient_predivide_factor != 1.0 and op != Average:
+            raise ValueError(
+                "gradient_predivide_factor only applies with op=Average "
+                "(reference contract)")
+        self._predivide = gradient_predivide_factor
         self._op = op
         self._ps = process_set
         self._pass_count = 0
@@ -583,10 +630,23 @@ class _DistributedOptimizer:
                 else acc + grad
             return
         wire, ctx = self._compression.compress(grad)
-        h = _world().allreduce_async_(
-            _np_of(wire), name=f"grad.{self._param_name(p)}", op=self._op,
-            process_set_id=_ps_id(self._ps))
+        h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
         self._handles[p] = (h, ctx, wire.dtype)
+
+    def _enqueue_wire(self, wire, name: str) -> int:
+        """Reduction split per the reference's gradient_predivide_factor:
+        grads scale by 1/f before a SUM reduction and f/size after, so
+        the result is still the average but intermediate magnitudes are
+        controlled (fp16 overflow headroom)."""
+        if self._predivide != 1.0:
+            return _world().allreduce_async_(
+                _np_of(wire), name=name, op=Sum,
+                process_set_id=_ps_id(self._ps),
+                prescale_factor=1.0 / self._predivide,
+                postscale_factor=self._predivide / self._eff_size())
+        return _world().allreduce_async_(
+            _np_of(wire), name=name, op=self._op,
+            process_set_id=_ps_id(self._ps))
 
     def step(self, closure=None):
         if self._eff_size() <= 1 and (self._handles or self._acc):
@@ -605,10 +665,8 @@ class _DistributedOptimizer:
                             continue
                         wire, ctx = self._compression.compress(
                             acc / self._bpps)
-                        h = _world().allreduce_async_(
-                            _np_of(wire),
-                            name=f"grad.{self._param_name(p)}", op=self._op,
-                            process_set_id=_ps_id(self._ps))
+                        h = self._enqueue_wire(
+                            wire, f"grad.{self._param_name(p)}")
                         self._handles[p] = (h, ctx, wire.dtype)
             for p, (h, ctx, wire_dtype) in list(self._handles.items()):
                 out = np.asarray(_world().synchronize(h))
@@ -625,15 +683,19 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step: int = 1,
                          op: str = Average,
-                         process_set: ProcessSet | None = None):
+                         process_set: ProcessSet | None = None,
+                         gradient_predivide_factor: float = 1.0):
     """Wrap a torch optimizer with gradient allreduce hooks (reference:
     ``hvd.DistributedOptimizer``). ``process_set`` scopes the gradient
-    averaging to a subset of processes (members only construct/step)."""
+    averaging to a subset of processes (members only construct/step);
+    ``gradient_predivide_factor=f`` splits the averaging into 1/f before
+    and f/size after the sum (fp16 headroom, reference contract)."""
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
         process_set=process_set,
+        gradient_predivide_factor=gradient_predivide_factor,
     )
 
 

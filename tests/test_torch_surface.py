@@ -168,6 +168,24 @@ class TestMultiProcess:
             assert float(b[0]) == 0.0
             assert hvd.synchronize(h).shape == (2, 1)
 
+            # prescale/postscale ride the fused native op:
+            # sum over 2 ranks of 2*0.5 = 2, then *3 = 6
+            pre = hvd.allreduce(torch.tensor([2.0]), op=hvd.Sum,
+                                name="a.pre", prescale_factor=0.5,
+                                postscale_factor=3.0)
+            assert float(pre[0]) == 6.0, pre
+
+            # gradient_predivide_factor: 1/f presum, f/size post — the
+            # result must equal the plain average (grads r+1 -> 1.5).
+            wp = torch.nn.Parameter(torch.tensor([0.0]))
+            optp = hvd.DistributedOptimizer(
+                torch.optim.SGD([wp], lr=1.0),
+                named_parameters=[("wp", wp)],
+                gradient_predivide_factor=4.0)
+            (wp * float(r + 1)).sum().backward()
+            optp.step()
+            assert abs(float(wp) + 1.5) < 1e-6, float(wp)
+
             # unknown handle raises
             try:
                 hvd.synchronize(12345)
