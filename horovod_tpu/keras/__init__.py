@@ -58,6 +58,12 @@ def DistributedOptimizer(optimizer, op: str = Average,
     class _Distributed(base):  # type: ignore[valid-type, misc]
         _hvd_wrapped = True
 
+        def _hvd_reset(self):
+            """Drop local-accumulation state after an elastic failure (a
+            step that died mid-flight leaves a partial accumulator)."""
+            self._hvd_acc = None
+            self._hvd_count = 0
+
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv = list(grads_and_vars)
             eff = (process_set.size() if process_set is not None

@@ -146,3 +146,41 @@ def resolve_ps_id(process_set) -> int:
             f"process set {process_set.ranks} was not created via "
             "add_process_set")
     return cache[key]
+
+
+_bobj_host_counter = 0
+
+
+def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None):
+    """Pickle-broadcast an object from ``root_rank`` through the NATIVE
+    host data plane (two-phase: size header then payload).
+
+    This is the host-surface analog of ``functions.broadcast_object`` —
+    which rides jax.distributed and silently no-ops in hvdrun worker
+    processes (``jax.process_count()`` is 1 there). ``obj`` is only read
+    on the root; other ranks may pass None.
+    """
+    import pickle
+
+    import numpy as np
+
+    if size() <= 1:
+        return obj
+    global _bobj_host_counter
+    _bobj_host_counter += 1
+    from .parallel.hierarchical import _default_native_world
+
+    w = _default_native_world()
+    tag = name or f"host.bobj.{_bobj_host_counter}"
+    if rank() == root_rank:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    else:
+        payload = np.zeros(0, np.uint8)
+    n = int(np.asarray(
+        w.broadcast(np.array([payload.size], np.int64), root_rank,
+                    name=f"{tag}.sz"))[0])
+    buf = np.zeros(n, np.uint8)
+    if rank() == root_rank:
+        buf[:] = payload
+    out = np.asarray(w.broadcast(buf, root_rank, name=f"{tag}.data"))
+    return pickle.loads(out.tobytes())

@@ -15,8 +15,8 @@ import numpy as np
 
 from ..elastic.runner import run  # noqa: F401  (reference: hvd.elastic.run)
 from ..elastic.state import ExtrasState
-from ..functions import broadcast_object
-from . import broadcast_variables, size
+from ..process_world import broadcast_object_host, rank
+from . import size
 
 
 def _var_key(v) -> str:
@@ -52,22 +52,53 @@ class TensorFlowKerasState(ExtrasState):
         self.commit_extras()
         self.check_host_updates()
 
+    def _assign_opt_state(self, mapping: dict) -> None:
+        for v in self._opt_vars():
+            saved = mapping.get(_var_key(v))
+            if saved is not None:
+                v.assign(saved)
+
     def restore(self) -> None:
         if self.model is not None and self._saved_weights is not None:
             self.model.set_weights(self._saved_weights)
-        for v in self._opt_vars():
-            saved = self._saved_opt.get(_var_key(v))
-            if saved is not None:
-                v.assign(saved)
+        self._assign_opt_state(self._saved_opt)
+        if hasattr(self.optimizer, "_hvd_reset"):
+            # Drop the keras wrapper's local-accumulation state: a step
+            # that died mid-flight leaves a partial accumulator/count that
+            # would misalign backward_passes_per_step on the retry.
+            self.optimizer._hvd_reset()
         self.restore_extras()
 
     def sync(self) -> None:
         if size() <= 1:
             return
+        # Everything ships through the NATIVE host plane as object
+        # broadcasts (functions.broadcast_object rides jax.distributed and
+        # silently no-ops in hvdrun workers, where jax.process_count() is
+        # 1), and as ONE symmetric op per payload: a freshly joined worker
+        # may have an unbuilt model / no slot variables yet, so
+        # per-variable broadcasts would enqueue different op lists per
+        # rank and deadlock negotiation.
+        me = rank()
         if self.model is not None:
-            broadcast_variables(self.model.variables, root_rank=0)
-        opt_vars = self._opt_vars()
-        if opt_vars:
-            broadcast_variables(opt_vars, root_rank=0)
-        self.sync_extras(lambda o: broadcast_object(o, root_rank=0))
+            weights = (
+                [np.asarray(w) for w in self.model.get_weights()]
+                if me == 0 else None
+            )
+            weights = broadcast_object_host(weights, root_rank=0)
+            mine = self.model.get_weights()
+            if weights is not None and len(mine) == len(weights):
+                self.model.set_weights(weights)
+            # unbuilt receiver (no weights yet): its first build gets the
+            # values via the broadcast callback / next sync instead.
+        opt_state = (
+            {_var_key(v): np.asarray(v) for v in self._opt_vars()}
+            if me == 0 else None
+        )
+        opt_state = broadcast_object_host(opt_state, root_rank=0)
+        if opt_state:
+            # Slots the receiver doesn't have yet are recreated by its own
+            # first step; ones it has get rank 0's values.
+            self._assign_opt_state(opt_state)
+        self.sync_extras(lambda o: broadcast_object_host(o, root_rank=0))
         self.commit()

@@ -496,24 +496,10 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
 
 def broadcast_object(obj, root_rank: int = 0, name: str | None = None):
     """Pickle-broadcast an arbitrary object (reference:
-    ``hvd.broadcast_object``)."""
-    import pickle
+    ``hvd.broadcast_object``) — shared host-plane implementation."""
+    from ..process_world import broadcast_object_host
 
-    if size() <= 1:
-        return obj
-    global _bobj_counter
-    _bobj_counter += 1
-    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-    w = _world()
-    tag = name or f"bobj.{_bobj_counter}"
-    size_arr = np.array([payload.size], np.int64)
-    n = int(np.asarray(w.broadcast(size_arr, root_rank,
-                                   name=f"{tag}.sz"))[0])
-    buf = np.zeros(n, np.uint8)
-    if rank() == root_rank:
-        buf[:] = payload
-    out = np.asarray(w.broadcast(buf, root_rank, name=f"{tag}.data"))
-    return pickle.loads(out.tobytes())
+    return broadcast_object_host(obj, root_rank=root_rank, name=name)
 
 
 # -- DistributedOptimizer (parity: horovod/torch/optimizer.py) ---------------

@@ -282,3 +282,86 @@ class TestTorchElasticE2E:
         # The survivor ran some epochs in a 2-process world, then alone.
         assert any("np=2" in l for l in lines), lines
         assert any("host=127.0.0.1 epoch=5 np=1" in l for l in lines), lines
+
+
+class TestTensorFlowElasticE2E:
+    """Full-stack elastic recovery on the TF/Keras surface: a worker dies
+    mid-training; the survivor takes a HorovodInternalError in its next
+    collective, restores the last TensorFlowKerasState commit, re-forms
+    the world, and finishes alone (mirror of TestTorchElasticE2E)."""
+
+    @pytest.mark.slow
+    def test_worker_death_recovery_keras_state(self, tmp_path):
+        pytest.importorskip("tensorflow")
+        worker = tmp_path / "tf_elastic_worker.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys
+            sys.path.insert(0, {REPO_ROOT!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.keras as hvdk
+            from horovod_tpu.elastic import run as elastic_run
+            from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+            host = os.environ["HOROVOD_HOSTNAME"]
+            tmp = os.environ["TEST_TMP"]
+
+            tf.random.set_seed(0)
+            model = tf.keras.Sequential(
+                [tf.keras.layers.Dense(1, input_shape=(4,))])
+            opt = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=0.05, momentum=0.9))
+            state = TensorFlowKerasState(model=model, optimizer=opt,
+                                         epoch=0)
+
+            @elastic_run
+            def train(state):
+                while state.epoch < 5:
+                    if (host == "localhost" and state.epoch == 2
+                            and not os.path.exists(tmp + "/died")):
+                        open(tmp + "/died", "w").close()
+                        print("worker %s dying at epoch %d" % (
+                            host, state.epoch), flush=True)
+                        os._exit(1)
+                    x = np.random.RandomState(
+                        state.epoch).randn(8, 4).astype(np.float32)
+                    with tf.GradientTape() as tape:
+                        loss = tf.reduce_mean(model(tf.constant(x)) ** 2)
+                    opt.apply_gradients(zip(
+                        tape.gradient(loss, model.trainable_variables),
+                        model.trainable_variables))
+                    state.epoch += 1
+                    state.commit()
+                    print("host=%s epoch=%d np=%d loss=%.4f" % (
+                        host, state.epoch, hvdk.size(), float(loss)),
+                        flush=True)
+                return state.epoch
+
+            done = train(state)
+            print("host=%s finished at epoch %d" % (host, done),
+                  flush=True)
+        """))
+        script, _ = _write_discovery(tmp_path, LOCAL_ALIASES)
+        settings = Settings(
+            num_proc=2,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=True,
+            elastic=True,
+            min_np=1,
+            max_np=2,
+            discovery_script=script,
+            elastic_timeout=30.0,
+            env={"TEST_TMP": str(tmp_path)},
+        )
+        lines: list[str] = []
+        rc = run_elastic(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("dying at epoch 2" in l for l in lines), lines
+        assert any("finished at epoch 5" in l for l in lines), lines
+        assert any("np=2" in l for l in lines), lines
+        assert any("host=127.0.0.1 epoch=5 np=1" in l for l in lines), lines

@@ -270,6 +270,75 @@ class TestMultiProcess:
         for i in range(4):
             assert any(f"tfps rank{i} ok" in l for l in lines), lines
 
+    def test_keras_state_sync_flows_across_processes(self, tmp_path):
+        """TensorFlowKerasState.sync() must really move rank 0's model
+        weights, optimizer slots, and extras to other ranks through the
+        HOST plane (regression: it previously rode the jax.distributed
+        broadcast_object, which silently no-ops in hvdrun workers)."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = _worker_script(
+            tmp_path,
+            """
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.keras as hvdk
+            from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+            hvdk.init()
+            r = hvdk.rank()
+            tf.random.set_seed(123)  # same init everywhere
+            model = tf.keras.Sequential(
+                [tf.keras.layers.Dense(1, input_shape=(3,))])
+            opt = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(0.1, momentum=0.9))
+            state = TensorFlowKerasState(model=model, optimizer=opt,
+                                         epoch=0)
+            # one real step so momentum slots exist, then DIVERGE rank 1
+            x = tf.constant(np.ones((4, 3), np.float32))
+            with tf.GradientTape() as t:
+                loss = tf.reduce_mean(model(x) ** 2)
+            opt.apply_gradients(zip(
+                t.gradient(loss, model.trainable_variables),
+                model.trainable_variables))
+            state.epoch = 7 if r == 0 else 99
+            if r == 1:
+                model.set_weights(
+                    [w * 0 + 5.0 for w in model.get_weights()])
+                for v in opt.variables:
+                    try:
+                        v.assign(tf.ones_like(v) * 9.0)
+                    except Exception:
+                        pass
+            state.sync()
+            digest = float(sum(np.abs(w).sum()
+                               for w in model.get_weights()))
+            slots = float(sum(
+                np.abs(np.asarray(v)).sum() for v in opt.variables
+                if np.asarray(v).dtype.kind == "f"))
+            print("sync rank%d epoch %d digest %.6f slots %.6f"
+                  % (r, state.epoch, digest, slots), flush=True)
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        vals = {}
+        for line in lines:
+            if "sync rank" in line:
+                part = line.split("sync rank", 1)[1].split()
+                vals[int(part[0])] = (int(part[2]), float(part[4]),
+                                      float(part[6]))
+        assert set(vals) == {0, 1}, lines
+        # rank 1's divergent epoch/weights/slots were overwritten by rank 0's
+        assert vals[1][0] == 7, vals
+        assert vals[0][1] == pytest.approx(vals[1][1], abs=1e-5), vals
+        assert vals[0][2] == pytest.approx(vals[1][2], abs=1e-5), vals
+
     def test_sync_batch_norm_matches_full_batch(self, tmp_path):
         """Each rank holds half the batch; SyncBatchNormalization's
         training output and gradients must equal stock BatchNormalization
