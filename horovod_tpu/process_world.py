@@ -148,17 +148,28 @@ def resolve_ps_id(process_set) -> int:
     return cache[key]
 
 
-_bobj_host_counter = 0
+def _next_world_tag(w, kind: str) -> str:
+    """Per-WORLD auto-name counter. Module-global counters would survive
+    an elastic world re-formation in surviving processes while fresh
+    workers start at zero — and the controller pairs ops BY NAME, so
+    diverged counters deadlock the first post-rendezvous exchange."""
+    attr = f"_obj_tag_{kind}"
+    n = getattr(w, attr, 0) + 1
+    setattr(w, attr, n)
+    return f"host.{kind}.{n}"
 
 
-def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None):
+def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None,
+                          process_set=None):
     """Pickle-broadcast an object from ``root_rank`` through the NATIVE
     host data plane (two-phase: size header then payload).
 
     This is the host-surface analog of ``functions.broadcast_object`` —
     which rides jax.distributed and silently no-ops in hvdrun worker
     processes (``jax.process_count()`` is 1 there). ``obj`` is only read
-    on the root; other ranks may pass None.
+    on the root; other ranks may pass None. Callers on elastic
+    re-rendezvous paths should pass a STABLE ``name`` (old and new
+    workers' auto counters need not agree).
     """
     import pickle
 
@@ -166,21 +177,51 @@ def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None):
 
     if size() <= 1:
         return obj
-    global _bobj_host_counter
-    _bobj_host_counter += 1
     from .parallel.hierarchical import _default_native_world
 
     w = _default_native_world()
-    tag = name or f"host.bobj.{_bobj_host_counter}"
+    psid = resolve_ps_id(process_set)
+    tag = name or _next_world_tag(w, "bobj")
     if rank() == root_rank:
         payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
     else:
         payload = np.zeros(0, np.uint8)
     n = int(np.asarray(
         w.broadcast(np.array([payload.size], np.int64), root_rank,
-                    name=f"{tag}.sz"))[0])
+                    name=f"{tag}.sz", process_set_id=psid))[0])
     buf = np.zeros(n, np.uint8)
     if rank() == root_rank:
         buf[:] = payload
-    out = np.asarray(w.broadcast(buf, root_rank, name=f"{tag}.data"))
+    out = np.asarray(w.broadcast(buf, root_rank, name=f"{tag}.data",
+                                 process_set_id=psid))
     return pickle.loads(out.tobytes())
+
+
+def allgather_object_host(obj, process_set=None,
+                          name: str | None = None) -> list:
+    """Gather one picklable object per process into a rank-ordered list
+    on every member, through the NATIVE host data plane (reference:
+    ``hvd.allgather_object``). Ragged sizes ride ``allgather_v``."""
+    import pickle
+
+    import numpy as np
+
+    if size() <= 1:
+        return [obj]
+    from .parallel.hierarchical import _default_native_world
+
+    w = _default_native_world()
+    psid = resolve_ps_id(process_set)
+    tag = name or _next_world_tag(w, "agobj")
+    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
+    sizes = np.asarray(
+        w.allgather(np.array([payload.size], np.int64), name=f"{tag}.sz",
+                    process_set_id=psid)
+    ).reshape(-1)
+    data = np.asarray(
+        w.allgather_v(payload, name=f"{tag}.data", process_set_id=psid))
+    out, off = [], 0
+    for sz in sizes:
+        out.append(pickle.loads(data[off:off + int(sz)].tobytes()))
+        off += int(sz)
+    return out

@@ -122,31 +122,27 @@ def _graph_wrap(tensor, fn, keep_shape: bool = True):
     return out
 
 
-def _allgather_object_host(obj):
-    """Gather one picklable object per process through the host data
-    plane (used to make variable sets agree before symmetric
-    collectives)."""
-    import pickle
+def allgather_object(obj, process_set: "ProcessSet | None" = None,
+                     name: str | None = None) -> list:
+    """Gather one picklable object per process, rank-ordered (parity:
+    ``hvd.allgather_object`` tensorflow flavor)."""
+    from ..process_world import allgather_object_host
 
-    if size() <= 1:
-        return [obj]
-    global _agobj_counter
-    _agobj_counter += 1
-    tag = f"tf.agobj.{_agobj_counter}"
-    w = _world()
-    payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
-    sizes = np.asarray(
-        w.allgather(np.array([payload.size], np.int64), name=f"{tag}.sz")
-    ).reshape(-1)
-    data = np.asarray(w.allgather_v(payload, name=f"{tag}.data"))
-    out, off = [], 0
-    for sz in sizes:
-        out.append(pickle.loads(data[off:off + int(sz)].tobytes()))
-        off += int(sz)
-    return out
+    return allgather_object_host(obj, process_set=process_set, name=name)
 
 
-_agobj_counter = 0
+_allgather_object_host = allgather_object  # internal alias (callback use)
+
+
+def broadcast_object(obj, root_rank: int = 0, name: str | None = None,
+                     process_set: "ProcessSet | None" = None):
+    """Pickle-broadcast an object from ``root_rank`` (parity:
+    ``hvd.broadcast_object`` tensorflow flavor — see
+    ``horovod/tensorflow/functions.py``)."""
+    from ..process_world import broadcast_object_host
+
+    return broadcast_object_host(obj, root_rank=root_rank, name=name,
+                                 process_set=process_set)
 
 
 def allreduce(tensor, op: str = Average, name: str | None = None,
@@ -436,7 +432,8 @@ __all__ = [
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "grouped_allreduce", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
-    "broadcast_variables", "DistributedGradientTape", "Compression",
+    "broadcast_variables", "broadcast_object", "allgather_object",
+    "DistributedGradientTape", "Compression",
     "SyncBatchNormalization",
     "ProcessSet", "add_process_set", "global_process_set",
 ]

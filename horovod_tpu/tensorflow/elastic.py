@@ -27,6 +27,14 @@ def _var_key(v) -> str:
 class TensorFlowKerasState(ExtrasState):
     def __init__(self, model=None, optimizer=None, **extras: Any):
         super().__init__(**extras)
+        if model is not None and not model.get_weights():
+            # Fail fast: an unbuilt model cannot receive rank 0's weights
+            # at sync() (nothing to assign into) — a replacement worker
+            # would silently train from random init and diverge.
+            raise ValueError(
+                "TensorFlowKerasState needs a BUILT model (call it on a "
+                "sample batch or give the first layer an input_shape) so "
+                "elastic sync() can assign rank 0's weights")
         self.model = model
         self.optimizer = optimizer
         self._saved_weights = None
@@ -79,26 +87,29 @@ class TensorFlowKerasState(ExtrasState):
         # may have an unbuilt model / no slot variables yet, so
         # per-variable broadcasts would enqueue different op lists per
         # rank and deadlock negotiation.
+        # STABLE names: this path mixes surviving and freshly launched
+        # workers whose auto-name counters need not agree, and the
+        # controller pairs ops by name.
         me = rank()
         if self.model is not None:
             weights = (
                 [np.asarray(w) for w in self.model.get_weights()]
                 if me == 0 else None
             )
-            weights = broadcast_object_host(weights, root_rank=0)
-            mine = self.model.get_weights()
-            if weights is not None and len(mine) == len(weights):
-                self.model.set_weights(weights)
-            # unbuilt receiver (no weights yet): its first build gets the
-            # values via the broadcast callback / next sync instead.
+            weights = broadcast_object_host(weights, root_rank=0,
+                                            name="tf_state_weights")
+            if weights is not None:
+                self.model.set_weights(weights)  # built by construction
         opt_state = (
             {_var_key(v): np.asarray(v) for v in self._opt_vars()}
             if me == 0 else None
         )
-        opt_state = broadcast_object_host(opt_state, root_rank=0)
+        opt_state = broadcast_object_host(opt_state, root_rank=0,
+                                          name="tf_state_opt")
         if opt_state:
             # Slots the receiver doesn't have yet are recreated by its own
             # first step; ones it has get rank 0's values.
             self._assign_opt_state(opt_state)
-        self.sync_extras(lambda o: broadcast_object_host(o, root_rank=0))
+        self.sync_extras(lambda o: broadcast_object_host(
+            o, root_rank=0, name="tf_state_extras"))
         self.commit()

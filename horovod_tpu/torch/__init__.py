@@ -121,7 +121,6 @@ class Compression:
 
 # handle -> (compression ctx, original dtype restore info)
 _handle_ctx: dict[int, Any] = {}
-_bobj_counter = 0
 _agv_counter = 0
 _local_handle = 0  # unique negative handles for 1-process worlds
 
@@ -494,12 +493,23 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     optimizer.load_state_dict(sd)
 
 
-def broadcast_object(obj, root_rank: int = 0, name: str | None = None):
+def broadcast_object(obj, root_rank: int = 0, name: str | None = None,
+                     process_set: ProcessSet | None = None):
     """Pickle-broadcast an arbitrary object (reference:
     ``hvd.broadcast_object``) — shared host-plane implementation."""
     from ..process_world import broadcast_object_host
 
-    return broadcast_object_host(obj, root_rank=root_rank, name=name)
+    return broadcast_object_host(obj, root_rank=root_rank, name=name,
+                                 process_set=process_set)
+
+
+def allgather_object(obj, process_set: ProcessSet | None = None,
+                     name: str | None = None) -> list:
+    """Gather one picklable object per process, rank-ordered (reference:
+    ``hvd.allgather_object``)."""
+    from ..process_world import allgather_object_host
+
+    return allgather_object_host(obj, process_set=process_set, name=name)
 
 
 # -- DistributedOptimizer (parity: horovod/torch/optimizer.py) ---------------
@@ -699,6 +709,6 @@ __all__ = [
     "alltoall", "alltoall_async",
     "reducescatter", "reducescatter_async", "barrier", "join",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
-    "DistributedOptimizer",
+    "allgather_object", "DistributedOptimizer",
     "ProcessSet", "add_process_set", "global_process_set",
 ]

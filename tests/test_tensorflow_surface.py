@@ -170,6 +170,11 @@ class TestMultiProcess:
             g6 = np.asarray(g6)
             assert np.allclose(g6[[0, 2]], 1.5), g6
             assert np.allclose(g6[[1, 3]], 0.0), g6
+            # object collectives (reference horovod/tensorflow/functions)
+            bo = hvd.broadcast_object({"cfg": r * 10}, root_rank=1)
+            assert bo == {"cfg": 10}, bo
+            ao = hvd.allgather_object(("r", r))
+            assert ao == [("r", 0), ("r", 1)], ao
             # Keras optimizer wrapper trains in lockstep.
             import horovod_tpu.keras as hvdk
             opt = hvdk.DistributedOptimizer(

@@ -186,6 +186,11 @@ class TestMultiProcess:
             optp.step()
             assert abs(float(wp) + 1.5) < 1e-6, float(wp)
 
+            # object collectives (reference functions parity)
+            ao = hvd.allgather_object({"rank": r, "x": [r] * (r + 1)})
+            assert ao == [{"rank": 0, "x": [0]},
+                          {"rank": 1, "x": [1, 1]}], ao
+
             # unknown handle raises
             try:
                 hvd.synchronize(12345)
