@@ -148,15 +148,21 @@ def resolve_ps_id(process_set) -> int:
     return cache[key]
 
 
-def _next_world_tag(w, kind: str) -> str:
-    """Per-WORLD auto-name counter. Module-global counters would survive
-    an elastic world re-formation in surviving processes while fresh
-    workers start at zero — and the controller pairs ops BY NAME, so
-    diverged counters deadlock the first post-rendezvous exchange."""
-    attr = f"_obj_tag_{kind}"
-    n = getattr(w, attr, 0) + 1
-    setattr(w, attr, n)
-    return f"host.{kind}.{n}"
+def _next_world_tag(w, kind: str, psid: int) -> str:
+    """Per-WORLD, per-PROCESS-SET auto-name counter. Module-global
+    counters would survive an elastic world re-formation in surviving
+    processes while fresh workers start at zero; a per-world-but-shared
+    counter would diverge the moment a subset op runs (members count it,
+    non-members don't) — and the controller pairs ops BY NAME, so
+    diverged counters deadlock the next exchange (same reasoning as the
+    runtime's per-set _auto_name)."""
+    tags = getattr(w, "_obj_tags", None)
+    if tags is None:
+        tags = w._obj_tags = {}
+    n = tags.get((kind, psid), 0) + 1
+    tags[(kind, psid)] = n
+    scope = f"ps{psid}/" if psid else ""
+    return f"{scope}host.{kind}.{n}"
 
 
 def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None,
@@ -181,7 +187,7 @@ def broadcast_object_host(obj, root_rank: int = 0, name: str | None = None,
 
     w = _default_native_world()
     psid = resolve_ps_id(process_set)
-    tag = name or _next_world_tag(w, "bobj")
+    tag = name or _next_world_tag(w, "bobj", psid)
     if rank() == root_rank:
         payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
     else:
@@ -212,14 +218,13 @@ def allgather_object_host(obj, process_set=None,
 
     w = _default_native_world()
     psid = resolve_ps_id(process_set)
-    tag = name or _next_world_tag(w, "agobj")
+    tag = name or _next_world_tag(w, "agobj", psid)
     payload = np.frombuffer(pickle.dumps(obj), np.uint8).copy()
-    sizes = np.asarray(
-        w.allgather(np.array([payload.size], np.int64), name=f"{tag}.sz",
-                    process_set_id=psid)
-    ).reshape(-1)
-    data = np.asarray(
-        w.allgather_v(payload, name=f"{tag}.data", process_set_id=psid))
+    # allgather_v's internal size pre-exchange doubles as our split table
+    # (return_sizes) — no separate size collective.
+    data, sizes = w.allgather_v(payload, name=f"{tag}.data",
+                                process_set_id=psid, return_sizes=True)
+    data = np.asarray(data)
     out, off = [], 0
     for sz in sizes:
         out.append(pickle.loads(data[off:off + int(sz)].tobytes()))

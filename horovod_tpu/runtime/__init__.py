@@ -389,12 +389,15 @@ class NativeWorld:
     def allgather(self, x, name=None, **kw) -> np.ndarray:
         return self.synchronize(self.allgather_async(x, name, **kw))
 
-    def allgather_v(self, x, name=None, process_set_id: int = 0) -> np.ndarray:
+    def allgather_v(self, x, name=None, process_set_id: int = 0,
+                    return_sizes: bool = False):
         """Ragged allgather: ranks may contribute DIFFERENT dim-0 sizes
         (the reference's ``hvd.allgather`` contract — trailing dims must
         still agree). Implemented as a size pre-exchange + pad-to-max
         gather + compact: two collectives, both through the normal
-        negotiation path.
+        negotiation path. ``return_sizes=True`` additionally returns the
+        per-rank dim-0 sizes (callers needing a split table reuse the
+        internal exchange instead of running their own).
         """
         x = np.ascontiguousarray(x)
         if x.ndim == 0:
@@ -410,8 +413,11 @@ class NativeWorld:
         gathered = np.asarray(self.allgather(
             padded, name=f"{base}.data", process_set_id=process_set_id))
         gathered = gathered.reshape((n, max_d0) + x.shape[1:])
-        return np.concatenate(
+        out = np.concatenate(
             [gathered[r, : int(sizes[r])] for r in range(n)], axis=0)
+        if return_sizes:
+            return out, sizes
+        return out
 
     def broadcast(self, x, root_rank: int, name=None, **kw) -> np.ndarray:
         return self.synchronize(self.broadcast_async(x, root_rank, name, **kw))
@@ -447,16 +453,18 @@ class NativeWorld:
             _raise_last(self._lib, "join failed")
         return rc
 
-    def grouped_allreduce_async(self, tensors, name=None, op="average",
-                                process_set_id: int = 0,
-                                prescale_factor: float = 1.0,
-                                postscale_factor: float = 1.0) -> list:
-        """Atomically enqueue a list; returns one native handle per
-        tensor (synchronize each). The controller schedules the group
-        all-or-nothing and fuses it into one ring collective (reference:
-        ``hvd.grouped_allreduce`` backed by ``group_table.cc``'s
-        GroupTable — here the registration IS atomic, one C call under one
-        queue lock, not same-cycle-arrival luck)."""
+    def _grouped_async(self, op_code, tensors, out_shapes, name=None,
+                       op="average", process_set_id: int = 0,
+                       prescale_factor: float = 1.0,
+                       postscale_factor: float = 1.0) -> list:
+        """Atomically enqueue a list under one group key; returns one
+        native handle per tensor (synchronize each). The controller
+        schedules the group all-or-nothing (reference: ``group_table.cc``
+        GroupTable — here the registration IS atomic, one C call under
+        one queue lock, not same-cycle-arrival luck). ``out_shapes[i]``
+        sizes each output buffer (op-dependent: allreduce mirrors the
+        input, allgather concatenates over the set, reducescatter
+        shards)."""
         base = name or self._auto_name("group", process_set_id)
         if process_set_id:
             base = f"ps{process_set_id}/{base}"  # per-set name scope
@@ -464,12 +472,14 @@ class NativeWorld:
         for x in xs:
             if x.dtype != xs[0].dtype:
                 raise TypeError(
-                    "grouped_allreduce requires a uniform dtype per group "
-                    f"(got {x.dtype} and {xs[0].dtype}); split the group"
+                    "grouped collectives require a uniform dtype per "
+                    f"group (got {x.dtype} and {xs[0].dtype}); split the "
+                    "group"
                 )
             if x.dtype not in _DTYPE_MAP:
                 raise TypeError(f"unsupported dtype {x.dtype}")
-        outs = [np.empty_like(x) for x in xs]
+        outs = [np.empty(shape, dtype=x.dtype)
+                for shape, x in zip(out_shapes, xs)]
         n = len(xs)
         names = [f"{base}.{i}".encode() for i in range(n)]
         c_names = (ctypes.c_char_p * n)(*names)
@@ -480,7 +490,7 @@ class NativeWorld:
         c_counts = (ctypes.c_longlong * n)(*[x.size for x in xs])
         c_handles = (ctypes.c_int * n)()
         rc = self._lib.hvdrt_enqueue_group(
-            n, c_names, OP_ALLREDUCE, _REDUCE_MAP[op],
+            n, c_names, op_code, _REDUCE_MAP[op],
             _DTYPE_MAP[xs[0].dtype], c_ins, c_outs, c_counts,
             process_set_id, prescale_factor, postscale_factor, c_handles,
         )
@@ -491,6 +501,41 @@ class NativeWorld:
             for h, x, o in zip(handles, xs, outs):
                 self._inflight[h] = (x, o)
         return handles
+
+    def grouped_allreduce_async(self, tensors, name=None, op="average",
+                                process_set_id: int = 0,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0) -> list:
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        return self._grouped_async(
+            OP_ALLREDUCE, xs, [x.shape for x in xs], name=name, op=op,
+            process_set_id=process_set_id,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+
+    def grouped_allgather_async(self, tensors, name=None,
+                                process_set_id: int = 0) -> list:
+        """Uniform-shape grouped allgather: every member contributes the
+        same dim-0 per tensor; outputs concatenate over the set."""
+        n_members = self.process_set_size(process_set_id)
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        xs = [x[None] if x.ndim == 0 else x for x in xs]
+        shapes = [(n_members * x.shape[0],) + x.shape[1:] for x in xs]
+        return self._grouped_async(OP_ALLGATHER, xs, shapes, name=name,
+                                   process_set_id=process_set_id)
+
+    def grouped_reducescatter_async(self, tensors, name=None,
+                                    op="average") -> list:
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        for x in xs:
+            if x.shape[0] % self.size != 0:
+                raise ValueError(
+                    f"reducescatter dim0 ({x.shape[0]}) must divide by "
+                    f"world size ({self.size})"
+                )
+        shapes = [(x.shape[0] // self.size,) + x.shape[1:] for x in xs]
+        return self._grouped_async(OP_REDUCESCATTER, xs, shapes,
+                                   name=name, op=op)
 
     def grouped_allreduce(self, tensors, name=None, op="average",
                           process_set_id: int = 0,

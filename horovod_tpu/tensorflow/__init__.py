@@ -191,6 +191,33 @@ def grouped_allreduce(tensors: Sequence[Any], op: str = Average,
     return [tf.convert_to_tensor(o) for o in outs]
 
 
+def grouped_allgather(tensors: Sequence[Any], name: str | None = None,
+                      process_set: ProcessSet | None = None):
+    """Atomic grouped allgather (uniform dim-0 per tensor across members;
+    parity: ``hvd.grouped_allgather``)."""
+    if size() <= 1:
+        return [tf.identity(t) for t in tensors]
+    w = _world()
+    handles = w.grouped_allgather_async(
+        [_np(t) for t in tensors], name=name,
+        process_set_id=_ps_id(process_set))
+    return [tf.convert_to_tensor(np.asarray(w.synchronize(h)))
+            for h in handles]
+
+
+def grouped_reducescatter(tensors: Sequence[Any], op: str = Average,
+                          name: str | None = None):
+    """Atomic grouped reducescatter (default Average; parity:
+    ``hvd.grouped_reducescatter``)."""
+    if size() <= 1:
+        return [tf.identity(t) for t in tensors]
+    w = _world()
+    handles = w.grouped_reducescatter_async(
+        [_np(t) for t in tensors], name=name, op=op)
+    return [tf.convert_to_tensor(np.asarray(w.synchronize(h)))
+            for h in handles]
+
+
 def allgather(tensor, name: str | None = None,
               process_set: ProcessSet | None = None):
     """Concatenate each member's tensor along axis 0 on every member;
@@ -430,7 +457,8 @@ __all__ = [
     "Average", "Sum", "Min", "Max",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
-    "allreduce", "grouped_allreduce", "allgather", "broadcast",
+    "allreduce", "grouped_allreduce", "grouped_allgather",
+    "grouped_reducescatter", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
     "broadcast_variables", "broadcast_object", "allgather_object",
     "DistributedGradientTape", "Compression",

@@ -27,10 +27,13 @@ def _var_key(v) -> str:
 class TensorFlowKerasState(ExtrasState):
     def __init__(self, model=None, optimizer=None, **extras: Any):
         super().__init__(**extras)
-        if model is not None and not model.get_weights():
+        if model is not None and not getattr(model, "built", True):
             # Fail fast: an unbuilt model cannot receive rank 0's weights
             # at sync() (nothing to assign into) — a replacement worker
-            # would silently train from random init and diverge.
+            # would silently train from random init and diverge. (Checked
+            # via .built, not get_weights(): weightless-but-built models
+            # are fine, and keras raises its own error on get_weights()
+            # of an unbuilt model.)
             raise ValueError(
                 "TensorFlowKerasState needs a BUILT model (call it on a "
                 "sample batch or give the first layer an input_shape) so "

@@ -309,7 +309,56 @@ def grouped_allreduce_async(tensors: Sequence[Any],
         process_set_id=_ps_id(process_set),
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor)
-    return _register_async(None, "group", (list(tensors), native))
+    return _register_async(None, "group",
+                           (list(tensors), native, "allreduce"))
+
+
+def grouped_allgather_async(tensors: Sequence[Any],
+                            name: str | None = None,
+                            process_set: ProcessSet | None = None) -> int:
+    """Atomic grouped allgather (uniform dim-0 per tensor across members;
+    reference: ``hvd.grouped_allgather``); one handle, list of results."""
+    if size() <= 1:
+        return _register_async(
+            None, "group_identity", [t.clone() for t in tensors])
+    native = _world().grouped_allgather_async(
+        [_np_of(t) for t in tensors], name=name,
+        process_set_id=_ps_id(process_set))
+    return _register_async(None, "group",
+                           (list(tensors), native, "allgather"))
+
+
+def grouped_reducescatter_async(tensors: Sequence[Any],
+                                name: str | None = None,
+                                op: str | None = None,
+                                process_set: ProcessSet | None = None) -> int:
+    """Atomic grouped reducescatter (default Average; reference:
+    ``hvd.grouped_reducescatter``); one handle, list of results."""
+    if process_set is not None and process_set.process_set_id != 0:
+        raise ValueError(
+            "reducescatter on a non-global process set is not supported "
+            "by the native runtime; reduce on the global set or use "
+            "allreduce + local slice")
+    if size() <= 1:
+        return _register_async(
+            None, "group_identity", [t.clone() for t in tensors])
+    native = _world().grouped_reducescatter_async(
+        [_np_of(t) for t in tensors], name=name, op=op or Average)
+    return _register_async(None, "group",
+                           (list(tensors), native, "reducescatter"))
+
+
+def grouped_allgather(tensors: Sequence[Any], name: str | None = None,
+                      process_set: ProcessSet | None = None) -> list:
+    return synchronize(grouped_allgather_async(
+        tensors, name=name, process_set=process_set))
+
+
+def grouped_reducescatter(tensors: Sequence[Any], name: str | None = None,
+                          op: str | None = None,
+                          process_set: ProcessSet | None = None) -> list:
+    return synchronize(grouped_reducescatter_async(
+        tensors, name=name, op=op, process_set=process_set))
 
 
 def synchronize(handle: int):
@@ -322,14 +371,17 @@ def synchronize(handle: int):
     if kind in ("identity", "group_identity"):
         return payload
     if kind == "group":
-        tensors, native = payload
+        tensors, native, group_op = payload
         w = _world()
-        return [
-            torch.from_numpy(
-                np.asarray(w.synchronize(h)).reshape(tuple(t.shape))
-            ).to(t.dtype)
-            for h, t in zip(native, tensors)
-        ]
+        outs = []
+        for h, t in zip(native, tensors):
+            out = np.asarray(w.synchronize(h))
+            if group_op == "allreduce":
+                out = out.reshape(tuple(t.shape))
+            # allgather/reducescatter outputs carry their own (per-op)
+            # shapes from the native binding — no reshape to the input.
+            outs.append(torch.from_numpy(out).to(t.dtype))
+        return outs
     if kind == "allgather_future":
         tensor, fut = payload
         out = np.asarray(fut.result())
@@ -704,6 +756,8 @@ __all__ = [
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
     "synchronize", "poll",
     "grouped_allreduce", "grouped_allreduce_async",
+    "grouped_allgather", "grouped_allgather_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
     "allgather", "allgather_async",
     "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
     "alltoall", "alltoall_async",

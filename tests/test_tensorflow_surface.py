@@ -170,6 +170,18 @@ class TestMultiProcess:
             g6 = np.asarray(g6)
             assert np.allclose(g6[[0, 2]], 1.5), g6
             assert np.allclose(g6[[1, 3]], 0.0), g6
+            # grouped allgather / reducescatter
+            ga = hvd.grouped_allgather(
+                [tf.constant([[float(r)]]), tf.constant([[float(5 + r)]])],
+                name="g.gag")
+            assert np.asarray(ga[0]).shape == (2, 1), ga
+            assert np.allclose(np.asarray(ga[1]).ravel(), [5.0, 6.0]), ga
+            grs = hvd.grouped_reducescatter(
+                [tf.constant([[1.0 + r], [3.0 + r]])], op=hvd.Sum,
+                name="g.grs")
+            # summed [[3],[7]]; rank r gets row r
+            assert np.allclose(np.asarray(grs[0]), [[3.0, 7.0][r]]), grs
+
             # object collectives (reference horovod/tensorflow/functions)
             bo = hvd.broadcast_object({"cfg": r * 10}, root_rank=1)
             assert bo == {"cfg": 10}, bo

@@ -186,6 +186,19 @@ class TestMultiProcess:
             optp.step()
             assert abs(float(wp) + 1.5) < 1e-6, float(wp)
 
+            # grouped allgather / reducescatter (one atomic group each)
+            ga = hvd.grouped_allgather(
+                [torch.full((1, 2), float(r)),
+                 torch.full((2, 1), float(10 + r))], name="a.gag")
+            assert ga[0].shape == (2, 2) and ga[1].shape == (4, 1), ga
+            assert torch.allclose(
+                ga[0], torch.tensor([[0.0, 0.0], [1.0, 1.0]])), ga[0]
+            grs = hvd.grouped_reducescatter(
+                [torch.tensor([[2.0 + 2 * r], [6.0 + 2 * r]])],
+                name="a.grs")
+            assert torch.allclose(
+                grs[0], torch.tensor([[3.0, 7.0][r]])), grs[0]
+
             # object collectives (reference functions parity)
             ao = hvd.allgather_object({"rank": r, "x": [r] * (r + 1)})
             assert ao == [{"rank": 0, "x": [0]},
