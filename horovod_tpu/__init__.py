@@ -60,6 +60,7 @@ from .ops import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    grouped_allgather,
     grouped_allreduce,
     grouped_reducescatter,
     reducescatter,

@@ -599,6 +599,32 @@ def grouped_reducescatter(tensors: Sequence[Any], op: str | None = None, **kw):
     return [reducescatter(t, op=op, **kw) for t in tensors]
 
 
+def grouped_allgather(tensors: Sequence[Any], process_set=None,
+                      name: str | None = None):
+    """Parity: ``hvd.grouped_allgather``. In the compiled/traced regime
+    grouping is a no-op by design — XLA fuses same-cycle collectives — so
+    the list maps over :func:`allgather`. In the per-process host-tensor
+    regime the group takes the native ATOMIC group path (one enqueue,
+    GroupTable semantics — same dispatch as :func:`grouped_allreduce`).
+    The grouped flavor requires UNIFORM per-rank dim-0 (the controller
+    rejects mismatches with a clear signature error); for ragged
+    contributions use plain :func:`allgather` per tensor."""
+    tensors = list(tensors)
+    ps = _resolve_process_set(process_set)
+    world = (
+        _native_world_if_per_process(ps, tensors[0])
+        if tensors and _effective_traced_axis(ps) is None else None
+    )
+    if world is not None:
+        import numpy as np
+
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        handles = world.grouped_allgather_async(
+            xs, name=name, process_set_id=_native_set_for(ps, world))
+        return [np.asarray(world.synchronize(h)) for h in handles]
+    return [allgather(t, process_set=ps, name=name) for t in tensors]
+
+
 def barrier(process_set=None) -> None:
     """Block until every rank in the set reaches the barrier.
 

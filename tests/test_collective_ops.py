@@ -131,6 +131,21 @@ def test_grouped_allreduce_eager(hvd):
         np.testing.assert_allclose(np.asarray(out), np.tile(x.sum(0), (8, 1)), rtol=1e-5)
 
 
+def test_grouped_allgather_eager(hvd):
+    n = hvd.size()
+    xs = [
+        np.arange(n * 2, dtype=np.float32).reshape(n, 2) * (i + 1)
+        for i in range(2)
+    ]
+    outs = hvd.grouped_allgather(xs)
+    for x, out in zip(xs, outs):
+        out = np.asarray(out)
+        # stacked-rank convention: every rank's row holds the concat
+        assert out.shape == (n, n * 2), out.shape
+        for r in range(n):
+            np.testing.assert_array_equal(out[r], x.reshape(-1))
+
+
 def test_barrier(hvd):
     hvd.barrier()  # must simply not deadlock/throw
 

@@ -501,6 +501,13 @@ class TestPerProcessEagerIdiom:
             # rank (2 procs x 2 devices -> 4 entries).
             objs = hvd.allgather_object({"pid": pid})
             assert [o["pid"] for o in objs] == [0, 0, 1, 1], objs
+            # grouped_allgather: one ATOMIC native group (uniform dim-0).
+            ga1, ga2 = hvd.grouped_allgather(
+                [np.full((2, 1), float(pid), np.float32),
+                 np.full((1,), float(10 + pid), np.float32)])
+            assert ga1.shape == (4, 1), ga1.shape
+            assert np.allclose(ga1[:2], 0.0) and np.allclose(ga1[2:], 1.0)
+            assert np.allclose(ga2, [10.0, 11.0]), ga2
             hvd.barrier()
             print("perproc rank%s ok" % pid)
             """,
