@@ -160,7 +160,9 @@ def bench_bert(hvd, timing):
     n = hvd.size()
     if on_tpu:
         cfg = dataclasses.replace(bert_mod.BERT_LARGE, dropout_rate=0.0)
-        per_chip, seq, preds = 8, 512, 76
+        # batch sweep (docs/benchmarks.md): 8 -> 51.2k tok/s, 16 -> 52.0k,
+        # 24 -> 55.0k (peak), 32 -> 51.9k, 48 -> 48.2k on one v5e
+        per_chip, seq, preds = 24, 512, 76
         attention_fn = bert_mod.flash_attention_fn
     else:
         cfg = dataclasses.replace(bert_mod.BERT_TINY, dropout_rate=0.0)
