@@ -192,6 +192,70 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
                 logs[k] = float(np.asarray(out)[0])
 
 
+def _set_model_lr(model, lr: float) -> None:
+    """Assign a scalar LR on the model's optimizer, failing with guidance
+    when the optimizer was built with a LearningRateSchedule (keras's
+    setter raises there — two schedulers fighting over the LR is a user
+    error, not something to paper over)."""
+    opt = model.optimizer
+    if not hasattr(opt, "learning_rate"):
+        return
+    try:
+        opt.learning_rate = lr
+    except TypeError as e:
+        raise TypeError(
+            "the optimizer's learning_rate is a LearningRateSchedule and "
+            "cannot be driven by an hvd LR callback; use one scheduling "
+            "mechanism, not both"
+        ) from e
+
+
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Set LR to ``initial_lr * multiplier(epoch)`` between
+    ``start_epoch`` and ``end_epoch`` (reference:
+    ``hvd.callbacks.LearningRateScheduleCallback``; ``multiplier`` may be
+    a callable or a constant). ``staircase=False`` with
+    ``steps_per_epoch`` applies the multiplier per batch on fractional
+    epochs (reference contract); ``momentum_correction`` is accepted for
+    signature parity and ignored — keras optimizers own their momentum
+    state."""
+
+    def __init__(self, initial_lr: float, multiplier,
+                 start_epoch: int = 0, end_epoch: int | None = None,
+                 staircase: bool = True, steps_per_epoch: int | None = None,
+                 momentum_correction: bool = True):
+        super().__init__()
+        del momentum_correction
+        self.initial_lr = initial_lr
+        self.multiplier = (
+            multiplier if callable(multiplier) else (lambda e: multiplier)
+        )
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self._epoch = 0
+
+    def _active(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        if self._active(epoch):
+            _set_model_lr(self.model,
+                          self.initial_lr * float(self.multiplier(epoch)))
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.staircase or not self.steps_per_epoch:
+            return
+        epoch = self._epoch + batch / float(self.steps_per_epoch)
+        if self._active(epoch):
+            _set_model_lr(self.model,
+                          self.initial_lr * float(self.multiplier(epoch)))
+
+
 class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
     """Linearly ramp LR from lr/size to lr over warmup epochs (reference:
     ``hvd.callbacks.LearningRateWarmupCallback`` — the large-batch recipe's
@@ -205,9 +269,7 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
         self.verbose = verbose
 
     def _set_lr(self, lr: float):
-        opt = self.model.optimizer
-        if hasattr(opt, "learning_rate"):
-            opt.learning_rate = lr
+        _set_model_lr(self.model, lr)
 
     def on_epoch_begin(self, epoch, logs=None):
         if epoch >= self.warmup_epochs:
@@ -228,5 +290,6 @@ __all__ = [
     "allreduce", "allgather", "broadcast", "broadcast_variables",
     "Compression", "ProcessSet", "add_process_set", "global_process_set",
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
-    "MetricAverageCallback", "LearningRateWarmupCallback", "callbacks",
+    "MetricAverageCallback", "LearningRateWarmupCallback",
+    "LearningRateScheduleCallback", "callbacks",
 ]

@@ -9,6 +9,7 @@ works verbatim.
 
 from . import (  # noqa: F401
     BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
     LearningRateWarmupCallback,
     MetricAverageCallback,
 )

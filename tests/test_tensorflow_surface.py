@@ -62,6 +62,28 @@ class TestSingleProcess:
         opt.apply_gradients(zip(grads, [v]))
         np.testing.assert_allclose(v.numpy(), [0.0])  # 2 - 0.5*4
 
+    def test_lr_schedule_callback(self):
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(2,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse",
+                      run_eagerly=True)
+        cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.1, multiplier=lambda e: 0.5 ** e, start_epoch=1)
+        lrs = []
+
+        class Probe(tf.keras.callbacks.Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                lrs.append(float(self.model.optimizer.learning_rate))
+
+        x = np.ones((8, 2), np.float32)
+        y = np.ones((8, 1), np.float32)
+        model.fit(x, y, epochs=4, batch_size=8, verbose=0,
+                  callbacks=[cb, Probe()])
+        # epoch 0 untouched (before start_epoch); then 0.1 * 0.5**e
+        assert lrs[0] == pytest.approx(0.1)
+        assert lrs[1] == pytest.approx(0.05)
+        assert lrs[2] == pytest.approx(0.025)
+
     def test_broadcast_variables_noop_single(self):
         v = tf.Variable([1.0, 2.0])
         hvd_tf.broadcast_variables([v], root_rank=0)
