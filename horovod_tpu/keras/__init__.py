@@ -226,6 +226,10 @@ class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
                  momentum_correction: bool = True):
         super().__init__()
         del momentum_correction
+        if not staircase and not steps_per_epoch:
+            raise ValueError(
+                "staircase=False needs steps_per_epoch to compute "
+                "fractional epochs (reference contract)")
         self.initial_lr = initial_lr
         self.multiplier = (
             multiplier if callable(multiplier) else (lambda e: multiplier)

@@ -230,3 +230,57 @@ def allgather_object_host(obj, process_set=None,
         out.append(pickle.loads(data[off:off + int(sz)].tobytes()))
         off += int(sz)
     return out
+
+
+def adasum_pair_np(a, b):
+    """Numpy Adasum pairwise rule (reference: adasum.h): each side shrunk
+    by half its projection onto the other — scaling-invariant."""
+    import numpy as np
+
+    af = a.ravel().astype(np.float64)
+    bf = b.ravel().astype(np.float64)
+    dot = float(af @ bf)
+    asq = float(af @ af)
+    bsq = float(bf @ bf)
+    a_scale = 1.0 - dot / (2.0 * asq) if asq > 0 else 0.0
+    b_scale = 1.0 - dot / (2.0 * bsq) if bsq > 0 else 0.0
+    return (a_scale * af + b_scale * bf).reshape(a.shape).astype(a.dtype)
+
+
+def adasum_tree_np(parts):
+    """Pairwise-tree Adasum over a list of same-shaped arrays (odd
+    leftovers carry to the next round, like the reference's
+    non-power-of-two handling)."""
+    parts = list(parts)
+    while len(parts) > 1:
+        nxt = [adasum_pair_np(parts[i], parts[i + 1])
+               for i in range(0, len(parts) - 1, 2)]
+        if len(parts) % 2 == 1:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def adasum_allreduce_host(x, name: str | None = None,
+                          process_set=None):
+    """Adasum-allreduce a host array across the process set: gather the
+    per-rank contributions through the native plane, evaluate the
+    pairwise tree locally (identical result on every member — the same
+    gather-then-combine stance as the compiled regime's
+    ops/adasum.py, traded against the reference's MPI recursive
+    halving)."""
+    import numpy as np
+
+    if size() <= 1:
+        return np.asarray(x)
+    from .parallel.hierarchical import _default_native_world
+
+    w = _default_native_world()
+    psid = resolve_ps_id(process_set)
+    tag = name or _next_world_tag(w, "adasum", psid)
+    x = np.ascontiguousarray(x)
+    gathered = np.asarray(
+        w.allgather(x[None], name=tag, process_set_id=psid))
+    members = w.process_set_size(psid)
+    gathered = gathered.reshape((members,) + x.shape)
+    return adasum_tree_np([gathered[i] for i in range(members)])

@@ -37,7 +37,7 @@ except ImportError as e:  # pragma: no cover
 
 import numpy as np
 
-from ..ops.collective_ops import Average, Max, Min, Sum
+from ..ops.collective_ops import Adasum, Average, Max, Min, Sum
 
 _initialized = False
 
@@ -171,6 +171,11 @@ def allreduce_async_(tensor, average: bool | None = None,
     the native runtime applies them inside the fused op). In a
     single-process world completes immediately with a synthetic handle."""
     reduce_op = op or (Sum if average is False else Average)
+    if reduce_op == Adasum:
+        raise ValueError(
+            "op=Adasum has no async form on the host plane (it is a "
+            "gather + local pairwise tree); use the synchronous "
+            "hvd.allreduce or DistributedOptimizer(op=hvd.Adasum)")
     if size() <= 1:
         scale = prescale_factor * postscale_factor
         if scale != 1.0:
@@ -191,6 +196,11 @@ def allreduce_async(tensor, average: bool | None = None,
     """Out-of-place async allreduce (reference: ``hvd.allreduce_async``);
     ``synchronize`` returns a NEW tensor."""
     reduce_op = op or (Sum if average is False else Average)
+    if reduce_op == Adasum:
+        raise ValueError(
+            "op=Adasum has no async form on the host plane (it is a "
+            "gather + local pairwise tree); use the synchronous "
+            "hvd.allreduce or DistributedOptimizer(op=hvd.Adasum)")
     if size() <= 1:
         return _register_async(
             None, "identity",
@@ -299,6 +309,11 @@ def grouped_allreduce_async(tensors: Sequence[Any],
     """Atomic grouped allreduce; ONE handle for the whole group
     (reference contract) — ``synchronize`` returns the list of results."""
     reduce_op = op or Average
+    if reduce_op == Adasum:
+        raise ValueError(
+            "op=Adasum has no grouped/async form on the host plane; "
+            "use hvd.allreduce per tensor or "
+            "DistributedOptimizer(op=hvd.Adasum)")
     if size() <= 1:
         scale = prescale_factor * postscale_factor
         return _register_async(
@@ -424,6 +439,16 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
     if size() <= 1:
         return _scaled(tensor.clone(), prescale_factor * postscale_factor)
     wire, ctx = compression.compress(tensor)
+    if reduce_op == Adasum:
+        # Scaling-invariant combination (reference: hvd.Adasum on torch,
+        # adasum_mpi.cc): gather-then-pairwise-tree on the host plane.
+        from ..process_world import adasum_allreduce_host
+
+        out = adasum_allreduce_host(_np_of(wire), name=name,
+                                    process_set=process_set)
+        result = _scaled(torch.from_numpy(out).to(wire.dtype),
+                         prescale_factor * postscale_factor)
+        return compression.decompress(result, ctx)
     out = np.asarray(
         _world().allreduce(_np_of(wire), name=name, op=reduce_op,
                            process_set_id=_ps_id(process_set),
@@ -681,11 +706,20 @@ class _DistributedOptimizer:
         h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
         self._handles[p] = (h, ctx, wire.dtype)
 
-    def _enqueue_wire(self, wire, name: str) -> int:
+    def _enqueue_wire(self, wire, name: str):
         """Reduction split per the reference's gradient_predivide_factor:
         grads scale by 1/f before a SUM reduction and f/size after, so
         the result is still the average but intermediate magnitudes are
-        controlled (fp16 overflow headroom)."""
+        controlled (fp16 overflow headroom). Adasum grads resolve
+        synchronously (gather + local pairwise tree — no native async
+        form), returning the combined array instead of a handle."""
+        if self._op == Adasum:
+            # DEFER the exchange to step(): a blocking collective inside
+            # the autograd hook would hang when ranks' backward orders
+            # diverge (the controller pairs ops by name). step() resolves
+            # pending Adasum grads in sorted-name order — identical on
+            # every rank regardless of hook order.
+            return ("adasum_pending", _np_of(wire), name)
         if self._predivide != 1.0:
             return _world().allreduce_async_(
                 _np_of(wire), name=name, op=Sum,
@@ -716,10 +750,25 @@ class _DistributedOptimizer:
                         h = self._enqueue_wire(
                             wire, f"grad.{self._param_name(p)}")
                         self._handles[p] = (h, ctx, wire.dtype)
+            from ..process_world import adasum_allreduce_host
+
+            pending = sorted(
+                ((h[2], p) for p, (h, _, _) in self._handles.items()
+                 if isinstance(h, tuple) and h[0] == "adasum_pending"),
+                key=lambda kv: kv[0])
+            adasum_results = {
+                p: adasum_allreduce_host(
+                    self._handles[p][0][1], name=nm, process_set=self._ps)
+                for nm, p in pending
+            }
             for p, (h, ctx, wire_dtype) in list(self._handles.items()):
-                out = np.asarray(_world().synchronize(h))
+                if isinstance(h, tuple) and h[0] == "adasum_pending":
+                    out = adasum_results[p]
+                else:
+                    out = np.asarray(_world().synchronize(h))
                 result = torch.from_numpy(
-                    out.reshape(tuple(p.grad.shape))).to(wire_dtype)
+                    np.ascontiguousarray(out).reshape(
+                        tuple(p.grad.shape))).to(wire_dtype)
                 p.grad.data.copy_(
                     self._compression.decompress(result, ctx).to(
                         p.grad.dtype))
@@ -750,7 +799,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 from .sync_batch_norm import SyncBatchNorm  # noqa: E402
 
 __all__ = [
-    "Average", "Sum", "Min", "Max", "Compression", "SyncBatchNorm",
+    "Average", "Sum", "Min", "Max", "Adasum", "Compression", "SyncBatchNorm",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
     "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",

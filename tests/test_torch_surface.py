@@ -199,6 +199,27 @@ class TestMultiProcess:
             assert torch.allclose(
                 grs[0], torch.tensor([[3.0, 7.0][r]])), grs[0]
 
+            # Adasum allreduce: matches the local pairwise tree of both
+            # ranks' contributions (scaling-invariant combination).
+            from horovod_tpu.process_world import adasum_pair_np
+            mine_np = np.array([1.0, 2.0]) * (r + 1)
+            ada = hvd.allreduce(torch.from_numpy(mine_np.astype(np.float32)),
+                                op=hvd.Adasum, name="a.ada")
+            expect_ada = adasum_pair_np(
+                np.array([1.0, 2.0]), np.array([2.0, 4.0]))
+            assert np.allclose(ada.numpy(), expect_ada, atol=1e-5), (
+                ada, expect_ada)
+
+            # Adasum optimizer: both ranks end with identical weights.
+            wa = torch.nn.Parameter(torch.tensor([1.0]))
+            opta = hvd.DistributedOptimizer(
+                torch.optim.SGD([wa], lr=0.5),
+                named_parameters=[("wa", wa)], op=hvd.Adasum)
+            (wa * float(r + 1)).sum().backward()
+            opta.step()
+            got = hvd.allgather(torch.tensor([[float(wa)]]), name="a.adaw")
+            assert torch.allclose(got[0], got[1]), got
+
             # object collectives (reference functions parity)
             ao = hvd.allgather_object({"rank": r, "x": [r] * (r + 1)})
             assert ao == [{"rank": 0, "x": [0]},
