@@ -45,19 +45,14 @@ def adasum_tree(stack):
     """Reduce a stacked (N, ...) array of per-rank tensors pairwise.
 
     N need not be a power of two: odd elements are carried to the next
-    round, matching the reference's handling of non-power-of-two worlds.
+    round, matching the reference's handling of non-power-of-two worlds
+    (control flow shared with the host regime via
+    :func:`horovod_tpu.process_world.pairwise_tree`).
     """
-    n = stack.shape[0]
-    parts = [stack[i] for i in range(n)]
-    while len(parts) > 1:
-        nxt = [
-            adasum_pair(parts[i], parts[i + 1])
-            for i in range(0, len(parts) - 1, 2)
-        ]
-        if len(parts) % 2 == 1:
-            nxt.append(parts[-1])
-        parts = nxt
-    return parts[0]
+    from ..process_world import pairwise_tree
+
+    return pairwise_tree([stack[i] for i in range(stack.shape[0])],
+                         adasum_pair)
 
 
 def adasum_reduce(x, axis_name: str):

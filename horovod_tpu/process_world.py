@@ -247,18 +247,23 @@ def adasum_pair_np(a, b):
     return (a_scale * af + b_scale * bf).reshape(a.shape).astype(a.dtype)
 
 
-def adasum_tree_np(parts):
-    """Pairwise-tree Adasum over a list of same-shaped arrays (odd
-    leftovers carry to the next round, like the reference's
-    non-power-of-two handling)."""
+def pairwise_tree(parts, pair):
+    """Binary-tree reduction of a list by ``pair`` (odd leftovers carry
+    to the next round — the reference's non-power-of-two handling). One
+    control-flow implementation shared by the numpy (host) and jnp
+    (compiled) Adasum regimes."""
     parts = list(parts)
     while len(parts) > 1:
-        nxt = [adasum_pair_np(parts[i], parts[i + 1])
+        nxt = [pair(parts[i], parts[i + 1])
                for i in range(0, len(parts) - 1, 2)]
         if len(parts) % 2 == 1:
             nxt.append(parts[-1])
         parts = nxt
     return parts[0]
+
+
+def adasum_tree_np(parts):
+    return pairwise_tree(parts, adasum_pair_np)
 
 
 def adasum_allreduce_host(x, name: str | None = None,

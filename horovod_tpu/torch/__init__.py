@@ -463,6 +463,13 @@ def allreduce_(tensor, average: bool | None = None,
                name: str | None = None, op: str | None = None,
                process_set: ProcessSet | None = None,
                prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    if (op or (Sum if average is False else Average)) == Adasum:
+        # In-place IS synchronous: ride the sync gather+tree path.
+        tensor.data.copy_(allreduce(
+            tensor, name=name, op=Adasum, process_set=process_set,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
+        return tensor
     h = allreduce_async_(tensor, average=average, name=name, op=op,
                          process_set=process_set,
                          prescale_factor=prescale_factor,

@@ -581,6 +581,11 @@ class TestPerProcessSubsetCollectives:
             assert np.allclose(np.asarray(g)[:, 0], peers), g
             b = hvd.broadcast(t, root_rank=peers[1], process_set=mine)
             assert np.allclose(b, peers[1] + 1.0), b
+            # subset work is uneven across sets: barrier before exit —
+            # the first exiting rank's (negotiated) shutdown would reach
+            # the other set mid-collective (reference semantics; see
+            # docs/process_set.md).
+            hvd.barrier()
             print("subset rank%s ok" % pid, flush=True)
             """,
         )
