@@ -453,6 +453,33 @@ class DistributedGradientTape:
 
 from .sync_batch_norm import SyncBatchNormalization  # noqa: E402
 
+
+def DistributedOptimizer(optimizer, *args, **kwargs):
+    """Parity entry point: reference TF2 scripts call
+    ``hvd.DistributedOptimizer(opt)`` with a keras optimizer after the
+    TF2 migration — delegate to the shared keras wrapper. TF1
+    ``tf.compat.v1.train.Optimizer`` instances are not supported (the
+    graph-session regime is out of scope); they get guidance."""
+    keras_bases = [tf.keras.optimizers.Optimizer]
+    legacy = getattr(tf.keras.optimizers, "legacy", None)
+    if legacy is not None and hasattr(legacy, "Optimizer"):
+        keras_bases.append(legacy.Optimizer)
+    if isinstance(optimizer, tuple(keras_bases)) or (
+        # duck-type: keras-compatible wrappers (the subclassing wrapper
+        # only needs these two)
+        callable(getattr(optimizer, "apply_gradients", None))
+        and callable(getattr(optimizer, "get_config", None))
+    ):
+        from ..keras import DistributedOptimizer as _keras_opt
+
+        return _keras_opt(optimizer, *args, **kwargs)
+    raise TypeError(
+        f"hvd.DistributedOptimizer on the TF surface supports keras "
+        f"optimizers (got {type(optimizer).__name__}); for TF2 training "
+        "loops use DistributedGradientTape, for keras model.fit use "
+        "horovod_tpu.keras.DistributedOptimizer"
+    )
+
 __all__ = [
     "Average", "Sum", "Min", "Max",
     "init", "shutdown", "is_initialized",
@@ -461,7 +488,7 @@ __all__ = [
     "grouped_reducescatter", "allgather", "broadcast",
     "alltoall", "reducescatter", "barrier", "join",
     "broadcast_variables", "broadcast_object", "allgather_object",
-    "DistributedGradientTape", "Compression",
+    "DistributedGradientTape", "DistributedOptimizer", "Compression",
     "SyncBatchNormalization",
     "ProcessSet", "add_process_set", "global_process_set",
 ]

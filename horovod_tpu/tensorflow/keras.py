@@ -1,0 +1,7 @@
+"""``horovod_tpu.tensorflow.keras`` — the reference's canonical tf.keras
+import path (``import horovod.tensorflow.keras as hvd``; impl shared with
+``horovod/keras`` via ``horovod/_keras``). Everything re-exports from
+:mod:`horovod_tpu.keras`, which is the shared implementation here."""
+
+from ..keras import *  # noqa: F401,F403
+from ..keras import callbacks  # noqa: F401

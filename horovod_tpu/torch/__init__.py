@@ -170,7 +170,7 @@ def allreduce_async_(tensor, average: bool | None = None,
     scale the tensor before/after the reduction (reference contract —
     the native runtime applies them inside the fused op). In a
     single-process world completes immediately with a synthetic handle."""
-    reduce_op = op or (Sum if average is False else Average)
+    reduce_op = _resolve_reduce_op(op, average)
     if reduce_op == Adasum:
         raise ValueError(
             "op=Adasum has no async form on the host plane (it is a "
@@ -195,7 +195,7 @@ def allreduce_async(tensor, average: bool | None = None,
                     postscale_factor: float = 1.0) -> int:
     """Out-of-place async allreduce (reference: ``hvd.allreduce_async``);
     ``synchronize`` returns a NEW tensor."""
-    reduce_op = op or (Sum if average is False else Average)
+    reduce_op = _resolve_reduce_op(op, average)
     if reduce_op == Adasum:
         raise ValueError(
             "op=Adasum has no async form on the host plane (it is a "
@@ -435,7 +435,7 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """Synchronous allreduce returning a NEW tensor (reference semantics:
     ``hvd.allreduce`` is out-of-place; ``allreduce_`` is in-place)."""
-    reduce_op = op or (Sum if average is False else Average)
+    reduce_op = _resolve_reduce_op(op, average)
     if size() <= 1:
         return _scaled(tensor.clone(), prescale_factor * postscale_factor)
     wire, ctx = compression.compress(tensor)
@@ -459,11 +459,16 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
     return compression.decompress(result, ctx)
 
 
+def _resolve_reduce_op(op, average):
+    """One place for the reference's op/average resolution rule."""
+    return op or (Sum if average is False else Average)
+
+
 def allreduce_(tensor, average: bool | None = None,
                name: str | None = None, op: str | None = None,
                process_set: ProcessSet | None = None,
                prescale_factor: float = 1.0, postscale_factor: float = 1.0):
-    if (op or (Sum if average is False else Average)) == Adasum:
+    if _resolve_reduce_op(op, average) == Adasum:
         # In-place IS synchronous: ride the sync gather+tree path.
         tensor.data.copy_(allreduce(
             tensor, name=name, op=Adasum, process_set=process_set,

@@ -62,6 +62,20 @@ class TestSingleProcess:
         opt.apply_gradients(zip(grads, [v]))
         np.testing.assert_allclose(v.numpy(), [0.0])  # 2 - 0.5*4
 
+    def test_tensorflow_keras_module_path_and_optimizer_entry(self):
+        """Reference import parity: `import horovod.tensorflow.keras` and
+        TF2 scripts' `hvd.DistributedOptimizer(keras_opt)`."""
+        import horovod_tpu.tensorflow.keras as hvdk2
+
+        assert hvdk2.DistributedOptimizer is hvd_keras.DistributedOptimizer
+        assert hvdk2.callbacks.BroadcastGlobalVariablesCallback \
+            is hvd_keras.callbacks.BroadcastGlobalVariablesCallback
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.5))
+        assert "SGD" in type(opt).__name__
+        with pytest.raises(TypeError, match="keras optimizers"):
+            hvd_tf.DistributedOptimizer(object())
+
     def test_lr_schedule_callback(self):
         model = tf.keras.Sequential(
             [tf.keras.layers.Dense(1, input_shape=(2,))])
