@@ -37,6 +37,7 @@ import numpy as np
 
 # Reduce-op names: the same objects the core dispatch compares against.
 from ..ops.collective_ops import Average, Max, Min, Sum  # noqa: E402
+from ..timeline import start_timeline, stop_timeline  # noqa: E402,F401
 
 _initialized = False
 

@@ -110,6 +110,43 @@ def get_timeline() -> Timeline | None:
         return _timeline
 
 
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Start (or re-target) timeline capture at runtime (parity:
+    ``hvd.start_timeline`` — the reference's dynamic-activation API,
+    equivalent to launching with ``HOROVOD_TIMELINE=<path>``).
+    ``mark_cycles`` mirrors ``HOROVOD_TIMELINE_MARK_CYCLES``."""
+    global _timeline, _mark_cycles
+    # Swap env + globals + the new writer ATOMICALLY: a concurrent
+    # collective's get_timeline() between the steps would otherwise
+    # materialize a writer at the stale path (truncating a flushed
+    # trace). The old writer shuts down outside the lock.
+    with _lock:
+        old = _timeline
+        os.environ["HOROVOD_TIMELINE"] = file_path
+        if mark_cycles:
+            os.environ["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+        else:
+            os.environ.pop("HOROVOD_TIMELINE_MARK_CYCLES", None)
+        _mark_cycles = mark_cycles  # reset the first-use cache
+        _timeline = Timeline(file_path)
+    if old is not None:
+        old.shutdown()
+
+
+def stop_timeline() -> None:
+    """Stop capture and flush the trace file (parity:
+    ``hvd.stop_timeline``)."""
+    global _timeline, _mark_cycles
+    with _lock:
+        tl = _timeline
+        _timeline = None
+        os.environ.pop("HOROVOD_TIMELINE", None)
+        os.environ.pop("HOROVOD_TIMELINE_MARK_CYCLES", None)
+        _mark_cycles = None
+    if tl is not None:
+        tl.shutdown()
+
+
 class activity:
     """Context manager: ``with activity('allreduce.dense_1', 'collective')``.
 

@@ -38,6 +38,7 @@ except ImportError as e:  # pragma: no cover
 import numpy as np
 
 from ..ops.collective_ops import Adasum, Average, Max, Min, Sum
+from ..timeline import start_timeline, stop_timeline  # noqa: E402,F401
 
 _initialized = False
 
@@ -460,8 +461,12 @@ def allreduce(tensor, average: bool | None = None, name: str | None = None,
 
 
 def _resolve_reduce_op(op, average):
-    """One place for the reference's op/average resolution rule."""
-    return op or (Sum if average is False else Average)
+    """The reference's op/average resolution rule — the CORE surface's
+    implementation (raises when both are given, validates op names), so
+    the two surfaces cannot drift."""
+    from ..ops.collective_ops import _resolve_op
+
+    return _resolve_op(op, average)
 
 
 def allreduce_(tensor, average: bool | None = None,

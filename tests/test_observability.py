@@ -29,6 +29,27 @@ def test_timeline_records_collectives(hvd, tmp_path, monkeypatch):
     monkeypatch.setattr(tl, "_timeline", None)
 
 
+def test_start_stop_timeline_api(hvd, tmp_path, monkeypatch):
+    """Dynamic activation (parity: hvd.start_timeline/stop_timeline): no
+    env at launch, capture starts mid-run, stop flushes a readable
+    trace."""
+    import horovod_tpu.timeline as tl
+
+    monkeypatch.delenv("HOROVOD_TIMELINE", raising=False)
+    monkeypatch.setattr(tl, "_timeline", None)
+    assert tl.get_timeline() is None
+
+    path = tmp_path / "dyn.json"
+    hvd.start_timeline(str(path))
+    x = np.random.RandomState(0).randn(hvd.size(), 2).astype(np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.stop_timeline()
+    events = json.loads(path.read_text())
+    assert any(e["name"] == "allreduce" for e in events), events
+    # stopped: no more capture
+    assert tl.get_timeline() is None
+
+
 def test_stall_inspector_reports_outstanding():
     from horovod_tpu.stall import StallInspector
 
