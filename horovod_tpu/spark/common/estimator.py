@@ -168,10 +168,36 @@ class Estimator:
             data = read_shard(train_path, self.store, 0, 1, columns)
             state = self._worker_fn()(data, p, 0)
 
-        # Persist the trained state in the store (parity: checkpoint dir).
-        ckpt = f"{self.store.checkpoint_path(run_id)}/final.pkl"
-        self.store.write_bytes(ckpt, pickle.dumps(state))
+        # Persist the trained state AND the params in effect (parity:
+        # checkpoint dir) — load() must rebuild the Model against the
+        # fit-time configuration, not whatever the estimator holds later.
+        self.store.write_bytes(
+            self._final_ckpt(run_id),
+            pickle.dumps({"state": state, "params": p}))
         return self._make_model(state, run_id)
+
+    def _final_ckpt(self, run_id: str) -> str:
+        return f"{self.store.checkpoint_path(run_id)}/final.pkl"
+
+    def load(self, run_id: str) -> "Model":
+        """Rebuild the trained Model from the store's checkpoint of a
+        prior ``fit`` run (parity: reference estimators read trained
+        models back from the Store; the estimator supplies the
+        architecture/builders, the checkpoint supplies the state AND the
+        fit-time params — a later reconfiguration of this estimator does
+        not leak into the loaded Model)."""
+        ckpt = self._final_ckpt(run_id)
+        if not self.store.exists(ckpt):
+            raise FileNotFoundError(
+                f"no checkpoint for run {run_id!r} at {ckpt}")
+        blob = pickle.loads(self.store.read_bytes(ckpt))
+        state, params = blob["state"], blob["params"]
+        saved = self.params
+        self.params = params  # _make_model reads self.params
+        try:
+            return self._make_model(state, run_id)
+        finally:
+            self.params = saved
 
     # -- subclass surface ----------------------------------------------------
 

@@ -326,6 +326,28 @@ class TestLightningEstimatorE2E:
         mse = float(np.mean((preds - y[:, 0]) ** 2))
         assert mse < np.var(y), mse
 
+    def test_load_from_store(self, tmp_path):
+        """est.load(run_id) rebuilds the trained Model from the store's
+        checkpoint — same predictions, no retraining."""
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        torch.manual_seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 3).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5], np.float32))[:, None]
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+        est = LightningEstimator(str(tmp_path), self._module(torch),
+                                 epochs=3, batch_size=16, verbose=0)
+        fitted = est.fit(df)
+        reloaded = est.load(fitted.run_id)
+        np.testing.assert_allclose(
+            np.asarray(reloaded.predict(x)), np.asarray(fitted.predict(x)),
+            rtol=1e-6)
+        with pytest.raises(FileNotFoundError, match="no checkpoint"):
+            est.load("does-not-exist")
+
     def test_protocol_enforced(self, tmp_path):
         torch = pytest.importorskip("torch")
 
