@@ -171,10 +171,15 @@ class Estimator:
         # Persist the trained state AND the params in effect (parity:
         # checkpoint dir) — load() must rebuild the Model against the
         # fit-time configuration, not whatever the estimator holds later.
+        # Callbacks are stripped first: they are live callables (lambdas,
+        # bound methods) consumed during training, not persistable config.
+        import dataclasses
+
+        persistable = dataclasses.replace(p, callbacks=())
         self.store.write_bytes(
             self._final_ckpt(run_id),
-            pickle.dumps({"state": state, "params": p}))
-        return self._make_model(state, run_id)
+            pickle.dumps({"state": state, "params": persistable}))
+        return self._make_model(state, run_id, p)
 
     def _final_ckpt(self, run_id: str) -> str:
         return f"{self.store.checkpoint_path(run_id)}/final.pkl"
@@ -191,13 +196,7 @@ class Estimator:
             raise FileNotFoundError(
                 f"no checkpoint for run {run_id!r} at {ckpt}")
         blob = pickle.loads(self.store.read_bytes(ckpt))
-        state, params = blob["state"], blob["params"]
-        saved = self.params
-        self.params = params  # _make_model reads self.params
-        try:
-            return self._make_model(state, run_id)
-        finally:
-            self.params = saved
+        return self._make_model(blob["state"], run_id, blob["params"])
 
     # -- subclass surface ----------------------------------------------------
 
@@ -205,7 +204,10 @@ class Estimator:
         """Return a picklable fn(data_dict, params, shard) -> state."""
         raise NotImplementedError
 
-    def _make_model(self, state, run_id: str) -> "Model":
+    def _make_model(self, state, run_id: str, params) -> "Model":
+        """Build the Model from trained ``state`` under the given
+        ``params`` (fit passes the live params; load passes the
+        checkpointed fit-time ones)."""
         raise NotImplementedError
 
 

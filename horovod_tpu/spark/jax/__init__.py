@@ -153,8 +153,8 @@ class JaxEstimator(Estimator):
 
         return fn
 
-    def _make_model(self, state, run_id: str) -> "JaxModel":
-        return JaxModel(self.model, state["params"], run_id, self.params,
+    def _make_model(self, state, run_id: str, params) -> "JaxModel":
+        return JaxModel(self.model, state["params"], run_id, params,
                         history=state["history"])
 
 

@@ -82,9 +82,9 @@ class KerasEstimator(Estimator):
 
         return fn
 
-    def _make_model(self, state, run_id: str) -> "KerasModel":
+    def _make_model(self, state, run_id: str, params) -> "KerasModel":
         return KerasModel(self.model_fn, state["weights"], run_id,
-                          self.params, history=state["history"])
+                          params, history=state["history"])
 
 
 class KerasModel(Model):

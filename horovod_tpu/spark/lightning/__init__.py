@@ -171,12 +171,12 @@ class LightningEstimator(Estimator):
 
         return fn
 
-    def _make_model(self, state, run_id: str) -> "LightningModel":
+    def _make_model(self, state, run_id: str, params) -> "LightningModel":
         return LightningModel(
             self.model,
             state["state_dict"],
             run_id,
-            self.params,
+            params,
             history=state["history"],
         )
 

@@ -154,9 +154,9 @@ class TorchEstimator(Estimator):
 
         return fn
 
-    def _make_model(self, state, run_id: str) -> "TorchModel":
+    def _make_model(self, state, run_id: str, params) -> "TorchModel":
         return TorchModel(self.model, state["state_dict"], run_id,
-                          self.params, history=state["history"])
+                          params, history=state["history"])
 
 
 class TorchModel(Model):

@@ -326,6 +326,27 @@ class TestLightningEstimatorE2E:
         mse = float(np.mean((preds - y[:, 0]) ** 2))
         assert mse < np.var(y), mse
 
+    def test_fit_with_lambda_callback_then_load(self, tmp_path):
+        """Live callables in params (lambda callbacks) must not break the
+        checkpoint write — they are stripped before pickling, and load()
+        still works."""
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.spark.lightning import LightningEstimator
+
+        seen = []
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        df = pd.DataFrame({"features": list(x), "label": list(y)})
+        est = LightningEstimator(
+            str(tmp_path), self._module(torch), epochs=2, batch_size=16,
+            verbose=0, callbacks=[lambda e, m: seen.append(e)])
+        fitted = est.fit(df)
+        assert seen == [0, 1]
+        reloaded = est.load(fitted.run_id)
+        assert reloaded.params.callbacks == ()  # stripped in the checkpoint
+
     def test_load_from_store(self, tmp_path):
         """est.load(run_id) rebuilds the trained Model from the store's
         checkpoint — same predictions, no retraining."""
