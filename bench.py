@@ -1,9 +1,15 @@
 """Benchmark: ResNet-50 + BERT-Large data-parallel training via horovod_tpu.
 
-Prints ONE JSON line. Headline metric is ResNet-50 images/sec (BASELINE
-config #2); the same line carries the BERT-Large pretraining row (config
-#3: tokens/sec + MFU, flash-attention kernel, masked-position MLM head)
-and both efficiency numbers:
+Prints one JSON line per completed section — each line is the FULL
+cumulative record so far, so the LAST complete line always carries every
+measurement taken before any later failure (the driver parses the last
+line; round-3 lost all measurements to a single late remote-compile flake
+because everything was printed once at the very end).
+
+Headline metric is ResNet-50 images/sec (BASELINE config #2); the record
+also carries the BERT-Large pretraining row (config #3: tokens/sec + MFU,
+flash-attention kernel, masked-position MLM head) and both efficiency
+numbers:
 
 - ``vs_baseline``: DistributedOptimizer step throughput / hand-written
   raw-JAX step throughput on the same devices — what a user actually
@@ -15,16 +21,78 @@ and both efficiency numbers:
   execute. This is the non-circular "what does the machinery cost" number
   VERDICT r2 asked for; on n>1 worlds the two converge.
 
-The reference publishes no absolute images/sec (BASELINE.md), so
-efficiency-vs-raw is the honest comparable; absolute throughput is the
-recorded value.
+Robustness contract (VERDICT r3 #1): every section is wrapped in
+``_with_retry`` — one retry on transient remote-compile/transport errors
+(the exact class of flake that killed BENCH_r03) — and a failed section
+records an ``errors`` entry instead of destroying the run. Exit code is 0
+as long as the headline ResNet row was measured.
 """
 
 from __future__ import annotations
 
 import json
+import statistics
 import sys
 import time
+
+
+# Substrings identifying transient infra errors (remote-compile tunnel
+# drops, transport resets) worth one retry; anything else is a real bug
+# and should fail the section immediately.
+_TRANSIENT_MARKERS = (
+    "remote_compile",
+    "read body",
+    "response body closed",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "Connection reset",
+    "Connection refused",
+    "Broken pipe",
+    "socket",
+)
+
+
+def _is_transient(exc: BaseException) -> bool:
+    text = f"{type(exc).__name__}: {exc}"
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+def _with_retry(section: str, fn, errors: list, allow_retry: bool = True):
+    """Run ``fn()``; on a transient infra error retry once (when
+    ``allow_retry`` — a multi-controller bench must not retry locally, or
+    the retrying rank deserts its peers mid-collective). Returns the
+    result or None (recording the failure in ``errors``)."""
+    for attempt in (1, 2):
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — bench must survive anything
+            transient = _is_transient(exc)
+            msg = f"{section} attempt {attempt}: {type(exc).__name__}: {exc}"
+            print(f"# bench: {msg}"[:500], file=sys.stderr)
+            if transient and allow_retry and attempt == 1:
+                time.sleep(5.0)
+                continue
+            errors.append(msg[:300])
+            return None
+    return None
+
+
+class _Emitter:
+    """Cumulative-record printer: every call prints the FULL record as one
+    JSON line (flushed), so the last complete stdout line is always the
+    best snapshot."""
+
+    def __init__(self):
+        self.record = {
+            "metric": "resnet50_images_per_sec",
+            "value": None,
+            "unit": "images/sec",
+            "vs_baseline": None,
+        }
+
+    def update(self, **kv):
+        self.record.update(kv)
+        print(json.dumps(self.record), flush=True)
 
 
 def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None):
@@ -68,7 +136,7 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None):
     )
 
 
-def _time_steps(step, state, batch, warmup=5, iters=20, repeats=3):
+def _time_steps(step, state, batch, warmup=4, iters=10, repeats=3):
     """Median-of-repeats step time (sec) + relative spread.
 
     Warmup absorbs compilation; each repeat times ``iters`` steps
@@ -98,8 +166,6 @@ def _time_steps(step, state, batch, warmup=5, iters=20, repeats=3):
             )
         _sync(loss)
         times.append((time.perf_counter() - t0) / iters)
-    import statistics
-
     times.sort()
     median = statistics.median(times)
     spread = (times[-1] - times[0]) / median if median else 0.0
@@ -202,9 +268,7 @@ def bench_bert(hvd, timing):
 
         loss, grads = jax.value_and_grad(loss_of)(params)
         updates, new_opt = opt.update(grads, opt_state, params)
-        import optax as _ox
-
-        return _ox.apply_updates(params, updates), new_opt, loss
+        return optax.apply_updates(params, updates), new_opt, loss
 
     step = jax.jit(
         jax.shard_map(
@@ -216,26 +280,20 @@ def bench_bert(hvd, timing):
         ),
         donate_argnums=(0, 1),
     )
-    state = (
-        hvd.data_parallel.replicate(params),
-        hvd.data_parallel.replicate(opt.init(params)),
-    )
+    p_ = hvd.data_parallel.replicate(params)
+    o_ = hvd.data_parallel.replicate(opt.init(params))
 
-    import time as _t
-
-    p_, o_ = state
     for _ in range(timing["warmup"]):
         p_, o_, loss = step(p_, o_, batch)
     float(np.asarray(loss))
     times = []
     for _ in range(timing["repeats"]):
-        t0 = _t.perf_counter()
+        t0 = time.perf_counter()
         for _ in range(timing["iters"]):
             p_, o_, loss = step(p_, o_, batch)
         float(np.asarray(loss))
-        times.append((_t.perf_counter() - t0) / timing["iters"])
+        times.append((time.perf_counter() - t0) / timing["iters"])
     times.sort()
-    import statistics
 
     t_step = statistics.median(times)
     tokens_per_sec = B * seq / t_step
@@ -255,7 +313,23 @@ def bench_bert(hvd, timing):
 
 
 def main() -> int:
+    import os
+
     import jax
+
+    # Persistent compilation cache: the four large programs here dominate
+    # wall time through the remote-compile tunnel; warming this cache once
+    # makes every later bench run (including the driver's) compile-free.
+    try:
+        cache_dir = os.environ.get(
+            "BENCH_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as exc:  # noqa: BLE001 — cache is an optimization only
+        print(f"# bench: compile cache unavailable: {exc}", file=sys.stderr)
+
     import jax.numpy as jnp
     import numpy as np
     import optax
@@ -264,8 +338,25 @@ def main() -> int:
     from horovod_tpu.models.lenet import cross_entropy_loss  # reuse CE
     from horovod_tpu.models.resnet import ResNet50
 
+    t_start = time.perf_counter()
+    emit = _Emitter()
+    errors: list = []
+
     hvd.init()
     n = hvd.size()
+    # Deadline/retry gates are LOCAL decisions; in a multi-controller world
+    # a rank skipping or re-running a section would desert peers
+    # mid-collective and hang the bench. Single-controller (the driver's
+    # shape: one process, one chip or a virtual mesh) keeps both gates;
+    # multi-process worlds run every section exactly once.
+    single_controller = int(
+        os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) <= 1
+    deadline_s = (float(os.environ.get("BENCH_DEADLINE", "480"))
+                  if single_controller else float("inf"))
+
+    def out_of_time() -> bool:
+        return time.perf_counter() - t_start > deadline_s
+
     on_tpu = jax.default_backend() == "tpu"
     # 128/chip saturates the v5e MXU for ResNet-50 (measured: 64→24.5% MFU,
     # 128→30.3%, 256→30.3% — same throughput, double latency).
@@ -299,81 +390,97 @@ def main() -> int:
             hvd.data_parallel.replicate(opt.init(params)),
         )
 
-    # --- horovod_tpu path: DistributedOptimizer (fused allreduce + bf16 wire)
-    dist_opt = hvd.DistributedOptimizer(
-        optax.sgd(0.1, momentum=0.9),
-        compression=hvd.Compression.bf16 if on_tpu else hvd.Compression.none,
-    )
     # CPU-mesh runs exist to exercise the fusion machinery and produce
     # vs_baseline, not absolute speed — keep the loop short there.
     timing = (
-        dict(warmup=5, iters=20, repeats=5)
+        dict(warmup=4, iters=10, repeats=3)
         if on_tpu
         else dict(warmup=2, iters=5, repeats=2)
     )
 
-    dist_step = _build_step(model, dist_opt, mesh, axis, loss_fn)
-    t_dist, spread = _time_steps(
-        dist_step, fresh_state(dist_opt), batch, **timing
+    peak = _chip_peak_flops(jax.devices()[0]) if on_tpu else None
+
+    # --- section 1 (headline): DistributedOptimizer (fused allreduce +
+    # bf16 wire). Emitted immediately so a later flake cannot erase it.
+    dist_opt = hvd.DistributedOptimizer(
+        optax.sgd(0.1, momentum=0.9),
+        compression=hvd.Compression.bf16 if on_tpu else hvd.Compression.none,
     )
 
-    # --- raw JAX baseline: hand-written DP step (per-leaf grad pmean, no
-    # fusion/compression machinery) — what a user would write without the
-    # framework.
-    raw_opt = optax.sgd(0.1, momentum=0.9)
+    def run_dist():
+        step = _build_step(model, dist_opt, mesh, axis, loss_fn)
+        return _time_steps(step, fresh_state(dist_opt), batch, **timing)
 
-    def hand_pmean(grads):
-        return jax.tree.map(lambda g: jax.lax.pmean(g, axis), grads)
-
-    raw_step = _build_step(
-        model, raw_opt, mesh, axis, loss_fn, sync_grads=hand_pmean
-    )
-    t_raw, _ = _time_steps(raw_step, fresh_state(raw_opt), batch, **timing)
-
-    # --- machinery-forced efficiency: disable the n=1 short-circuit so the
-    # compression/bucketing/collective path actually executes (non-circular
-    # on one chip; converges with vs_baseline on real multi-chip worlds).
-    import os
-
-    os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
-    try:
-        forced_step = _build_step(model, dist_opt, mesh, axis, loss_fn)
-        t_forced, _ = _time_steps(
-            forced_step, fresh_state(dist_opt), batch, **timing
+    dist = _with_retry("resnet_dist", run_dist, errors,
+                       allow_retry=single_controller)
+    if dist is not None:
+        t_dist, spread = dist
+        images_per_sec = global_batch / t_dist
+        mfu = None
+        if on_tpu and image == 224 and peak is not None:
+            mfu = (images_per_sec *
+                   RESNET50_TRAIN_FLOPS_PER_IMAGE_224) / (peak * n)
+        emit.update(
+            value=round(images_per_sec, 2),
+            step_time_ms=round(t_dist * 1e3, 3),
+            step_time_spread=round(spread, 4),
+            mfu=round(mfu, 4) if mfu is not None else None,
+            global_batch=global_batch,
+            n_devices=n,
+            backend=jax.default_backend(),
+            device_kind=getattr(jax.devices()[0], "device_kind", "unknown"),
         )
-    finally:
-        del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
 
-    images_per_sec = global_batch / t_dist
-    vs_baseline = (global_batch / t_dist) / (global_batch / t_raw)
-    vs_baseline_machinery = t_raw / t_forced
+    # --- section 2: raw JAX baseline — hand-written DP step (per-leaf grad
+    # pmean, no fusion/compression machinery).
+    def run_raw():
+        raw_opt = optax.sgd(0.1, momentum=0.9)
 
-    mfu = None
-    if on_tpu and image == 224:
-        peak = _chip_peak_flops(jax.devices()[0])
-        if peak is not None:
-            achieved = images_per_sec * RESNET50_TRAIN_FLOPS_PER_IMAGE_224
-            mfu = achieved / (peak * n)
+        def hand_pmean(grads):
+            return jax.tree.map(lambda g: jax.lax.pmean(g, axis), grads)
 
-    bert = bench_bert(hvd, timing)
+        step = _build_step(
+            model, raw_opt, mesh, axis, loss_fn, sync_grads=hand_pmean
+        )
+        return _time_steps(step, fresh_state(raw_opt), batch, **timing)
 
-    record = {
-        "metric": "resnet50_images_per_sec",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec",
-        "vs_baseline": round(vs_baseline, 4),
-        "vs_baseline_machinery": round(vs_baseline_machinery, 4),
-        "step_time_ms": round(t_dist * 1e3, 3),
-        "step_time_spread": round(spread, 4),
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "global_batch": global_batch,
-        "n_devices": n,
-        "backend": jax.default_backend(),
-        "device_kind": getattr(jax.devices()[0], "device_kind", "unknown"),
-    }
-    record.update(bert)
-    print(json.dumps(record))
-    return 0
+    raw = None
+    if not out_of_time():
+        raw = _with_retry("resnet_raw", run_raw, errors,
+                          allow_retry=single_controller)
+        if raw is not None and dist is not None:
+            emit.update(vs_baseline=round(raw[0] / dist[0], 4))
+
+    # --- section 3: BERT-Large MLM pretraining row. Runs BEFORE the
+    # machinery-forced variant: under a tight budget the BERT MFU row is
+    # worth more than the second efficiency ratio.
+    bert = None
+    if not out_of_time():
+        bert = _with_retry("bert", lambda: bench_bert(hvd, timing), errors,
+                           allow_retry=single_controller)
+        if bert is not None:
+            emit.update(**bert)
+
+    # --- section 4: machinery-forced efficiency — disable the n=1
+    # short-circuit so compression/bucketing/collective actually execute.
+    def run_forced():
+        os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
+        try:
+            step = _build_step(model, dist_opt, mesh, axis, loss_fn)
+            return _time_steps(step, fresh_state(dist_opt), batch, **timing)
+        finally:
+            del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
+
+    if raw is not None and not out_of_time():
+        forced = _with_retry("resnet_forced", run_forced, errors,
+                             allow_retry=single_controller)
+        if forced is not None:
+            emit.update(vs_baseline_machinery=round(raw[0] / forced[0], 4))
+
+    if errors:
+        emit.record["errors"] = errors
+    emit.update(bench_wall_time_s=round(time.perf_counter() - t_start, 1))
+    return 0 if dist is not None else 1
 
 
 if __name__ == "__main__":

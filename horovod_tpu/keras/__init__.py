@@ -65,11 +65,16 @@ def DistributedOptimizer(optimizer, op: str = Average,
             self._hvd_count = 0
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
-            gv = list(grads_and_vars)
+            gv_all = list(grads_and_vars)
+            # Unconnected/unused trainables yield g=None — exclude them
+            # from the exchange (None has no dtype) and hand them to the
+            # base optimizer untouched, as DistributedGradientTape does.
+            gv = [(g, v) for g, v in gv_all if g is not None]
+            none_pairs = [(g, v) for g, v in gv_all if g is None]
             eff = (process_set.size() if process_set is not None
                    else hvd_tf.size())
             if hvd_tf.size() <= 1 or eff <= 1 or not gv:
-                return super().apply_gradients(gv, *args, **kwargs)
+                return super().apply_gradients(gv_all, *args, **kwargs)
             acc = getattr(self, "_hvd_acc", None)
             self._hvd_count = getattr(self, "_hvd_count", 0) + 1
             if backward_passes_per_step > 1:
@@ -92,7 +97,8 @@ def DistributedOptimizer(optimizer, op: str = Average,
                 (tf.cast(tf.convert_to_tensor(a), g.dtype), v)
                 for a, (g, v) in zip(reduced_arrays, gv)
             ]
-            return super().apply_gradients(reduced, *args, **kwargs)
+            return super().apply_gradients(reduced + none_pairs,
+                                           *args, **kwargs)
 
     _Distributed.__name__ = f"Distributed{base.__name__}"
     cfg = optimizer.get_config()
@@ -115,7 +121,19 @@ class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
     def _broadcast(self):
         model_vars = list(self.model.trainable_variables
                           + self.model.non_trainable_variables)
-        if not model_vars:
+        if hvd_tf.size() > 1:
+            # Builtness is a LOCAL fact (rank 0 may have built/restored
+            # the model before fit while peers are unbuilt); gating entry
+            # to the exchange on it would let built ranks enter the
+            # collectives below while unbuilt ranks skip — a negotiation
+            # hang. Agree collectively first: proceed only once every
+            # rank has model variables. Every rank reaches this point the
+            # same number of times (keras fires callbacks symmetrically),
+            # so the agreement collective itself always pairs up.
+            built = hvd_tf._allgather_object_host(bool(model_vars))
+            if not all(built):
+                return
+        elif not model_vars:
             # Unbuilt model. The optimizer may already own variables
             # (keras 3 creates `iterations` at construction), but
             # broadcasting those alone would mark the job done before the
