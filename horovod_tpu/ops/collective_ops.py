@@ -513,36 +513,97 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
 
     Parity: ``hvd.alltoall`` (the collective primitive MoE/expert-parallel
     dispatch builds on). Equal splits compile to one AllToAll HLO — the
-    all-to-all rides ICI directly. Uneven `splits` are not supported in the
-    compiled path (XLA static shapes); pad to equal chunks.
+    all-to-all rides ICI directly.
+
+    Uneven ``splits`` (the reference's variable-chunk contract) are
+    supported outside the traced regime and return the reference's pair
+    ``(output, received_splits)``:
+
+    - per-process host path: ``alltoall_v`` recipe — split-table exchange +
+      pad-to-max + one equal alltoall + compact (native negotiation
+      throughout, subsets included);
+    - eager stacked-rank path: pad-to-max into the ONE compiled AllToAll
+      HLO, then per-row compaction. ``splits`` may be per-rank ``(n, n)``
+      (row r = rank r's split table) or a shared ``(n,)`` vector; the
+      ragged per-rank results come back as a list of arrays (row r = rank
+      r's received concatenation).
+
+    Inside jit (traced regime) XLA's static shapes make ragged exchange
+    unrepresentable — pad to equal chunks upstream.
     """
-    if splits is not None:
-        raise NotImplementedError(
-            "uneven alltoall splits require dynamic shapes, which cannot "
-            "compile on TPU; pad chunks to equal size (see "
-            "horovod_tpu.ops.fusion.pad_to_multiple)"
-        )
     ps = _resolve_process_set(process_set)
     traced_axis = _effective_traced_axis(ps)
     if traced_axis is not None:
+        if splits is not None:
+            raise NotImplementedError(
+                "uneven alltoall splits cannot compile inside jit (XLA "
+                "static shapes); pad chunks to equal size (see "
+                "horovod_tpu.ops.fusion.pad_to_multiple) or call the "
+                "eager/host flavor outside the trace"
+            )
         return _alltoall_traced(tensor, traced_axis)
     world = _native_world_if_per_process(ps, tensor)
     if world is not None:
-        if ps.process_set_id != 0:
-            raise ValueError(
-                "per-process eager alltoall on a non-global process set is "
-                "not supported by the native data plane; use the traced "
-                "(shard_map) path"
-            )
         import numpy as np
 
-        return world.alltoall(np.ascontiguousarray(tensor), name=name)
+        ps_id = _native_set_for(ps, world)
+        if splits is not None:
+            return world.alltoall_v(
+                np.ascontiguousarray(tensor), splits, name=name,
+                process_set_id=ps_id,
+                members=ps.ranks if ps_id else None)
+        return world.alltoall(np.ascontiguousarray(tensor), name=name,
+                              process_set_id=ps_id)
     del name
+    if splits is not None:
+        return _alltoall_splits_stacked(tensor, splits, ps)
 
     def traced(x):
         return _alltoall_traced(x, ps.axis_name)
 
     return _eager_dispatch("alltoall", traced, tensor, ps)
+
+
+def _alltoall_splits_stacked(tensor, splits, ps):
+    """Eager stacked-rank uneven alltoall: pad every chunk to the global
+    max so the exchange itself is the ONE compiled equal-split AllToAll
+    HLO, then compact per row. Returns ``(outputs, received_splits)`` with
+    ``outputs`` a list (row r = rank r's ragged result — ragged rows
+    cannot stack into one array)."""
+    import numpy as np
+
+    n = ps.size()
+    x = np.asarray(tensor)
+    if x.ndim < 2 or x.shape[0] != n:
+        raise ValueError(
+            f"eager alltoall(splits=) expects the stacked-rank convention: "
+            f"shape (n={n}, d0, ...); got {x.shape}"
+        )
+    sp = np.asarray(splits, dtype=np.int64)
+    if sp.shape == (n,):
+        sp = np.tile(sp, (n, 1))
+    if sp.shape != (n, n):
+        raise ValueError(
+            f"splits must be shape ({n},) or ({n}, {n}); got {sp.shape}")
+    if not np.all(sp.sum(axis=1) == x.shape[1]):
+        raise ValueError(
+            f"each rank's splits must sum to dim-0 size {x.shape[1]}; got "
+            f"row sums {sp.sum(axis=1).tolist()}"
+        )
+    from ..runtime import compact_chunks, pad_chunks
+
+    max_c = max(1, int(sp.max()))
+    padded = np.stack([pad_chunks(x[r], sp[r], max_c) for r in range(n)])
+
+    def traced(v):
+        return _alltoall_traced(v, ps.axis_name)
+
+    exchanged = np.asarray(
+        _eager_dispatch("alltoall", traced, padded, ps))
+    received = sp.T  # received[i, j] = what rank i got from rank j
+    outputs = [compact_chunks(exchanged[i], received[i], max_c)
+               for i in range(n)]
+    return outputs, received
 
 
 def reducescatter(
@@ -567,12 +628,6 @@ def reducescatter(
         )
     world = _native_world_if_per_process(ps, tensor)
     if world is not None:
-        if ps.process_set_id != 0:
-            raise ValueError(
-                "per-process eager reducescatter on a non-global process "
-                "set is not supported by the native data plane; use the "
-                "traced (shard_map) path"
-            )
         if op not in (Sum, Average) or prescale_factor != 1.0 \
                 or postscale_factor != 1.0:
             raise ValueError(
@@ -582,7 +637,8 @@ def reducescatter(
         import numpy as np
 
         return world.reducescatter(np.ascontiguousarray(tensor), name=name,
-                                   op=op)
+                                   op=op,
+                                   process_set_id=_native_set_for(ps, world))
     del name
 
     def traced(x):
@@ -636,20 +692,13 @@ def barrier(process_set=None) -> None:
     import os
 
     if int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) > 1:
-        if ps.process_set_id != 0:
-            # A device-mesh psum would only synchronize devices, not the
-            # controller processes' host threads — refusing beats silently
-            # handing back a weaker primitive.
-            raise ValueError(
-                "barrier on a non-global process set is not supported in "
-                "multi-process worlds yet; use the global barrier or a "
-                "traced collective"
-            )
         # Multi-controller: the native runtime's barrier synchronizes the
-        # controller processes themselves.
+        # controller processes themselves. Subset barriers release once
+        # every MEMBER announced (the world ring only carries execution).
         from ..parallel.hierarchical import _default_native_world
 
-        _default_native_world().barrier()
+        world = _default_native_world()
+        world.barrier(process_set_id=_native_set_for(ps, world))
         return
     token = jnp.ones((ps.size(),), dtype=jnp.int32)
     out = _eager_dispatch(

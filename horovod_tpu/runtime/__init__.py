@@ -58,6 +58,32 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+# -- ragged-chunk helpers (shared by every uneven-alltoall substrate:
+# NativeWorld.alltoall_v here, the stacked-rank compiled path in
+# ops/collective_ops) -------------------------------------------------------
+
+
+def pad_chunks(x: np.ndarray, splits, max_c: int) -> np.ndarray:
+    """Lay out ``x``'s variable-size dim-0 chunks (``splits[j]`` rows each)
+    into equal ``max_c``-row slots: slot j = chunk j zero-padded."""
+    n = len(splits)
+    padded = np.zeros((n * max_c,) + x.shape[1:], dtype=x.dtype)
+    off = 0
+    for j in range(n):
+        c = int(splits[j])
+        padded[j * max_c: j * max_c + c] = x[off: off + c]
+        off += c
+    return padded
+
+
+def compact_chunks(exchanged: np.ndarray, received, max_c: int) -> np.ndarray:
+    """Inverse of :func:`pad_chunks`: take the first ``received[j]`` rows
+    of each ``max_c``-row slot and concatenate."""
+    return np.concatenate(
+        [exchanged[j * max_c: j * max_c + int(received[j])]
+         for j in range(len(received))], axis=0)
+
+
 def _build() -> None:
     subprocess.run(
         ["make", "-s", "-C", os.path.join(_HERE, "cpp")],
@@ -201,6 +227,7 @@ class NativeWorld:
         self._inflight: dict[int, tuple[Any, Any]] = {}
         self._inflight_lock = threading.Lock()
         self._name_counters: dict[int, int] = {}
+        self._name_lock = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -248,10 +275,20 @@ class NativeWorld:
         # Counters are PER SET: co-members of a set must generate matching
         # auto-names even when their activity on OTHER sets differs (a
         # shared counter diverges the moment rank A does an op on a set
-        # rank B is not in).
-        n = self._name_counters.get(process_set_id, 0) + 1
-        self._name_counters[process_set_id] = n
+        # rank B is not in). Locked: composite async ops reserve names
+        # from framework threads.
+        with self._name_lock:
+            n = self._name_counters.get(process_set_id, 0) + 1
+            self._name_counters[process_set_id] = n
         return f"{prefix}.{n}"
+
+    def reserve_name(self, prefix: str, process_set_id: int = 0) -> str:
+        """Reserve the next auto-name ON THE CALLING THREAD. Composite
+        async ops (ragged allgather/alltoall futures) must take their name
+        in deterministic program order BEFORE handing work to a thread —
+        auto-naming inside an unordered worker thread would pair tensors
+        across ranks by scheduler luck."""
+        return self._auto_name(prefix, process_set_id)
 
     def _enqueue(self, op: int, x: np.ndarray, out: np.ndarray,
                  name: str | None, reduce_op: str = "sum", root_rank: int = 0,
@@ -364,22 +401,29 @@ class NativeWorld:
 
     def alltoall_async(self, x: np.ndarray, name: str | None = None,
                        process_set_id: int = 0) -> int:
-        # Non-global sets are rejected at negotiation (clear error response)
-        # — passing the id through keeps the failure mode user-visible.
-        out = np.empty_like(np.ascontiguousarray(x))
+        x = np.ascontiguousarray(x)
+        n = self.process_set_size(process_set_id)
+        if x.ndim and x.shape[0] % n != 0:
+            raise ValueError(
+                f"alltoall dim0 ({x.shape[0]}) must divide by the process "
+                f"set size ({n})"
+            )
+        out = np.empty_like(x)
         return self._enqueue(OP_ALLTOALL, x, out, name,
                              process_set_id=process_set_id)
 
     def reducescatter_async(self, x: np.ndarray, name: str | None = None,
-                            op: str = "sum") -> int:
+                            op: str = "sum", process_set_id: int = 0) -> int:
         x = np.ascontiguousarray(x)
-        if x.shape[0] % self.size != 0:
+        n = self.process_set_size(process_set_id)
+        if x.shape[0] % n != 0:
             raise ValueError(
-                f"reducescatter dim0 ({x.shape[0]}) must divide by world "
-                f"size ({self.size})"
+                f"reducescatter dim0 ({x.shape[0]}) must divide by the "
+                f"process set size ({n})"
             )
-        out = np.empty((x.shape[0] // self.size,) + x.shape[1:], dtype=x.dtype)
-        return self._enqueue(OP_REDUCESCATTER, x, out, name, reduce_op=op)
+        out = np.empty((x.shape[0] // n,) + x.shape[1:], dtype=x.dtype)
+        return self._enqueue(OP_REDUCESCATTER, x, out, name, reduce_op=op,
+                             process_set_id=process_set_id)
 
     # -- blocking wrappers ----------------------------------------------------
 
@@ -425,14 +469,62 @@ class NativeWorld:
     def alltoall(self, x, name=None, **kw) -> np.ndarray:
         return self.synchronize(self.alltoall_async(x, name, **kw))
 
-    def reducescatter(self, x, name=None, op="sum") -> np.ndarray:
-        return self.synchronize(self.reducescatter_async(x, name, op=op))
+    def alltoall_v(self, x, splits, name=None, process_set_id: int = 0,
+                   members=None):
+        """Uneven alltoall (parity: ``hvd.alltoall(splits=)``): this rank's
+        ``x`` holds one variable-size dim-0 chunk per member — chunk j
+        (``splits[j]`` rows) goes to member j. Returns ``(out,
+        received_splits)``: the concatenation of the chunks each member sent
+        here, plus who-sent-how-much (the reference's second return value).
 
-    def barrier(self) -> None:
+        Recipe (same shape as ``allgather_v``): exchange the split tables,
+        pad every chunk to the global max, one equal-split alltoall through
+        the normal negotiation path, compact. ``members`` (sorted global
+        ranks) is required for non-global sets to locate this rank's
+        set-index.
+        """
+        x = np.ascontiguousarray(x)
+        if x.ndim == 0:
+            x = x[None]
+        n = self.process_set_size(process_set_id)
+        splits = np.asarray(splits, dtype=np.int64).reshape(n)
+        if int(splits.sum()) != x.shape[0]:
+            raise ValueError(
+                f"splits sum to {int(splits.sum())} but tensor dim0 is "
+                f"{x.shape[0]}"
+            )
+        if process_set_id == 0:
+            my_index = self.rank
+        else:
+            if members is None:
+                raise ValueError(
+                    "alltoall_v on a non-global set needs members= (sorted "
+                    "global ranks) to locate this rank's set index")
+            my_index = sorted(members).index(self.rank)
+        base = name or self._auto_name("atv", process_set_id)
+        # Split-table exchange: row j = member j's splits.
+        all_splits = np.asarray(self.allgather(
+            splits, name=f"{base}.sp",
+            process_set_id=process_set_id)).reshape(n, n)
+        max_c = int(all_splits.max()) if n else 0
+        max_c = max(max_c, 1)  # zero-size chunks still need a wire slot
+        exchanged = np.asarray(self.alltoall(
+            pad_chunks(x, splits, max_c), name=f"{base}.data",
+            process_set_id=process_set_id))
+        received = all_splits[:, my_index]
+        return compact_chunks(exchanged, received, max_c), received
+
+    def reducescatter(self, x, name=None, op="sum", **kw) -> np.ndarray:
+        return self.synchronize(
+            self.reducescatter_async(x, name, op=op, **kw))
+
+    def barrier(self, process_set_id: int = 0) -> None:
         token = np.zeros(1, dtype=np.int32)
         out = np.empty_like(token)
         self.synchronize(
-            self._enqueue(OP_BARRIER, token, out, self._auto_name("barrier"))
+            self._enqueue(OP_BARRIER, token, out,
+                          self._auto_name("barrier", process_set_id),
+                          process_set_id=process_set_id)
         )
 
     def join(self, timeout_s: float = 600.0) -> int:
@@ -525,17 +617,20 @@ class NativeWorld:
                                    process_set_id=process_set_id)
 
     def grouped_reducescatter_async(self, tensors, name=None,
-                                    op="average") -> list:
+                                    op="average",
+                                    process_set_id: int = 0) -> list:
+        n = self.process_set_size(process_set_id)
         xs = [np.ascontiguousarray(t) for t in tensors]
         for x in xs:
-            if x.shape[0] % self.size != 0:
+            if x.shape[0] % n != 0:
                 raise ValueError(
                     f"reducescatter dim0 ({x.shape[0]}) must divide by "
-                    f"world size ({self.size})"
+                    f"the process set size ({n})"
                 )
-        shapes = [(x.shape[0] // self.size,) + x.shape[1:] for x in xs]
+        shapes = [(x.shape[0] // n,) + x.shape[1:] for x in xs]
         return self._grouped_async(OP_REDUCESCATTER, xs, shapes,
-                                   name=name, op=op)
+                                   name=name, op=op,
+                                   process_set_id=process_set_id)
 
     def grouped_allreduce(self, tensors, name=None, op="average",
                           process_set_id: int = 0,

@@ -438,11 +438,16 @@ Status Controller::CoordinatorCycle(const RequestList& mine,
       }
       if (req.process_set_id != 0 &&
           (req.op == OpType::kAlltoall || req.op == OpType::kReducescatter)) {
-        resp.error = "op on tensor '" + req.name +
-                     "' does not support non-global process sets in the "
-                     "native data plane (allreduce/allgather/broadcast/"
-                     "barrier do); use the traced XLA path for subset " +
-                     "alltoall/reducescatter";
+        // Subset alltoall/reducescatter ride the world ring with identity
+        // contributions (like allreduce/allgather); the only structural
+        // requirement is that the member count divides the tensor.
+        const int64_t m = static_cast<int64_t>(
+            members_of(req.process_set_id).size());
+        if (m > 0 && req.count % m != 0) {
+          resp.error = "op on tensor '" + req.name + "': count " +
+                       std::to_string(req.count) + " does not divide by "
+                       "process set size " + std::to_string(m);
+        }
       }
       if (resp.error.empty()) resp.error = joined_member_error(req);
       responses.push_back(std::move(resp));

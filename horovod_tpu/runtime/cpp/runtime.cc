@@ -186,7 +186,8 @@ void PerformOperation(GlobalState* st, const Response& resp) {
         if (st->joining.load() || !is_member) {
           scratch.emplace_back(new std::vector<char>(
               static_cast<size_t>(resp.counts[i]) * elem0, 0));
-          if (!is_member && resp.op == OpType::kAllreduce) {
+          if (!is_member && (resp.op == OpType::kAllreduce ||
+                             resp.op == OpType::kReducescatter)) {
             // Joined ranks contribute zeros even to Min/Max (reference
             // caveat, docs/join.md); non-members must be invisible.
             FillIdentity(scratch.back()->data(), resp.counts[i], resp.dtype,
@@ -347,15 +348,74 @@ void PerformOperation(GlobalState* st, const Response& resp) {
     case OpType::kAlltoall: {
       TensorEntry& e = entries[0];
       st->timeline.Begin(e.name, "RING_ALLTOALL");
-      s = t->Alltoall(e.input, e.output, e.count, resp.dtype);
+      if (resp.process_set_id == 0) {
+        s = t->Alltoall(e.input, e.output, e.count, resp.dtype);
+      } else {
+        // Subset alltoall rides the world ring: gather every rank's full
+        // input (non-members contribute zero scratch), then member with
+        // set-index i compacts chunk i of each member's input, in member
+        // order. The controller validated count % members == 0.
+        const auto members =
+            st->controller->ProcessSetMembers(resp.process_set_id);
+        const int64_t m = static_cast<int64_t>(members.size());
+        std::vector<char> tmp(static_cast<size_t>(t->size()) *
+                              static_cast<size_t>(e.count) * elem);
+        s = t->Allgather(e.input, tmp.data(), e.count, resp.dtype);
+        if (s.ok && is_member) {
+          int64_t my_index = -1;
+          for (size_t j = 0; j < members.size(); ++j) {
+            if (members[j] == st->rank) my_index = static_cast<int64_t>(j);
+          }
+          const size_t chunk =
+              static_cast<size_t>(e.count / m) * elem;
+          const size_t stride = static_cast<size_t>(e.count) * elem;
+          for (int64_t j = 0; j < m; ++j) {
+            std::memcpy(
+                static_cast<char*>(e.output) + static_cast<size_t>(j) * chunk,
+                tmp.data() + static_cast<size_t>(members[j]) * stride +
+                    static_cast<size_t>(my_index) * chunk,
+                chunk);
+          }
+        }
+      }
       st->timeline.End(e.name);
       break;
     }
     case OpType::kReducescatter: {
       TensorEntry& e = entries[0];
       st->timeline.Begin(e.name, "RING_REDUCESCATTER");
-      s = t->Reducescatter(e.input, e.output, e.count, resp.dtype,
-                           resp.reduce_op);
+      if (resp.process_set_id == 0) {
+        s = t->Reducescatter(e.input, e.output, e.count, resp.dtype,
+                             resp.reduce_op);
+      } else {
+        // Subset reducescatter: full-tensor world-ring allreduce (identity
+        // contributions from non-members), then member with set-index i
+        // keeps slice i. Average divides by the member count.
+        const auto members =
+            st->controller->ProcessSetMembers(resp.process_set_id);
+        const int64_t m = static_cast<int64_t>(members.size());
+        ReduceOp ring_op = resp.reduce_op == ReduceOp::kAverage
+                               ? ReduceOp::kSum
+                               : resp.reduce_op;
+        std::vector<char> tmp(static_cast<size_t>(e.count) * elem);
+        std::memcpy(tmp.data(), e.input, tmp.size());
+        s = t->Allreduce(tmp.data(), e.count, resp.dtype, ring_op);
+        if (s.ok && is_member) {
+          int64_t my_index = -1;
+          for (size_t j = 0; j < members.size(); ++j) {
+            if (members[j] == st->rank) my_index = static_cast<int64_t>(j);
+          }
+          const int64_t slice_count = e.count / m;
+          const size_t slice_bytes =
+              static_cast<size_t>(slice_count) * elem;
+          std::memcpy(e.output,
+                      tmp.data() + static_cast<size_t>(my_index) * slice_bytes,
+                      slice_bytes);
+          if (resp.reduce_op == ReduceOp::kAverage) {
+            ScaleBuffer(e.output, slice_count, resp.dtype, 1.0 / m);
+          }
+        }
+      }
       st->timeline.End(e.name);
       break;
     }

@@ -249,10 +249,35 @@ def broadcast(tensor, root_rank: int, name: str | None = None,
     )
 
 
-def alltoall(tensor, name: str | None = None,
+def alltoall(tensor, splits=None, name: str | None = None,
              process_set: ProcessSet | None = None):
     """Scatter dim-0 splits of ``tensor`` to every rank and gather theirs
-    (even splits; parity: ``hvd.alltoall`` tensorflow flavor)."""
+    (parity: ``hvd.alltoall`` tensorflow flavor). With uneven ``splits``
+    returns the reference's pair ``(output, received_splits)``."""
+    if splits is not None:
+        if _in_graph(tensor) or _in_graph(splits):
+            # Two-output py_function: the (output, received_splits) pair
+            # of the eager path, traced into the graph (output dim-0 is
+            # data-dependent — no static shape to restore). splits stays
+            # a graph input — it is usually computed in-graph (e.g. a
+            # bincount of expert assignments), so no trace-time numpy.
+            return tf.py_function(
+                lambda t, s: alltoall(t, splits=s,
+                                      process_set=process_set, name=name),
+                [tensor, tf.cast(tf.convert_to_tensor(splits), tf.int64)],
+                Tout=[tensor.dtype, tf.int64])
+        sp = np.asarray(_np(splits), dtype=np.int64)
+        x = _np(tensor)
+        if size() <= 1:
+            return (tf.convert_to_tensor(x),
+                    tf.convert_to_tensor(sp.reshape(1)))
+        ps_id = _ps_id(process_set)
+        members = process_set.ranks if (
+            process_set is not None and ps_id) else None
+        out, received = _world().alltoall_v(
+            x, sp, name=name, process_set_id=ps_id, members=members)
+        return (tf.convert_to_tensor(np.ascontiguousarray(out)),
+                tf.convert_to_tensor(np.ascontiguousarray(received)))
     if _in_graph(tensor):
         return _graph_wrap(
             tensor,
@@ -268,33 +293,30 @@ def alltoall(tensor, name: str | None = None,
 def reducescatter(tensor, op: str = Average, name: str | None = None,
                   process_set: ProcessSet | None = None):
     """Reduce across ranks (default Average — reference parity, same as
-    the JAX surface), return this rank's dim-0 shard."""
-    if process_set is not None and process_set.process_set_id != 0:
-        # checked WITHOUT resolving: _ps_id would spin up the native
-        # runtime as a side effect just to raise
-        raise ValueError(
-            "reducescatter on a non-global process set is not supported "
-            "by the native runtime; reduce on the global set or use "
-            "allreduce + local slice")
+    the JAX surface), return this rank's dim-0 shard. Non-global process
+    sets ride the world ring with identity contributions."""
     if _in_graph(tensor):
         return _graph_wrap(
-            tensor, lambda t: reducescatter(t, op=op, name=name),
+            tensor, lambda t: reducescatter(t, op=op, name=name,
+                                            process_set=process_set),
             keep_shape=False,  # output is the dim-0 shard, not input-shaped
         )
     x = _np(tensor)
     if size() <= 1:
         return tf.convert_to_tensor(x)
-    out = np.asarray(_world().reducescatter(x, name=name, op=op))
+    out = np.asarray(_world().reducescatter(
+        x, name=name, op=op, process_set_id=_ps_id(process_set)))
     return tf.convert_to_tensor(out)
 
 
-def barrier() -> None:
-    """Block until every process reaches the barrier (parity:
-    ``hvd.barrier``). Call before exiting when ranks finish uneven work —
-    a rank's exit shuts the shared world down (reference semantics), so
-    peers mid-collective would otherwise see 'runtime shut down'."""
+def barrier(process_set: ProcessSet | None = None) -> None:
+    """Block until every process (or set member) reaches the barrier
+    (parity: ``hvd.barrier``). Call before exiting when ranks finish
+    uneven work — a rank's exit shuts the shared world down (reference
+    semantics), so peers mid-collective would otherwise see 'runtime shut
+    down'."""
     if size() > 1:
-        _world().barrier()
+        _world().barrier(process_set_id=_ps_id(process_set))
 
 
 def join(timeout_s: float = 600.0) -> int:

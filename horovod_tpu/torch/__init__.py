@@ -122,7 +122,6 @@ class Compression:
 
 # handle -> (compression ctx, original dtype restore info)
 _handle_ctx: dict[int, Any] = {}
-_agv_counter = 0
 _local_handle = 0  # unique negative handles for 1-process worlds
 
 
@@ -245,12 +244,16 @@ def allgather_async(tensor, name: str | None = None,
     worker thread."""
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
-    global _agv_counter
-    _agv_counter += 1
-    base = name or f"torch.agv.{_agv_counter}"
+    # Reserve the auto-name HERE, on the calling thread (deterministic
+    # program order), via the world's per-set counter — a module-global
+    # counter would survive elastic re-formation and diverge across subset
+    # members, while naming inside the worker thread would pair tensors
+    # across ranks by scheduler luck.
     w = _world()
-    fut = _spawn_future(w.allgather_v, _np_of(tensor), name=base,
-                        process_set_id=_ps_id(process_set))
+    ps_id = _ps_id(process_set)
+    name = name or w.reserve_name("agv", ps_id)
+    fut = _spawn_future(w.allgather_v, _np_of(tensor), name=name,
+                        process_set_id=ps_id)
     return _register_async(None, "allgather_future", (tensor, fut))
 
 
@@ -275,8 +278,30 @@ def broadcast_async_(tensor, root_rank: int, name: str | None = None,
     return _register_async(h, "allreduce", tensor)  # in-place copy-back
 
 
-def alltoall_async(tensor, name: str | None = None,
+def alltoall_async(tensor, splits=None, name: str | None = None,
                    process_set: ProcessSet | None = None) -> int:
+    if splits is not None:
+        # Uneven splits are a composite protocol (split-table exchange +
+        # padded alltoall + compact) — ride a worker thread like the
+        # ragged allgather; synchronize() returns the (output,
+        # received_splits) pair.
+        sp = np.asarray(
+            splits.cpu().numpy() if torch.is_tensor(splits) else splits,
+            dtype=np.int64)
+        if size() <= 1:
+            return _register_async(
+                None, "identity",
+                (tensor.clone(), torch.from_numpy(sp.reshape(1))))
+        w = _world()
+        ps_id = _ps_id(process_set)
+        members = process_set.ranks if (
+            process_set is not None and ps_id) else None
+        # Name reserved on the calling thread (see allgather_async).
+        name = name or w.reserve_name("atv", ps_id)
+        fut = _spawn_future(w.alltoall_v, _np_of(tensor), sp,
+                            name=name, process_set_id=ps_id,
+                            members=members)
+        return _register_async(None, "alltoall_v_future", (tensor, fut))
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
     h = _world().alltoall_async(_np_of(tensor), name=name,
@@ -287,17 +312,11 @@ def alltoall_async(tensor, name: str | None = None,
 def reducescatter_async(tensor, name: str | None = None,
                         op: str | None = None,
                         process_set: ProcessSet | None = None) -> int:
-    if process_set is not None and process_set.process_set_id != 0:
-        # checked WITHOUT resolving: _ps_id would spin up the native
-        # runtime as a side effect just to raise
-        raise ValueError(
-            "reducescatter on a non-global process set is not supported "
-            "by the native runtime; reduce on the global set or use "
-            "allreduce + local slice")
     if size() <= 1:
         return _register_async(None, "identity", tensor.clone())
     h = _world().reducescatter_async(_np_of(tensor), name=name,
-                                     op=op or Average)
+                                     op=op or Average,
+                                     process_set_id=_ps_id(process_set))
     return _register_async(h, "reducescatter", tensor)
 
 
@@ -350,16 +369,12 @@ def grouped_reducescatter_async(tensors: Sequence[Any],
                                 process_set: ProcessSet | None = None) -> int:
     """Atomic grouped reducescatter (default Average; reference:
     ``hvd.grouped_reducescatter``); one handle, list of results."""
-    if process_set is not None and process_set.process_set_id != 0:
-        raise ValueError(
-            "reducescatter on a non-global process set is not supported "
-            "by the native runtime; reduce on the global set or use "
-            "allreduce + local slice")
     if size() <= 1:
         return _register_async(
             None, "group_identity", [t.clone() for t in tensors])
     native = _world().grouped_reducescatter_async(
-        [_np_of(t) for t in tensors], name=name, op=op or Average)
+        [_np_of(t) for t in tensors], name=name, op=op or Average,
+        process_set_id=_ps_id(process_set))
     return _register_async(None, "group",
                            (list(tensors), native, "reducescatter"))
 
@@ -404,6 +419,13 @@ def synchronize(handle: int):
         return torch.from_numpy(
             out.reshape((-1,) + tuple(tensor.shape[1:]))
         ).to(tensor.dtype)
+    if kind == "alltoall_v_future":
+        tensor, fut = payload
+        out, received = fut.result()
+        return (
+            torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype),
+            torch.from_numpy(np.ascontiguousarray(received)),
+        )
     out = np.asarray(_world().synchronize(handle))
     if kind == "reducescatter":
         return torch.from_numpy(out).to(payload.dtype)
@@ -528,8 +550,27 @@ def broadcast_(tensor, root_rank: int, name: str | None = None,
     return tensor
 
 
-def alltoall(tensor, name: str | None = None,
+def alltoall(tensor, splits=None, name: str | None = None,
              process_set: ProcessSet | None = None):
+    """Parity: ``hvd.alltoall``. With ``splits`` (uneven chunks), returns
+    the reference's pair ``(output, received_splits)``; without, the
+    equal-split output alone."""
+    if splits is not None:
+        sp = np.asarray(
+            splits.cpu().numpy() if torch.is_tensor(splits) else splits,
+            dtype=np.int64)
+        if size() <= 1:
+            return tensor.clone(), torch.from_numpy(sp.reshape(1))
+        ps_id = _ps_id(process_set)
+        members = process_set.ranks if (
+            process_set is not None and ps_id) else None
+        out, received = _world().alltoall_v(
+            _np_of(tensor), sp, name=name, process_set_id=ps_id,
+            members=members)
+        return (
+            torch.from_numpy(np.ascontiguousarray(out)).to(tensor.dtype),
+            torch.from_numpy(np.ascontiguousarray(received)),
+        )
     if size() <= 1:
         return tensor.clone()
     out = np.asarray(_world().alltoall(
@@ -543,9 +584,11 @@ def reducescatter(tensor, name: str | None = None, op: str | None = None,
         tensor, name=name, op=op, process_set=process_set))
 
 
-def barrier() -> None:
+def barrier(process_set: ProcessSet | None = None) -> None:
+    """Parity: ``hvd.barrier``. Subset barriers release once every MEMBER
+    has arrived (members only call — reference contract)."""
     if size() > 1:
-        _world().barrier()
+        _world().barrier(process_set_id=_ps_id(process_set))
 
 
 def join(timeout_s: float = 600.0) -> int:

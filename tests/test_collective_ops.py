@@ -102,9 +102,63 @@ def test_alltoall_eager(hvd):
     np.testing.assert_array_equal(out, x.T)
 
 
-def test_alltoall_uneven_splits_rejected(hvd):
+def test_alltoall_uneven_splits_stacked(hvd):
+    # Rank r's chunk j has j+1 rows valued r*100+j; rank i receives i+1
+    # rows from every rank (pad-to-max through ONE compiled AllToAll HLO,
+    # compacted per row).
+    n = 8
+    splits = np.arange(1, n + 1, dtype=np.int64)
+    total = int(splits.sum())
+    x = np.zeros((n, total), np.float32)
+    for r in range(n):
+        off = 0
+        for j in range(n):
+            x[r, off: off + j + 1] = r * 100 + j
+            off += j + 1
+    outs, received = hvd.alltoall(x[:, :, None], splits=splits)
+    assert len(outs) == n
+    for i in range(n):
+        want = np.concatenate(
+            [np.full(i + 1, s * 100 + i, np.float32) for s in range(n)])
+        np.testing.assert_array_equal(np.asarray(outs[i])[..., 0], want)
+        np.testing.assert_array_equal(received[i], np.full(n, i + 1))
+
+
+def test_alltoall_uneven_splits_matrix(hvd):
+    # Per-rank split tables (n, n): rank r sends r rows to rank 0 and the
+    # rest to rank 1 (2-rank subset semantics exercised on the world set
+    # via zero-padding of the remaining destinations).
+    n = 8
+    sp = np.zeros((n, n), np.int64)
+    sp[:, 0] = np.arange(n)
+    sp[:, 1] = n - np.arange(n)
+    x = np.zeros((n, n), np.float32)
+    for r in range(n):
+        x[r, : r] = r * 10  # destined to rank 0
+        x[r, r:] = r * 10 + 1  # destined to rank 1
+    outs, received = hvd.alltoall(x[:, :, None], splits=sp)
+    np.testing.assert_array_equal(received[0], np.arange(n))
+    np.testing.assert_array_equal(received[2], np.zeros(n))
+    want0 = np.concatenate(
+        [np.full(r, r * 10, np.float32) for r in range(n)])
+    np.testing.assert_array_equal(np.asarray(outs[0])[..., 0], want0)
+    assert np.asarray(outs[2]).size == 0
+
+
+def test_alltoall_splits_traced_rejected(hvd):
     with pytest.raises(NotImplementedError):
-        hvd.alltoall(np.zeros((8, 8), np.float32), splits=[1] * 8)
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        jax.jit(
+            jax.shard_map(
+                lambda v: hvd.alltoall(v, splits=[1] * 8),
+                mesh=hvd.global_mesh(),
+                in_specs=P(hvd.global_axis_name()),
+                out_specs=P(hvd.global_axis_name()),
+                check_vma=False,
+            )
+        )(np.zeros((8, 8), np.float32))
 
 
 def test_reducescatter_eager(hvd):

@@ -100,6 +100,18 @@ WORKER = textwrap.dedent(
         want = np.concatenate(
             [np.full(2, s * 10 + rank, np.float32) for s in range(size)])
         check(a2a, want, "alltoall")
+        # Uneven alltoall (splits=): rank r sends j+1 rows (valued
+        # r*100+j) to rank j; rank r receives rank+1 rows from everyone.
+        sp = np.arange(1, size + 1, dtype=np.int64)
+        rows = np.concatenate(
+            [np.full(j + 1, rank * 100 + j, np.float32)
+             for j in range(size)])
+        out, received = w.alltoall_v(rows, sp, name="atv")
+        want = np.concatenate(
+            [np.full(rank + 1, s * 100 + rank, np.float32)
+             for s in range(size)])
+        check(out, want, "alltoall_v")
+        check(received, np.full(size, rank + 1), "alltoall_v.splits")
         # reducescatter
         rs = w.reducescatter(
             np.arange(size * 3, dtype=np.float32) + rank, "rs", op="sum")
@@ -232,17 +244,52 @@ WORKER = textwrap.dedent(
             sys.exit(15)
         except Exception:
             pass
-        # Subset alltoall is rejected at negotiation with guidance (the
-        # native data plane doesn't support it; the traced XLA path does).
+        # Subset alltoall: member with set-index i receives chunk i of
+        # every member's input, member order (world ring + compaction).
+        my_index = peers.index(rank)
+        blocks = np.concatenate(
+            [np.full(2, rank * 10 + j, np.float32) for j in range(2)])
+        a2a = w.alltoall(blocks, f"ps.a2a.{mine}", process_set_id=mine)
+        want = np.concatenate(
+            [np.full(2, p * 10 + my_index, np.float32) for p in peers])
+        check(a2a, want, "ps.alltoall")
+        # Subset alltoall with a non-dividing dim-0 is rejected clearly.
         try:
-            w.alltoall(np.arange(4, dtype=np.float32), f"ps.a2a.{mine}",
+            w.alltoall(np.arange(3, dtype=np.float32), f"ps.a2abad.{mine}",
                        process_set_id=mine)
-            print(f"rank{rank} SUBSET ALLTOALL not rejected", flush=True)
+            print(f"rank{rank} BAD SPLIT not rejected", flush=True)
             sys.exit(16)
         except Exception as e:
-            if "traced XLA path" not in str(e):
+            if "divide" not in str(e):
                 print(f"rank{rank} wrong a2a error: {e}", flush=True)
                 sys.exit(17)
+        # Subset reducescatter: sum over MEMBERS, member-index slice; the
+        # world's non-member values must not leak in.
+        rs_in = np.arange(2 * 3, dtype=np.float32) + rank
+        rs = w.reducescatter(rs_in, f"ps.rs.{mine}", op="sum",
+                             process_set_id=mine)
+        summed = np.arange(2 * 3, dtype=np.float32) * 2 + sum(peers)
+        check(rs, summed[my_index * 3:(my_index + 1) * 3],
+              "ps.reducescatter")
+        rs_avg = w.reducescatter(rs_in, f"ps.rsavg.{mine}", op="average",
+                                 process_set_id=mine)
+        check(rs_avg, summed[my_index * 3:(my_index + 1) * 3] / 2.0,
+              "ps.reducescatter.avg")
+        # Uneven alltoall (splits=) on the subset: member j gets
+        # splits[j] rows. Rank r sends rows valued r*100+j to member j.
+        sp = np.array([1, 2], np.int64)
+        rows = np.concatenate(
+            [np.full(int(sp[j]), rank * 100 + j, np.float32)
+             for j in range(2)])
+        out, received = w.alltoall_v(rows, sp, name=f"ps.atv.{mine}",
+                                     process_set_id=mine, members=peers)
+        want = np.concatenate(
+            [np.full(int(sp[my_index]), p * 100 + my_index, np.float32)
+             for p in peers])
+        check(out, want, "ps.alltoall_v")
+        check(received, np.full(2, sp[my_index]), "ps.alltoall_v.splits")
+        # Subset barrier (releases when every MEMBER arrives).
+        w.barrier(process_set_id=mine)
         w.barrier()
         print(f"rank{rank} process_sets ok", flush=True)
         w.shutdown()
