@@ -6,10 +6,14 @@ Parity: ``horovod/torch/__init__.py`` + ``mpi_ops.py`` + ``optimizer.py``
 re-based on this framework's native C++ runtime instead of a pybind11
 bridge:
 
-- torch tensors here are host tensors (the TPU compute path is XLA/JAX;
-  a torch-xla/PJRT device mode needs torch-xla, which this image lacks —
-  the executable-cache-per-fused-signature design it would use is the one
-  already serving the JAX eager path, ``horovod_tpu.ops.executable_cache``).
+- torch tensors on THIS surface are host tensors riding the native TCP
+  plane (per-process scripting). The DEVICE leg is
+  ``horovod_tpu.torch.device``: DLPack zero-copy torch↔``jax.Array``
+  interop routing through the compiled executable cache
+  (``horovod_tpu.ops.executable_cache``) — the torch-xla/PJRT role of the
+  reference's ``mpi_ops_v2.cc``, minus torch-xla itself (absent from this
+  image; the same entry points apply to torch-xla's dlpack-capable XLA
+  tensors unchanged).
 - ``allreduce_async_`` → handle, ``synchronize(handle)`` match the
   reference's async contract exactly; the native runtime provides
   negotiation, the response-cache fast path, fusion, and the TCP ring.
@@ -857,8 +861,10 @@ def DistributedOptimizer(optimizer, named_parameters=None,
 
 
 from .sync_batch_norm import SyncBatchNorm  # noqa: E402
+from . import device  # noqa: E402  (DLPack → compiled-XLA device plane)
 
 __all__ = [
+    "device",
     "Average", "Sum", "Min", "Max", "Adasum", "Compression", "SyncBatchNorm",
     "init", "shutdown", "is_initialized",
     "size", "rank", "local_rank", "local_size", "cross_rank", "cross_size", "is_homogeneous",
