@@ -26,6 +26,14 @@ from ..utils.env import get_int
 
 
 def fusion_threshold_bytes() -> int:
+    # Precedence: explicit autotune decision > init-time config > env >
+    # default — the tuner's choice is the most specific fact available
+    # (it was measured on THIS model; see autotune.tune_step_fusion).
+    from ..autotune import tuned_threshold
+
+    tuned = tuned_threshold()
+    if tuned is not None:
+        return tuned
     from ..basics import _state
 
     if _state.initialized and _state.config is not None:

@@ -99,11 +99,19 @@ def _reduce_grads(
 
 
 def _known_size(ps) -> int | None:
-    """Process-set size if determinable at trace time, else None."""
+    """Process-set size if determinable at trace time, else None.
+
+    Only the not-yet-initialized cases map to "unknown" (framework error,
+    or the pre-init global set whose rank list is still empty); a
+    genuinely broken process set raises — silently disabling the
+    single-rank short-circuit would mask it."""
+    from .exceptions import HorovodTpuError
+
     try:
-        return ps.size()
-    except Exception:
+        n = ps.size()
+    except HorovodTpuError:
         return None
+    return n if n > 0 else None
 
 
 class _AccumulationState(NamedTuple):
