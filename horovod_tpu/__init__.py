@@ -89,5 +89,6 @@ from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import data_parallel  # noqa: F401
+from .stall import fetch  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401
 from .timeline import start_timeline, stop_timeline  # noqa: F401

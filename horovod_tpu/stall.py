@@ -137,3 +137,72 @@ def get_inspector() -> StallInspector:
         if _inspector is None:
             _inspector = StallInspector()
         return _inspector
+
+
+def fetch(tree, name: str | None = None, timeout_s: float = 600.0):
+    """Materialize a compiled step's results under stall inspection.
+
+    The gap VERDICT r3 #7 names: a diverged rank hanging INSIDE a jitted
+    multi-host step (the classic Horovod deadlock) used to hang the fetch
+    silently — the eager-op inspector never saw it. ``fetch`` closes it by
+    wiring the fetch into BOTH inspectors:
+
+    - **local ticket**: the fetch registers with this process's
+      :class:`StallInspector`, so the watchdog names the hung step after
+      ``HOROVOD_STALL_CHECK_TIME``;
+    - **cross-rank report** (multi-controller worlds): a one-scalar
+      ``stallwatch/<name>`` allreduce is announced on the native host
+      plane alongside the fetch. The native controller's stall inspector
+      already diffs announcements across ranks, so a rank that never
+      reaches this step produces the reference-style report on rank 0 —
+      ``tensor stallwatch/<name> submitted Ns ago, still missing from
+      rank(s) [...]`` — naming exactly who diverged, while the host plane
+      stays live even though the device collective is wedged.
+
+    Use it on the result of a compiled train step::
+
+        params, opt_state, loss = hvd.fetch(
+            step(params, opt_state, batch), name=f"step.{i}")
+
+    Returns ``tree`` with every array ready. ``timeout_s`` bounds the
+    cross-rank watch (not the device fetch itself).
+    """
+    import jax
+
+    from .process_world import size as _proc_size
+
+    inspector = get_inspector()
+    handle = None
+    world = None
+    if _proc_size() > 1:
+        from .parallel.hierarchical import _default_native_world
+
+        import numpy as np
+
+        world = _default_native_world()
+        tag = name or world.reserve_name("step")
+        handle = world.allreduce_async_(
+            np.ones(1, np.float32), name=f"stallwatch/{tag}", op="sum")
+    else:
+        tag = name or "step"
+    ticket = inspector.begin(f"fetch[{tag}]")
+    try:
+        out = jax.block_until_ready(tree)
+        if handle is not None:
+            world.synchronize(handle, timeout_s=timeout_s)
+            handle = None
+        return out
+    finally:
+        inspector.end(ticket)
+        if handle is not None:
+            # The device fetch raised (e.g. the inspector's own shutdown
+            # interrupt) with the stallwatch allreduce still in flight.
+            # Collect it if it already completed; otherwise it MUST stay
+            # pinned — the native runtime holds raw pointers into its
+            # buffers until the collective finishes, and elastic recovery
+            # fails it (releasing the pin) at the next world teardown.
+            try:
+                if world.poll(handle):
+                    world.synchronize(handle, timeout_s=1.0)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
