@@ -71,6 +71,43 @@ class FixedHostDiscovery(HostDiscovery):
         return dict(self._hosts)
 
 
+def snap_to_topology(
+    hosts: Sequence[HostInfo],
+    max_hosts: int | None = None,
+) -> list[HostInfo]:
+    """Snap a candidate host set to a TOPOLOGY-VALID world (SURVEY §8 hard
+    part 3: ICI slices cannot shrink by arbitrary chip counts).
+
+    Validity rules, in order:
+
+    - **host granularity**: whole hosts only — a TPU VM's chips leave or
+      join together (preemption takes the VM, not a chip);
+    - **homogeneous local size**: every chosen host contributes the SAME
+      slot count L. The hierarchical (cross, local) mesh needs equal rows
+      — a ragged world would push full-payload legs onto DCN
+      (``parallel/hierarchical.py``) — and an ICI sub-slice is uniform by
+      construction.
+
+    The chosen L maximizes total ranks ``count(slots >= L) * L`` over the
+    candidate L values present in the set; ties prefer the LARGER L (a
+    wider ICI leg beats more DCN rows at equal rank count). Hosts are
+    returned in the input order (rank stability) with slots clamped to L.
+    """
+    ordered = list(hosts)
+    if max_hosts is not None:
+        ordered = ordered[:max_hosts]
+    if not ordered:
+        return []
+    candidates = sorted({h.slots for h in ordered}, reverse=True)
+    best_l, best_total = 0, -1
+    for L in candidates:
+        total = sum(1 for h in ordered if h.slots >= L) * L
+        if total > best_total:  # ties keep the earlier (larger) L
+            best_l, best_total = L, total
+    return [HostInfo(h.hostname, best_l)
+            for h in ordered if h.slots >= best_l]
+
+
 class HostManager:
     """Tracks discovered hosts, the blacklist, and world-size validity."""
 
@@ -115,8 +152,10 @@ class HostManager:
         self, preferred: Sequence[str], max_np: int | None
     ) -> list[HostInfo]:
         """Choose the next world's hosts: keep `preferred` (current workers)
-        first for rank stability, append new hosts, cap at max_np, then snap
-        down to the largest topology-valid count."""
+        first for rank stability, append new hosts, cap at max_np, snap to
+        a topology-valid shape (host granularity + homogeneous local size,
+        :func:`snap_to_topology`), then snap down to the largest valid
+        host count."""
         with self._lock:
             usable = self._usable_locked()
         ordered: list[HostInfo] = []
@@ -126,8 +165,7 @@ class HostManager:
         for h, s in sorted(usable.items()):
             if all(o.hostname != h for o in ordered):
                 ordered.append(HostInfo(h, s))
-        if max_np is not None:
-            ordered = ordered[:max_np]
+        ordered = snap_to_topology(ordered, max_hosts=max_np)
         while ordered and not self._valid(len(ordered)):
             ordered.pop()
         return ordered

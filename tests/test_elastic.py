@@ -140,3 +140,115 @@ class TestElasticTrainStep:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestTopologySnap:
+    """snap_to_topology (SURVEY §8 hard part 3): worlds form only on
+    host-granular, homogeneous-local-size shapes."""
+
+    def test_drops_ragged_host_when_wide_rows_win(self):
+        from horovod_tpu.runner.elastic.discovery import snap_to_topology
+        from horovod_tpu.runner.hosts import HostInfo
+
+        hosts = [HostInfo("a", 8), HostInfo("b", 8), HostInfo("c", 4)]
+        snapped = snap_to_topology(hosts)
+        # L=8 covers 2*8=16 ranks; L=4 covers 3*4=12 — keep the 8s.
+        assert [(h.hostname, h.slots) for h in snapped] == [
+            ("a", 8), ("b", 8)]
+
+    def test_clamps_to_smaller_local_when_rows_win(self):
+        from horovod_tpu.runner.elastic.discovery import snap_to_topology
+        from horovod_tpu.runner.hosts import HostInfo
+
+        hosts = [HostInfo("a", 8), HostInfo("b", 4), HostInfo("c", 4)]
+        snapped = snap_to_topology(hosts)
+        # L=4 covers 12 > L=8's 8: every host clamps to 4 slots.
+        assert [(h.hostname, h.slots) for h in snapped] == [
+            ("a", 4), ("b", 4), ("c", 4)]
+
+    def test_tie_prefers_wider_ici_leg(self):
+        from horovod_tpu.runner.elastic.discovery import snap_to_topology
+        from horovod_tpu.runner.hosts import HostInfo
+
+        hosts = [HostInfo("a", 8), HostInfo("b", 4)]
+        snapped = snap_to_topology(hosts)  # 1*8 == 2*4: wider local wins
+        assert [(h.hostname, h.slots) for h in snapped] == [("a", 8)]
+
+    def test_pick_world_applies_snap_and_rank_stability(self):
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHostDiscovery, HostManager,
+        )
+        from horovod_tpu.runner.hosts import HostInfo
+
+        mgr = HostManager(FixedHostDiscovery([
+            HostInfo("b", 4), HostInfo("a", 4), HostInfo("c", 2)]))
+        mgr.update_available_hosts()
+        world = mgr.pick_world(preferred=["b"], max_np=None)
+        # Preferred host keeps rank 0; ragged "c" dropped (2*4=8 > 3*2=6).
+        assert [(h.hostname, h.slots) for h in world] == [
+            ("b", 4), ("a", 4)]
+
+
+class TestTopologyResize:
+    """CPU-side proof of elastic × topology (VERDICT r3 #5): a world
+    shrinks 8→4 mid-training on the virtual mesh, the mesh + hierarchical
+    factorization re-form, training continues from committed state with
+    the loss still improving, then the world regrows 4→8."""
+
+    def test_shrink_then_regrow_mid_training(self):
+        import jax
+        import optax
+
+        from horovod_tpu.parallel import data_parallel as dp
+        from horovod_tpu.parallel.hierarchical import hierarchical_mesh
+
+        rng = np.random.RandomState(0)
+        true_w = rng.randn(6).astype(np.float32)
+        x = rng.randn(32, 6).astype(np.float32)
+        y = (x @ true_w).astype(np.float32)
+
+        def loss_fn(params, batch):
+            bx, by = batch
+            return jnp.mean((bx @ params - by) ** 2)
+
+        all_devices = list(jax.devices())
+        assert len(all_devices) == 8
+
+        def form_world(devices):
+            if hvd.is_initialized():
+                hvd.shutdown()
+            hvd.init(devices=devices)
+            assert hvd.size() == len(devices)
+            # The hierarchical factorization must re-form on each epoch's
+            # world (not serve a stale mesh from the previous one).
+            hmesh = hierarchical_mesh()
+            assert hmesh.size == len(devices)
+            opt = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = dp.make_train_step(loss_fn, opt, donate=False)
+            return step, opt
+
+        def train(step, params_host, opt, steps):
+            params = dp.replicate(jnp.asarray(params_host))
+            opt_state = dp.replicate(opt.init(jnp.asarray(params_host)))
+            batch = dp.shard_batch((x, y))
+            loss = None
+            for _ in range(steps):
+                params, opt_state, loss = step(params, opt_state, batch)
+            # Commit: host copy survives the world teardown.
+            return np.asarray(params), float(np.asarray(loss))
+
+        step, opt = form_world(all_devices)
+        w = np.zeros(6, np.float32)
+        w, loss_8 = train(step, w, opt, 5)
+
+        # Preemption takes half the world; the snap re-forms on 4 devices.
+        step, opt = form_world(all_devices[:4])
+        w, loss_4 = train(step, w, opt, 5)
+        assert loss_4 < loss_8, (loss_4, loss_8)  # surviving loss improves
+
+        # Hosts return: regrow to the full mesh and keep improving.
+        step, opt = form_world(all_devices)
+        w, loss_regrow = train(step, w, opt, 5)
+        assert loss_regrow < loss_4, (loss_regrow, loss_4)
+        hvd.shutdown()
+        hvd.init()  # leave the suite's default world behind us
