@@ -25,11 +25,13 @@ def _run_example(name, *args, timeout=300):
 
 
 class TestExamples:
+    @pytest.mark.slow
     def test_mnist(self):
         r = _run_example("jax_mnist.py")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "done" in r.stdout
 
+    @pytest.mark.slow
     def test_synthetic_benchmark(self):
         r = _run_example(
             "jax_synthetic_benchmark.py", "--batch-size", "2",
@@ -37,6 +39,7 @@ class TestExamples:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "Img/sec" in r.stdout
 
+    @pytest.mark.slow
     def test_bert_pretraining(self):
         r = _run_example(
             "jax_bert_pretraining.py", "--config", "tiny", "--steps", "2",
@@ -44,11 +47,31 @@ class TestExamples:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "sequences/sec" in r.stdout
 
+    @pytest.mark.slow
     def test_adasum(self):
         r = _run_example("jax_adasum.py", "--steps", "2")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "done" in r.stdout
 
+    @pytest.mark.slow
+    def test_imagenet_resnet50_flagship(self):
+        """The flagship real-data-scale example (VERDICT r3 #9), smoke-run
+        on synthetic data with checkpointing + timeline wired."""
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            # --autotune-fusion is left out: it re-traces the ResNet step
+            # per candidate (minutes each on the CPU mesh); the tuner has
+            # its own battery in test_autotune.py.
+            r = _run_example(
+                "jax_imagenet_resnet50.py", "--synthetic", "--steps", "2",
+                "--batch-size", "16", "--image-size", "32",
+                "--timeline", os.path.join(d, "tl.json"), timeout=600)
+            assert r.returncode == 0, r.stdout + r.stderr
+            assert "done:" in r.stdout
+            assert os.path.exists(os.path.join(d, "tl.json"))
+
+    @pytest.mark.slow
     def test_spark_keras_estimator_pandas_substrate(self):
         pytest.importorskip("tensorflow")
         try:
