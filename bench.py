@@ -136,7 +136,31 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None):
     )
 
 
-def _time_steps(step, state, batch, warmup=4, iters=10, repeats=3):
+def _measure_fetch_overhead(loss) -> float:
+    """Round-trip cost of fetching an already-computed scalar (the tunnel
+    RTT on remote backends). Each timed repeat ends in exactly one such
+    fetch, so this constant is measurement overhead — subtracting it
+    reports the device's step time, not the debug tunnel's latency.
+
+    Each sample fetches a DISTINCT derived scalar: jax caches a fetched
+    array's numpy value on the Array object, so re-fetching the same one
+    times a host cache hit (~µs), not the RTT. The derived scalars are
+    trivial device ops dispatched well before their fetch, so their
+    compute time is noise against the round trip. Median of 3."""
+    import numpy as np
+
+    float(np.asarray(loss))  # drain any queued work first
+    probes = [loss * 0 + float(i) for i in range(3)]
+    samples = []
+    for i, p in enumerate(probes):
+        t0 = time.perf_counter()
+        got = float(np.asarray(p))
+        samples.append(time.perf_counter() - t0)
+        assert got == float(i)
+    return statistics.median(samples)
+
+
+def _time_steps(step, state, batch, warmup=4, iters=20, repeats=3):
     """Median-of-repeats step time (sec) + relative spread.
 
     Warmup absorbs compilation; each repeat times ``iters`` steps
@@ -146,7 +170,9 @@ def _time_steps(step, state, batch, warmup=4, iters=10, repeats=3):
     Synchronization is a scalar device-to-host fetch of the last loss, NOT
     ``block_until_ready`` — on remote-tunneled backends block_until_ready
     can return before execution finishes, inflating throughput by orders of
-    magnitude; a value fetch cannot lie.
+    magnitude; a value fetch cannot lie. The fetch's own round-trip
+    (~100ms through the axon tunnel) is measured separately and
+    subtracted, so fewer iters no longer inflates the step time.
     """
     import numpy as np
 
@@ -156,7 +182,7 @@ def _time_steps(step, state, batch, warmup=4, iters=10, repeats=3):
     params, stats, opt_state = state
     for _ in range(warmup):
         params, stats, opt_state, loss = step(params, stats, opt_state, batch)
-    _sync(loss)
+    fetch_s = _measure_fetch_overhead(loss)
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
@@ -165,7 +191,8 @@ def _time_steps(step, state, batch, warmup=4, iters=10, repeats=3):
                 params, stats, opt_state, batch
             )
         _sync(loss)
-        times.append((time.perf_counter() - t0) / iters)
+        times.append(
+            max(time.perf_counter() - t0 - fetch_s, 1e-9) / iters)
     times.sort()
     median = statistics.median(times)
     spread = (times[-1] - times[0]) / median if median else 0.0
@@ -285,14 +312,16 @@ def bench_bert(hvd, timing):
 
     for _ in range(timing["warmup"]):
         p_, o_, loss = step(p_, o_, batch)
-    float(np.asarray(loss))
+    fetch_s = _measure_fetch_overhead(loss)
     times = []
     for _ in range(timing["repeats"]):
         t0 = time.perf_counter()
         for _ in range(timing["iters"]):
             p_, o_, loss = step(p_, o_, batch)
         float(np.asarray(loss))
-        times.append((time.perf_counter() - t0) / timing["iters"])
+        times.append(
+            max(time.perf_counter() - t0 - fetch_s, 1e-9)
+            / timing["iters"])
     times.sort()
 
     t_step = statistics.median(times)
@@ -351,7 +380,10 @@ def main() -> int:
     # multi-process worlds run every section exactly once.
     single_controller = int(
         os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1) <= 1
-    deadline_s = (float(os.environ.get("BENCH_DEADLINE", "480"))
+    # Loose by default: the driver has no hard bench budget (r3's failure
+    # was a flake, not a timeout) — the deadline exists so a pathological
+    # run still exits rc=0 with every row measured so far.
+    deadline_s = (float(os.environ.get("BENCH_DEADLINE", "900"))
                   if single_controller else float("inf"))
 
     def out_of_time() -> bool:
@@ -393,7 +425,7 @@ def main() -> int:
     # CPU-mesh runs exist to exercise the fusion machinery and produce
     # vs_baseline, not absolute speed — keep the loop short there.
     timing = (
-        dict(warmup=4, iters=10, repeats=3)
+        dict(warmup=4, iters=20, repeats=3)
         if on_tpu
         else dict(warmup=2, iters=5, repeats=2)
     )
