@@ -429,6 +429,14 @@ def grouped_allreduce(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set_id=_native_set_for(ps, world))
+    # Contract note (vs the native plane's ATOMIC group enqueue): this
+    # eager fallback maps per-tensor. That is sound, not a race, because
+    # the single-controller regime has exactly one thread issuing ops in
+    # program order — there is no peer whose interleaving could split the
+    # group (the hazard GroupTable exists for). The compiled path gets
+    # true fusion from fused_allreduce above; the native path gets the
+    # atomic group. If a multi-threaded eager issuer is ever added, this
+    # fallback must become atomic too.
     return [
         allreduce(
             t,
@@ -652,6 +660,10 @@ def reducescatter(
 
 
 def grouped_reducescatter(tensors: Sequence[Any], op: str | None = None, **kw):
+    # Same single-controller contract as grouped_allreduce's eager
+    # fallback: a per-tensor loop cannot be split by a peer because one
+    # thread issues everything in program order; host-surface callers get
+    # the native atomic group via their own grouped_reducescatter.
     return [reducescatter(t, op=op, **kw) for t in tensors]
 
 

@@ -143,6 +143,7 @@ def _settings(tmp_path, script, behavior: dict, min_np=1, max_np=None):
 
 
 class TestElasticDriver:
+    @pytest.mark.slow
     def test_completes_when_worker_exits_zero(self, tmp_path):
         script, _ = _write_discovery(tmp_path, ["localhost"])
         settings = _settings(
@@ -152,6 +153,7 @@ class TestElasticDriver:
         assert run_elastic(settings, sink=lines.append) == 0
         assert any("sees v1 np=1" in l for l in lines)
 
+    @pytest.mark.slow
     def test_worker_failure_blacklists_and_recovers(self, tmp_path):
         # Two "hosts"; the first fails once. The driver must blacklist it,
         # re-form the world as {127.0.0.1} (v2), and the survivor finishes.
@@ -166,6 +168,7 @@ class TestElasticDriver:
         assert run_elastic(settings, sink=lines.append) == 0
         assert any("host=127.0.0.1 sees v2 np=1" in l for l in lines)
 
+    @pytest.mark.slow
     def test_scale_up_on_host_added(self, tmp_path):
         # Start with one host; add a second mid-run by editing the hosts
         # file (the reference's fault-injection idiom). Workers wait for v2.

@@ -143,6 +143,7 @@ class TestTracedHierarchical:
 
 
 class TestHierarchicalTrainStep:
+    @pytest.mark.slow
     def test_train_step_matches_flat(self, hvd):
         from horovod_tpu.models.lenet import LeNet, cross_entropy_loss
 

@@ -13,6 +13,7 @@ from horovod_tpu import callbacks as cb
 
 
 class TestBert:
+    @pytest.mark.slow
     def test_forward_shapes_and_mask(self):
         cfg = BERT_TINY
         model = Bert(cfg)
@@ -27,6 +28,7 @@ class TestBert:
         assert logits.shape == (B, S, cfg.vocab_size)
         assert np.isfinite(np.asarray(logits, np.float32)).all()
 
+    @pytest.mark.slow
     def test_mlm_loss_and_train_step(self, hvd):
         cfg = BERT_TINY
         model = Bert(cfg)
@@ -51,6 +53,7 @@ class TestBert:
         p2, _, loss2 = step(p1, o1, batch)
         assert float(loss2) < float(loss1)  # learns on a fixed batch
 
+    @pytest.mark.slow
     def test_flash_attention_plugs_in(self):
         from horovod_tpu.models.bert import flash_attention_fn
         import functools

@@ -425,6 +425,7 @@ def _run_world(tmp_path, size: int, mode: str, extra_env=None, timeout=90):
 
 
 class TestNativeRuntime:
+    @pytest.mark.slow
     def test_battery_4_processes(self, tmp_path):
         results = _run_world(tmp_path, 4, "battery")
         for r, (rc, out, err) in enumerate(results):
@@ -446,12 +447,14 @@ class TestNativeRuntime:
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} large ok" in out
 
+    @pytest.mark.slow
     def test_join_uneven_batch_counts(self, tmp_path):
         results = _run_world(tmp_path, 3, "join")
         for r, (rc, out, err) in enumerate(results):
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} join ok (last=2)" in out
 
+    @pytest.mark.slow
     def test_process_sets_4_processes(self, tmp_path):
         """VERDICT r2 item 6: 2-rank-subset collectives through libhvdrt —
         two disjoint sets reduce CONCURRENTLY; min/max prove non-member
@@ -461,6 +464,7 @@ class TestNativeRuntime:
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} process_sets ok" in out
 
+    @pytest.mark.slow
     def test_autotune_moves_knobs_and_improves_score(self, tmp_path):
         """The online tuner takes samples, moves the fusion threshold off
         its (deliberately bad) initial value, and its windowed bytes/sec
@@ -486,6 +490,7 @@ class TestNativeRuntime:
         # Steady state beats the first (tiny-threshold) sample.
         assert max(scores[1:]) > scores[0] * 1.1, scores
 
+    @pytest.mark.slow
     def test_cache_lru_eviction(self, tmp_path):
         """More distinct tensors than cache capacity: rank-identical LRU
         eviction keeps negotiation correct through churn, and a working
@@ -498,12 +503,14 @@ class TestNativeRuntime:
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} cache_evict ok" in out
 
+    @pytest.mark.slow
     def test_grouped_enqueue_atomicity(self, tmp_path):
         results = _run_world(tmp_path, 2, "group_atomic")
         for r, (rc, out, err) in enumerate(results):
             assert rc == 0, f"rank {r} rc={rc}\nstdout:{out}\nstderr:{err}"
             assert f"rank{r} group_atomic ok" in out
 
+    @pytest.mark.slow
     def test_stall_inspector_warns_then_resolves(self, tmp_path):
         results = _run_world(
             tmp_path, 2, "stall",
@@ -517,6 +524,7 @@ class TestNativeRuntime:
         assert "stall detected" in stderr0 and "stall.t" in stderr0, stderr0
         assert "[1]" in stderr0
 
+    @pytest.mark.slow
     def test_peer_death_raises_internal_error(self, tmp_path):
         results = _run_world(tmp_path, 3, "peerdeath")
         # Last rank deliberately dies with rc=1; survivors must get
@@ -527,6 +535,7 @@ class TestNativeRuntime:
             assert rc == 0, f"rank {r}: {out}\n{err}"
             assert "got HorovodInternalError ok" in out
 
+    @pytest.mark.slow
     def test_timeline_written(self, tmp_path):
         tl = tmp_path / "timeline.json"
         results = _run_world(

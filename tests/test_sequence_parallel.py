@@ -33,6 +33,7 @@ def make_qkv(B=2, H=4, S=64, D=16, dtype=jnp.float32, seed=0):
 
 class TestBlockwiseOracle:
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.slow
     def test_matches_dense(self, causal):
         q, k, v = make_qkv()
         out = blockwise_attention_reference(q, k, v, causal=causal,
@@ -80,6 +81,7 @@ class TestFlashKernel:
         with pytest.raises(ValueError, match="ambiguous"):
             flash_attention(q[:, :, :128], k, v, causal=True, interpret=True)
 
+    @pytest.mark.slow
     def test_causal_offsets_match_oracle(self):
         q, k, v = make_qkv(B=1, H=2, S=256, D=32)
         qs = q[:, :, :128]
@@ -92,6 +94,7 @@ class TestFlashKernel:
                                    rtol=2e-5, atol=2e-5)
 
     @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.slow
     def test_backward_matches_reference(self, causal):
         # VERDICT r2 item 4: the kernel must be trainable — custom_vjp
         # Pallas backward vs jax.grad of the jnp oracle.
@@ -141,6 +144,7 @@ class TestRingAttention:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
         )
 
+    @pytest.mark.slow
     def test_bf16_long_sequence(self, hvd):
         # bf16 inputs, fp32 accumulation: tolerance at bf16 resolution.
         q, k, v = make_qkv(B=1, H=2, S=16 * hvd.size(), D=32,
@@ -199,6 +203,7 @@ class TestRingFlashAttention:
         np.testing.assert_allclose(
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_backward_matches_dense(self, hvd):
         n = hvd.size()
         q, k, v = make_qkv(B=1, H=1, S=16 * n, D=16)

@@ -84,6 +84,7 @@ class TestMaterialization:
 
 
 class TestJaxEstimatorE2E:
+    @pytest.mark.slow
     def test_fit_transform_pandas(self, hvd, tmp_path):
         import flax.linen as nn
         import optax
