@@ -666,7 +666,8 @@ class _DistributedOptimizer:
                  compression=Compression.none,
                  backward_passes_per_step: int = 1, op: str = Average,
                  process_set: ProcessSet | None = None,
-                 gradient_predivide_factor: float = 1.0):
+                 gradient_predivide_factor: float = 1.0,
+                 sparse_as_dense: bool = False):
         self._opt = optimizer
         self._compression = compression
         self._bpps = max(1, backward_passes_per_step)
@@ -677,9 +678,11 @@ class _DistributedOptimizer:
         self._predivide = gradient_predivide_factor
         self._op = op
         self._ps = process_set
+        self._sparse_as_dense = sparse_as_dense
         self._pass_count = 0
         self._handles: dict[Any, int] = {}
         self._acc: dict[Any, "torch.Tensor"] = {}
+        self._densified: set = set()  # params whose sparse grads densified
         self._names: dict[Any, str] = {}
         self._hooks = []
         self._hooked: set = set()
@@ -715,6 +718,7 @@ class _DistributedOptimizer:
         double-backward guard on the retry."""
         self._handles.clear()
         self._acc.clear()
+        self._densified.clear()
         self._pass_count = 0
 
     def _param_name(self, p) -> str:
@@ -761,6 +765,20 @@ class _DistributedOptimizer:
         grad = p.grad
         if grad is None:
             return
+        if grad.is_sparse:
+            if self._sparse_as_dense:
+                grad = grad.to_dense()
+                self._densified.add(p)
+            elif self._bpps > 1:
+                # Sparse grads accumulate sparsely (sum of COO tensors);
+                # step()'s flush takes the sparse exchange below.
+                acc = self._acc.get(p)
+                self._acc[p] = grad.detach().clone() if acc is None \
+                    else (acc + grad)
+                return
+            else:
+                self._enqueue_sparse(p, grad)
+                return
         if self._bpps > 1:
             acc = self._acc.get(p)
             self._acc[p] = grad.detach().clone() if acc is None \
@@ -769,6 +787,34 @@ class _DistributedOptimizer:
         wire, ctx = self._compression.compress(grad)
         h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
         self._handles[p] = (h, ctx, wire.dtype)
+
+    def _enqueue_sparse(self, p, grad):
+        """Sparse allreduce (reference: sparse_allreduce_async role):
+        ragged allgather of (indices, values) across ranks; step()
+        rebuilds the coalesced average. Composite protocol -> worker
+        thread, explicit names (hook order differs across ranks; the
+        controller pairs by name)."""
+        if self._op != Average and self._op != Sum:
+            raise ValueError(
+                "sparse gradients support op=Average/Sum (or "
+                "sparse_as_dense=True)")
+        name = f"grad.{self._param_name(p)}"
+        g = grad.coalesce()
+        idx = np.ascontiguousarray(
+            g.indices().t().cpu().numpy().astype(np.int64))
+        vals = np.ascontiguousarray(g.values().detach().cpu().numpy())
+        w = _world()
+        ps_id = _ps_id(self._ps)
+
+        def run(idx=idx, vals=vals, name=name, w=w, ps_id=ps_id):
+            gi = w.allgather_v(idx, name=f"{name}.i",
+                               process_set_id=ps_id)
+            gv = w.allgather_v(vals, name=f"{name}.v",
+                               process_set_id=ps_id)
+            return np.asarray(gi), np.asarray(gv)
+
+        self._handles[p] = (("sparse_future", _spawn_future(run)),
+                            None, grad.dtype)
 
     def _enqueue_wire(self, wire, name: str):
         """Reduction split per the reference's gradient_predivide_factor:
@@ -809,6 +855,9 @@ class _DistributedOptimizer:
                         acc = self._acc.pop(p, None)
                         if acc is None:
                             continue
+                        if acc.is_sparse:
+                            self._enqueue_sparse(p, acc / self._bpps)
+                            continue
                         wire, ctx = self._compression.compress(
                             acc / self._bpps)
                         h = self._enqueue_wire(
@@ -826,16 +875,32 @@ class _DistributedOptimizer:
                 for nm, p in pending
             }
             for p, (h, ctx, wire_dtype) in list(self._handles.items()):
+                if isinstance(h, tuple) and h[0] == "sparse_future":
+                    gi, gv = h[1].result()
+                    vals = torch.from_numpy(
+                        np.ascontiguousarray(gv)).to(wire_dtype)
+                    if self._op == Average:
+                        vals = vals / self._eff_size()
+                    p.grad = torch.sparse_coo_tensor(
+                        torch.from_numpy(np.ascontiguousarray(gi)).t(),
+                        vals, size=tuple(p.grad.shape)
+                    ).coalesce().to(p.device)
+                    continue
                 if isinstance(h, tuple) and h[0] == "adasum_pending":
                     out = adasum_results[p]
                 else:
                     out = np.asarray(_world().synchronize(h))
+                shape = tuple(p.grad.shape)
                 result = torch.from_numpy(
-                    np.ascontiguousarray(out).reshape(
-                        tuple(p.grad.shape))).to(wire_dtype)
-                p.grad.data.copy_(
-                    self._compression.decompress(result, ctx).to(
-                        p.grad.dtype))
+                    np.ascontiguousarray(out).reshape(shape)).to(wire_dtype)
+                result = self._compression.decompress(result, ctx)
+                if p in self._densified:
+                    # sparse_as_dense: the averaged gradient IS dense now
+                    # (same device as the parameter, like the copy_ path).
+                    p.grad = result.to(dtype=p.dtype, device=p.device)
+                    self._densified.discard(p)
+                else:
+                    p.grad.data.copy_(result.to(p.grad.dtype))
             self._handles.clear()
         return self._opt.step(closure)
 
@@ -845,18 +910,28 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step: int = 1,
                          op: str = Average,
                          process_set: ProcessSet | None = None,
-                         gradient_predivide_factor: float = 1.0):
+                         gradient_predivide_factor: float = 1.0,
+                         sparse_as_dense: bool = False):
     """Wrap a torch optimizer with gradient allreduce hooks (reference:
     ``hvd.DistributedOptimizer``). ``process_set`` scopes the gradient
     averaging to a subset of processes (members only construct/step);
     ``gradient_predivide_factor=f`` splits the averaging into 1/f before
-    and f/size after the sum (fp16 headroom, reference contract)."""
+    and f/size after the sum (fp16 headroom, reference contract).
+
+    Sparse gradients (``Embedding(sparse=True)``): by default they ride a
+    SPARSE allreduce — ragged allgather of (indices, values) + coalesced
+    average, the reference's ``sparse_allreduce_async`` role — keeping
+    wire traffic proportional to the touched rows; ``sparse_as_dense=True``
+    densifies before a regular allreduce instead (the reference's flag,
+    for models whose sparse grads are nearly dense anyway). Compression
+    applies to dense wires only."""
     return _DistributedOptimizer(
         optimizer, named_parameters=named_parameters,
         compression=compression,
         backward_passes_per_step=backward_passes_per_step, op=op,
         process_set=process_set,
         gradient_predivide_factor=gradient_predivide_factor,
+        sparse_as_dense=sparse_as_dense,
     )
 
 

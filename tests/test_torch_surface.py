@@ -337,6 +337,87 @@ class TestMultiProcess:
         assert any("torch-async rank0 ok" in l for l in lines), lines
         assert any("torch-async rank1 ok" in l for l in lines), lines
 
+    def test_e2e_sparse_gradients(self, tmp_path):
+        """Sparse embedding gradients (reference sparse_allreduce role):
+        default path gathers (indices, values) raggedly and averages the
+        coalesced rows; sparse_as_dense densifies. Both must land the
+        embedding at the same weights as manual averaging."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = tmp_path / "torch_sparse_worker.py"
+        script.write_text(
+            "import os, sys\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            + textwrap.dedent("""
+            import numpy as np
+            import torch
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            r = hvd.rank()
+            assert hvd.size() == 2
+
+            def train(sparse_as_dense):
+                torch.manual_seed(0)
+                emb = torch.nn.Embedding(6, 2, sparse=True)
+                w0 = emb.weight.detach().clone()
+                opt = hvd.DistributedOptimizer(
+                    torch.optim.SGD(emb.parameters(), lr=1.0),
+                    named_parameters=emb.named_parameters(),
+                    sparse_as_dense=sparse_as_dense)
+                # rank 0 touches rows {0,1}, rank 1 rows {1,2}: row 1 is
+                # shared (coalesce must SUM it before averaging).
+                idx = torch.tensor([0 + r, 1 + r])
+                emb(idx).sum().backward()
+                opt.step()
+                return w0, emb.weight.detach().clone()
+
+            for sad in (False, True):
+                w0, w1 = train(sad)
+                # grads: rank0 rows 0,1 = 1; rank1 rows 1,2 = 1
+                # average: row0 = .5, row1 = 1, row2 = .5
+                want = w0.clone()
+                want[0] -= 0.5
+                want[1] -= 1.0
+                want[2] -= 0.5
+                assert torch.allclose(w1, want, atol=1e-6), (
+                    sad, r, w1 - w0)
+
+            # bpps=2 + sparse: two backwards accumulate SPARSELY, the
+            # flush rides the sparse exchange — same final weights.
+            torch.manual_seed(0)
+            emb = torch.nn.Embedding(6, 2, sparse=True)
+            w0 = emb.weight.detach().clone()
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(emb.parameters(), lr=1.0),
+                named_parameters=emb.named_parameters(),
+                backward_passes_per_step=2)
+            idx = torch.tensor([0 + r, 1 + r])
+            for _ in range(2):
+                opt.zero_grad()
+                emb(idx).sum().backward()
+                opt.step()
+            # each micro-pass grad == single-pass grad; mean over 2
+            # passes == single-pass -> same update as above.
+            want = w0.clone()
+            want[0] -= 0.5
+            want[1] -= 1.0
+            want[2] -= 0.5
+            assert torch.allclose(
+                emb.weight.detach(), want, atol=1e-6), (r, emb.weight - w0)
+            print(f"torch-sparse rank{r} ok", flush=True)
+            """)
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", str(script)])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("torch-sparse rank0 ok" in l for l in lines), lines
+        assert any("torch-sparse rank1 ok" in l for l in lines), lines
+
     def test_e2e_process_sets(self, tmp_path):
         """process_set= scoping (reference contract): two disjoint 2-rank
         sets reduce concurrently in a 4-process world; a subset-scoped
