@@ -64,6 +64,15 @@ class TestExamples:
         assert "two 4-device" in r.stdout
 
     @pytest.mark.slow
+    def test_moe_expert_parallel(self):
+        """EP MoE layer (alltoall's raison d'être, SURVEY §3.6 EP row):
+        capacity-factor dispatch over the mesh matches the dense oracle;
+        the host path exercises uneven splits."""
+        r = _run_example("jax_moe_expert_parallel.py")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "matches the oracle" in r.stdout
+
+    @pytest.mark.slow
     def test_imagenet_resnet50_flagship(self):
         """The flagship real-data-scale example (VERDICT r3 #9), smoke-run
         on synthetic data with checkpointing + timeline wired."""
