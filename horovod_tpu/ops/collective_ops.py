@@ -672,11 +672,9 @@ def grouped_allgather(tensors: Sequence[Any], process_set=None,
     """Parity: ``hvd.grouped_allgather``. In the compiled/traced regime
     grouping is a no-op by design — XLA fuses same-cycle collectives — so
     the list maps over :func:`allgather`. In the per-process host-tensor
-    regime the group takes the native ATOMIC group path (one enqueue,
-    GroupTable semantics — same dispatch as :func:`grouped_allreduce`).
-    The grouped flavor requires UNIFORM per-rank dim-0 (the controller
-    rejects mismatches with a clear signature error); for ragged
-    contributions use plain :func:`allgather` per tensor."""
+    regime the group rides the native ATOMIC group machinery with the
+    reference's RAGGED dim-0 contract (``grouped_allgather_v``: one
+    atomic size-table group + one atomic pad-to-max data group)."""
     tensors = list(tensors)
     ps = _resolve_process_set(process_set)
     world = (
@@ -687,9 +685,8 @@ def grouped_allgather(tensors: Sequence[Any], process_set=None,
         import numpy as np
 
         xs = [np.ascontiguousarray(t) for t in tensors]
-        handles = world.grouped_allgather_async(
-            xs, name=name, process_set_id=_native_set_for(ps, world))
-        return [np.asarray(world.synchronize(h)) for h in handles]
+        return [np.asarray(o) for o in world.grouped_allgather_v(
+            xs, name=name, process_set_id=_native_set_for(ps, world))]
     return [allgather(t, process_set=ps, name=name) for t in tensors]
 
 

@@ -84,6 +84,24 @@ def compact_chunks(exchanged: np.ndarray, received, max_c: int) -> np.ndarray:
          for j in range(len(received))], axis=0)
 
 
+def pad_rows(x: np.ndarray, rows: int) -> np.ndarray:
+    """Zero-pad ``x`` along dim 0 to exactly ``rows`` (no copy when the
+    size already matches — the common uniform case)."""
+    if x.shape[0] == rows:
+        return x
+    buf = np.zeros((rows,) + x.shape[1:], x.dtype)
+    buf[: x.shape[0]] = x
+    return buf
+
+
+def compact_ranks(gathered: np.ndarray, sizes) -> np.ndarray:
+    """From a rank-stacked padded gather ``(n, max_rows, ...)``, keep
+    each rank's first ``sizes[r]`` rows and concatenate in rank order
+    (the allgather_v / grouped_allgather_v compaction)."""
+    return np.concatenate(
+        [gathered[r, : int(sizes[r])] for r in range(len(sizes))], axis=0)
+
+
 def _build() -> None:
     subprocess.run(
         ["make", "-s", "-C", os.path.join(_HERE, "cpp")],
@@ -451,14 +469,12 @@ class NativeWorld:
         sizes = np.asarray(self.allgather(
             np.asarray([x.shape[0]], np.int64), name=f"{base}.sz",
             process_set_id=process_set_id)).reshape(n)
-        max_d0 = int(sizes.max())
-        padded = np.zeros((max_d0,) + x.shape[1:], dtype=x.dtype)
-        padded[: x.shape[0]] = x
+        max_d0 = max(1, int(sizes.max()))  # all-empty still needs a slot
         gathered = np.asarray(self.allgather(
-            padded, name=f"{base}.data", process_set_id=process_set_id))
-        gathered = gathered.reshape((n, max_d0) + x.shape[1:])
-        out = np.concatenate(
-            [gathered[r, : int(sizes[r])] for r in range(n)], axis=0)
+            pad_rows(x, max_d0), name=f"{base}.data",
+            process_set_id=process_set_id))
+        out = compact_ranks(
+            gathered.reshape((n, max_d0) + x.shape[1:]), sizes)
         if return_sizes:
             return out, sizes
         return out
@@ -615,6 +631,34 @@ class NativeWorld:
         shapes = [(n_members * x.shape[0],) + x.shape[1:] for x in xs]
         return self._grouped_async(OP_ALLGATHER, xs, shapes, name=name,
                                    process_set_id=process_set_id)
+
+    def grouped_allgather_v(self, tensors, name=None,
+                            process_set_id: int = 0) -> list:
+        """Ragged grouped allgather: members may contribute DIFFERENT
+        dim-0 sizes per tensor (the reference's allgather contract,
+        grouped). Two atomic phases through the normal negotiation path —
+        one grouped size exchange, one grouped pad-to-max gather — then
+        per-tensor compaction. Uniform dtype per group (same contract as
+        every grouped op)."""
+        xs = [np.ascontiguousarray(t) for t in tensors]
+        xs = [x[None] if x.ndim == 0 else x for x in xs]
+        base = name or self._auto_name("gagv", process_set_id)
+        n = self.process_set_size(process_set_id)
+        size_handles = self.grouped_allgather_async(
+            [np.asarray([x.shape[0]], np.int64) for x in xs],
+            name=f"{base}.sz", process_set_id=process_set_id)
+        tables = [np.asarray(self.synchronize(h)).reshape(n)
+                  for h in size_handles]
+        padded = [pad_rows(x, max(1, int(sizes.max())))
+                  for x, sizes in zip(xs, tables)]
+        data_handles = self.grouped_allgather_async(
+            padded, name=f"{base}.data", process_set_id=process_set_id)
+        return [
+            compact_ranks(
+                np.asarray(self.synchronize(h)).reshape((n,) + buf.shape),
+                sizes)
+            for h, sizes, buf in zip(data_handles, tables, padded)
+        ]
 
     def grouped_reducescatter_async(self, tensors, name=None,
                                     op="average",

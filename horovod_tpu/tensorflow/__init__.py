@@ -194,16 +194,15 @@ def grouped_allreduce(tensors: Sequence[Any], op: str = Average,
 
 def grouped_allgather(tensors: Sequence[Any], name: str | None = None,
                       process_set: ProcessSet | None = None):
-    """Atomic grouped allgather (uniform dim-0 per tensor across members;
-    parity: ``hvd.grouped_allgather``)."""
+    """Grouped allgather with the reference's RAGGED dim-0 contract
+    (parity: ``hvd.grouped_allgather``) — same two-phase atomic protocol
+    as the torch surface, so mixed-surface jobs pair correctly."""
     if size() <= 1:
         return [tf.identity(t) for t in tensors]
-    w = _world()
-    handles = w.grouped_allgather_async(
+    outs = _world().grouped_allgather_v(
         [_np(t) for t in tensors], name=name,
         process_set_id=_ps_id(process_set))
-    return [tf.convert_to_tensor(np.asarray(w.synchronize(h)))
-            for h in handles]
+    return [tf.convert_to_tensor(np.asarray(o)) for o in outs]
 
 
 def grouped_reducescatter(tensors: Sequence[Any], op: str = Average,

@@ -355,16 +355,21 @@ def grouped_allreduce_async(tensors: Sequence[Any],
 def grouped_allgather_async(tensors: Sequence[Any],
                             name: str | None = None,
                             process_set: ProcessSet | None = None) -> int:
-    """Atomic grouped allgather (uniform dim-0 per tensor across members;
-    reference: ``hvd.grouped_allgather``); one handle, list of results."""
+    """Grouped allgather with the reference's RAGGED dim-0 contract
+    (members may contribute different row counts per tensor); one handle,
+    list of results. Two atomic grouped phases (size table + pad-to-max
+    data) ride a worker thread; the name is reserved on the calling
+    thread so cross-rank pairing stays in program order."""
     if size() <= 1:
         return _register_async(
             None, "group_identity", [t.clone() for t in tensors])
-    native = _world().grouped_allgather_async(
-        [_np_of(t) for t in tensors], name=name,
-        process_set_id=_ps_id(process_set))
-    return _register_async(None, "group",
-                           (list(tensors), native, "allgather"))
+    w = _world()
+    ps_id = _ps_id(process_set)
+    name = name or w.reserve_name("gagv", ps_id)
+    fut = _spawn_future(w.grouped_allgather_v,
+                        [_np_of(t) for t in tensors], name=name,
+                        process_set_id=ps_id)
+    return _register_async(None, "group_v_future", (list(tensors), fut))
 
 
 def grouped_reducescatter_async(tensors: Sequence[Any],
@@ -423,6 +428,12 @@ def synchronize(handle: int):
         return torch.from_numpy(
             out.reshape((-1,) + tuple(tensor.shape[1:]))
         ).to(tensor.dtype)
+    if kind == "group_v_future":
+        tensors, fut = payload
+        return [
+            torch.from_numpy(np.ascontiguousarray(out)).to(t.dtype)
+            for out, t in zip(fut.result(), tensors)
+        ]
     if kind == "alltoall_v_future":
         tensor, fut = payload
         out, received = fut.result()
@@ -445,7 +456,7 @@ def poll(handle: int) -> bool:
     kind, payload = _handle_ctx.get(handle, (None, None))
     if kind in ("identity", "group_identity"):
         return True
-    if kind == "allgather_future":
+    if kind in ("allgather_future", "alltoall_v_future", "group_v_future"):
         return payload[1].done()
     if kind == "group":
         w = _world()

@@ -212,6 +212,12 @@ class TestMultiProcess:
                 name="g.gag")
             assert np.asarray(ga[0]).shape == (2, 1), ga
             assert np.allclose(np.asarray(ga[1]).ravel(), [5.0, 6.0]), ga
+            # ragged grouped allgather (reference contract)
+            gv = hvd.grouped_allgather(
+                [tf.fill((r + 1, 2), float(r))], name="g.gagv")
+            assert np.asarray(gv[0]).shape == (3, 2), gv
+            assert np.allclose(np.asarray(gv[0])[:1], 0.0), gv
+            assert np.allclose(np.asarray(gv[0])[1:], 1.0), gv
             grs = hvd.grouped_reducescatter(
                 [tf.constant([[1.0 + r], [3.0 + r]])], op=hvd.Sum,
                 name="g.grs")

@@ -288,6 +288,17 @@ class TestMultiProcess:
             assert ga[0].shape == (2, 2) and ga[1].shape == (4, 1), ga
             assert torch.allclose(
                 ga[0], torch.tensor([[0.0, 0.0], [1.0, 1.0]])), ga[0]
+
+            # RAGGED grouped allgather (reference contract): per-rank
+            # dim-0 differs per tensor; outputs concatenate in rank order.
+            gr = hvd.grouped_allgather(
+                [torch.full((r + 1, 2), float(r)),
+                 torch.full((2 - r, 1), float(r))], name="a.gagv")
+            assert gr[0].shape == (3, 2) and gr[1].shape == (3, 1), gr
+            assert torch.allclose(
+                gr[0], torch.cat([torch.zeros(1, 2), torch.ones(2, 2)]))
+            assert torch.allclose(
+                gr[1], torch.tensor([[0.0], [0.0], [1.0]])), gr[1]
             grs = hvd.grouped_reducescatter(
                 [torch.tensor([[2.0 + 2 * r], [6.0 + 2 * r]])],
                 name="a.grs")
