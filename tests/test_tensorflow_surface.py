@@ -104,6 +104,18 @@ class TestSingleProcess:
         np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
 
 
+def _parse_digests(lines, marker: str) -> dict:
+    """Collect {rank: digest} from worker stdout lines of the form
+    '<marker> rank<N> digest <float>'."""
+    digests = {}
+    for line in lines:
+        if marker + " rank" in line:
+            part = line.split(marker + " rank", 1)[1]
+            rank, dig = part.split(" digest ")
+            digests[int(rank)] = float(dig)
+    return digests
+
+
 def _worker_script(tmp_path, body: str) -> str:
     path = tmp_path / "tf_worker.py"
     path.write_text(
@@ -463,6 +475,65 @@ class TestMultiProcess:
         assert any("syncbn rank0 ok" in l for l in lines), lines
         assert any("syncbn rank1 ok" in l for l in lines), lines
 
+    def test_keras_none_grads_and_divergent_builtness(self, tmp_path):
+        """ADVICE r3 regressions: (a) None grads (unconnected trainables)
+        pass through the keras DistributedOptimizer unreduced instead of
+        crashing _reduce_arrays; (b) ranks disagreeing on model builtness
+        agree COLLECTIVELY before the broadcast exchange — built ranks
+        must not enter collectives unbuilt ranks skip (the hang)."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = _worker_script(
+            tmp_path,
+            """
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.keras as hvdk
+
+            hvdk.init()
+            r = hvdk.rank()
+
+            # (a) None-grad filtering: var "b" gets no gradient.
+            a = tf.Variable([1.0 + r])
+            b = tf.Variable([5.0])
+            opt = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0))
+            opt.apply_gradients([(tf.constant([2.0 * (r + 1)]), a),
+                                 (None, b)])
+            # grads 2,4 -> avg 3; b untouched.
+            assert np.allclose(a.numpy(), [1.0 + r - 3.0]), a.numpy()
+            assert np.allclose(b.numpy(), [5.0]), b.numpy()
+
+            # (b) divergent builtness: rank 0 builds BEFORE the callback
+            # runs, rank 1 stays unbuilt. The agreement gate must defer
+            # (no hang); once rank 1 builds, the broadcast completes.
+            tf.random.set_seed(100 + r)
+            model = tf.keras.Sequential([tf.keras.layers.Dense(2)])
+            cb = hvdk.BroadcastGlobalVariablesCallback(0)
+            cb.set_model(model)
+            if r == 0:
+                model.build((None, 3))
+            cb.on_train_begin()       # divergent builtness: must defer
+            assert not cb._done
+            if r == 1:
+                model.build((None, 3))
+            cb.on_train_batch_end(0)  # all built now: exchange runs
+            assert cb._done
+            w = np.abs(model.get_weights()[0]).sum()
+            print("kerasadvice rank%d digest %.6f" % (r, w))
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", script])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        digests = _parse_digests(lines, "kerasadvice")
+        assert set(digests) == {0, 1}, lines
+        assert digests[0] == pytest.approx(digests[1], abs=1e-6), digests
+
     def test_broadcast_callback_syncs_unbuilt_model(self, tmp_path):
         """An input-shape-less Sequential has no variables at
         on_train_begin; the callback must defer to first-batch-end and
@@ -514,11 +585,6 @@ class TestMultiProcess:
         lines: list[str] = []
         rc = run_static(settings, sink=lines.append)
         assert rc == 0, "\n".join(lines)
-        digests = {}
-        for line in lines:
-            if "kerascb rank" in line:
-                part = line.split("kerascb rank", 1)[1]
-                rank, dig = part.split(" digest ")
-                digests[int(rank)] = float(dig)
+        digests = _parse_digests(lines, "kerascb")
         assert set(digests) == {0, 1}, lines
         assert digests[0] == pytest.approx(digests[1], abs=1e-6), digests
