@@ -339,3 +339,48 @@ def test_executable_cache_hits(hvd):
     hvd.allreduce(x + 1, op=hvd.Sum)  # same signature -> hit
     assert cache.misses == misses
     assert cache.hits == hits + 1
+
+
+class TestRaggedHelpers:
+    """Pure-numpy ragged-chunk helpers shared by every uneven-exchange
+    substrate (alltoall_v, grouped_allgather_v, stacked splits path)."""
+
+    def test_pad_and_compact_chunks_roundtrip(self):
+        from horovod_tpu.runtime import compact_chunks, pad_chunks
+
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        splits = [1, 3, 2]
+        padded = pad_chunks(x, splits, 3)
+        assert padded.shape == (9, 2)
+        np.testing.assert_array_equal(padded[0], x[0])      # chunk 0
+        np.testing.assert_array_equal(padded[3:6], x[1:4])  # chunk 1
+        np.testing.assert_array_equal(padded[1:3], 0.0)     # chunk 0 pad
+        back = compact_chunks(padded, splits, 3)
+        np.testing.assert_array_equal(back, x)
+
+    def test_pad_rows_no_copy_when_exact(self):
+        from horovod_tpu.runtime import pad_rows
+
+        x = np.ones((4, 3), np.float32)
+        assert pad_rows(x, 4) is x  # uniform case: zero-copy
+        padded = pad_rows(x, 6)
+        assert padded.shape == (6, 3)
+        np.testing.assert_array_equal(padded[4:], 0.0)
+
+    def test_compact_ranks(self):
+        from horovod_tpu.runtime import compact_ranks
+
+        g = np.zeros((2, 3, 1), np.float32)
+        g[0, :2] = 1.0
+        g[1, :1] = 2.0
+        out = compact_ranks(g, [2, 1])
+        np.testing.assert_array_equal(out, [[1.0], [1.0], [2.0]])
+
+    def test_empty_contributions_everywhere(self):
+        from horovod_tpu.runtime import compact_ranks, pad_rows
+
+        x = np.zeros((0, 2), np.float32)
+        padded = pad_rows(x, 1)  # the all-empty wire slot
+        assert padded.shape == (1, 2)
+        out = compact_ranks(np.zeros((3, 1, 2), np.float32), [0, 0, 0])
+        assert out.shape == (0, 2)
