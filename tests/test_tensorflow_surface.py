@@ -171,6 +171,31 @@ class TestMultiProcess:
                 tf.constant([[10.0 * r + 0], [10.0 * r + 1]]))
             expect = np.array([[0.0 + r], [10.0 + r]])
             assert np.allclose(np.asarray(a2a), expect), a2a
+            # alltoall with uneven splits: rank r sends r+1 rows to rank
+            # 0 and the rest to rank 1 -> reference (output,
+            # received_splits) pair. Eagerly AND inside tf.function (the
+            # two-output py_function path with in-graph splits).
+            rows = tf.fill((3, 1), float(r))
+            sp = tf.constant([r + 1, 2 - r], dtype=tf.int64)
+            out_v, recv = hvd.alltoall(rows, splits=sp)
+            # rank 0 receives: 1 row of 0.0, 2 rows of 1.0; rank 1: 2
+            # rows of 0.0, 1 row of 1.0
+            expect_v = [[0.0], [1.0], [1.0]] if r == 0 else \
+                [[0.0], [0.0], [1.0]]
+            assert np.allclose(np.asarray(out_v), expect_v), out_v
+            assert np.asarray(recv).tolist() == (
+                [1, 2] if r == 0 else [2, 1]), recv
+
+            @tf.function
+            def graph_a2av(x):
+                return hvd.alltoall(
+                    x, splits=tf.constant([r + 1, 2 - r], tf.int64),
+                    name="g.a2av")
+            out_g, recv_g = graph_a2av(rows)
+            assert np.allclose(np.asarray(out_g), expect_v), out_g
+            assert np.asarray(recv_g).tolist() == (
+                [1, 2] if r == 0 else [2, 1]), recv_g
+
             # reducescatter: reduce then shard dim 0 (default Average,
             # reference parity).
             rs = hvd.reducescatter(
