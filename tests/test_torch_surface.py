@@ -237,6 +237,22 @@ class TestMultiProcess:
                 torch.tensor([10.0 * r, 10.0 * r + 1]), name="a.a2a"))
             assert torch.allclose(a2a, torch.tensor([0.0 + r, 10.0 + r]))
 
+            # alltoall with uneven splits (reference pair contract):
+            # rank r sends r+1 rows to rank 0, 2-r rows to rank 1.
+            rows = torch.full((3, 1), float(r))
+            out_v, recv = hvd.alltoall(
+                rows, splits=torch.tensor([r + 1, 2 - r]), name="a.a2av")
+            expect_v = torch.tensor(
+                [[0.0], [1.0], [1.0]] if r == 0 else [[0.0], [0.0], [1.0]])
+            assert torch.allclose(out_v, expect_v), (r, out_v)
+            assert recv.tolist() == ([1, 2] if r == 0 else [2, 1]), recv
+            # async flavor returns the same pair via synchronize()
+            h_v = hvd.alltoall_async(
+                rows, splits=[r + 1, 2 - r], name="a.a2av2")
+            out_v2, recv2 = hvd.synchronize(h_v)
+            assert torch.allclose(out_v2, expect_v), out_v2
+            assert recv2.tolist() == recv.tolist()
+
             # reducescatter_async (default Average)
             rs = hvd.synchronize(hvd.reducescatter_async(
                 torch.tensor([[2.0 + 2 * r], [6.0 + 2 * r]]), name="a.rs"))
