@@ -76,6 +76,22 @@ def test_stall_inspector_clean_ops_not_reported():
     ins.stop()
 
 
+def test_fetch_single_controller(hvd):
+    """hvd.fetch materializes a compiled result under a local inspector
+    ticket (no host plane in 1-process worlds) and returns the tree."""
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu.stall import get_inspector
+
+    f = jax.jit(lambda v: (v * 2.0, v + 1.0))
+    a, b = hvd.fetch(f(jnp.ones(3)), name="unit.step")
+    np.testing.assert_allclose(np.asarray(a), 2.0)
+    np.testing.assert_allclose(np.asarray(b), 2.0)
+    # The ticket must be closed (nothing outstanding afterwards).
+    assert not get_inspector()._outstanding
+
+
 @pytest.mark.slow
 class TestCompiledStepStall:
     def test_diverged_rank_named_in_report(self, tmp_path):
