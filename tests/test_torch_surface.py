@@ -172,6 +172,20 @@ class TestDevicePlane:
         assert rs.shape == (n, 1, 2)
         assert torch.allclose(rs, torch.full((n, 1, 2), float(n)))
 
+    def test_process_set_scoped_device_allreduce(self):
+        import horovod_tpu as hvd
+
+        hvd.init()
+        dev = hvd_torch.device
+        ps = hvd.add_process_set([0, 2, 4, 6])
+        try:
+            t = torch.arange(4 * 2, dtype=torch.float32).reshape(4, 2)
+            out = dev.allreduce(t, op=dev.Sum, process_set=ps)
+            want = t.sum(dim=0, keepdim=True).expand(4, 2)
+            assert torch.allclose(out, want), (out, want)
+        finally:
+            hvd.remove_process_set(ps)
+
     def test_grouped_allreduce_device(self):
         import horovod_tpu as hvd
 
