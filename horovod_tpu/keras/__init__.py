@@ -38,6 +38,7 @@ broadcast_variables = hvd_tf.broadcast_variables
 Compression = hvd_tf.Compression
 ProcessSet = hvd_tf.ProcessSet
 add_process_set = hvd_tf.add_process_set
+remove_process_set = hvd_tf.remove_process_set
 global_process_set = hvd_tf.global_process_set
 
 
@@ -310,7 +311,7 @@ from . import callbacks  # noqa: E402,F401  (reference: hvd.callbacks.*)
 __all__ = [
     "Average", "Sum", "init", "shutdown", "size", "rank", "local_rank",
     "allreduce", "allgather", "broadcast", "broadcast_variables",
-    "Compression", "ProcessSet", "add_process_set", "global_process_set",
+    "Compression", "ProcessSet", "add_process_set", "remove_process_set", "global_process_set",
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
     "MetricAverageCallback", "LearningRateWarmupCallback",
     "LearningRateScheduleCallback", "callbacks",

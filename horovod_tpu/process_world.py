@@ -116,6 +116,28 @@ def add_process_set(ranks) -> ProcessSet:
     return ps
 
 
+def remove_process_set(process_set) -> bool:
+    """Drop a subset (parity: ``hvd.remove_process_set`` on the host
+    surfaces). COLLECTIVE on EVERY process — members and non-members
+    alike, exactly like ``add_process_set`` (the reference contract):
+    registries must stay rank-identical or an elastic re-registration
+    would assign diverging native ids. Returns False for the global set
+    or an unknown/already-removed set.
+
+    Python-level removal: the set leaves the registry, so later
+    ``process_set=`` uses raise with guidance. The native-runtime id
+    stays allocated — ids are never reused, and re-adding the identical
+    rank list legitimately maps back to the same native set."""
+    if process_set is None or getattr(process_set, "process_set_id", 0) == 0:
+        return False
+    for i, ps in enumerate(_ps_registry):
+        if ps is process_set:
+            del _ps_registry[i]
+            process_set.process_set_id = -1
+            return True
+    return False
+
+
 def resolve_ps_id(process_set) -> int:
     """Native set id of ``process_set`` in the CURRENT world.
 
@@ -126,6 +148,10 @@ def resolve_ps_id(process_set) -> int:
     of dangling old ids."""
     if process_set is None or process_set.process_set_id == 0:
         return 0
+    if all(ps is not process_set for ps in _ps_registry):
+        raise ValueError(
+            f"process set {getattr(process_set, 'ranks', '?')} was removed "
+            "(or never created via add_process_set)")
     from .parallel.hierarchical import _default_native_world
 
     w = _default_native_world()
@@ -141,10 +167,8 @@ def resolve_ps_id(process_set) -> int:
         if k not in cache:
             cache[k] = w.register_process_set(ps.ranks)
         ps.process_set_id = cache[k]
-    if key not in cache:
-        raise ValueError(
-            f"process set {process_set.ranks} was not created via "
-            "add_process_set")
+    # The registry-membership guard above guarantees `process_set` was
+    # registered by the loop, so `key` is always in the cache here.
     return cache[key]
 
 

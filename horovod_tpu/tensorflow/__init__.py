@@ -89,6 +89,7 @@ from ..process_world import (  # noqa: E402
     ProcessSet,
     add_process_set,
     global_process_set,
+    remove_process_set,
 )
 from ..process_world import resolve_ps_id as _ps_id  # noqa: E402
 
@@ -512,5 +513,5 @@ __all__ = [
     "broadcast_variables", "broadcast_object", "allgather_object",
     "DistributedGradientTape", "DistributedOptimizer", "Compression",
     "SyncBatchNormalization",
-    "ProcessSet", "add_process_set", "global_process_set",
+    "ProcessSet", "add_process_set", "remove_process_set", "global_process_set",
 ]

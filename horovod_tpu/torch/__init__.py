@@ -88,6 +88,7 @@ from ..process_world import (  # noqa: E402
     ProcessSet,
     add_process_set,
     global_process_set,
+    remove_process_set,
 )
 from ..process_world import resolve_ps_id as _ps_id  # noqa: E402
 
@@ -965,5 +966,5 @@ __all__ = [
     "reducescatter", "reducescatter_async", "barrier", "join",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer",
-    "ProcessSet", "add_process_set", "global_process_set",
+    "ProcessSet", "add_process_set", "remove_process_set", "global_process_set",
 ]
