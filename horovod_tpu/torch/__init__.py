@@ -679,7 +679,8 @@ class _DistributedOptimizer:
                  backward_passes_per_step: int = 1, op: str = Average,
                  process_set: ProcessSet | None = None,
                  gradient_predivide_factor: float = 1.0,
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False,
+                 num_groups: int = 0, groups=None):
         self._opt = optimizer
         self._compression = compression
         self._bpps = max(1, backward_passes_per_step)
@@ -691,6 +692,36 @@ class _DistributedOptimizer:
         self._op = op
         self._ps = process_set
         self._sparse_as_dense = sparse_as_dense
+        # Explicit grouping (reference: num_groups / groups kwargs backed
+        # by GroupTable). A group fires all-or-nothing, so grouped grads
+        # defer to step()'s flush and ride ATOMIC native groups — the same
+        # readiness semantics as the reference (a group waits for its
+        # slowest member; per-parameter overlap is traded away by the
+        # user's explicit choice).
+        if isinstance(groups, int):
+            # Reference API accepts groups as a non-negative int too —
+            # identical to num_groups.
+            num_groups, groups = groups or num_groups, None
+        if num_groups and groups:
+            raise ValueError("pass either num_groups or groups, not both")
+        if (num_groups or groups) and op == Adasum:
+            raise ValueError("num_groups/groups do not compose with "
+                             "op=Adasum (no grouped Adasum form)")
+        self._num_groups = max(0, int(num_groups))
+        self._explicit_groups = (
+            [list(g) for g in groups] if groups else None)
+        self._grouped_params: set = set()
+        self._group_ids: list[set] = []
+        if self._explicit_groups is not None:
+            for g in self._explicit_groups:
+                ids = {id(p) for p in g}
+                if ids & self._grouped_params:
+                    raise ValueError(
+                        "a parameter appears in more than one group; "
+                        "groups must be disjoint (each gradient rides "
+                        "exactly one atomic group)")
+                self._grouped_params |= ids
+                self._group_ids.append(ids)
         self._pass_count = 0
         self._handles: dict[Any, int] = {}
         self._acc: dict[Any, "torch.Tensor"] = {}
@@ -777,28 +808,52 @@ class _DistributedOptimizer:
         grad = p.grad
         if grad is None:
             return
-        if grad.is_sparse:
-            if self._sparse_as_dense:
-                grad = grad.to_dense()
-                self._densified.add(p)
-            elif self._bpps > 1:
+        if grad.is_sparse and not self._sparse_as_dense:
+            if self._bpps > 1 or self._grouping_for(p):
                 # Sparse grads accumulate sparsely (sum of COO tensors);
-                # step()'s flush takes the sparse exchange below.
+                # step()'s flush takes the sparse exchange (groups carry
+                # dense wires only — sparse members flush per tensor).
                 acc = self._acc.get(p)
+                if acc is not None and self._bpps <= 1:
+                    raise RuntimeError(
+                        f"gradient for parameter "
+                        f"'{self._param_name(p)}' was produced twice "
+                        "before step(); increase backward_passes_per_step "
+                        "to accumulate locally (reference contract)")
                 self._acc[p] = grad.detach().clone() if acc is None \
                     else (acc + grad)
                 return
             else:
                 self._enqueue_sparse(p, grad)
                 return
-        if self._bpps > 1:
+        if grad.is_sparse:  # sparse_as_dense
+            grad = grad.to_dense()
+            self._densified.add(p)
+        if self._bpps > 1 or self._grouping_for(p):
             acc = self._acc.get(p)
+            if acc is not None and self._bpps <= 1:
+                # Grouped params defer via _acc, but without bpps a second
+                # backward before step() is still the user error the
+                # ungrouped path raises for — don't silently double.
+                raise RuntimeError(
+                    f"gradient for parameter '{self._param_name(p)}' was "
+                    "produced twice before step(); increase "
+                    "backward_passes_per_step to accumulate locally "
+                    "(reference contract)")
             self._acc[p] = grad.detach().clone() if acc is None \
                 else acc + grad
             return
         wire, ctx = self._compression.compress(grad)
         h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
         self._handles[p] = (h, ctx, wire.dtype)
+
+    def _grouping_for(self, p) -> bool:
+        """True when ``p``'s gradient rides an explicit atomic group (it
+        then defers to step()'s flush — reference GroupTable semantics)."""
+        if self._num_groups > 0:
+            return True
+        return (self._explicit_groups is not None
+                and id(p) in self._grouped_params)
 
     def _enqueue_sparse(self, p, grad):
         """Sparse allreduce (reference: sparse_allreduce_async role):
@@ -852,6 +907,63 @@ class _DistributedOptimizer:
             _np_of(wire), name=name, op=self._op,
             process_set_id=_ps_id(self._ps))
 
+    def _flush_acc(self, scale: float) -> None:
+        """Enqueue every accumulated gradient: sparse per tensor, grouped
+        params as ATOMIC native groups (one grouped enqueue per group and
+        wire dtype — the reference's GroupTable all-or-nothing firing),
+        everything else as individual async allreduces."""
+        grouped: list[tuple[Any, "torch.Tensor"]] = []
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                acc = self._acc.pop(p, None)
+                if acc is None:
+                    continue
+                if acc.is_sparse:
+                    self._enqueue_sparse(p, acc * scale)
+                    continue
+                if self._grouping_for(p):
+                    grouped.append((p, acc))
+                    continue
+                wire, ctx = self._compression.compress(acc * scale)
+                h = self._enqueue_wire(
+                    wire, f"grad.{self._param_name(p)}")
+                self._handles[p] = (h, ctx, wire.dtype)
+        if not grouped:
+            return
+        if self._explicit_groups is not None:
+            ordered = [[p for p, _ in grouped if id(p) in ids]
+                       for ids in self._group_ids]
+        else:
+            k = min(self._num_groups, len(grouped))
+            chunk = -(-len(grouped) // k)
+            ps = [p for p, _ in grouped]
+            ordered = [ps[i: i + chunk] for i in range(0, len(ps), chunk)]
+        acc_of = {id(p): a for p, a in grouped}
+        for gi, members in enumerate(ordered):
+            # One atomic native group per wire dtype (uniform-dtype group
+            # contract); registration order is rank-identical, so names
+            # pair deterministically.
+            by_dtype: dict = {}
+            for p in members:
+                wire, ctx = self._compression.compress(
+                    acc_of[id(p)] * scale)
+                by_dtype.setdefault(wire.dtype, []).append((p, wire, ctx))
+            for wire_dtype, entries in by_dtype.items():
+                kwargs = dict(op=self._op,
+                              process_set_id=_ps_id(self._ps))
+                if self._predivide != 1.0:
+                    kwargs = dict(
+                        op=Sum, process_set_id=_ps_id(self._ps),
+                        prescale_factor=1.0 / self._predivide,
+                        postscale_factor=(self._predivide /
+                                          self._eff_size()))
+                dtype_tag = str(wire_dtype).split(".")[-1]
+                handles = _world().grouped_allreduce_async(
+                    [_np_of(w) for _, w, _ in entries],
+                    name=f"gradgrp.{gi}.{dtype_tag}", **kwargs)
+                for (p, w, ctx), h in zip(entries, handles):
+                    self._handles[p] = (h, ctx, w.dtype)
+
     def step(self, closure=None):
         if self._eff_size() <= 1 and (self._handles or self._acc):
             # State from before an elastic shrink is unsynchronizable
@@ -862,19 +974,11 @@ class _DistributedOptimizer:
                 self._pass_count += 1
                 if self._pass_count % self._bpps != 0:
                     return None  # accumulate only
-                for group in self._opt.param_groups:
-                    for p in group["params"]:
-                        acc = self._acc.pop(p, None)
-                        if acc is None:
-                            continue
-                        if acc.is_sparse:
-                            self._enqueue_sparse(p, acc / self._bpps)
-                            continue
-                        wire, ctx = self._compression.compress(
-                            acc / self._bpps)
-                        h = self._enqueue_wire(
-                            wire, f"grad.{self._param_name(p)}")
-                        self._handles[p] = (h, ctx, wire.dtype)
+                self._flush_acc(1.0 / self._bpps)
+            elif self._acc:
+                # Grouped params (num_groups/groups) defer to this flush
+                # even without local accumulation.
+                self._flush_acc(1.0)
             from ..process_world import adasum_allreduce_host
 
             pending = sorted(
@@ -923,12 +1027,18 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          op: str = Average,
                          process_set: ProcessSet | None = None,
                          gradient_predivide_factor: float = 1.0,
-                         sparse_as_dense: bool = False):
+                         sparse_as_dense: bool = False,
+                         num_groups: int = 0, groups=None):
     """Wrap a torch optimizer with gradient allreduce hooks (reference:
     ``hvd.DistributedOptimizer``). ``process_set`` scopes the gradient
     averaging to a subset of processes (members only construct/step);
     ``gradient_predivide_factor=f`` splits the averaging into 1/f before
     and f/size after the sum (fp16 headroom, reference contract).
+
+    ``num_groups=k`` / ``groups=[[p, ...], ...]`` (reference GroupTable
+    kwargs): gradients defer to step() and fire as ATOMIC native groups
+    (all-or-nothing, like the reference — trading per-parameter overlap
+    for explicit, deterministic fusion).
 
     Sparse gradients (``Embedding(sparse=True)``): by default they ride a
     SPARSE allreduce — ragged allgather of (indices, values) + coalesced
@@ -944,6 +1054,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         process_set=process_set,
         gradient_predivide_factor=gradient_predivide_factor,
         sparse_as_dense=sparse_as_dense,
+        num_groups=num_groups, groups=groups,
     )
 
 
