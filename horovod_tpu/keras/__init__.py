@@ -28,13 +28,36 @@ Sum = hvd_tf.Sum
 
 init = hvd_tf.init
 shutdown = hvd_tf.shutdown
+is_initialized = hvd_tf.is_initialized
 size = hvd_tf.size
 rank = hvd_tf.rank
 local_rank = hvd_tf.local_rank
+local_size = hvd_tf.local_size
+cross_rank = hvd_tf.cross_rank
+cross_size = hvd_tf.cross_size
+is_homogeneous = hvd_tf.is_homogeneous
 allreduce = hvd_tf.allreduce
 allgather = hvd_tf.allgather
 broadcast = hvd_tf.broadcast
+alltoall = hvd_tf.alltoall
+reducescatter = hvd_tf.reducescatter
+barrier = hvd_tf.barrier
+join = hvd_tf.join
+broadcast_object = hvd_tf.broadcast_object
+allgather_object = hvd_tf.allgather_object
 broadcast_variables = hvd_tf.broadcast_variables
+mpi_built = hvd_tf.mpi_built
+mpi_enabled = hvd_tf.mpi_enabled
+mpi_threads_supported = hvd_tf.mpi_threads_supported
+gloo_built = hvd_tf.gloo_built
+gloo_enabled = hvd_tf.gloo_enabled
+nccl_built = hvd_tf.nccl_built
+ddl_built = hvd_tf.ddl_built
+ccl_built = hvd_tf.ccl_built
+cuda_built = hvd_tf.cuda_built
+rocm_built = hvd_tf.rocm_built
+start_timeline = hvd_tf.start_timeline
+stop_timeline = hvd_tf.stop_timeline
 Compression = hvd_tf.Compression
 ProcessSet = hvd_tf.ProcessSet
 add_process_set = hvd_tf.add_process_set
@@ -309,8 +332,14 @@ class LearningRateWarmupCallback(tf.keras.callbacks.Callback):
 from . import callbacks  # noqa: E402,F401  (reference: hvd.callbacks.*)
 
 __all__ = [
-    "Average", "Sum", "init", "shutdown", "size", "rank", "local_rank",
-    "allreduce", "allgather", "broadcast", "broadcast_variables",
+    "Average", "Sum", "init", "shutdown", "is_initialized", "size",
+    "rank", "local_rank", "local_size", "cross_rank", "cross_size",
+    "is_homogeneous", "allreduce", "allgather", "broadcast",
+    "alltoall", "reducescatter", "barrier", "join",
+    "broadcast_object", "allgather_object", "broadcast_variables",
+    "mpi_built", "mpi_enabled", "mpi_threads_supported", "gloo_built",
+    "gloo_enabled", "nccl_built", "ddl_built", "ccl_built",
+    "cuda_built", "rocm_built", "start_timeline", "stop_timeline",
     "Compression", "ProcessSet", "add_process_set", "remove_process_set", "global_process_set",
     "DistributedOptimizer", "BroadcastGlobalVariablesCallback",
     "MetricAverageCallback", "LearningRateWarmupCallback",

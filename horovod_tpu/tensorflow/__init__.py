@@ -93,6 +93,21 @@ from ..process_world import (  # noqa: E402
 )
 from ..process_world import resolve_ps_id as _ps_id  # noqa: E402
 
+# Build-introspection shims (reference: every surface re-exports the
+# basics' horovod_*_built facts; they answer for the TPU build).
+from ..basics import (  # noqa: E402
+    ccl_built,
+    cuda_built,
+    ddl_built,
+    gloo_built,
+    gloo_enabled,
+    mpi_built,
+    mpi_enabled,
+    mpi_threads_supported,
+    nccl_built,
+    rocm_built,
+)
+
 
 def _np(tensor) -> np.ndarray:
     if isinstance(tensor, np.ndarray):
@@ -514,4 +529,6 @@ __all__ = [
     "DistributedGradientTape", "DistributedOptimizer", "Compression",
     "SyncBatchNormalization",
     "ProcessSet", "add_process_set", "remove_process_set", "global_process_set",
+    "mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled", "nccl_built",
+    "ddl_built", "ccl_built", "cuda_built", "rocm_built", "mpi_threads_supported",
 ]

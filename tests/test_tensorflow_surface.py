@@ -98,6 +98,23 @@ class TestSingleProcess:
         assert lrs[1] == pytest.approx(0.05)
         assert lrs[2] == pytest.approx(0.025)
 
+    def test_surface_export_parity(self):
+        """Reference export audit: the TF surface carries the basics'
+        build-introspection shims; the keras surface re-exports the full
+        TF world (upstream horovod.keras does the same)."""
+        for n in ("mpi_built", "mpi_enabled", "gloo_built", "gloo_enabled",
+                  "nccl_built", "ddl_built", "ccl_built", "cuda_built",
+                  "rocm_built", "mpi_threads_supported"):
+            assert hasattr(hvd_tf, n), n
+        assert not hvd_tf.mpi_built()
+        assert hvd_tf.nccl_built()  # XLA/ICI plays NCCL's role
+        for n in ("allgather_object", "broadcast_object", "join",
+                  "alltoall", "reducescatter", "barrier", "cross_rank",
+                  "cross_size", "local_size", "is_homogeneous",
+                  "is_initialized", "mpi_built", "start_timeline",
+                  "stop_timeline", "remove_process_set"):
+            assert hasattr(hvd_keras, n), n
+
     def test_broadcast_variables_noop_single(self):
         v = tf.Variable([1.0, 2.0])
         hvd_tf.broadcast_variables([v], root_rank=0)
