@@ -26,6 +26,12 @@ class EstimatorParams:
     # Validation: a float in (0,1) = split fraction, or a column name whose
     # truthy rows are validation (parity: setValidation).
     validation: float | str | None = None
+    # Gradient exchange (parity: setCompression /
+    # setBackwardPassesPerStep on the reference estimators). compression
+    # is a surface-appropriate Compression member (e.g.
+    # horovod_tpu.torch.Compression.fp16) or None for none.
+    compression: Any = None
+    backward_passes_per_step: int = 1
     # Launch.
     num_proc: int | None = None
     verbose: int = 1
@@ -48,6 +54,10 @@ class EstimatorParams:
             raise ValueError("feature_cols must name at least one column")
         if not self.label_cols:
             raise ValueError("label_cols must name at least one column")
+        if self.backward_passes_per_step < 1:
+            raise ValueError(
+                "backward_passes_per_step must be >= 1, got "
+                f"{self.backward_passes_per_step}")
 
 
 def merge_params(base: EstimatorParams, **overrides: Any) -> EstimatorParams:

@@ -88,8 +88,23 @@ def _train_worker(model, optimizer, loss_fn, data, p: EstimatorParams,
         updates, new_opt = optimizer.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt
 
+    comp = p.compression
+    bpps = p.backward_passes_per_step
+
     def average_grads(grads):
         leaves, treedef = jax.tree.flatten(grads)
+        if comp is not None:
+            # Wire compression (setCompression parity): cast to the wire
+            # dtype around the host exchange, restore after.
+            wires = [comp.compress(jnp.asarray(l)) for l in leaves]
+            host = [np.asarray(w) for w, _ in wires]
+            reduced = hvd.grouped_allreduce(host, op=hvd.Average)
+            return jax.tree.unflatten(
+                treedef,
+                [jnp.asarray(comp.decompress(jnp.asarray(r), c)).astype(
+                    l.dtype)
+                 for r, (_, c), l in zip(reduced, wires, leaves)],
+            )
         host = [np.asarray(l, np.float32) for l in leaves]
         reduced = hvd.grouped_allreduce(host, op=hvd.Average)
         return jax.tree.unflatten(
@@ -98,17 +113,37 @@ def _train_worker(model, optimizer, loss_fn, data, p: EstimatorParams,
              for r, l in zip(reduced, leaves)],
         )
 
+    def apply_accumulated(params, opt_state, acc, n_passes):
+        g = jax.tree.map(lambda a: a / n_passes, acc)
+        if nprocs > 1:
+            g = average_grads(g)
+        return apply_step(params, opt_state, g)
+
     history = []
     for epoch in range(p.epochs):
         losses = []
+        acc, acc_n = None, 0
         for batch in batches({"x": x_all, "y": y_all}, p.batch_size,
                              p.shuffle, p.seed + epoch):
             loss, grads = grad_step(
                 params, jnp.asarray(batch["x"]), jnp.asarray(batch["y"]))
-            if nprocs > 1:
-                grads = average_grads(grads)
-            params, opt_state = apply_step(params, opt_state, grads)
             losses.append(float(loss))
+            # Local accumulation (setBackwardPassesPerStep parity): one
+            # exchange + update per bpps microbatches.
+            acc = grads if acc is None else jax.tree.map(
+                jnp.add, acc, grads)
+            acc_n += 1
+            if acc_n < bpps:
+                continue
+            params, opt_state = apply_accumulated(
+                params, opt_state, acc, acc_n)
+            acc, acc_n = None, 0
+        if acc is not None:
+            # Partial tail window: apply it (averaged over the passes it
+            # actually holds) instead of dropping the work or straddling
+            # epochs.
+            params, opt_state = apply_accumulated(
+                params, opt_state, acc, acc_n)
         epoch_loss = float(np.mean(losses)) if losses else float("nan")
         entry = {"epoch": epoch, "loss": epoch_loss}
         if val is not None:

@@ -58,7 +58,9 @@ class KerasEstimator(Estimator):
 
             hvdk.init()
             model = model_fn()
-            opt = hvdk.DistributedOptimizer(optimizer_fn())
+            opt = hvdk.DistributedOptimizer(
+                optimizer_fn(), compression=p.compression,
+                backward_passes_per_step=p.backward_passes_per_step)
             model.compile(optimizer=opt, loss=loss)
             x = np.asarray(list(data[p.feature_cols[0]]), np.float32)
             y = np.asarray(list(data[p.label_cols[0]]))

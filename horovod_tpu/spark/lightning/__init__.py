@@ -136,7 +136,9 @@ class LightningEstimator(Estimator):
                 net.configure_optimizers()
             )
             opt = hvd.DistributedOptimizer(
-                opt, named_parameters=net.named_parameters()
+                opt, named_parameters=net.named_parameters(),
+                compression=p.compression or hvd.Compression.none,
+                backward_passes_per_step=p.backward_passes_per_step,
             )
             hvd.broadcast_parameters(net.state_dict(), root_rank=0)
 

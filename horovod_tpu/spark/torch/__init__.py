@@ -75,10 +75,21 @@ def run_torch_epochs(net, opt, data, p: EstimatorParams, shard: int,
             opt.zero_grad()
             loss = train_step(to_batch(cols), i)
             loss.backward()
+            before = getattr(opt, "update_count", None)
             opt.step()
-            if sched is not None and sched_interval == "step":
+            # Gate per-step schedulers on REAL updates: with
+            # backward_passes_per_step > 1 most step() calls are
+            # accumulate-only and must not advance the LR schedule.
+            updated = (before is None
+                       or getattr(opt, "update_count", None) != before)
+            if sched is not None and sched_interval == "step" and updated:
                 sched.step()
             losses.append(float(loss.detach()))
+        if callable(getattr(opt, "flush_step", None)):
+            # Partial tail accumulation window (batch count not divisible
+            # by bpps): apply it now instead of dropping the work or
+            # straddling epochs.
+            opt.flush_step()
         if sched is not None and sched_interval != "step":
             sched.step()
         if on_epoch_end is not None:
@@ -135,6 +146,8 @@ class TorchEstimator(Estimator):
             opt = hvd.DistributedOptimizer(
                 optimizer_fn(net.parameters()),
                 named_parameters=net.named_parameters(),
+                compression=p.compression or hvd.Compression.none,
+                backward_passes_per_step=p.backward_passes_per_step,
             )
             hvd.broadcast_parameters(net.state_dict(), root_rank=0)
 

@@ -979,46 +979,68 @@ class _DistributedOptimizer:
                 # Grouped params (num_groups/groups) defer to this flush
                 # even without local accumulation.
                 self._flush_acc(1.0)
-            from ..process_world import adasum_allreduce_host
-
-            pending = sorted(
-                ((h[2], p) for p, (h, _, _) in self._handles.items()
-                 if isinstance(h, tuple) and h[0] == "adasum_pending"),
-                key=lambda kv: kv[0])
-            adasum_results = {
-                p: adasum_allreduce_host(
-                    self._handles[p][0][1], name=nm, process_set=self._ps)
-                for nm, p in pending
-            }
-            for p, (h, ctx, wire_dtype) in list(self._handles.items()):
-                if isinstance(h, tuple) and h[0] == "sparse_future":
-                    gi, gv = h[1].result()
-                    vals = torch.from_numpy(
-                        np.ascontiguousarray(gv)).to(wire_dtype)
-                    if self._op == Average:
-                        vals = vals / self._eff_size()
-                    p.grad = torch.sparse_coo_tensor(
-                        torch.from_numpy(np.ascontiguousarray(gi)).t(),
-                        vals, size=tuple(p.grad.shape)
-                    ).coalesce().to(p.device)
-                    continue
-                if isinstance(h, tuple) and h[0] == "adasum_pending":
-                    out = adasum_results[p]
-                else:
-                    out = np.asarray(_world().synchronize(h))
-                shape = tuple(p.grad.shape)
-                result = torch.from_numpy(
-                    np.ascontiguousarray(out).reshape(shape)).to(wire_dtype)
-                result = self._compression.decompress(result, ctx)
-                if p in self._densified:
-                    # sparse_as_dense: the averaged gradient IS dense now
-                    # (same device as the parameter, like the copy_ path).
-                    p.grad = result.to(dtype=p.dtype, device=p.device)
-                    self._densified.discard(p)
-                else:
-                    p.grad.data.copy_(result.to(p.grad.dtype))
-            self._handles.clear()
+            self._synchronize_handles()
+        # Counts REAL updates (not accumulate-only passes): training
+        # loops gate per-step LR schedulers on this so a schedule cannot
+        # run bpps-times faster than the weights move.
+        self.update_count = getattr(self, "update_count", 0) + 1
         return self._opt.step(closure)
+
+    def flush_step(self, closure=None):
+        """Force an update from a PARTIAL accumulation window (epoch/fit
+        end with batch count not divisible by backward_passes_per_step):
+        averages over the passes actually accumulated instead of
+        dropping the tail or straddling it into the next epoch. No-op
+        when nothing is pending."""
+        if self._eff_size() <= 1 or not self._acc:
+            return None
+        pending = self._pass_count % self._bpps
+        self._flush_acc(1.0 / max(1, pending))
+        self._pass_count = 0
+        self._synchronize_handles()
+        self.update_count = getattr(self, "update_count", 0) + 1
+        return self._opt.step(closure)
+
+    def _synchronize_handles(self):
+        from ..process_world import adasum_allreduce_host
+
+        pending = sorted(
+            ((h[2], p) for p, (h, _, _) in self._handles.items()
+             if isinstance(h, tuple) and h[0] == "adasum_pending"),
+            key=lambda kv: kv[0])
+        adasum_results = {
+            p: adasum_allreduce_host(
+                self._handles[p][0][1], name=nm, process_set=self._ps)
+            for nm, p in pending
+        }
+        for p, (h, ctx, wire_dtype) in list(self._handles.items()):
+            if isinstance(h, tuple) and h[0] == "sparse_future":
+                gi, gv = h[1].result()
+                vals = torch.from_numpy(
+                    np.ascontiguousarray(gv)).to(wire_dtype)
+                if self._op == Average:
+                    vals = vals / self._eff_size()
+                p.grad = torch.sparse_coo_tensor(
+                    torch.from_numpy(np.ascontiguousarray(gi)).t(),
+                    vals, size=tuple(p.grad.shape)
+                ).coalesce().to(p.device)
+                continue
+            if isinstance(h, tuple) and h[0] == "adasum_pending":
+                out = adasum_results[p]
+            else:
+                out = np.asarray(_world().synchronize(h))
+            shape = tuple(p.grad.shape)
+            result = torch.from_numpy(
+                np.ascontiguousarray(out).reshape(shape)).to(wire_dtype)
+            result = self._compression.decompress(result, ctx)
+            if p in self._densified:
+                # sparse_as_dense: the averaged gradient IS dense now
+                # (same device as the parameter, like the copy_ path).
+                p.grad = result.to(dtype=p.dtype, device=p.device)
+                self._densified.discard(p)
+            else:
+                p.grad.data.copy_(result.to(p.grad.dtype))
+        self._handles.clear()
 
 
 def DistributedOptimizer(optimizer, named_parameters=None,

@@ -445,6 +445,28 @@ class TestMultiProcess:
             opt.step()
             ge = torch.cat([p.detach().reshape(-1) for p in ps])
             assert torch.allclose(base, ge, atol=1e-6), (base - ge)
+
+            # bpps=2 with an ODD batch count: flush_step applies the
+            # partial tail window; update_count counts REAL updates only
+            # (the per-step LR scheduler gate in the estimator loop).
+            torch.manual_seed(0)
+            m = torch.nn.Linear(2, 1, bias=False)
+            w0 = m.weight.detach().clone()
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(m.parameters(), lr=1.0),
+                named_parameters=m.named_parameters(),
+                backward_passes_per_step=2)
+            for _ in range(3):
+                opt.zero_grad()
+                (m(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+                opt.step()
+            assert getattr(opt, "update_count", 0) == 1, opt.update_count
+            opt.flush_step()
+            assert opt.update_count == 2
+            # per-pass weight grad avg over ranks = 1.5*ones; two updates
+            # (full window mean 1.5, tail window mean 1.5) -> delta -3.
+            assert torch.allclose(
+                m.weight.detach(), w0 - 3.0, atol=1e-6), m.weight - w0
             print(f"torch-groups rank{r} ok", flush=True)
             """)
         )
