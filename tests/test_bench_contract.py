@@ -1,0 +1,79 @@
+"""The bench robustness contract (VERDICT r3 #1): incremental cumulative
+emission, transient-error retry, and the driver-facing record keys. These
+units protect the machinery that made BENCH_r04 green — a regression here
+silently reverts to the all-or-nothing bench that lost round 3's numbers.
+"""
+
+import io
+import json
+import sys
+
+import bench
+
+
+class TestEmitter:
+    def test_every_line_is_the_full_cumulative_record(self, capsys):
+        e = bench._Emitter()
+        e.update(value=1.0, mfu=0.3)
+        e.update(vs_baseline=0.99)
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        first, last = (json.loads(line) for line in out)
+        # Driver contract keys present from the very first line.
+        for k in ("metric", "value", "unit", "vs_baseline"):
+            assert k in first
+        # The LAST line carries everything measured so far.
+        assert last["value"] == 1.0 and last["mfu"] == 0.3
+        assert last["vs_baseline"] == 0.99
+
+    def test_last_line_survives_later_failure(self, capsys):
+        e = bench._Emitter()
+        e.update(value=2724.07, mfu=0.339)
+        # a later section failing emits nothing — the last complete line
+        # still holds the headline row.
+        out = capsys.readouterr().out.strip().splitlines()
+        rec = json.loads(out[-1])
+        assert rec["value"] == 2724.07
+
+
+class TestRetry:
+    def test_transient_error_retries_once(self, monkeypatch):
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError(
+                    "INTERNAL: http://x/remote_compile: read body: "
+                    "response body closed before all bytes were read")
+            return "ok"
+
+        errors = []
+        assert bench._with_retry("s", flaky, errors) == "ok"
+        assert len(calls) == 2 and not errors
+
+    def test_permanent_error_records_and_returns_none(self):
+        errors = []
+        out = bench._with_retry(
+            "s", lambda: (_ for _ in ()).throw(ValueError("shape")), errors)
+        assert out is None
+        assert len(errors) == 1 and "shape" in errors[0]
+
+    def test_no_retry_in_multi_controller_mode(self, monkeypatch):
+        monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE: socket closed")
+
+        errors = []
+        assert bench._with_retry("s", flaky, errors,
+                                 allow_retry=False) is None
+        assert len(calls) == 1  # a retrying rank would desert its peers
+
+    def test_transient_classification(self):
+        assert bench._is_transient(RuntimeError("read body: closed"))
+        assert bench._is_transient(ConnectionError("Connection reset"))
+        assert not bench._is_transient(ValueError("bad shape"))
