@@ -88,6 +88,25 @@ def DistributedOptimizer(optimizer, op: str = Average,
             self._hvd_acc = None
             self._hvd_count = 0
 
+        def _reduce_and_apply(self, gv, name_prefix, extra=(),
+                              reduce_op=None, divisor=None,
+                              apply_args=(), apply_kwargs=None):
+            """Exchange + decompress + apply — the shared wire tail of
+            the per-step and flush paths. ``divisor`` post-scales a Sum
+            exchange (the flush's global-pending mean)."""
+            reduced_arrays = hvd_tf._reduce_arrays(
+                [hvd_tf._np(g) for g, _ in gv], reduce_op or op,
+                hvd_tf._ps_id(process_set), compression, name_prefix)
+            if divisor:
+                reduced_arrays = [a / divisor for a in reduced_arrays]
+            reduced = [
+                (tf.cast(tf.convert_to_tensor(a), g.dtype), v)
+                for a, (g, v) in zip(reduced_arrays, gv)
+            ]
+            return super().apply_gradients(reduced + list(extra),
+                                           *apply_args,
+                                           **(apply_kwargs or {}))
+
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             gv_all = list(grads_and_vars)
             # Unconnected/unused trainables yield g=None — exclude them
@@ -99,30 +118,72 @@ def DistributedOptimizer(optimizer, op: str = Average,
                    else hvd_tf.size())
             if hvd_tf.size() <= 1 or eff <= 1 or not gv:
                 return super().apply_gradients(gv_all, *args, **kwargs)
-            acc = getattr(self, "_hvd_acc", None)
             self._hvd_count = getattr(self, "_hvd_count", 0) + 1
             if backward_passes_per_step > 1:
-                grads = [g for g, _ in gv]
-                if acc is None:
-                    acc = [tf.convert_to_tensor(g) for g in grads]
-                else:
-                    acc = [a + tf.convert_to_tensor(g)
-                           for a, g in zip(acc, grads)]
+                # Accumulate KEYED BY VARIABLE, not position: the
+                # None-grad pattern may vary across passes within one
+                # window, and a positional zip would add gradients into
+                # the wrong accumulator slots.
+                acc = getattr(self, "_hvd_acc", None) or {}
+                var_of = getattr(self, "_hvd_var_of", {})
+                for g, v in gv:
+                    t = tf.convert_to_tensor(g)
+                    ref = v.ref()
+                    acc[ref] = t if ref not in acc else acc[ref] + t
+                    var_of[ref] = v
+                self._hvd_var_of = var_of
                 if self._hvd_count % backward_passes_per_step != 0:
                     self._hvd_acc = acc
                     return None
                 self._hvd_acc = None
-                gv = [(a / backward_passes_per_step, v)
-                      for a, (_, v) in zip(acc, gv)]
-            reduced_arrays = hvd_tf._reduce_arrays(
-                [hvd_tf._np(g) for g, _ in gv], op,
-                hvd_tf._ps_id(process_set), compression, "keras.grad")
-            reduced = [
-                (tf.cast(tf.convert_to_tensor(a), g.dtype), v)
-                for a, (g, v) in zip(reduced_arrays, gv)
-            ]
-            return super().apply_gradients(reduced + none_pairs,
-                                           *args, **kwargs)
+                gv = [(acc[ref] / backward_passes_per_step, var_of[ref])
+                      for ref in acc]
+            return self._reduce_and_apply(gv, "keras.grad", none_pairs,
+                                          apply_args=args,
+                                          apply_kwargs=kwargs)
+
+        def _hvd_flush(self):
+            """Apply a PARTIAL accumulation window (epoch end with batch
+            count not divisible by backward_passes_per_step) instead of
+            dropping it or straddling epochs.
+
+            COLLECTIVE: every member must call at the same loop point
+            (keras callbacks fire symmetrically — the estimator's
+            epoch-end hook). Whether anything is pending is a LOCAL fact
+            (uneven shards give ranks different batch counts), so the
+            members first AGREE on the global pending-pass count; ranks
+            with nothing pending contribute zeros, and the exchange sums
+            then divides by that global count — the true mean over every
+            pending microbatch, with no rank gating a collective on
+            local state."""
+            eff = (process_set.size() if process_set is not None
+                   else hvd_tf.size())
+            if hvd_tf.size() <= 1 or eff <= 1:
+                return None
+            acc = getattr(self, "_hvd_acc", None)
+            var_of = getattr(self, "_hvd_var_of", None)
+            pending = (self._hvd_count % backward_passes_per_step
+                       if acc else 0)
+            counts = hvd_tf._allgather_object_host(
+                pending, process_set=process_set)
+            total = sum(counts)
+            if total == 0:
+                return None
+            if not var_of:
+                # This rank never accumulated at all (it cannot know the
+                # variable set) — with peers pending this is the same
+                # divergence the per-step path would already have hit.
+                raise RuntimeError(
+                    "flush with no local accumulation history while "
+                    "peers have pending gradients; ranks diverged")
+            self._hvd_acc = None
+            self._hvd_count = 0
+            gv = [(acc[ref] if acc and ref in acc
+                   else tf.zeros_like(var_of[ref]), var_of[ref])
+                  for ref in var_of]
+            return self._reduce_and_apply(
+                gv, "keras.flush", reduce_op=hvd_tf.Sum,
+                divisor=float(total))
 
     _Distributed.__name__ = f"Distributed{base.__name__}"
     cfg = optimizer.get_config()

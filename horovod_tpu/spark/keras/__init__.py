@@ -71,11 +71,20 @@ class KerasEstimator(Estimator):
             model(x[:1])
             if hvdk.size() > 1:
                 hvdk.broadcast_variables(model.weights, root_rank=0)
+            class _FlushTail(tf.keras.callbacks.Callback):
+                # Partial bpps window at epoch end: apply it (collective
+                # — callbacks fire symmetrically on every rank).
+                def on_epoch_end(self, epoch, logs=None):
+                    o = self.model.optimizer
+                    if callable(getattr(o, "_hvd_flush", None)):
+                        o._hvd_flush()
+
             history = model.fit(
                 x, y, batch_size=p.batch_size, epochs=p.epochs,
                 shuffle=p.shuffle, verbose=p.verbose if shard == 0 else 0,
                 validation_data=((val["x"], val["y"])
                                  if val is not None else None),
+                callbacks=[_FlushTail()],
             )
             return {
                 "weights": [np.asarray(w) for w in model.get_weights()],

@@ -517,6 +517,65 @@ class TestMultiProcess:
         assert any("syncbn rank0 ok" in l for l in lines), lines
         assert any("syncbn rank1 ok" in l for l in lines), lines
 
+    def test_keras_bpps_tail_flush(self, tmp_path):
+        """keras DistributedOptimizer with backward_passes_per_step=2 and
+        an ODD apply count: _hvd_flush applies the tail window (averaged
+        over the passes it holds) — weights match the expected closed
+        form instead of silently dropping the last microbatch."""
+        from horovod_tpu.runner.launch import (
+            parse_args, run_static, settings_from_args,
+        )
+
+        script = _worker_script(
+            tmp_path,
+            """
+            import numpy as np
+            import tensorflow as tf
+            import horovod_tpu.keras as hvdk
+
+            hvdk.init()
+            r = hvdk.rank()
+            v = tf.Variable([0.0])
+            opt = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0),
+                backward_passes_per_step=2)
+            # 3 applies of grad (r+1): two full-window passes -> one
+            # update of mean over (2 passes x 2 ranks) = 1.5; the third
+            # pass sits in the accumulator until flush.
+            for _ in range(3):
+                opt.apply_gradients([(tf.constant([float(r + 1)]), v)])
+            assert np.allclose(v.numpy(), [-1.5]), v.numpy()
+            opt._hvd_flush()  # tail window: 1 pass each, rank-avg 1.5
+            assert np.allclose(v.numpy(), [-3.0]), v.numpy()
+            # flush is a no-op when nothing is pending ANYWHERE (the
+            # agreement collective returns total=0)
+            assert opt._hvd_flush() is None
+
+            # UNEVEN pending (the uneven-shard case): rank 0 has one
+            # pending pass, rank 1 none — the flush must not hang; the
+            # update is the mean over the ONE global pending pass.
+            w = tf.Variable([0.0])
+            opt2 = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0),
+                backward_passes_per_step=2)
+            passes = 3 if r == 0 else 2
+            for _ in range(passes):
+                opt2.apply_gradients([(tf.constant([1.0]), w)])
+            opt2._hvd_flush()
+            # window 1 (both ranks): mean grad 1 -> -1; flush: rank 0's
+            # single pending grad 1 over total=1 -> -1 more.
+            assert np.allclose(w.numpy(), [-2.0]), (r, w.numpy())
+            print(f"kerasflush rank{r} ok", flush=True)
+            """,
+        )
+        args = parse_args(["-np", "2", "--cpu-mode", str(script)])
+        settings = settings_from_args(args)
+        lines: list[str] = []
+        rc = run_static(settings, sink=lines.append)
+        assert rc == 0, "\n".join(lines)
+        assert any("kerasflush rank0 ok" in l for l in lines), lines
+        assert any("kerasflush rank1 ok" in l for l in lines), lines
+
     def test_keras_none_grads_and_divergent_builtness(self, tmp_path):
         """ADVICE r3 regressions: (a) None grads (unconnected trainables)
         pass through the keras DistributedOptimizer unreduced instead of
