@@ -988,14 +988,45 @@ class _DistributedOptimizer:
 
     def flush_step(self, closure=None):
         """Force an update from a PARTIAL accumulation window (epoch/fit
-        end with batch count not divisible by backward_passes_per_step):
-        averages over the passes actually accumulated instead of
-        dropping the tail or straddling it into the next epoch. No-op
-        when nothing is pending."""
-        if self._eff_size() <= 1 or not self._acc:
+        end with batch count not divisible by backward_passes_per_step)
+        instead of dropping the tail or straddling it into the next
+        epoch.
+
+        COLLECTIVE on every member: whether anything is pending is a
+        LOCAL fact (uneven shards give ranks different batch counts), so
+        members first AGREE on the global pending count; ranks with
+        nothing pending contribute zeros and the exchange sums then
+        divides by that count — no rank ever gates a collective on local
+        state. Sparse accumulators densify here (the tail is rare; a
+        zero-pending peer cannot know which tensors would be sparse).
+        Returns None when nothing is pending anywhere."""
+        if self._eff_size() <= 1:
             return None
-        pending = self._pass_count % self._bpps
-        self._flush_acc(1.0 / max(1, pending))
+        from ..process_world import allgather_object_host
+
+        pending = (self._pass_count % self._bpps) if self._acc else 0
+        counts = allgather_object_host(pending, process_set=self._ps)
+        total = sum(int(c) for c in counts)
+        if total == 0:
+            return None
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if not p.requires_grad or id(p) not in self._hooked:
+                    continue
+                acc = self._acc.pop(p, None)
+                if acc is None:
+                    src = torch.zeros_like(p.data)
+                elif acc.is_sparse:
+                    src = acc.to_dense()
+                else:
+                    src = acc
+                wire, ctx = self._compression.compress(src)
+                h = _world().allreduce_async_(
+                    _np_of(wire), name=f"grad.{self._param_name(p)}",
+                    op=Sum, process_set_id=_ps_id(self._ps),
+                    postscale_factor=1.0 / total)
+                self._handles[p] = (h, ctx, wire.dtype)
+        self._acc.clear()
         self._pass_count = 0
         self._synchronize_handles()
         self.update_count = getattr(self, "update_count", 0) + 1
@@ -1029,13 +1060,15 @@ class _DistributedOptimizer:
                 out = adasum_results[p]
             else:
                 out = np.asarray(_world().synchronize(h))
-            shape = tuple(p.grad.shape)
+            shape = tuple(p.shape)
             result = torch.from_numpy(
                 np.ascontiguousarray(out).reshape(shape)).to(wire_dtype)
             result = self._compression.decompress(result, ctx)
-            if p in self._densified:
-                # sparse_as_dense: the averaged gradient IS dense now
-                # (same device as the parameter, like the copy_ path).
+            if p.grad is None or p in self._densified or p.grad.is_sparse:
+                # No local grad to copy into (zero-pending flush rank
+                # after zero_grad), or the exchanged gradient is dense
+                # now (sparse_as_dense / densifying flush) — REPLACE on
+                # the parameter's device.
                 p.grad = result.to(dtype=p.dtype, device=p.device)
                 self._densified.discard(p)
             else:

@@ -467,6 +467,29 @@ class TestMultiProcess:
             # (full window mean 1.5, tail window mean 1.5) -> delta -3.
             assert torch.allclose(
                 m.weight.detach(), w0 - 3.0, atol=1e-6), m.weight - w0
+
+            # UNEVEN pending (uneven shards): rank 0 runs 3 passes,
+            # rank 1 only 2 — flush_step must not hang (collective
+            # agreement; zero contribution from rank 1) and applies the
+            # mean over the ONE global pending pass.
+            torch.manual_seed(0)
+            m2 = torch.nn.Linear(2, 1, bias=False)
+            w0 = m2.weight.detach().clone()
+            opt2 = hvd.DistributedOptimizer(
+                torch.optim.SGD(m2.parameters(), lr=1.0),
+                named_parameters=m2.named_parameters(),
+                backward_passes_per_step=2)
+            for _ in range(3 if r == 0 else 2):
+                opt2.zero_grad()
+                (m2(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+                opt2.step()
+            opt2.flush_step()
+            # window 1: rank-avg grad 1.5 -> -1.5; flush: rank 0's
+            # single pending grad (1.0) over total=1 -> -1 more.
+            assert torch.allclose(
+                m2.weight.detach(), w0 - 2.5, atol=1e-6), m2.weight - w0
+            # nothing pending anywhere: no-op on both ranks
+            assert opt2.flush_step() is None
             print(f"torch-groups rank{r} ok", flush=True)
             """)
         )
