@@ -25,66 +25,11 @@ from __future__ import annotations
 
 import argparse
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
-from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
-
-
-def expert_ffn(w1, w2, x):
-    return jnp.maximum(x @ w1, 0.0) @ w2
-
-
-def moe_layer(tokens, gates_w, w1, w2, axis, capacity):
-    """One expert-parallel MoE layer, per-device view under shard_map.
-
-    tokens: [T, D] this device's tokens; w1/w2: THIS device's expert.
-    Returns [T, D] with each token processed by its routed expert
-    (dropped tokens — over capacity — pass through unchanged, the
-    standard capacity-factor semantics).
-    """
-    n = lax.psum(1, axis)
-    T, D = tokens.shape
-    logits = tokens @ gates_w                      # [T, n]
-    expert = jnp.argmax(logits, axis=-1)           # [T]
-    gate = jax.nn.softmax(logits, axis=-1)
-    gate = jnp.take_along_axis(gate, expert[:, None], axis=1)[:, 0]
-
-    # Position of each token within its expert's send buffer; tokens past
-    # `capacity` are dropped (pass through). Static shapes throughout.
-    onehot = jax.nn.one_hot(expert, n, dtype=jnp.int32)        # [T, n]
-    pos = jnp.cumsum(onehot, axis=0) * onehot                  # 1-based
-    pos = jnp.sum(pos, axis=1) - 1                             # [T]
-    keep = (pos >= 0) & (pos < capacity)
-
-    # Scatter kept tokens into the [n, capacity, D+1] dispatch buffer —
-    # the last channel carries the occupancy mask, so ONE exchange moves
-    # payload and mask together.
-    send = jnp.zeros((n, capacity, D + 1), tokens.dtype)
-    payload = jnp.concatenate(
-        [tokens, jnp.ones((T, 1), tokens.dtype)], axis=1)
-    send = send.at[expert, jnp.clip(pos, 0, capacity - 1)].add(
-        jnp.where(keep[:, None], payload, 0.0))
-
-    # ONE all_to_all out: slot j of my buffer -> device j. Received:
-    # [n, capacity, D+1] = every device's tokens routed to MY expert.
-    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0,
-                          tiled=True).reshape(n, capacity, D + 1)
-    recv_mask = recv[..., -1] > 0.5
-    out = expert_ffn(w1, w2, recv[..., :D].reshape(n * capacity, D))
-    out = jnp.where(recv_mask.reshape(-1)[:, None], out, 0.0)
-    out = out.reshape(n, capacity, D)
-
-    # all_to_all back: expert results return to their source devices.
-    back = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
-                          tiled=True).reshape(n, capacity, D)
-
-    # Gather each token's result from (its expert's row, its position).
-    result = back[expert, jnp.clip(pos, 0, capacity - 1)]
-    return jnp.where(keep[:, None], gate[:, None] * result, tokens)
+from horovod_tpu.parallel.moe import expert_ffn
 
 
 def host_path_demo(n, d):
@@ -127,12 +72,8 @@ def main() -> int:
     w1 = rng.randn(n, args.dim, args.hidden).astype(np.float32) * 0.1
     w2 = rng.randn(n, args.hidden, args.dim).astype(np.float32) * 0.1
 
-    step = jax.jit(jax.shard_map(
-        lambda t, g, w1, w2: moe_layer(t, g, w1[0], w2[0], axis, capacity),
-        mesh=mesh,
-        in_specs=(P(axis), P(), P(axis), P(axis)),
-        out_specs=P(axis),
-        check_vma=False))
+    step = hvd.parallel.make_moe_step(axis_name=axis, capacity=capacity,
+                                      mesh=mesh)
     out = np.asarray(step(tokens, gates_w, w1, w2))
 
     # Dense oracle: apply each token's expert directly (same drop rule).

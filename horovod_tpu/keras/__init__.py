@@ -88,15 +88,57 @@ def DistributedOptimizer(optimizer, op: str = Average,
             self._hvd_acc = None
             self._hvd_count = 0
 
+        @staticmethod
+        def _wire_keyed(gv):
+            """Sort (grad, var) pairs by a STABLE per-variable key and
+            return (keys, sorted_gv). Wire names derive from these keys,
+            not positions: positional naming follows each rank's local
+            list order, which is only rank-identical when the None-grad /
+            accumulation history is — the exact data-dependent case the
+            accumulation paths exist for. Duplicate names (rare; keras
+            variable paths are unique) fall back to a shape/dtype
+            tiebreak; a still-ambiguous pair raises rather than silently
+            cross-pairing different variables across ranks (an
+            occurrence-counter suffix would depend on each rank's LOCAL
+            tie order — exactly the positional bug again)."""
+            def base(v):
+                return str(getattr(v, "path", None)
+                           or getattr(v, "name", None) or "var")
+
+            counts: dict = {}
+            for _, v in gv:
+                b = base(v)
+                counts[b] = counts.get(b, 0) + 1
+            keyed = []
+            for g, v in gv:
+                b = base(v)
+                if counts[b] > 1:
+                    b = f"{b}|{tuple(v.shape)}|{v.dtype}"
+                keyed.append((b, g, v))
+            keyed.sort(key=lambda t: t[0])
+            keys = [k for k, _, _ in keyed]
+            if len(set(keys)) != len(keys):
+                dup = sorted({k for k in keys if keys.count(k) > 1})
+                raise ValueError(
+                    f"variables {dup} share a name AND shape/dtype — "
+                    "cross-rank wire pairing would be ambiguous; give "
+                    "the variables unique names")
+            return keys, [(g, v) for _, g, v in keyed]
+
         def _reduce_and_apply(self, gv, name_prefix, extra=(),
                               reduce_op=None, divisor=None,
                               apply_args=(), apply_kwargs=None):
             """Exchange + decompress + apply — the shared wire tail of
             the per-step and flush paths. ``divisor`` post-scales a Sum
-            exchange (the flush's global-pending mean)."""
+            exchange (the flush's global-pending mean). Wires are named
+            by stable per-variable keys (see _wire_keyed) so the
+            controller pairs the same VARIABLE across ranks regardless
+            of each rank's local list order."""
+            keys, gv = self._wire_keyed(gv)
             reduced_arrays = hvd_tf._reduce_arrays(
                 [hvd_tf._np(g) for g, _ in gv], reduce_op or op,
-                hvd_tf._ps_id(process_set), compression, name_prefix)
+                hvd_tf._ps_id(process_set), compression, name_prefix,
+                names=keys)
             if divisor:
                 reduced_arrays = [a / divisor for a in reduced_arrays]
             reduced = [
@@ -160,27 +202,53 @@ def DistributedOptimizer(optimizer, op: str = Average,
                    else hvd_tf.size())
             if hvd_tf.size() <= 1 or eff <= 1:
                 return None
-            acc = getattr(self, "_hvd_acc", None)
-            var_of = getattr(self, "_hvd_var_of", None)
+            acc = getattr(self, "_hvd_acc", None) or {}
+            var_of = getattr(self, "_hvd_var_of", None) or {}
             pending = (self._hvd_count % backward_passes_per_step
                        if acc else 0)
-            counts = hvd_tf._allgather_object_host(
-                pending, process_set=process_set)
-            total = sum(counts)
+            # Agree on the pending count AND which variables actually
+            # accumulated THIS WINDOW on any rank: only those get an
+            # update (zero contributions from ranks that missed one),
+            # so a variable no rank touched keeps its per-step None-grad
+            # semantics — applying a zero grad would let momentum /
+            # weight decay drift it on every epoch-end flush.
+            keys_hist, hist = self._wire_keyed(
+                [(ref, v) for ref, v in var_of.items()])
+            local_active = [k for k, (ref, _) in zip(keys_hist, hist)
+                            if ref in acc]
+            replies = hvd_tf._allgather_object_host(
+                (pending, local_active), process_set=process_set)
+            total = sum(p for p, _ in replies)
             if total == 0:
                 return None
-            if not var_of:
-                # This rank never accumulated at all (it cannot know the
-                # variable set) — with peers pending this is the same
-                # divergence the per-step path would already have hit.
+            active: set = set()
+            for _, ks in replies:
+                active.update(ks)
+            unknown = active - set(keys_hist)
+            if unknown:
+                # A peer accumulated a variable this rank has never seen
+                # — it cannot contribute zeros of the right shape; this
+                # is the divergence the per-step path would also hit.
                 raise RuntimeError(
-                    "flush with no local accumulation history while "
-                    "peers have pending gradients; ranks diverged")
+                    "flush variable sets diverged across ranks: peers "
+                    f"accumulated {sorted(unknown)} unknown to this rank "
+                    f"(local history: {keys_hist})")
+            if op not in (hvd_tf.Average, hvd_tf.Sum):
+                raise ValueError(
+                    f"flush supports op=Average/Sum, got {op!r}")
             self._hvd_acc = None
             self._hvd_count = 0
-            gv = [(acc[ref] if acc and ref in acc
-                   else tf.zeros_like(var_of[ref]), var_of[ref])
-                  for ref in var_of]
+            gv = [(acc[ref] if ref in acc else tf.zeros_like(v), v)
+                  for k, (ref, v) in zip(keys_hist, hist) if k in active]
+            if op == hvd_tf.Sum:
+                # Window rule is "sum over ranks of the per-rank window
+                # mean": pre-divide the local accumulator by the LOCAL
+                # pending count (zero-pending ranks hold zeros); a
+                # 1/total postscale would shrink the tail update ~size()×
+                # relative to every full window.
+                gv = [(g / float(pending or 1), v) for g, v in gv]
+                return self._reduce_and_apply(
+                    gv, "keras.flush", reduce_op=hvd_tf.Sum)
             return self._reduce_and_apply(
                 gv, "keras.flush", reduce_op=hvd_tf.Sum,
                 divisor=float(total))

@@ -8,6 +8,7 @@ from .hierarchical import (  # noqa: F401
     hierarchical_mesh,
     host_hierarchical_allreduce,
 )
+from .moe import expert_ffn, make_moe_step, moe_layer  # noqa: F401
 from .sequence import (  # noqa: F401
     make_sp_attention_step,
     ring_attention,

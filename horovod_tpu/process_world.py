@@ -130,12 +130,28 @@ def remove_process_set(process_set) -> bool:
     rank list legitimately maps back to the same native set."""
     if process_set is None or getattr(process_set, "process_set_id", 0) == 0:
         return False
+    key = None
     for i, ps in enumerate(_ps_registry):
         if ps is process_set:
-            del _ps_registry[i]
-            process_set.process_set_id = -1
-            return True
-    return False
+            key = (i, tuple(ps.ranks))
+            break
+    if size() > 1:
+        # Mirror add_process_set's collective stance: agree on WHAT is
+        # being removed before touching the registry. A rank removing a
+        # different set (or removing alone — this gather then stalls and
+        # the inspector names it) diverges registries silently until the
+        # next elastic re-registration assigns mismatched native ids;
+        # fail at the call site instead.
+        keys = allgather_object_host(key)
+        if any(k != keys[0] for k in keys):
+            raise RuntimeError(
+                "remove_process_set is collective but ranks disagree on "
+                f"the set being removed: {keys} (index, ranks) per rank")
+    if key is None:
+        return False
+    del _ps_registry[key[0]]
+    process_set.process_set_id = -1
+    return True
 
 
 def resolve_ps_id(process_set) -> int:

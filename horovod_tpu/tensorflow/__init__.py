@@ -355,16 +355,24 @@ def broadcast_variables(variables, root_rank: int = 0) -> None:
         v.assign(tf.convert_to_tensor(np.asarray(out).reshape(v.shape)))
 
 
-def _reduce_arrays(arrays, op, process_set_id, compression, name_prefix):
+def _reduce_arrays(arrays, op, process_set_id, compression, name_prefix,
+                   names=None):
     """Shared wire protocol for gradient reduction on the host plane:
     compress -> async enqueue (stable names; same-cycle arrival fuses,
     steady state rides the response cache) -> synchronize -> decompress.
-    Used by DistributedGradientTape and the Keras optimizer wrapper."""
+    Used by DistributedGradientTape and the Keras optimizer wrapper.
+
+    ``names`` (optional, parallel to ``arrays``) overrides the default
+    positional wire tags — callers whose array ORDER is not guaranteed
+    rank-identical (the keras accumulation paths) must pass stable
+    per-tensor keys so the controller pairs the same tensor across ranks.
+    """
     w = _world()
     wires = [compression.compress(a) for a in arrays]
     handles = [
-        w.allreduce_async_(arr, name=f"{name_prefix}.{i}", op=op,
-                           process_set_id=process_set_id)
+        w.allreduce_async_(
+            arr, name=f"{name_prefix}.{names[i] if names else i}", op=op,
+            process_set_id=process_set_id)
         for i, (arr, _) in enumerate(wires)
     ]
     return [

@@ -725,6 +725,12 @@ class _DistributedOptimizer:
         self._pass_count = 0
         self._handles: dict[Any, int] = {}
         self._acc: dict[Any, "torch.Tensor"] = {}
+        # Per-param count of locally ACCUMULATED backwards this window.
+        # flush_step derives its pending-pass count from this (max over
+        # params), NOT from step()-call parity: backward() calls without
+        # a following step() (and grouped params with bpps==1) accumulate
+        # without advancing _pass_count.
+        self._acc_passes: dict[Any, int] = {}
         self._densified: set = set()  # params whose sparse grads densified
         self._names: dict[Any, str] = {}
         self._hooks = []
@@ -761,6 +767,7 @@ class _DistributedOptimizer:
         double-backward guard on the retry."""
         self._handles.clear()
         self._acc.clear()
+        self._acc_passes.clear()
         self._densified.clear()
         self._pass_count = 0
 
@@ -779,6 +786,10 @@ class _DistributedOptimizer:
                 if not p.requires_grad or id(p) in self._hooked:
                     continue
                 self._hooked.add(id(p))
+                # Mint the wire name NOW, in param_groups order — it is
+                # rank-identical, unlike autograd-hook firing order, and
+                # the controller pairs wires BY NAME across ranks.
+                self._param_name(p)
                 # The reference hooks the grad-accumulation node; torch now
                 # exposes that directly.
                 self._hooks.append(
@@ -822,6 +833,7 @@ class _DistributedOptimizer:
                         "to accumulate locally (reference contract)")
                 self._acc[p] = grad.detach().clone() if acc is None \
                     else (acc + grad)
+                self._acc_passes[p] = self._acc_passes.get(p, 0) + 1
                 return
             else:
                 self._enqueue_sparse(p, grad)
@@ -842,6 +854,7 @@ class _DistributedOptimizer:
                     "(reference contract)")
             self._acc[p] = grad.detach().clone() if acc is None \
                 else acc + grad
+            self._acc_passes[p] = self._acc_passes.get(p, 0) + 1
             return
         wire, ctx = self._compression.compress(grad)
         h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
@@ -928,6 +941,7 @@ class _DistributedOptimizer:
                 h = self._enqueue_wire(
                     wire, f"grad.{self._param_name(p)}")
                 self._handles[p] = (h, ctx, wire.dtype)
+        self._acc_passes.clear()  # window consumed
         if not grouped:
             return
         if self._explicit_groups is not None:
@@ -1004,15 +1018,59 @@ class _DistributedOptimizer:
             return None
         from ..process_world import allgather_object_host
 
-        pending = (self._pass_count % self._bpps) if self._acc else 0
-        counts = allgather_object_host(pending, process_set=self._ps)
-        total = sum(int(c) for c in counts)
+        # Locally-accumulated pass count (NOT step()-call parity: a
+        # backward() without a following step(), or grouped params with
+        # bpps==1, accumulate without advancing _pass_count).
+        pending = max(self._acc_passes.values(), default=0)
+        # Agree on the global pending count AND which params actually
+        # accumulated anywhere: enqueueing zeros for globally-unused
+        # params would hand the base optimizer a zero grad where a
+        # normal step leaves p.grad None — weight decay / momentum would
+        # drift unused weights on every epoch-end flush.
+        local_active = sorted(
+            self._param_name(p)
+            for group in self._opt.param_groups
+            for p in group["params"] if p in self._acc)
+        replies = allgather_object_host((pending, local_active),
+                                        process_set=self._ps)
+        total = sum(int(c) for c, _ in replies)
         if total == 0:
             return None
+        # Op checks AFTER the total==0 return: the epoch-loop pattern
+        # calls flush_step unconditionally (spark/torch does), and a
+        # clean window under op=Adasum must stay a no-op — only a REAL
+        # partial window whose update rule we cannot honor fails loudly.
+        if self._op == Adasum:
+            raise ValueError(
+                "flush_step does not compose with op=Adasum (the tail "
+                "flush computes a plain global mean); drain the window "
+                "with step() instead")
+        if self._op not in (Average, Sum):
+            raise ValueError(
+                f"flush_step supports op=Average/Sum, got {self._op!r}")
+        active: set = set()
+        for _, names in replies:
+            active.update(names)
+        # op=Average: the true mean over every pending microbatch
+        # globally (Sum wire, postscale 1/total). op=Sum: keep the
+        # window rule "sum over ranks of the per-rank mean" — each rank
+        # pre-divides its accumulator by ITS pass count, no postscale
+        # (a 1/total postscale would shrink the tail update ~size()×
+        # relative to every full window).
+        kwargs = dict(op=Sum, process_set_id=_ps_id(self._ps))
+        if self._op == Average:
+            kwargs.update(postscale_factor=1.0 / total)
+            if self._predivide != 1.0:
+                # Keep the reference's predivide split (fp16 overflow
+                # headroom): 1/f before the sum, f/total after.
+                kwargs.update(prescale_factor=1.0 / self._predivide,
+                              postscale_factor=self._predivide / total)
         for group in self._opt.param_groups:
             for p in group["params"]:
                 if not p.requires_grad or id(p) not in self._hooked:
                     continue
+                if self._param_name(p) not in active:
+                    continue  # no rank accumulated it — leave grad as-is
                 acc = self._acc.pop(p, None)
                 if acc is None:
                     src = torch.zeros_like(p.data)
@@ -1020,13 +1078,19 @@ class _DistributedOptimizer:
                     src = acc.to_dense()
                 else:
                     src = acc
+                if self._op == Sum and acc is not None:
+                    # Divide by the rank's PENDING pass count (the full
+                    # window divides uniformly by bpps) — a per-param
+                    # count would over-weight params that got grads in
+                    # only some tail passes.
+                    src = src / float(pending or 1)
                 wire, ctx = self._compression.compress(src)
                 h = _world().allreduce_async_(
                     _np_of(wire), name=f"grad.{self._param_name(p)}",
-                    op=Sum, process_set_id=_ps_id(self._ps),
-                    postscale_factor=1.0 / total)
+                    **kwargs)
                 self._handles[p] = (h, ctx, wire.dtype)
         self._acc.clear()
+        self._acc_passes.clear()
         self._pass_count = 0
         self._synchronize_handles()
         self.update_count = getattr(self, "update_count", 0) + 1

@@ -565,6 +565,42 @@ class TestMultiProcess:
             # window 1 (both ranks): mean grad 1 -> -1; flush: rank 0's
             # single pending grad 1 over total=1 -> -1 more.
             assert np.allclose(w.numpy(), [-2.0]), (r, w.numpy())
+
+            # Window-unused var keeps None-grad semantics at the flush:
+            # fy trains in the FULL window (momentum buffer nonzero),
+            # only fx is in the tail — a zero-grad apply would let
+            # momentum keep moving fy.
+            fx = tf.Variable([0.0], name="flushx")
+            fy = tf.Variable([0.0], name="flushy")
+            opt4 = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0, momentum=0.9),
+                backward_passes_per_step=2)
+            one = tf.constant([1.0])
+            for _ in range(2):
+                opt4.apply_gradients([(one, fx), (one, fy)])
+            fy_frozen = fy.numpy().copy()
+            fx_window = fx.numpy().copy()
+            opt4.apply_gradients([(one, fx)])  # tail: only fx
+            opt4._hvd_flush()
+            assert np.allclose(fy.numpy(), fy_frozen), (r, fy.numpy())
+            assert not np.allclose(fx.numpy(), fx_window), fx.numpy()
+
+            # ADVICE r4 regression: ranks accumulate the SAME variables
+            # in DIFFERENT order (data-dependent None-grad history).
+            # Wires pair by stable per-variable key, not position — a
+            # positional pairing would silently average a's grad with
+            # b's (both shapes match, no error raised).
+            a = tf.Variable([0.0], name="wirekey_a")
+            b = tf.Variable([0.0], name="wirekey_b")
+            opt3 = hvdk.DistributedOptimizer(
+                tf.keras.optimizers.SGD(learning_rate=1.0),
+                backward_passes_per_step=2)
+            ga, gb = tf.constant([1.0]), tf.constant([3.0])
+            order = [(ga, a), (gb, b)] if r == 0 else [(gb, b), (ga, a)]
+            opt3.apply_gradients(order)
+            opt3.apply_gradients(order)
+            assert np.allclose(a.numpy(), [-1.0]), (r, a.numpy())
+            assert np.allclose(b.numpy(), [-3.0]), (r, b.numpy())
             print(f"kerasflush rank{r} ok", flush=True)
             """,
         )

@@ -490,6 +490,98 @@ class TestMultiProcess:
                 m2.weight.detach(), w0 - 2.5, atol=1e-6), m2.weight - w0
             # nothing pending anywhere: no-op on both ranks
             assert opt2.flush_step() is None
+
+            # backward() calls NOT followed by step(): the pending count
+            # tracks accumulated passes, not step()-call parity — two
+            # hook-accumulated backwards with zero step() calls must
+            # flush as two pending passes, not read 0 and strand _acc.
+            torch.manual_seed(0)
+            m3 = torch.nn.Linear(2, 1, bias=False)
+            w0 = m3.weight.detach().clone()
+            opt3 = hvd.DistributedOptimizer(
+                torch.optim.SGD(m3.parameters(), lr=1.0),
+                named_parameters=m3.named_parameters(),
+                backward_passes_per_step=2)
+            for _ in range(2):
+                (m3(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+                m3.zero_grad(set_to_none=True)
+            opt3.flush_step()
+            assert opt3.update_count == 1
+            # 4 pending passes globally: (2*1 + 2*2)/4 = 1.5 -> -1.5
+            assert torch.allclose(
+                m3.weight.detach(), w0 - 1.5, atol=1e-6), m3.weight - w0
+
+            # Globally-unused param: no rank produced its grad, so the
+            # flush must NOT zero-fill it — weight decay/momentum on a
+            # zero grad would drift weights a normal step leaves alone.
+            torch.manual_seed(0)
+            used = torch.nn.Linear(2, 1, bias=False)
+            unused = torch.nn.Linear(2, 1, bias=False)
+            u0 = unused.weight.detach().clone()
+            opt4 = hvd.DistributedOptimizer(
+                torch.optim.SGD(
+                    list(used.parameters()) + list(unused.parameters()),
+                    lr=1.0, momentum=0.9, weight_decay=0.1),
+                backward_passes_per_step=2)
+            opt4.zero_grad(set_to_none=True)
+            (used(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+            opt4.flush_step()
+            assert unused.weight.grad is None
+            assert torch.equal(unused.weight.detach(), u0), \
+                (unused.weight - u0)
+
+            # gradient_predivide_factor keeps the predivide split through
+            # the flush (same mean, controlled intermediate magnitudes).
+            torch.manual_seed(0)
+            m5 = torch.nn.Linear(2, 1, bias=False)
+            w0 = m5.weight.detach().clone()
+            opt5 = hvd.DistributedOptimizer(
+                torch.optim.SGD(m5.parameters(), lr=1.0),
+                named_parameters=m5.named_parameters(),
+                backward_passes_per_step=2,
+                gradient_predivide_factor=4.0)
+            opt5.zero_grad()
+            (m5(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+            opt5.flush_step()
+            assert torch.allclose(
+                m5.weight.detach(), w0 - 1.5, atol=1e-6), m5.weight - w0
+
+            # op=Sum tail keeps the window rule "sum over ranks of the
+            # per-rank window mean" — NOT a global mean (which would
+            # shrink the tail update ~size()x vs every full window).
+            torch.manual_seed(0)
+            m7 = torch.nn.Linear(2, 1, bias=False)
+            w0 = m7.weight.detach().clone()
+            opt7 = hvd.DistributedOptimizer(
+                torch.optim.SGD(m7.parameters(), lr=1.0),
+                named_parameters=m7.named_parameters(),
+                op=hvd.Sum, backward_passes_per_step=2)
+            for _ in range(2):  # full window: sum of per-rank means = 3
+                opt7.zero_grad()
+                (m7(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+                opt7.step()
+            opt7.zero_grad()    # tail: ONE pass each -> same scale, 3
+            (m7(torch.ones(1, 2)) * float(r + 1)).sum().backward()
+            opt7.flush_step()
+            assert torch.allclose(
+                m7.weight.detach(), w0 - 6.0, atol=1e-6), m7.weight - w0
+
+            # op=Adasum: a CLEAN window is a no-op (the epoch loop calls
+            # flush_step unconditionally); a REAL partial window refuses
+            # loudly (it would silently compute a plain mean instead of
+            # an Adasum combination).
+            m6 = torch.nn.Linear(2, 1, bias=False)
+            opt6 = hvd.DistributedOptimizer(
+                torch.optim.SGD(m6.parameters(), lr=1.0),
+                named_parameters=m6.named_parameters(),
+                op=hvd.Adasum, backward_passes_per_step=2)
+            assert opt6.flush_step() is None  # nothing pending anywhere
+            (m6(torch.ones(1, 2))).sum().backward()
+            try:
+                opt6.flush_step()
+                raise AssertionError("flush_step(op=Adasum) did not raise")
+            except ValueError:
+                pass
             print(f"torch-groups rank{r} ok", flush=True)
             """)
         )
@@ -650,6 +742,19 @@ class TestMultiProcess:
             # barrier before exit: subset work is uneven and a finishing
             # rank's exit shuts the shared world down.
             hvd.barrier(process_set=mine)
+
+            # remove_process_set is COLLECTIVE: agreed removal succeeds
+            # on every rank; ranks disagreeing on WHICH set fail loudly
+            # (ADVICE r4 — a lone/divergent removal must not silently
+            # diverge registries until the next elastic re-registration).
+            assert hvd.remove_process_set(odds) is True
+            s1 = hvd.add_process_set([0, 1])
+            s2 = hvd.add_process_set([2, 3])
+            try:
+                hvd.remove_process_set(s1 if r < 2 else s2)
+                raise AssertionError("divergent remove did not raise")
+            except RuntimeError:
+                pass
             hvd.barrier()
             print("torch-ps rank%d ok" % r)
             """)
