@@ -153,14 +153,20 @@ def tune_step_fusion(
                 lambda x: jnp.copy(x) if isinstance(x, jax.Array) else x,
                 args)
 
+        # Time the BARE callable: a factory step's default-on stall
+        # watch (every Kth call drains the pipeline + a host-plane
+        # round trip) landing inside one candidate's window would bias
+        # the threshold choice.
+        timed = getattr(step, "_hvd_unwatched", step)
+
         def measure(threshold: int) -> float:  # noqa: F811
             set_tuned_threshold(threshold)
-            step.clear_cache()
-            out = step(*fresh_args())  # compile + warm
+            timed.clear_cache()
+            out = timed(*fresh_args())  # compile + warm
             jax.block_until_ready(out)
             t0 = _time.perf_counter()
             for _ in range(iters):
-                out = step(*fresh_args())
+                out = timed(*fresh_args())
             jax.block_until_ready(out)
             return (_time.perf_counter() - t0) / max(1, iters)
 

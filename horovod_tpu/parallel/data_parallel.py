@@ -20,6 +20,99 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 
+class _StallWatchedStep:
+    """Default-on stall watch for factory-built train steps.
+
+    The reference's stall inspector watches EVERYTHING submitted,
+    unconditionally (``stall_inspector.cc``); requiring users to call
+    ``hvd.fetch`` themselves left the exact user the inspector exists
+    for — a vanilla training loop hanging inside jit — unwatched. Every
+    Kth call (``HOROVOD_STALL_CHECK_STEPS``, default 50; <=0 disables)
+    the step's results route through :func:`horovod_tpu.stall.fetch`:
+    a local inspector ticket plus, in multi-controller worlds, the
+    cross-rank ``stallwatch/<name>`` announcement that NAMES a diverged
+    rank. Between check steps the call is a passthrough, so the watch
+    costs one pipeline drain per K steps.
+
+    Attribute access delegates to the wrapped callable, so jit surfaces
+    (``lower``, ``clear_cache`` — which ``tune_step_fusion`` requires)
+    keep working.
+    """
+
+    def __init__(self, fn, name_prefix: str):
+        from ..utils.env import get_int
+
+        self._fn = fn
+        self._prefix = name_prefix
+        self._every = get_int("HOROVOD_STALL_CHECK_STEPS", 50)
+        self._calls = 0
+
+    @staticmethod
+    def _cross_rank_available() -> bool:
+        """True when the cross-rank stallwatch can ride a host plane
+        this deployment actually has: an already-formed native world, or
+        the launcher env contract that makes one formable. NOT cached
+        and NEVER forms the world itself — a jax.distributed job that
+        deliberately skips the host plane must not have one spun up (or
+        crash on a missing rendezvous) as a side effect of the watch."""
+        import os
+
+        from . import hierarchical
+
+        return (hierarchical._host_world is not None
+                or bool(os.environ.get("HOROVOD_NATIVE_PORT"))
+                or bool(os.environ.get("HOROVOD_RENDEZVOUS_ADDR")))
+
+    def _step_number(self, cross_rank: bool) -> int:
+        """Watch-step counter. In multi-controller worlds the stallwatch
+        wire name must be RANK-IDENTICAL, and a process-local counter
+        diverges across elastic re-formations (a survivor has called the
+        step N times, a fresh worker 0) — so the counter lives on the
+        native world object, which every member recreates together at
+        each (re-)formation."""
+        from ..process_world import size as _psize
+
+        if cross_rank and _psize() > 1:
+            from .hierarchical import _default_native_world
+
+            w = _default_native_world()
+            n = getattr(w, "_stepwatch_n", 0) + 1
+            w._stepwatch_n = n
+            return n
+        self._calls += 1
+        return self._calls
+
+    def __call__(self, *args, **kwargs):
+        if self._every > 0:
+            cross = self._cross_rank_available()
+            n = self._step_number(cross)
+            if n % self._every == 0:
+                import jax
+
+                from ..stall import watch
+
+                # The announcement precedes the DISPATCH: on backends
+                # that execute synchronously (CPU) a diverged peer hangs
+                # this rank inside the jitted call itself, before any
+                # post-hoc fetch could announce.
+                with watch(name=f"{self._prefix}.{n}", cross_rank=cross):
+                    out = self._fn(*args, **kwargs)
+                    out = jax.block_until_ready(out)
+                return out
+        return self._fn(*args, **kwargs)
+
+    @property
+    def _hvd_unwatched(self):
+        """The bare step callable — timing loops (tune_step_fusion) use
+        this so a watch step's pipeline drain cannot bias a candidate."""
+        return self._fn
+
+    def __getattr__(self, item):
+        if item == "_fn":  # guard: lookup before __init__ must not recurse
+            raise AttributeError(item)
+        return getattr(self._fn, item)
+
+
 def make_train_step(
     loss_fn: Callable[..., Any],
     optimizer,
@@ -100,7 +193,8 @@ def make_train_step(
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
-    return jax.jit(sharded, donate_argnums=donate_argnums)
+    return _StallWatchedStep(
+        jax.jit(sharded, donate_argnums=donate_argnums), "train_step")
 
 
 def shard_batch(batch, mesh=None, axis_name: str | None = None):
@@ -252,4 +346,4 @@ def make_elastic_train_step(
         params, opt_state = apply_step(params, opt_state, grads)
         return params, opt_state, loss
 
-    return step
+    return _StallWatchedStep(step, "elastic_train_step")

@@ -20,6 +20,7 @@ has a name attached).
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 
@@ -139,6 +140,71 @@ def get_inspector() -> StallInspector:
         return _inspector
 
 
+@contextlib.contextmanager
+def watch(name: str | None = None, timeout_s: float | None = None,
+          label: str = "watch", cross_rank: bool = True):
+    """Stall-inspect a code region that must run in rank-lockstep.
+
+    On entry: a local inspector ticket opens, and in multi-controller
+    worlds a one-scalar ``stallwatch/<name>`` allreduce is announced on
+    the native host plane. The announcement happens BEFORE the body —
+    a peer that never reaches this region (or whose backend executes
+    the body synchronously, e.g. CPU, so it blocks inside dispatch) is
+    named by the controller's stall report either way. On exit the
+    announcement is collected and the ticket closes.
+
+    ``fetch`` is this watch wrapped around ``jax.block_until_ready``;
+    factory train steps use ``watch`` directly so the announcement
+    precedes the step dispatch.
+
+    ``timeout_s=None`` keeps the inspector's warn-only contract: the
+    announcement is awaited indefinitely (the controller reports the
+    stall meanwhile) unless ``HOROVOD_STALL_SHUTDOWN_TIME`` is set, in
+    which case that bounds the wait — shutdown stays opt-in exactly as
+    in the reference. ``cross_rank=False`` restricts to the local
+    inspector ticket (callers whose world has no host plane).
+    """
+    import numpy as np
+
+    from .process_world import size as _proc_size
+
+    if timeout_s is None:
+        shutdown_s = get_float("HOROVOD_STALL_SHUTDOWN_TIME", 0.0)
+        timeout_s = shutdown_s if shutdown_s > 0 else 1e9
+    inspector = get_inspector()
+    handle = None
+    world = None
+    if cross_rank and _proc_size() > 1:
+        from .parallel.hierarchical import _default_native_world
+
+        world = _default_native_world()
+        tag = name or world.reserve_name("step")
+        handle = world.allreduce_async_(
+            np.ones(1, np.float32), name=f"stallwatch/{tag}", op="sum")
+    else:
+        tag = name or "step"
+    ticket = inspector.begin(f"{label}[{tag}]")
+    try:
+        yield
+        if handle is not None:
+            world.synchronize(handle, timeout_s=timeout_s)
+            handle = None
+    finally:
+        inspector.end(ticket)
+        if handle is not None:
+            # The body raised (e.g. the inspector's own shutdown
+            # interrupt) with the stallwatch allreduce still in flight.
+            # Collect it if it already completed; otherwise it MUST stay
+            # pinned — the native runtime holds raw pointers into its
+            # buffers until the collective finishes, and elastic recovery
+            # fails it (releasing the pin) at the next world teardown.
+            try:
+                if world.poll(handle):
+                    world.synchronize(handle, timeout_s=1.0)
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+
+
 def fetch(tree, name: str | None = None, timeout_s: float = 600.0):
     """Materialize a compiled step's results under stall inspection.
 
@@ -169,40 +235,7 @@ def fetch(tree, name: str | None = None, timeout_s: float = 600.0):
     """
     import jax
 
-    from .process_world import size as _proc_size
-
-    inspector = get_inspector()
-    handle = None
-    world = None
-    if _proc_size() > 1:
-        from .parallel.hierarchical import _default_native_world
-
-        import numpy as np
-
-        world = _default_native_world()
-        tag = name or world.reserve_name("step")
-        handle = world.allreduce_async_(
-            np.ones(1, np.float32), name=f"stallwatch/{tag}", op="sum")
-    else:
-        tag = name or "step"
-    ticket = inspector.begin(f"fetch[{tag}]")
-    try:
+    out = tree
+    with watch(name=name, timeout_s=timeout_s, label="fetch"):
         out = jax.block_until_ready(tree)
-        if handle is not None:
-            world.synchronize(handle, timeout_s=timeout_s)
-            handle = None
-        return out
-    finally:
-        inspector.end(ticket)
-        if handle is not None:
-            # The device fetch raised (e.g. the inspector's own shutdown
-            # interrupt) with the stallwatch allreduce still in flight.
-            # Collect it if it already completed; otherwise it MUST stay
-            # pinned — the native runtime holds raw pointers into its
-            # buffers until the collective finishes, and elastic recovery
-            # fails it (releasing the pin) at the next world teardown.
-            try:
-                if world.poll(handle):
-                    world.synchronize(handle, timeout_s=1.0)
-            except Exception:  # noqa: BLE001 — cleanup is best-effort
-                pass
+    return out

@@ -1,0 +1,179 @@
+"""Per-shape ResNet-50 conv roofline: fwd / dx / dw MXU utilisation.
+
+Times every distinct conv shape in ResNet-50 (batch 128, bf16, NHWC) on
+the real chip — forward, input-grad (dx) and filter-grad (dw) separately
+via ``jax.linear_transpose`` (conv is linear in each argument, so the
+transpose map runs WITHOUT the forward pass) — and attributes the
+backward-conv time the step-level roofline (docs/benchmarks.md) can only
+report in aggregate. This names the shapes a Pallas backward kernel must
+beat.
+
+Timing: N async dispatches + one distinct-scalar value fetch, minus the
+measured fetch RTT (block_until_ready lies through the axon tunnel).
+"""
+
+from __future__ import annotations
+
+import functools
+import statistics
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BATCH = 128
+DTYPE = jnp.bfloat16
+
+# (H, k, stride, Cin, Cout, count) — every distinct conv in ResNet-50
+# v1.5 at 224**2 input (H = input spatial size of the conv).
+SHAPES = [
+    (224, 7, 2, 3, 64, 1),      # stem
+    # stage 1 (56x56, filters 64)
+    (56, 1, 1, 64, 64, 1),
+    (56, 3, 1, 64, 64, 3),
+    (56, 1, 1, 64, 256, 4),     # 3 expand + 1 projection
+    (56, 1, 1, 256, 64, 2),
+    # stage 2 (filters 128)
+    (56, 1, 1, 256, 128, 1),
+    (56, 3, 2, 128, 128, 1),
+    (28, 1, 1, 128, 512, 4),
+    (56, 1, 2, 256, 512, 1),    # projection
+    (28, 1, 1, 512, 128, 3),
+    (28, 3, 1, 128, 128, 3),
+    # stage 3 (filters 256)
+    (28, 1, 1, 512, 256, 1),
+    (28, 3, 2, 256, 256, 1),
+    (14, 1, 1, 256, 1024, 6),
+    (28, 1, 2, 512, 1024, 1),   # projection
+    (14, 1, 1, 1024, 256, 5),
+    (14, 3, 1, 256, 256, 5),
+    # stage 4 (filters 512)
+    (14, 1, 1, 1024, 512, 1),
+    (14, 3, 2, 512, 512, 1),
+    (7, 1, 1, 512, 2048, 3),
+    (14, 1, 2, 1024, 2048, 1),  # projection
+    (7, 1, 1, 2048, 512, 2),
+    (7, 3, 1, 512, 512, 2),
+]
+
+PEAKS = {"TPU v5 lite": 197e12, "TPU v5p": 459e12, "TPU v4": 275e12,
+         "TPU v6 lite": 918e12}
+
+
+def conv(x, w, stride, k):
+    # bf16 in/out with no preferred_element_type — exactly what
+    # flax nn.Conv(dtype=bf16) emits in the ResNet model (the MXU still
+    # accumulates bf16 passes in f32 internally).
+    pad = "SAME" if k != 7 else [(3, 3), (3, 3)]
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), pad,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def fetch_rtt(probe) -> float:
+    float(np.asarray(probe))
+    samples = []
+    for i in range(3):
+        p = probe * 0 + float(i)
+        t0 = time.perf_counter()
+        assert float(np.asarray(p)) == float(i)
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def time_op(fn, arg, iters=None) -> float:
+    out = fn(arg)
+    probe = jax.tree.leaves(out)[0].ravel()[0].astype(jnp.float32)
+    float(np.asarray(probe))  # compile + drain
+    rtt = fetch_rtt(probe)
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(arg)
+        p = jax.tree.leaves(out)[0].ravel()[0].astype(jnp.float32) * 0 + 7.0
+        assert float(np.asarray(p)) == 7.0
+        reps.append(max(time.perf_counter() - t0 - rtt, 1e-9) / iters)
+    return statistics.median(reps)
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    peak = PEAKS.get(dev.device_kind, 197e12)
+    print(f"device: {dev.device_kind}, peak {peak/1e12:.0f} TF/s bf16, "
+          f"batch {BATCH}")
+    header = (f"{'shape':>28} {'cnt':>3} | {'GFLOP':>6} |"
+              f" {'fwd ms':>7} {'mxu%':>5} | {'dx ms':>7} {'mxu%':>5} |"
+              f" {'dw ms':>7} {'mxu%':>5}")
+    print(header)
+    print("-" * len(header))
+    tot = {"fwd": 0.0, "dx": 0.0, "dw": 0.0}
+    tot_bound = 0.0
+    rows = []
+    for (H, k, s, cin, cout, count) in SHAPES:
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(
+            rng.randn(BATCH, H, H, cin).astype(np.float32), DTYPE)
+        w = jnp.asarray(
+            rng.randn(k, k, cin, cout).astype(np.float32) * 0.05, DTYPE)
+        hout = -(-H // s)
+        gflop = 2 * BATCH * hout * hout * k * k * cin * cout / 1e9
+        dy = jnp.asarray(
+            rng.randn(BATCH, hout, hout, cout).astype(np.float32), DTYPE)
+
+        cfn = functools.partial(conv, stride=s, k=k)
+        fwd = jax.jit(lambda xx: cfn(xx, w))
+        # vjp instead of linear_transpose: the trailing astype makes the
+        # cotangent dtype mismatch under pure transposition; the vjp fn
+        # applies ONLY the backward ops at call time either way.
+        _, vjp_x = jax.vjp(lambda xx: cfn(xx, w), x)
+        _, vjp_w = jax.vjp(lambda ww: cfn(x, ww), w)
+        dx_t = jax.jit(lambda gy: vjp_x(gy)[0])
+        dw_t = jax.jit(lambda gy: vjp_w(gy)[0])
+
+        iters = max(10, min(60, int(3e3 / max(gflop, 1))))
+        t_f = time_op(fwd, x, iters)
+        t_dx = time_op(dx_t, dy, iters)
+        t_dw = time_op(dw_t, dy, iters)
+
+        bound = gflop * 1e9 / peak * 1e3  # ms at peak
+        row = (H, k, s, cin, cout, count, gflop, t_f, t_dx, t_dw, bound)
+        rows.append(row)
+        tot["fwd"] += t_f * count * 1e3
+        tot["dx"] += t_dx * count * 1e3
+        tot["dw"] += t_dw * count * 1e3
+        tot_bound += bound * count
+        print(f"{H:>4}x{H:<4} k{k} s{s} {cin:>4}->{cout:<4} {count:>3} |"
+              f" {gflop:6.1f} |"
+              f" {t_f*1e3:7.3f} {bound/ (t_f*1e3) * 100:5.1f} |"
+              f" {t_dx*1e3:7.3f} {bound/(t_dx*1e3)*100:5.1f} |"
+              f" {t_dw*1e3:7.3f} {bound/(t_dw*1e3)*100:5.1f}",
+              flush=True)
+    print("-" * len(header))
+    print(f"totals (weighted): fwd {tot['fwd']:.2f} ms"
+          f" ({tot_bound/tot['fwd']*100:.1f}% mxu), "
+          f"dx {tot['dx']:.2f} ms ({tot_bound/tot['dx']*100:.1f}%), "
+          f"dw {tot['dw']:.2f} ms ({tot_bound/tot['dw']*100:.1f}%)")
+    print(f"peak-bound per pass: {tot_bound:.2f} ms")
+    # The worst backward offenders, cost-weighted.
+    scored = sorted(
+        rows, key=lambda r: -(r[8] + r[9]) * r[5])
+    print("top backward offenders (count-weighted dx+dw ms):")
+    for r in scored[:6]:
+        H, k, s, cin, cout, count, gflop, t_f, t_dx, t_dw, bound = r
+        print(f"  {H}x{H} k{k} s{s} {cin}->{cout} x{count}: "
+              f"{(t_dx+t_dw)*count*1e3:.2f} ms "
+              f"(dx {bound/(t_dx*1e3)*100:.0f}%, "
+              f"dw {bound/(t_dw*1e3)*100:.0f}% mxu)")
+
+
+if __name__ == "__main__":
+    import os
+
+    cache = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", cache)
+    main()
