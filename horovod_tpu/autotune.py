@@ -111,11 +111,188 @@ def autotune_state() -> dict:
     }
 
 
+DEFAULT_THRESHOLDS = (256 * 1024, 4 * 1024 * 1024, 64 * 1024 * 1024)
+
+
+class AutotuneStep:
+    """Transparent warmup autotuning of a factory-built train step — the
+    compiled-path consumer of ``HOROVOD_AUTOTUNE=1``.
+
+    The reference's contract is the env flag and NOTHING else: tuning
+    happens inside the first training steps, invisibly
+    (``parameter_manager.cc`` warmup windows). Here the tunable is the
+    trace-time fusion threshold, so the wrapper spends the first
+    ``len(thresholds) * (1 + iters)`` REAL training calls as sampling
+    windows: each candidate pins the threshold, re-traces the step
+    (``clear_cache`` — the wrapper owns the jit object, the user calls
+    nothing), and times ``iters`` live steps. Training progresses
+    normally throughout (every call returns its real result, exactly as
+    the reference tunes during real training). After the last window the
+    fastest candidate is pinned process-wide, the decision is logged
+    (and appended to ``HOROVOD_AUTOTUNE_LOG`` as a JSON line), and the
+    wrapper becomes a passthrough.
+
+    Window timing ends in ONE value fetch of the smallest output leaf —
+    ``block_until_ready`` can return early on tunneled backends; a value
+    fetch cannot — and every window pays the same single fetch, so the
+    constant cancels in the ranking. In multi-process worlds every rank
+    samples on the same call schedule (lockstep training) and rank 0's
+    winner is broadcast before pinning: the threshold changes the traced
+    program, so ranks MUST agree or their collective sequences diverge.
+    """
+
+    def __init__(self, jitted, thresholds=None, iters: int = 3,
+                 clock=None):
+        import time as _time
+
+        self._fn = jitted
+        self._cands = list(thresholds or DEFAULT_THRESHOLDS)
+        self._iters = max(1, int(iters))
+        self._win = 1 + self._iters  # 1 compile/settle call + timed calls
+        self._calls = 0
+        self._samples: list[tuple[int, float]] = []
+        self._t0 = 0.0
+        self._clock = clock or _time.perf_counter  # tests inject cost models
+        self._hvd_tuning = True  # stall watch skips while tuning
+
+    def _fetch_probe(self, out) -> None:
+        import jax
+        import numpy as np
+
+        leaves = [l for l in jax.tree.leaves(out)
+                  if isinstance(l, jax.Array)]
+        if not leaves:
+            jax.block_until_ready(out)
+            return
+        probe = min(leaves, key=lambda l: l.size)
+        np.asarray(probe)  # value fetch: proves execution finished
+
+    def _finish(self) -> None:
+        import json
+        import os
+
+        best = min(self._samples, key=lambda s: s[1])
+        decision = int(best[0])
+        from .process_world import rank as _prank
+        from .process_world import size as _psize
+
+        if _psize() > 1:
+            from .process_world import broadcast_object_host
+
+            decision = int(broadcast_object_host(
+                decision, name="autotune/step-decision"))
+        else:
+            import jax
+
+            if jax.process_count() > 1:
+                from .functions import broadcast_object
+
+                decision = int(broadcast_object(
+                    decision, name="autotune/step-decision"))
+        set_tuned_threshold(decision)
+        _tuned["history"].extend(self._samples)
+        if decision != self._cands[-1]:
+            # The cache holds the LAST candidate's trace; only a
+            # different winner needs the re-trace.
+            self._fn.clear_cache()
+        self._hvd_tuning = False
+        log = get_logger()
+        log.info(
+            "autotune: pinned fusion_threshold=%d after %d warmup "
+            "windows %s", decision, len(self._samples),
+            [(t, round(s, 5)) for t, s in self._samples])
+        path = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
+        # Rank 0 writes alone: the env propagates to every worker and the
+        # broadcast decision is rank 0's anyway — N appenders would tear
+        # lines on shared filesystems.
+        if path and _prank() == 0:
+            try:
+                with open(path, "a") as f:
+                    f.write(json.dumps({
+                        "tunable": "fusion_threshold_bytes",
+                        "decision": decision,
+                        "samples": self._samples,
+                    }) + "\n")
+            except OSError:  # pragma: no cover — logging is best-effort
+                log.warning("autotune: cannot write HOROVOD_AUTOTUNE_LOG=%s",
+                            path)
+
+    def _abort(self) -> None:
+        """A window (or the finish exchange) raised: pin the best sample
+        so far — or the first candidate if none completed — and stop
+        tuning. A half-tuned process must never crash later training
+        calls; the exception itself still propagates to the caller."""
+        decision = (min(self._samples, key=lambda s: s[1])[0]
+                    if self._samples else self._cands[0])
+        set_tuned_threshold(int(decision))
+        self._fn.clear_cache()
+        self._hvd_tuning = False
+        get_logger().warning(
+            "autotune: aborted mid-warmup; pinned fusion_threshold=%d "
+            "from %d completed sample(s)", decision, len(self._samples))
+
+    def __call__(self, *args, **kwargs):
+        if not self._hvd_tuning:
+            return self._fn(*args, **kwargs)
+        idx, pos = divmod(self._calls, self._win)
+        self._calls += 1
+        try:
+            if pos == 0:
+                # Window start: pin the candidate and force a re-trace.
+                # The call compiles + settles; timing starts after its
+                # fetch.
+                set_tuned_threshold(self._cands[idx])
+                self._fn.clear_cache()
+                out = self._fn(*args, **kwargs)
+                self._fetch_probe(out)
+                self._t0 = self._clock()
+                return out
+            out = self._fn(*args, **kwargs)
+            if pos == self._win - 1:
+                self._fetch_probe(out)
+                dt = (self._clock() - self._t0) / self._iters
+                self._samples.append((self._cands[idx], dt))
+                if idx + 1 == len(self._cands):
+                    self._finish()
+            return out
+        except Exception:
+            self._abort()
+            raise
+
+    def __getattr__(self, item):
+        if item == "_fn":  # guard: lookup before __init__ must not recurse
+            raise AttributeError(item)
+        return getattr(self._fn, item)
+
+
+_active_tuner: list = []  # at most one in-flight warmup tuner per process
+
+
+def maybe_autotune_step(jitted):
+    """Wrap ``jitted`` in transparent warmup tuning when
+    ``HOROVOD_AUTOTUNE=1`` (env or config) — the factory entry point.
+
+    At most ONE tuner is live per process: the threshold is
+    process-global, so a second factory call before the first tuner
+    finishes (a train step + an eval step built at startup) must not
+    race it — later steps pass through and inherit the first tuner's
+    decision, exactly as every step shares the native runtime's single
+    parameter_manager in the reference."""
+    from .utils.env import get_bool
+
+    if not get_bool("HOROVOD_AUTOTUNE") or tuned_threshold() is not None:
+        return jitted
+    if _active_tuner and _active_tuner[0]._hvd_tuning:
+        return jitted
+    tuner = AutotuneStep(jitted)
+    _active_tuner[:] = [tuner]
+    return tuner
+
+
 def tune_step_fusion(
     step,
     args: tuple,
-    thresholds: Sequence[int] = (
-        256 * 1024, 4 * 1024 * 1024, 64 * 1024 * 1024),
+    thresholds: Sequence[int] = DEFAULT_THRESHOLDS,
     iters: int = 3,
     measure: Callable[[int], float] | None = None,
 ) -> int:

@@ -83,7 +83,7 @@ class _StallWatchedStep:
         return self._calls
 
     def __call__(self, *args, **kwargs):
-        if self._every > 0:
+        if self._every > 0 and not getattr(self._fn, "_hvd_tuning", False):
             cross = self._cross_rank_available()
             n = self._step_number(cross)
             if n % self._every == 0:
@@ -193,8 +193,14 @@ def make_train_step(
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
+    from ..autotune import maybe_autotune_step
+
+    # Layering: stall watch OUTSIDE the autotuner OUTSIDE the jit — the
+    # tuner owns re-tracing (clear_cache) and the watch defers while a
+    # tuning window is live so its pipeline drain cannot bias a sample.
     return _StallWatchedStep(
-        jax.jit(sharded, donate_argnums=donate_argnums), "train_step")
+        maybe_autotune_step(jax.jit(sharded, donate_argnums=donate_argnums)),
+        "train_step")
 
 
 def shard_batch(batch, mesh=None, axis_name: str | None = None):
