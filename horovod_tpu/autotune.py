@@ -153,6 +153,7 @@ class AutotuneStep:
         self._samples: list[tuple[int, float]] = []
         self._t0 = 0.0
         self._clock = clock or _time.perf_counter  # tests inject cost models
+        self._co_steps: list = []  # steps built mid-warmup: re-trace at pin
         self._hvd_tuning = True  # stall watch skips while tuning
 
     def _fetch_probe(self, out) -> None:
@@ -195,6 +196,14 @@ class AutotuneStep:
             # The cache holds the LAST candidate's trace; only a
             # different winner needs the re-trace.
             self._fn.clear_cache()
+        for co in self._co_steps:
+            # Steps built mid-warmup traced under a candidate threshold;
+            # clear them so their next call re-traces with the winner.
+            try:
+                co.clear_cache()
+            except AttributeError:  # pragma: no cover — non-jit callable
+                pass
+        self._co_steps.clear()
         self._hvd_tuning = False
         log = get_logger()
         log.info(
@@ -202,10 +211,16 @@ class AutotuneStep:
             "windows %s", decision, len(self._samples),
             [(t, round(s, 5)) for t, s in self._samples])
         path = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
-        # Rank 0 writes alone: the env propagates to every worker and the
+        # One writer only: the env propagates to every worker and the
         # broadcast decision is rank 0's anyway — N appenders would tear
-        # lines on shared filesystems.
-        if path and _prank() == 0:
+        # lines on shared filesystems. In the jax-multicontroller regime
+        # (no hvdrun env contract) process_world.rank() is 0 everywhere,
+        # so gate on jax.process_index there.
+        import jax as _jax
+
+        writer = (_prank() == 0 if _psize() > 1
+                  else _jax.process_index() == 0)
+        if path and writer:
             try:
                 with open(path, "a") as f:
                     f.write(json.dumps({
@@ -226,6 +241,12 @@ class AutotuneStep:
                     if self._samples else self._cands[0])
         set_tuned_threshold(int(decision))
         self._fn.clear_cache()
+        for co in self._co_steps:
+            try:
+                co.clear_cache()
+            except AttributeError:  # pragma: no cover
+                pass
+        self._co_steps.clear()
         self._hvd_tuning = False
         get_logger().warning(
             "autotune: aborted mid-warmup; pinned fusion_threshold=%d "
@@ -283,6 +304,10 @@ def maybe_autotune_step(jitted):
     if not get_bool("HOROVOD_AUTOTUNE") or tuned_threshold() is not None:
         return jitted
     if _active_tuner and _active_tuner[0]._hvd_tuning:
+        # A step built mid-warmup would trace under whatever CANDIDATE
+        # is pinned at its first call — register it so the tuner clears
+        # its cache when the winner lands and it re-traces tuned.
+        _active_tuner[0]._co_steps.append(jitted)
         return jitted
     tuner = AutotuneStep(jitted)
     _active_tuner[:] = [tuner]

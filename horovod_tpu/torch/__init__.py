@@ -732,6 +732,7 @@ class _DistributedOptimizer:
         # without advancing _pass_count.
         self._acc_passes: dict[Any, int] = {}
         self._densified: set = set()  # params whose sparse grads densified
+        self._device_acc: set = set()  # params routed via the device plane
         self._names: dict[Any, str] = {}
         self._hooks = []
         self._hooked: set = set()
@@ -769,6 +770,7 @@ class _DistributedOptimizer:
         self._acc.clear()
         self._acc_passes.clear()
         self._densified.clear()
+        self._device_acc.clear()
         self._pass_count = 0
 
     def _param_name(self, p) -> str:
@@ -841,6 +843,25 @@ class _DistributedOptimizer:
         if grad.is_sparse:  # sparse_as_dense
             grad = grad.to_dense()
             self._densified.add(p)
+        if self._device_plane_for(grad):
+            # Device-resident gradient (or forced via
+            # HOROVOD_TORCH_DEVICE_PLANE=1, the torch-xla stand-in):
+            # DEFER to step() — the compiled plane's dispatch order must
+            # be rank-identical, and autograd hook order is not. step()
+            # flushes device params in param_groups order as fused
+            # buckets through the executable cache.
+            acc = self._acc.get(p)
+            if acc is not None and self._bpps <= 1:
+                raise RuntimeError(
+                    f"gradient for parameter '{self._param_name(p)}' was "
+                    "produced twice before step(); increase "
+                    "backward_passes_per_step to accumulate locally "
+                    "(reference contract)")
+            self._acc[p] = grad.detach().clone() if acc is None \
+                else acc + grad
+            self._acc_passes[p] = self._acc_passes.get(p, 0) + 1
+            self._device_acc.add(id(p))
+            return
         if self._bpps > 1 or self._grouping_for(p):
             acc = self._acc.get(p)
             if acc is not None and self._bpps <= 1:
@@ -859,6 +880,75 @@ class _DistributedOptimizer:
         wire, ctx = self._compression.compress(grad)
         h = self._enqueue_wire(wire, f"grad.{self._param_name(p)}")
         self._handles[p] = (h, ctx, wire.dtype)
+
+    def _device_plane_for(self, grad) -> bool:
+        """Route this gradient over the compiled (XLA) device plane?
+
+        True for accelerator-resident tensors — and for any tensor when
+        ``HOROVOD_TORCH_DEVICE_PLANE=1`` (CPU jax arrays accept DLPack
+        zero-copy too; the env flag is the torch-xla stand-in this image
+        can test) — provided the jax world has the one-device-per-process
+        shape a per-process tensor maps onto. Reference: the torch bridge
+        is accelerator-native end-to-end (``mpi_ops_v2.cc``); CPU tensors
+        keep the native TCP host plane."""
+        import os
+
+        forced = os.environ.get("HOROVOD_TORCH_DEVICE_PLANE", "") == "1"
+        if not forced and grad.device.type == "cpu":
+            return False
+        if self._op == Adasum or self._predivide != 1.0:
+            # Adasum's host pairwise tree and the predivide split keep
+            # their host-plane forms (op parity there is exact).
+            return False
+        if self._ps is not None:
+            # Subset-scoped exchanges stay on the host plane: the torch
+            # surface's ProcessSet is a host-world object (no sub-mesh),
+            # and a global-mesh dispatch from members only would hang.
+            return False
+        from .device import _device_world_ok
+
+        return _device_world_ok()
+
+    def _enqueue_device(self, pairs, scale: float) -> None:
+        """Fused device-plane exchange: pack param_groups-ordered buckets
+        into flat wires (the fusion-buffer role), run ONE compiled
+        AllReduce per bucket over the mesh (executable cache), record
+        per-param futures. No host copy touches the gradient path —
+        compression casts happen torch-side on the producing device,
+        packing/unpacking is device-side."""
+        import jax.numpy as jnp
+
+        from ..ops import collective_ops as _cops
+        from ..ops.fusion import bucket_leaves
+        from . import device as dev
+
+        wires, ctxs, wire_dtypes = [], [], []
+        for p, acc in pairs:
+            # Scale BEFORE the compression cast, exactly like the host
+            # plane: fp16 wires rely on the 1/bpps scale for overflow
+            # headroom — a post-cast prescale cannot recover an inf.
+            wire, ctx = self._compression.compress(
+                acc * scale if scale != 1.0 else acc)
+            wire_dtypes.append(wire.dtype)
+            wires.append(dev.to_jax(wire.contiguous()))
+            ctxs.append(ctx)
+        buckets = bucket_leaves(wires, None)
+        for bucket in buckets:
+            if len(bucket) == 1:
+                i = bucket[0]
+                flat = wires[i].ravel()
+            else:
+                flat = jnp.concatenate([wires[i].ravel() for i in bucket])
+            stacked = dev._stack_global(flat)
+            out = _cops.allreduce(stacked, op=self._op)
+            offset = 0
+            for i in bucket:
+                p, _ = pairs[i]
+                numel = int(wires[i].size)
+                self._handles[p] = (
+                    ("device_future", out, offset, numel),
+                    ctxs[i], wire_dtypes[i])
+                offset += numel
 
     def _grouping_for(self, p) -> bool:
         """True when ``p``'s gradient rides an explicit atomic group (it
@@ -926,10 +1016,14 @@ class _DistributedOptimizer:
         wire dtype — the reference's GroupTable all-or-nothing firing),
         everything else as individual async allreduces."""
         grouped: list[tuple[Any, "torch.Tensor"]] = []
+        device_pairs: list[tuple[Any, "torch.Tensor"]] = []
         for group in self._opt.param_groups:
             for p in group["params"]:
                 acc = self._acc.pop(p, None)
                 if acc is None:
+                    continue
+                if id(p) in self._device_acc:
+                    device_pairs.append((p, acc))
                     continue
                 if acc.is_sparse:
                     self._enqueue_sparse(p, acc * scale)
@@ -942,6 +1036,9 @@ class _DistributedOptimizer:
                     wire, f"grad.{self._param_name(p)}")
                 self._handles[p] = (h, ctx, wire.dtype)
         self._acc_passes.clear()  # window consumed
+        self._device_acc.clear()
+        if device_pairs:
+            self._enqueue_device(device_pairs, scale)
         if not grouped:
             return
         if self._explicit_groups is not None:
@@ -1091,6 +1188,7 @@ class _DistributedOptimizer:
                 self._handles[p] = (h, ctx, wire.dtype)
         self._acc.clear()
         self._acc_passes.clear()
+        self._device_acc.clear()  # tail flush rides the host plane
         self._pass_count = 0
         self._synchronize_handles()
         self.update_count = getattr(self, "update_count", 0) + 1
@@ -1108,7 +1206,33 @@ class _DistributedOptimizer:
                 self._handles[p][0][1], name=nm, process_set=self._ps)
             for nm, p in pending
         }
+        device_rows: dict[int, "torch.Tensor"] = {}
         for p, (h, ctx, wire_dtype) in list(self._handles.items()):
+            if isinstance(h, tuple) and h[0] == "device_future":
+                from . import device as dev
+
+                _, arr, off, numel = h
+                key = id(arr)
+                if key not in device_rows:
+                    # One fetch per bucket: the local row of the stacked
+                    # result (a zero-copy torch view of the jax buffer).
+                    device_rows[key] = dev._local_row(arr)
+                row = device_rows[key]
+                res = row[off:off + numel].reshape(tuple(p.shape))
+                res = self._compression.decompress(res, ctx)
+                # clone(): the row is a view of a jax-owned buffer; torch
+                # users mutate grads in place (clip_grad_norm_), which
+                # must not write through into an immutable jax array.
+                # Device-side copy — the MemcpyOutFusionBuffer cost the
+                # reference pays too; no host transfer.
+                res = res.clone().to(p.dtype)
+                if p.grad is None or p in self._densified or \
+                        p.grad.is_sparse:
+                    p.grad = res.to(device=p.device)
+                    self._densified.discard(p)
+                else:
+                    p.grad.data.copy_(res)
+                continue
             if isinstance(h, tuple) and h[0] == "sparse_future":
                 gi, gv = h[1].result()
                 vals = torch.from_numpy(

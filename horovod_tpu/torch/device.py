@@ -122,3 +122,193 @@ def grouped_allreduce(tensors: Sequence[Any], average: bool | None = None,
         [to_jax(t) for t in tensors], average=average, op=op,
         process_set=process_set)
     return [from_jax(o) for o in outs]
+
+
+def grouped_allgather(tensors: Sequence[Any], process_set=None) -> list:
+    outs = _ops.grouped_allgather(
+        [to_jax(t) for t in tensors], process_set=process_set)
+    return [from_jax(o) for o in outs]
+
+
+def grouped_reducescatter(tensors: Sequence[Any], op: str | None = None,
+                          process_set=None) -> list:
+    outs = _ops.grouped_reducescatter(
+        [to_jax(t) for t in tensors], op=op, process_set=process_set)
+    return [from_jax(o) for o in outs]
+
+
+# -- async flavors -----------------------------------------------------------
+# The eager compiled ops DISPATCH asynchronously (jax's execution model);
+# their sync flavors block for reference parity. The async flavors return
+# the un-fetched result as a handle instead — the reference's
+# allreduce_async_/synchronize contract on the device plane.
+
+
+class DeviceHandle:
+    """In-flight device-plane collective: holds the dispatched (not yet
+    fetched) result. ``synchronize()``/``wait()`` materializes the torch
+    view; ``poll()`` reports readiness without blocking."""
+
+    def __init__(self, out):
+        self._out = out
+        self._result = None
+
+    def poll(self) -> bool:
+        if self._result is not None:
+            return True
+        try:
+            return all(
+                getattr(s.data, "is_ready", lambda: True)()
+                for s in self._out.addressable_shards)
+        except Exception:  # pragma: no cover — conservatively not ready
+            return False
+
+    def synchronize(self) -> "torch.Tensor":
+        if self._result is None:
+            jax.block_until_ready(self._out)
+            self._result = from_jax(self._out)
+        return self._result
+
+    wait = synchronize
+
+
+def _run_async(op_fn, tensor, *args, **kwargs):
+    return DeviceHandle(op_fn(to_jax(tensor), *args, **kwargs))
+
+
+def allreduce_async(tensor, average: bool | None = None,
+                    op: str | None = None, prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0,
+                    process_set=None) -> DeviceHandle:
+    return _run_async(_ops.allreduce, tensor, average=average, op=op,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set)
+
+
+def allgather_async(tensor, process_set=None) -> DeviceHandle:
+    return _run_async(_ops.allgather, tensor, process_set=process_set)
+
+
+def broadcast_async(tensor, root_rank: int, process_set=None) -> DeviceHandle:
+    return _run_async(_ops.broadcast, tensor, root_rank,
+                      process_set=process_set)
+
+
+def alltoall_async(tensor, process_set=None) -> DeviceHandle:
+    return _run_async(_ops.alltoall, tensor, process_set=process_set)
+
+
+def reducescatter_async(tensor, op: str | None = None,
+                        process_set=None) -> DeviceHandle:
+    return _run_async(_ops.reducescatter, tensor, op=op,
+                      process_set=process_set)
+
+
+def synchronize(handle: DeviceHandle) -> "torch.Tensor":
+    return handle.synchronize()
+
+
+def poll(handle: DeviceHandle) -> bool:
+    return handle.poll()
+
+
+# -- per-process stacking (the optimizer's multi-controller bridge) ----------
+
+
+def _device_world_ok() -> bool:
+    """True when the device plane can carry PER-PROCESS tensors: the jax
+    surface is initialized and runs one device per process (device rank
+    == process id — the standard TPU deployment shape), so a local
+    tensor is exactly one row of the stacked-rank convention."""
+    import os
+
+    from .. import basics
+
+    if not basics.is_initialized():
+        return False
+    nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+    if nprocs <= 1:
+        return jax.process_count() == 1
+    return basics.size() == nprocs and len(jax.local_devices()) == 1
+
+
+def _stack_global(x: jax.Array, ps=None) -> jax.Array:
+    """This process's tensor -> the global stacked-rank array (row = my
+    process id) with NO host transfer: the local buffer becomes the
+    local shard of a process-spanning array."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops.collective_ops import _resolve_process_set
+
+    ps = _resolve_process_set(ps)
+    n = ps.size()
+    if jax.process_count() == 1:
+        if n != 1:
+            raise ValueError(
+                "per-process device-plane exchange needs one process per "
+                f"device rank; this single process owns a {n}-rank world "
+                "— use the stacked-rank API directly")
+        return x[None]
+    sharding = NamedSharding(ps.mesh, P(ps.axis_name))
+    return jax.make_array_from_single_device_arrays(
+        (n,) + x.shape, sharding, [x[None]])
+
+
+def _local_row(out: jax.Array) -> "torch.Tensor":
+    """This process's row of a stacked-rank result, as a zero-copy torch
+    view (allreduce rows are identical; allgather rows each hold the
+    concat; broadcast rows hold the root's value — the local row IS the
+    per-process result in every case)."""
+    shards = out.addressable_shards
+    if len(shards) == 1:
+        return torch.utils.dlpack.from_dlpack(shards[0].data)[0]
+    return from_jax(out)[0]
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast ``root_rank``'s parameter values into every process's
+    parameters ON the device plane (reference:
+    ``hvd.broadcast_parameters`` riding NCCL, fused). ``params`` is an
+    iterable of ``(name, tensor)`` or a ``state_dict``. Single-process
+    worlds no-op. The iteration order must be rank-identical (it is,
+    for a model's state_dict). Parameters pack into fusion-threshold
+    buckets — ONE compiled broadcast per bucket, not one dispatch per
+    tensor."""
+    import jax.numpy as jnp
+
+    from ..ops.fusion import bucket_leaves
+
+    if hasattr(params, "items"):
+        params = list(params.items())
+    else:
+        params = list(params)
+    if jax.process_count() <= 1 and _world_size_env() <= 1:
+        return
+    if not _device_world_ok():
+        raise ValueError(
+            "device-plane broadcast_parameters needs the jax mesh world "
+            "with one device per process; use "
+            "horovod_tpu.torch.broadcast_parameters (host plane) here")
+    tensors = [(n, p) for n, p in params
+               if p is not None and torch.is_tensor(p)]
+    wires = [to_jax(p.detach().contiguous()).ravel() for _, p in tensors]
+    for bucket in bucket_leaves(wires, None):
+        flat = (wires[bucket[0]] if len(bucket) == 1
+                else jnp.concatenate([wires[i] for i in bucket]))
+        out = _ops.broadcast(_stack_global(flat), root_rank)
+        row = _local_row(out)
+        offset = 0
+        with torch.no_grad():
+            for i in bucket:
+                _, p = tensors[i]
+                numel = int(wires[i].size)
+                p.copy_(row[offset:offset + numel].reshape(p.shape)
+                        .to(p.dtype))
+                offset += numel
+
+
+def _world_size_env() -> int:
+    import os
+
+    return int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)

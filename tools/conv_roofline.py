@@ -76,7 +76,7 @@ def conv(x, w, stride, k):
 def fetch_rtt(probe) -> float:
     float(np.asarray(probe))
     samples = []
-    for i in range(3):
+    for i in range(5):
         p = probe * 0 + float(i)
         t0 = time.perf_counter()
         assert float(np.asarray(p)) == float(i)
@@ -84,19 +84,47 @@ def fetch_rtt(probe) -> float:
     return statistics.median(samples)
 
 
-def time_op(fn, arg, iters=None) -> float:
-    out = fn(arg)
-    probe = jax.tree.leaves(out)[0].ravel()[0].astype(jnp.float32)
+# Large enough that the in-graph window (REPEAT x op) dwarfs the tunnel
+# RTT's run-to-run variance — at 16 the subtraction went negative on
+# sub-ms ops and the table read nonsense.
+REPEAT = 100
+
+
+def make_repeated(fn):
+    """Run ``fn`` REPEAT times inside ONE jit program.
+
+    Python-dispatched per-op loops measure the host dispatch floor
+    (~0.3-0.5 ms/call through the tunnel), not the op: summed per-op
+    forward times read 26 ms where the fused in-model forward runs
+    9.4 ms. ``optimization_barrier`` ties each iteration's input to the
+    loop carry so XLA can neither hoist the loop-invariant op nor CSE
+    the iterations; the carry consumes one scalar of each output so
+    nothing is dead."""
+    def run(a):
+        def body(carry, _):
+            ab, c = jax.lax.optimization_barrier((a, carry))
+            out = fn(ab)
+            leaf = jax.tree.leaves(out)[0]
+            c2 = c + leaf.ravel()[0].astype(jnp.float32) * 1e-30
+            return c2, None
+        c, _ = jax.lax.scan(
+            body, jnp.zeros((), jnp.float32), None, length=REPEAT)
+        return c
+    return jax.jit(run)
+
+
+def time_op(fn, arg) -> float:
+    rep = make_repeated(fn)
+    probe = rep(arg)
     float(np.asarray(probe))  # compile + drain
     rtt = fetch_rtt(probe)
     reps = []
     for _ in range(3):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(arg)
-        p = jax.tree.leaves(out)[0].ravel()[0].astype(jnp.float32) * 0 + 7.0
-        assert float(np.asarray(p)) == 7.0
-        reps.append(max(time.perf_counter() - t0 - rtt, 1e-9) / iters)
+        out = rep(arg)
+        float(np.asarray(out))
+        reps.append(
+            max(time.perf_counter() - t0 - rtt, 1e-9) / REPEAT)
     return statistics.median(reps)
 
 
@@ -134,10 +162,9 @@ def main() -> None:
         dx_t = jax.jit(lambda gy: vjp_x(gy)[0])
         dw_t = jax.jit(lambda gy: vjp_w(gy)[0])
 
-        iters = max(10, min(60, int(3e3 / max(gflop, 1))))
-        t_f = time_op(fwd, x, iters)
-        t_dx = time_op(dx_t, dy, iters)
-        t_dw = time_op(dw_t, dy, iters)
+        t_f = time_op(fwd, x)
+        t_dx = time_op(dx_t, dy)
+        t_dw = time_op(dw_t, dy)
 
         bound = gflop * 1e9 / peak * 1e3  # ms at peak
         row = (H, k, s, cin, cout, count, gflop, t_f, t_dx, t_dw, bound)
