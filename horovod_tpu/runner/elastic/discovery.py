@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import subprocess
 import threading
+import time
 from typing import Callable, Sequence
 
 from ..hosts import HostInfo
@@ -115,31 +116,67 @@ class HostManager:
         self,
         discovery: HostDiscovery,
         valid_sizes: Callable[[int], bool] | None = None,
+        cooldown_s: float | None = None,
     ):
+        from ...utils.env import get_float
+
         self._discovery = discovery
         self._lock = threading.Lock()
         self._current: dict[str, int] = {}
-        self._blacklist: set[str] = set()
+        # host -> blacklist timestamp. With a cooldown
+        # (HOROVOD_BLACKLIST_COOLDOWN seconds, reference:
+        # cooldown_range in horovod/runner/elastic/discovery.py) entries
+        # EXPIRE — the recovery path for whole-generation failures
+        # (preempted slice, host reboot) where the same hosts come back;
+        # 0 keeps the permanent blacklist.
+        self._blacklist: dict[str, float] = {}
+        self._cooldown_s = (
+            get_float("HOROVOD_BLACKLIST_COOLDOWN", 0.0)
+            if cooldown_s is None else cooldown_s)
+        self._expired_pending = False  # expiry happened since last poll
         self._valid = valid_sizes or (lambda n: n >= 1)
 
     def update_available_hosts(self) -> bool:
         """Poll discovery; returns True if the usable host set changed."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
-            before = self._usable_locked()
+            # 'before' is the PRE-PRUNE view — the world the caller last
+            # acted on. A cooldown expiry must read as a change whether
+            # it happens during this poll or was absorbed by an earlier
+            # lazy-pruning read (_expired_pending records those): an
+            # expired host that never reads as a change would never
+            # trigger the reconfiguration that re-admits it.
+            before = {h: s for h, s in self._current.items()
+                      if h not in self._blacklist}
             self._current = found
             after = self._usable_locked()
-            return before != after
+            changed = before != after or self._expired_pending
+            self._expired_pending = False
+            return changed
 
     def blacklist(self, hostname: str) -> None:
         with self._lock:
-            self._blacklist.add(hostname)
+            # monotonic: a wall-clock step (NTP after VM resume — this
+            # code's exact environment) must not stretch or collapse the
+            # cooldown window.
+            self._blacklist[hostname] = time.monotonic()
 
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
+            self._prune_blacklist_locked()
             return hostname in self._blacklist
 
+    def _prune_blacklist_locked(self) -> None:
+        if self._cooldown_s <= 0:
+            return
+        now = time.monotonic()
+        for h in [h for h, t in self._blacklist.items()
+                  if now - t >= self._cooldown_s]:
+            del self._blacklist[h]
+            self._expired_pending = True
+
     def _usable_locked(self) -> dict[str, int]:
+        self._prune_blacklist_locked()
         return {
             h: s for h, s in self._current.items() if h not in self._blacklist
         }

@@ -128,8 +128,24 @@ class ElasticDriver:
         kv_addr = driver_addr([a.hostname for a in assignments])
         coord_addr = coordinator_addr([a.hostname for a in assignments])
         for a in assignments:
-            if a.hostname in self._workers:
-                continue
+            w = self._workers.get(a.hostname)
+            if w is not None and w.popen.poll() is None:
+                continue  # alive: keep it
+            if w is not None:
+                if w.popen.returncode == 0:
+                    # Completed job racing a reconfiguration: leave the
+                    # corpse for the monitor, which surfaces rc=0 as job
+                    # completion — relaunching would silently restart a
+                    # finished job.
+                    continue
+                # Failed/removed but not yet reaped (a whole GENERATION
+                # crashing lands here: the first reap triggers
+                # reconfiguration while peers' corpses still occupy the
+                # table) — sweep it so the host gets its new-generation
+                # worker now instead of after another monitor round. A
+                # re-crash gets reaped (and blacklisted) by the monitor
+                # normally.
+                del self._workers[a.hostname]
             env = build_worker_env(
                 a,
                 base_env=dict(os.environ),

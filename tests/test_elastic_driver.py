@@ -47,6 +47,27 @@ class TestHostManager:
         world = m.pick_world(["a", "b"], max_np=None)
         assert [h.hostname for h in world] == ["b"]
 
+    def test_blacklist_cooldown_expiry_reports_change(self):
+        """A cooldown expiry IS a usable-host-set change: the poll must
+        return True so the driver reconfigures and re-admits the host —
+        even with identical discovery output."""
+        m = HostManager(
+            FixedHostDiscovery([HostInfo("a", 1), HostInfo("b", 1)]),
+            cooldown_s=0.2,
+        )
+        m.update_available_hosts()
+        m.blacklist("a")
+        assert m.is_blacklisted("a")
+        # (Blacklist ADDITIONS are acted on directly by the driver's
+        # failure path — the poll need not re-report them.)
+        m.update_available_hosts()
+        assert [h.hostname for h in m.usable_hosts()] == ["b"]
+        assert m.update_available_hosts() is False  # steady state
+        time.sleep(0.25)
+        assert not m.is_blacklisted("a")            # cooldown expired
+        assert m.update_available_hosts() is True   # a came BACK
+        assert [h.hostname for h in m.usable_hosts()] == ["a", "b"]
+
     def test_pick_world_stability_and_cap(self):
         m = HostManager(
             FixedHostDiscovery(
@@ -285,6 +306,158 @@ class TestTorchElasticE2E:
         # The survivor ran some epochs in a 2-process world, then alone.
         assert any("np=2" in l for l in lines), lines
         assert any("host=127.0.0.1 epoch=5 np=1" in l for l in lines), lines
+
+
+class TestGenerationRelaunchE2E:
+    """VERDICT r4 #5 — the documented multi-host recovery path, driven
+    by the REAL ElasticDriver: generation N (every worker) crashes at
+    once; the blacklist cooldown returns the hosts; the driver publishes
+    a new world version and relaunches generation N+1 as FRESH processes
+    that re-init and resume from the last committed (on-disk) state.
+    Loss continuity is asserted against an exact in-test replication of
+    the averaged-SGD schedule — the resumed generation's losses must be
+    the ones an uninterrupted run would have produced."""
+
+    @pytest.mark.slow
+    def test_generation_crash_relaunch_resumes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN", "1.0")
+        worker = tmp_path / "gen_worker.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            sys.path.insert(0, {REPO_ROOT!r})
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 1)
+            import numpy as np
+            import torch
+            import horovod_tpu.torch as hvd
+            from horovod_tpu.elastic import run as elastic_run
+            from horovod_tpu.torch.elastic import TorchState
+
+            host = os.environ["HOROVOD_HOSTNAME"]
+            gen = os.environ.get("HOROVOD_WORLD_VERSION", "?")
+            tmp = os.environ["TEST_TMP"]
+            ckpt = tmp + "/ckpt.pt"
+
+            torch.manual_seed(0)
+            model = torch.nn.Linear(4, 1, bias=False)
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.05),
+                named_parameters=model.named_parameters())
+            start_epoch = 0
+            if os.path.exists(ckpt):
+                saved = torch.load(ckpt)
+                model.load_state_dict(saved["model"])
+                start_epoch = saved["epoch"]
+                print("gen=%s host=%s restored epoch=%d" % (
+                    gen, host, start_epoch), flush=True)
+            state = TorchState(model=model, optimizer=opt,
+                               epoch=start_epoch)
+
+            @elastic_run
+            def train(state):
+                while state.epoch < 5:
+                    marker = tmp + "/died_" + host
+                    if state.epoch == 2 and not os.path.exists(marker):
+                        open(marker, "w").close()
+                        print("gen=%s worker %s dying at epoch 2" % (
+                            gen, host), flush=True)
+                        os._exit(1)
+                    r = hvd.rank()
+                    x = torch.from_numpy(np.random.RandomState(
+                        100 * state.epoch + r).randn(8, 4)
+                        .astype(np.float32))
+                    opt.zero_grad()
+                    loss = (model(x) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    print("gen=%s rank=%d epoch=%d np=%d loss=%.6f" % (
+                        gen, r, state.epoch, hvd.size(), float(loss)),
+                        flush=True)
+                    state.epoch += 1
+                    state.commit()
+                    if r == 0:
+                        torch.save({{"model": model.state_dict(),
+                                     "epoch": state.epoch}}, ckpt + ".tmp")
+                        os.replace(ckpt + ".tmp", ckpt)
+                return state.epoch
+
+            done = train(state)
+            print("gen=%s host=%s finished at epoch %d" % (
+                gen, host, done), flush=True)
+        """))
+        script, _ = _write_discovery(tmp_path, LOCAL_ALIASES)
+        settings = Settings(
+            num_proc=2,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=True,
+            elastic=True,
+            min_np=2,          # the NEW generation must be full-size
+            max_np=2,
+            discovery_script=script,
+            elastic_timeout=60.0,
+            env={"TEST_TMP": str(tmp_path)},
+        )
+        lines: list[str] = []
+        rc = run_elastic(settings, sink=lines.append)
+        text = "\n".join(str(x) for x in lines)
+        assert rc == 0, text
+        # Both workers of generation N died together.
+        assert text.count("dying at epoch 2") == 2, text
+        # A LATER generation restored the committed state and finished.
+        assert "restored epoch=2" in text, text
+        assert "finished at epoch 5" in text, text
+        gens = {int(m.split("=")[1].split()[0])
+                for m in text.splitlines() if m.find("gen=") != -1
+                for m in [m[m.find("gen="):]]}
+        assert len(gens) >= 2, gens  # the world version advanced
+
+        # Loss continuity: replicate the exact 2-rank averaged-SGD
+        # schedule; the relaunched generation's per-rank losses at
+        # epochs 2-4 must match what an uninterrupted run produces.
+        import re
+
+        import numpy as np
+        import torch
+
+        torch.manual_seed(0)
+        m = torch.nn.Linear(4, 1, bias=False)
+        sgd = torch.optim.SGD(m.parameters(), lr=0.05)
+        expected = {}
+        for e in range(5):
+            grads = []
+            for r in range(2):
+                x = torch.from_numpy(np.random.RandomState(
+                    100 * e + r).randn(8, 4).astype(np.float32))
+                sgd.zero_grad()
+                loss = (m(x) ** 2).mean()
+                expected[(e, r)] = float(loss.detach())
+                loss.backward()
+                grads.append([p.grad.clone() for p in m.parameters()])
+            with torch.no_grad():
+                for p, g0, g1 in zip(m.parameters(), *grads):
+                    p.grad = (g0 + g1) / 2
+            sgd.step()
+        seen = {}
+        for line in text.splitlines():
+            match = re.search(
+                r"gen=(\d+) rank=(\d+) epoch=(\d+) np=2 "
+                r"loss=([0-9.]+)", line)
+            if match:
+                g, r, e, l = (int(match.group(1)), int(match.group(2)),
+                              int(match.group(3)), float(match.group(4)))
+                seen[(e, r)] = (g, l)
+        for e in range(5):
+            for r in range(2):
+                assert (e, r) in seen, (e, r, sorted(seen))
+                _, got = seen[(e, r)]
+                assert abs(got - expected[(e, r)]) < 1e-4, (
+                    e, r, got, expected[(e, r)])
+        # Epochs 2-4 ran in the relaunched generation.
+        assert all(seen[(e, r)][0] > seen[(0, 0)][0]
+                   for e in (2, 3, 4) for r in (0, 1)), seen
 
 
 class TestTensorFlowElasticE2E:
