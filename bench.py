@@ -509,6 +509,29 @@ def main() -> int:
         if forced is not None:
             emit.update(vs_baseline_machinery=round(raw[0] / forced[0], 4))
 
+    # --- section 5: int8 (EQuARX-style) wire, machinery-forced — the
+    # quantize -> exchange -> dequant round trip demonstrably executes
+    # even on one chip; the ratio shows what the int8 wire costs relative
+    # to the raw step (on multi-chip meshes it buys halved ICI bytes).
+    def run_int8():
+        os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
+        try:
+            int8_opt = hvd.DistributedOptimizer(
+                optax.sgd(0.1, momentum=0.9),
+                compression=hvd.Compression.int8,
+            )
+            step = _build_step(model, int8_opt, mesh, axis, loss_fn)
+            return _time_steps(step, fresh_state(int8_opt), batch, **timing)
+        finally:
+            del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
+
+    if raw is not None and not out_of_time():
+        int8 = _with_retry("resnet_int8", run_int8, errors,
+                           allow_retry=single_controller)
+        if int8 is not None:
+            emit.update(
+                vs_baseline_machinery_int8=round(raw[0] / int8[0], 4))
+
     if errors:
         emit.record["errors"] = errors
     emit.update(bench_wall_time_s=round(time.perf_counter() - t_start, 1))

@@ -1,0 +1,157 @@
+"""Int8 quantized allreduce for the gradient wire (EQuARX-style).
+
+Reference context: the reference ships fp16 wire compression
+(``horovod/torch/compression.py``); SURVEY §3.6 flags int8 as the
+TPU-idiomatic next step (PAPERS.md: EQuARX — blockwise-quantized
+all-to-all allreduce inside XLA). A naive int8 AllReduce cannot work —
+summing N int8 contributions overflows the wire dtype — so the exchange
+changes shape, exactly as in EQuARX:
+
+1. blockwise quantize my gradient shard (per-block f32 scale, stochastic
+   rounding) to int8;
+2. ``all_to_all`` the int8 chunks + scales (each device receives every
+   rank's contribution for ITS chunk — no summation on the wire);
+3. dequantize and sum in f32 locally (op=Average divides by N);
+4. requantize the reduced chunk, ``all_gather`` int8 + scales;
+5. dequantize to the original dtype.
+
+Wire bytes per element: ~2 (one int8 all_to_all + one int8 all_gather)
+vs ~4 for a bf16 ring allreduce — half the ICI traffic, at a bounded
+quantization cost (per-block scales; the round-trip is tolerance-tested
+in ``tests/test_optimizer.py``).
+
+Stochastic rounding is SELF-SEEDED: the rounding offset derives from a
+hash of each value's own bits (a step counter does not exist inside the
+optimizer's trace), so it varies with the data each step and is unbiased
+in expectation for values not exactly on a grid point; see ``_sround``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+BLOCK = 1024  # elements per quantization scale (EQuARX blockwise scales)
+
+
+def _sround(x):
+    """Stochastically round ``x`` (f32) to int8 in [-127, 127].
+
+    The uniform offset comes from a multiplicative hash of the value's
+    own mantissa bits — deterministic per (value, step) but decorrelated
+    from the rounding residual, so E[round(x)] tracks x without needing
+    a PRNG key threaded through the optimizer trace."""
+    bits = lax.bitcast_convert_type(x, jnp.uint32)
+    h = bits * np.uint32(2654435761)
+    h = h ^ (h >> 16)
+    u = (h >> 8).astype(jnp.float32) * np.float32(2.0**-24)
+    return jnp.clip(jnp.floor(x + u), -127, 127).astype(jnp.int8)
+
+
+def _quantize_blocks(flat_f32):
+    """[m] f32 -> (int8 [m], scales f32 [m/BLOCK]); m % BLOCK == 0."""
+    rows = flat_f32.reshape(-1, BLOCK)
+    scale = jnp.max(jnp.abs(rows), axis=1) / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = _sround(rows / safe[:, None])
+    return q.reshape(-1), scale
+
+
+def int8_allreduce_flat(flat, axis_name: str, world_size: int,
+                        op: str = "average", prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0):
+    """Quantized allreduce of a flat tensor inside a shard_map trace.
+
+    ``world_size`` must be the axis size as a Python int (shapes depend
+    on it). Returns f32 with ``flat``'s shape; the caller casts back.
+    """
+    n = int(world_size)
+    m = int(flat.size)
+    x = flat.astype(jnp.float32)
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    if n <= 1:
+        # Single member: quantize-dequantize round trip only (the
+        # machinery-forced bench measures exactly this cost).
+        pad = (-m) % BLOCK
+        xp = jnp.pad(x, (0, pad))
+        q, scale = _quantize_blocks(xp)
+        out = (q.reshape(-1, BLOCK).astype(jnp.float32)
+               * scale[:, None]).reshape(-1)[:m]
+        return out * postscale_factor
+    # Pad so each rank's chunk is whole blocks.
+    chunk_elems = -(-m // (n * BLOCK)) * BLOCK
+    xp = jnp.pad(x, (0, n * chunk_elems - m))
+    q, scale = _quantize_blocks(xp)
+    rows_per_chunk = chunk_elems // BLOCK
+    q = q.reshape(n, rows_per_chunk, BLOCK)
+    scale = scale.reshape(n, rows_per_chunk)
+    # No summation on the wire: chunk j (int8 + scales) goes to rank j.
+    recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True).reshape(n, rows_per_chunk, BLOCK)
+    recv_scale = lax.all_to_all(
+        scale[:, :, None], axis_name, split_axis=0, concat_axis=0,
+        tiled=True).reshape(n, rows_per_chunk)
+    # Dequantize + reduce in f32 locally.
+    total = jnp.sum(recv.astype(jnp.float32)
+                    * recv_scale[:, :, None], axis=0)
+    if op == "average":
+        total = total / n
+    # Requantize MY reduced chunk, share it with everyone.
+    q2, scale2 = _quantize_blocks(total.reshape(-1))
+    gathered = lax.all_gather(
+        q2.reshape(rows_per_chunk, BLOCK), axis_name)      # [n, r, B]
+    gathered_scale = lax.all_gather(scale2, axis_name)     # [n, r]
+    out = (gathered.astype(jnp.float32)
+           * gathered_scale[:, :, None]).reshape(-1)[:m]
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
+
+
+def int8_fused_allreduce(
+    tensors,
+    axis_name: str,
+    world_size: int,
+    op: str = "average",
+    threshold_bytes: int | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+):
+    """Bucketed int8 allreduce of a tensor list (the fusion-buffer role:
+    same buckets as :func:`ops.fusion.fused_allreduce`, each bucket one
+    quantized exchange). Non-float leaves ride an uncompressed allreduce
+    — quantizing integer tensors would corrupt them."""
+    from .collective_ops import _allreduce_traced
+    from .fusion import bucket_leaves
+
+    tensors = [jnp.asarray(t) for t in tensors]
+    out: list = [None] * len(tensors)
+    float_idx = [i for i, t in enumerate(tensors)
+                 if jnp.issubdtype(t.dtype, jnp.floating)]
+    for i, t in enumerate(tensors):
+        if i not in float_idx:
+            out[i] = _allreduce_traced(
+                t, op, axis_name, prescale_factor, postscale_factor)
+    # Bucket the POST-CAST f32 view: the exchange is f32-sized whatever
+    # the leaf dtype was, and bucketing pre-cast would split buckets at
+    # every bf16/f32 boundary in a mixed-precision gradient list.
+    floats = [tensors[i].ravel().astype(jnp.float32) for i in float_idx]
+    for bucket in bucket_leaves(floats, threshold_bytes):
+        flats = [floats[j] for j in bucket]
+        packed = flats[0] if len(bucket) == 1 else jnp.concatenate(flats)
+        reduced = int8_allreduce_flat(
+            packed, axis_name, world_size, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        offset = 0
+        for j in bucket:
+            i = float_idx[j]
+            size = int(tensors[i].size)
+            out[i] = (reduced[offset:offset + size]
+                      .reshape(tensors[i].shape).astype(tensors[i].dtype))
+            offset += size
+    return out

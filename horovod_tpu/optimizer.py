@@ -75,6 +75,33 @@ def _reduce_grads(
             return grads
         return jax.tree.map(lambda g: g * jnp.asarray(scale, g.dtype), grads)
 
+    if getattr(compression, "marker", None) == "int8":
+        # Int8 changes the exchange, not just the wire dtype (summing
+        # int8 on the wire overflows): quantized all_to_all +
+        # dequant-sum + requant + all_gather, bucketed like the fused
+        # path. Needs the axis size as a static int for chunk shapes.
+        from .ops.quantization import int8_fused_allreduce
+
+        if op not in (collective_ops.Average, collective_ops.Sum):
+            raise ValueError(
+                f"Compression.int8 supports op=Average/Sum, got {op!r}")
+        if world_size is None:
+            raise ValueError(
+                "Compression.int8 needs a known process-set size at "
+                "trace time (init() first)")
+        leaves, treedef = jax.tree.flatten(grads)
+        if num_groups and num_groups > 0:
+            # Same num_groups contract as the cast path: cap buckets at
+            # total/num_groups bytes (sized on the f32 exchange view).
+            total = sum(int(jnp.asarray(g).size) * 4 for g in leaves)
+            threshold_bytes = max(1, total // num_groups)
+        reduced = int8_fused_allreduce(
+            leaves, axis_name, world_size, op=op,
+            threshold_bytes=threshold_bytes,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor)
+        return jax.tree.unflatten(treedef, reduced)
+
     leaves, treedef = jax.tree.flatten(grads)
     compressed = [compression.compress(g) for g in leaves]
     wire = [c[0] for c in compressed]

@@ -68,6 +68,79 @@ def test_distributed_optimizer_fp16_compression(hvd):
     )
 
 
+def test_distributed_optimizer_int8_compression(hvd):
+    """VERDICT r4 #7 — Compression.int8 (EQuARX-style): the exchange
+    becomes quantize -> all_to_all -> dequant-sum -> requant ->
+    all_gather. Tolerance bound: two blockwise-int8 round trips, each
+    |err| <= block_absmax/127 per element (first trip's errors also
+    average over ranks) — assert within 2*absmax/127."""
+    params = {"w": jnp.zeros((2000,)), "b": jnp.zeros((7,))}
+    rng = np.random.RandomState(4)
+    gw = rng.randn(8, 2000).astype(np.float32)
+    gb = rng.randn(8, 7).astype(np.float32)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(1.0), compression=hvd.Compression.int8
+    )
+    updates = _traced_update(hvd, opt, {"w": gw, "b": gb}, params)
+    assert updates["w"].dtype == jnp.float32
+    for got, g in ((updates["w"], gw), (updates["b"], gb)):
+        tol = 2.0 * np.abs(g).max() / 127.0
+        np.testing.assert_allclose(
+            np.asarray(got), -g.mean(0), atol=tol)
+
+
+def test_int8_training_loss_matches_uncompressed(hvd):
+    """Documented loss-match bound: 30 SGD steps on a quadratic, int8
+    wire vs none — final losses agree within 5% and both converge."""
+    mesh = hvd.global_mesh()
+    target = jnp.asarray(np.random.RandomState(5).randn(256).astype(
+        np.float32))
+
+    def loss_fn(p, x):
+        return jnp.sum((p["w"] * jnp.mean(x) - target) ** 2)
+
+    def run(compression):
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.3), compression=compression)
+        p = {"w": jnp.zeros((256,))}
+        state = opt.init(p)
+
+        def step(p, state, x):
+            l, g = jax.value_and_grad(loss_fn)(p, x)
+            updates, state = opt.update(g, state, p)
+            return optax.apply_updates(p, updates), state, l
+
+        f = jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(), P("hvd")),
+            out_specs=(P(), P(), P()), check_vma=False))
+        x = jnp.ones((8, 2), jnp.float32)
+        for _ in range(30):
+            p, state, l = f(p, state, x)
+        return float(jax.device_get(l).ravel()[0])
+
+    l0 = float(np.sum(np.asarray(target) ** 2))  # loss at w=0
+    base = run(hvd.Compression.none)
+    quant = run(hvd.Compression.int8)
+    assert base < 1e-3 * l0, (base, l0)   # converged >99.9%
+    assert quant < 1e-2 * l0, (quant, l0)  # converged under quantization
+    # Documented bound: the quantized run lands within 1% of the
+    # uncompressed final loss, relative to the initial loss.
+    assert abs(quant - base) <= 1e-2 * l0, (base, quant, l0)
+
+
+def test_int8_compressor_rejects_plain_wire_use(hvd):
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="int8"):
+        hvd.Compression.int8.compress(jnp.ones(4))
+    with _pytest.raises(ValueError, match="Average/Sum"):
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(1.0), compression=hvd.Compression.int8,
+            op=hvd.Adasum)
+        _traced_update(hvd, opt, {"w": np.ones((8, 4), np.float32)},
+                       {"w": jnp.zeros((4,))})
+
+
 def test_backward_passes_per_step_accumulates(hvd):
     """k=2: first microstep produces zero updates; second applies the
     allreduced mean of the accumulated grads."""

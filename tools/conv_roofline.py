@@ -119,18 +119,26 @@ def make_repeated(fn):
 
 
 def time_op(fn, arg) -> float:
-    rep = make_repeated(fn)
-    probe = rep(arg)
-    float(np.asarray(probe))  # compile + drain
-    rtt = fetch_rtt(probe)
-    reps = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = rep(arg)
-        float(np.asarray(out))
-        reps.append(
-            max(time.perf_counter() - t0 - rtt, 1e-9) / REPEAT)
-    return statistics.median(reps)
+    last = None
+    for attempt in range(3):  # transient tunnel/remote-compile retries
+        try:
+            rep = make_repeated(fn)
+            probe = rep(arg)
+            float(np.asarray(probe))  # compile + drain
+            rtt = fetch_rtt(probe)
+            reps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = rep(arg)
+                float(np.asarray(out))
+                reps.append(
+                    max(time.perf_counter() - t0 - rtt, 1e-9) / REPEAT)
+            return statistics.median(reps)
+        except Exception as exc:  # noqa: BLE001
+            last = exc
+            if attempt < 2:
+                time.sleep(2.0 * (attempt + 1))
+    raise last
 
 
 def main() -> None:
