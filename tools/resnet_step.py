@@ -1,0 +1,57 @@
+"""Shared ResNet-50 SPMD train-step builder for the profiling tools
+(step_op_profile captures the trace; step_attribution joins it with the
+HLO — both must profile the SAME program)."""
+
+from __future__ import annotations
+
+TRACE_STEPS = 3  # iterations captured inside the profiler trace
+
+
+def build_step():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models.lenet import cross_entropy_loss
+    from horovod_tpu.models.resnet import ResNet50
+
+    hvd.init()
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    B = 128
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 224, 224, 3)), train=True)
+    params, stats = variables["params"], variables["batch_stats"]
+    opt = optax.sgd(0.1, momentum=0.9)
+    mesh, axis = hvd.global_mesh(), hvd.global_axis_name()
+
+    def spmd_step(params, stats, opt_state, batch):
+        xb, yb = batch
+
+        def loss_of(p):
+            out, upd = model.apply(
+                {"params": p, "batch_stats": stats}, xb, train=True,
+                mutable=["batch_stats"])
+            return cross_entropy_loss(out, yb, num_classes=1000), upd
+
+        (loss, upd), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        updates, new_opt = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates),
+                upd["batch_stats"], new_opt, loss)
+
+    step = jax.jit(jax.shard_map(
+        spmd_step, mesh=mesh,
+        in_specs=(P(), P(), P(), P(axis)),
+        out_specs=(P(), P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1, 2))
+    rng = np.random.RandomState(0)
+    batch = hvd.data_parallel.shard_batch((
+        rng.rand(B, 224, 224, 3).astype(np.float32),
+        rng.randint(0, 1000, size=(B,)).astype(np.int32)))
+    p_ = hvd.data_parallel.replicate(params)
+    s_ = hvd.data_parallel.replicate(stats)
+    o_ = hvd.data_parallel.replicate(opt.init(params))
+    return step, (p_, s_, o_, batch)
