@@ -154,8 +154,12 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     q = q_ref[0]       # [block_q, D]
     k_tile = k_ref[0]  # [block_k, D]
     v_tile = v_ref[0]
+    # Matmuls take the STORED dtype (bf16 in production) with f32 MXU
+    # accumulation — upcasting bf16 operands to f32 first adds no
+    # precision (they were already rounded) and runs the MXU at 1/4
+    # rate; this one change moved BERT-Large flash fwd+bwd ~2x.
     s = jax.lax.dot_general(
-        q.astype(jnp.float32), k_tile.astype(jnp.float32),
+        q, k_tile,
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale  # [block_q, block_k]
@@ -169,8 +173,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
     if causal:
         p = jnp.where(mask, p, 0.0)
     l_scr[:, 0] = l_scr[:, 0] * corr + p.sum(axis=-1)
+    # P rounds to the value dtype for the MXU pass (the standard flash
+    # trade: probabilities in bf16, accumulation in f32).
     acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-        p, v_tile.astype(jnp.float32),
+        p.astype(v_tile.dtype), v_tile,
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -209,10 +215,12 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    q = q_ref[0].astype(jnp.float32)
-    k_tile = k_ref[0].astype(jnp.float32)
-    v_tile = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # Stored-dtype (bf16) matmul operands with f32 MXU accumulation —
+    # see the forward kernel's note; f32 upcasts quartered throughput.
+    q = q_ref[0]
+    k_tile = k_ref[0]
+    v_tile = v_ref[0]
+    do = do_ref[0]
     # lse/delta blocks are full rows [1, Sq] (TPU tiling); slice our q tile.
     lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
     delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
@@ -232,7 +240,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     )
     ds = p * (dp - delta[:, None] + glse[:, None])
     dq_scr[:] = dq_scr[:] + scale * jax.lax.dot_general(
-        ds, k_tile, (((1,), (0,)), ((), ())),
+        ds.astype(k_tile.dtype), k_tile, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -259,10 +267,12 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q = q_ref[0].astype(jnp.float32)
-    k_tile = k_ref[0].astype(jnp.float32)
-    v_tile = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # Stored-dtype (bf16) matmul operands with f32 MXU accumulation —
+    # see the forward kernel's note; f32 upcasts quartered throughput.
+    q = q_ref[0]
+    k_tile = k_ref[0]
+    v_tile = v_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
     delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
     glse = glse_ref[0, 0, pl.ds(i * block_q, block_q)]
@@ -275,9 +285,9 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = _causal_mask(i, kj, block_q, block_k, q_offset, k_offset)
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
-    # dV_j += P^T @ dO
+    # dV_j += P^T @ dO (P rounds to the stored dtype for the MXU pass)
     dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     dp = jax.lax.dot_general(
@@ -287,7 +297,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     ds = p * (dp - delta[:, None] + glse[:, None])
     # dK_j += scale * dS^T @ Q
     dk_scr[:] = dk_scr[:] + scale * jax.lax.dot_general(
-        ds, q, (((0,), (0,)), ((), ())),
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
 
@@ -295,6 +305,52 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _write():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                             delta_ref, glse_ref, dq_ref, dk_ref, dv_ref,
+                             *, causal: bool, scale: float, block_q: int,
+                             block_k: int, q_offset: int, k_offset: int):
+    """Fused single-tile backward: when the whole sequence is ONE
+    (block_q, block_k) tile (the BERT-Large S=512 shape), the separate
+    dQ and dK/dV passes each recompute the identical s → p → dp → ds
+    chain. This kernel computes the chain once and emits all three
+    grads — roughly a third of the backward softmax/VPU work saved.
+    Grid (BH,) only; the callers route here iff nq == nk == 1."""
+    q = q_ref[0]
+    k_tile = k_ref[0]
+    v_tile = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, 0, :]
+    delta = delta_ref[0, 0, :]
+    glse = glse_ref[0, 0, :]
+
+    s = jax.lax.dot_general(
+        q, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [block_q, block_k]
+    if causal:
+        mask = _causal_mask(0, 0, block_q, block_k, q_offset, k_offset)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse[:, None])
+    pw = p.astype(do.dtype)
+    dv_ref[0] = jax.lax.dot_general(
+        pw, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = (p * (dp - delta[:, None] + glse[:, None])).astype(q.dtype)
+    dq_ref[0] = (scale * jax.lax.dot_general(
+        ds, k_tile, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )).astype(dq_ref.dtype)
+    dk_ref[0] = (scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )).astype(dk_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -352,6 +408,41 @@ def _flash_bwd(causal, block_q, block_k, q_offset, k_offset, interpret,
     # cheap elementwise reduce, XLA fuses it.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)[:, None, :]  # [BH, 1, Sq]
+
+    if Sq == block_q and Sk == block_k:
+        # Single-tile sequences (BERT-Large S=512 with auto-block):
+        # one fused kernel computes dq, dk, dv — the two-pass split
+        # below exists only to bound VMEM for many-tile sequences.
+        specs = [
+            pl.BlockSpec((1, block_q, D), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda bh: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, Sq), lambda bh: (bh, 0, 0)),
+        ]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_dqkv_fused_kernel, causal=causal, scale=scale,
+                block_q=block_q, block_k=block_k, q_offset=q_offset,
+                k_offset=k_offset,
+            ),
+            grid=(BH,),
+            in_specs=specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
+                jax.ShapeDtypeStruct((BH, Sk, D), kr.dtype),
+                jax.ShapeDtypeStruct((BH, Sk, D), vr.dtype),
+            ],
+            interpret=interpret,
+        )(qr, kr, vr, do, lse, delta, g_lse)
+        return dq, dk, dv
 
     q_specs = [
         pl.BlockSpec((1, block_q, D), lambda bh, i, j: (bh, i, 0)),
@@ -436,10 +527,18 @@ def _flash_with_lse_bwd(causal, block_q, block_k, q_offset, k_offset,
 _flash_with_lse.defvjp(_flash_with_lse_fwd, _flash_with_lse_bwd)
 
 
-def _prepare_flash(q, k, causal, block_q, block_k, q_offset, k_offset):
+def _prepare_flash(q, k, v, causal, block_q, block_k, q_offset, k_offset):
     """Shared validation + block selection for the flash entry points —
     one implementation so the guards cannot drift between them."""
     Sq, Sk = q.shape[2], k.shape[2]
+    if not (q.dtype == k.dtype == v.dtype):
+        # The kernels run stored-dtype matmuls (f32 MXU accumulation);
+        # dot_general needs uniform operand dtypes — fail with guidance
+        # instead of a low-level kernel error.
+        raise ValueError(
+            f"flash attention operands must share a dtype; got "
+            f"q={q.dtype}, k={k.dtype}, v={v.dtype} — cast them to one "
+            "dtype")
     block_q = block_q if block_q is not None else _auto_block(Sq)
     block_k = block_k if block_k is not None else _auto_block(Sk)
     if Sq % block_q or Sk % block_k:
@@ -483,7 +582,7 @@ def flash_attention(q, k, v, causal: bool = False, block_q: int | None = None,
     """
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q, block_k = _prepare_flash(q, k, causal, block_q, block_k,
+    block_q, block_k = _prepare_flash(q, k, v, causal, block_q, block_k,
                                       q_offset, k_offset)
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
@@ -512,7 +611,7 @@ def flash_attention_lse(q, k, v, causal: bool = False,
     makes logsumexp-merged schemes like ring-flash train exactly."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
-    block_q, block_k = _prepare_flash(q, k, causal, block_q, block_k,
+    block_q, block_k = _prepare_flash(q, k, v, causal, block_q, block_k,
                                       q_offset, k_offset)
     out, lse = _flash_with_lse(
         q.reshape(B * H, Sq, D), k.reshape(B * H, Sk, D),

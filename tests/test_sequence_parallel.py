@@ -114,6 +114,34 @@ class TestFlashKernel:
             np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                        rtol=2e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_backward_two_pass_path_matches_reference(self, causal):
+        """Explicit sub-sequence blocks force the TWO-PASS backward (dq +
+        dkv kernels) — the default auto-block now routes every
+        single-tile sequence to the fused kernel, which would otherwise
+        leave the multi-tile path untested."""
+        q, k, v = make_qkv(B=1, H=2, S=256, D=64)
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, causal=causal, block_q=128,
+                                  block_k=128, interpret=True)
+            return jnp.sum(out * out)
+
+        def loss_ref(q, k, v):
+            out = blockwise_attention_reference(q, k, v, causal=causal)
+            return jnp.sum(out * out)
+
+        got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_mixed_dtype_operands_rejected(self):
+        q, k, v = make_qkv(B=1, H=1, S=128, D=32)
+        with pytest.raises(ValueError, match="share a dtype"):
+            flash_attention(q.astype(jnp.bfloat16), k, v, interpret=True)
+
     def test_backward_fully_masked_rows_zero_grad(self):
         # Rows whose keys are all in the future must get zero output AND
         # zero gradient (LSE sentinel path), not NaN.
