@@ -307,6 +307,39 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _flash_fwd_single_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                             causal: bool, scale: float, block_q: int,
+                             block_k: int, q_offset: int, k_offset: int):
+    """Single-tile forward: when the sequence is ONE (block_q, block_k)
+    tile there is nothing to run online-softmax OVER — the running-max
+    rescale machinery (scratch init/rw, correction exp, accumulator
+    rescale) is pure overhead. Direct softmax, same outputs/sentinels
+    as the general kernel. Grid (BH,)."""
+    q = q_ref[0]
+    k_tile = k_ref[0]
+    v_tile = v_ref[0]
+    s = jax.lax.dot_general(
+        q, k_tile, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        mask = _causal_mask(0, 0, block_q, block_k, q_offset, k_offset)
+        s = jnp.where(mask, s, NEG_INF)
+    m = s.max(axis=-1)
+    p = jnp.exp(s - m[:, None])
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    l = p.sum(axis=-1)
+    empty = l == 0.0
+    safe_l = jnp.where(empty, 1.0, l)
+    acc = jax.lax.dot_general(
+        p.astype(v_tile.dtype), v_tile, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = jnp.where(empty, LSE_MASKED, m + jnp.log(safe_l))
+
+
 def _flash_dqkv_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
                              delta_ref, glse_ref, dq_ref, dk_ref, dv_ref,
                              *, causal: bool, scale: float, block_q: int,
@@ -363,6 +396,30 @@ def _fwd_call(qr, kr, vr, causal, block_q, block_k, q_offset, k_offset,
     BH, Sq, D = qr.shape
     Sk = kr.shape[1]
     scale = 1.0 / (D ** 0.5)
+    if Sq == block_q and Sk == block_k:
+        # Single-tile sequences skip the online-softmax machinery.
+        return pl.pallas_call(
+            functools.partial(
+                _flash_fwd_single_kernel, causal=causal, scale=scale,
+                block_q=block_q, block_k=block_k,
+                q_offset=q_offset, k_offset=k_offset,
+            ),
+            grid=(BH,),
+            in_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, block_k, D), lambda bh: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, D), lambda bh: (bh, 0, 0)),
+                pl.BlockSpec((1, 1, Sq), lambda bh: (bh, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Sq, D), qr.dtype),
+                jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qr, kr, vr)
     kernel = functools.partial(
         _flash_fwd_kernel, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k,
