@@ -233,12 +233,16 @@ class AutotuneStep:
                             path)
 
     def _abort(self) -> None:
-        """A window (or the finish exchange) raised: pin the best sample
-        so far — or the first candidate if none completed — and stop
-        tuning. A half-tuned process must never crash later training
-        calls; the exception itself still propagates to the caller."""
-        decision = (min(self._samples, key=lambda s: s[1])[0]
-                    if self._samples else self._cands[0])
+        """A window (or the finish exchange) raised: pin the FIRST
+        candidate and stop tuning. Not best-so-far: an abort may hit a
+        single rank (a local exception), so any sample-derived choice
+        could differ across ranks — and the threshold changes the traced
+        program, so divergent pins deadlock the next collective. The
+        first candidate is rank-identical by construction and needs no
+        agreement exchange (which could itself hang mid-exception). A
+        half-tuned process must never crash later training calls; the
+        exception itself still propagates to the caller."""
+        decision = self._cands[0]
         set_tuned_threshold(int(decision))
         self._fn.clear_cache()
         for co in self._co_steps:
@@ -249,8 +253,9 @@ class AutotuneStep:
         self._co_steps.clear()
         self._hvd_tuning = False
         get_logger().warning(
-            "autotune: aborted mid-warmup; pinned fusion_threshold=%d "
-            "from %d completed sample(s)", decision, len(self._samples))
+            "autotune: aborted mid-warmup after %d sample(s); pinned the "
+            "rank-identical first candidate fusion_threshold=%d",
+            len(self._samples), decision)
 
     def __call__(self, *args, **kwargs):
         if not self._hvd_tuning:
@@ -360,6 +365,15 @@ def tune_step_fusion(
         # round trip) landing inside one candidate's window would bias
         # the threshold choice.
         timed = getattr(step, "_hvd_unwatched", step)
+        if hasattr(timed, "_hvd_tuning"):
+            # A live transparent tuner (HOROVOD_AUTOTUNE=1) wraps the
+            # jit: left armed, its window starts would re-pin its own
+            # candidates OVER each measure() threshold (every sample
+            # meaningless) and it would later override the explicit
+            # decision. The user's explicit call wins — disarm it and
+            # time the bare jit.
+            timed._hvd_tuning = False
+            timed = timed._fn
 
         def measure(threshold: int) -> float:  # noqa: F811
             set_tuned_threshold(threshold)

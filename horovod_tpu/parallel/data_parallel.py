@@ -82,8 +82,18 @@ class _StallWatchedStep:
         self._calls += 1
         return self._calls
 
+    @staticmethod
+    def _tuning_live() -> bool:
+        """True while ANY transparent autotune warmup window is live in
+        this process — not just one wrapping our own callable: a co-step
+        (built mid-warmup, returned unwrapped) must also defer its drain
+        or it biases the first tuner's samples."""
+        from ..autotune import _active_tuner
+
+        return bool(_active_tuner and _active_tuner[0]._hvd_tuning)
+
     def __call__(self, *args, **kwargs):
-        if self._every > 0 and not getattr(self._fn, "_hvd_tuning", False):
+        if self._every > 0 and not self._tuning_live():
             cross = self._cross_rank_available()
             n = self._step_number(cross)
             if n % self._every == 0:

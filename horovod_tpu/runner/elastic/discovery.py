@@ -173,7 +173,12 @@ class HostManager:
         for h in [h for h, t in self._blacklist.items()
                   if now - t >= self._cooldown_s]:
             del self._blacklist[h]
-            self._expired_pending = True
+            # Only a host discovery STILL lists is a usable-set change;
+            # flagging a departed host's expiry would trigger a no-op
+            # whole-world reconfiguration (new epoch, re-formed world)
+            # that re-admits nothing.
+            if h in self._current:
+                self._expired_pending = True
 
     def _usable_locked(self) -> dict[str, int]:
         self._prune_blacklist_locked()

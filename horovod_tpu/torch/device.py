@@ -221,13 +221,12 @@ def _device_world_ok() -> bool:
     surface is initialized and runs one device per process (device rank
     == process id — the standard TPU deployment shape), so a local
     tensor is exactly one row of the stacked-rank convention."""
-    import os
-
     from .. import basics
+    from ..process_world import size as _psize
 
     if not basics.is_initialized():
         return False
-    nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+    nprocs = _psize()
     if nprocs <= 1:
         return jax.process_count() == 1
     return basics.size() == nprocs and len(jax.local_devices()) == 1
@@ -283,7 +282,9 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
         params = list(params.items())
     else:
         params = list(params)
-    if jax.process_count() <= 1 and _world_size_env() <= 1:
+    from ..process_world import size as _psize
+
+    if jax.process_count() <= 1 and _psize() <= 1:
         return
     if not _device_world_ok():
         raise ValueError(
@@ -307,8 +308,3 @@ def broadcast_parameters(params, root_rank: int = 0) -> None:
                         .to(p.dtype))
                 offset += numel
 
-
-def _world_size_env() -> int:
-    import os
-
-    return int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)

@@ -274,6 +274,28 @@ class TestTransparentAutotune:
         assert large_best == DEFAULT_THRESHOLDS[-1]
         assert small_best != large_best
 
+    def test_explicit_tuning_disarms_transparent_tuner(self, monkeypatch):
+        """Round-5 review regression: tune_step_fusion on a factory step
+        with HOROVOD_AUTOTUNE=1 must DISARM the live transparent tuner —
+        armed, its window starts re-pin its own candidates over every
+        measure() threshold (all samples meaningless) and it later
+        overrides the explicit decision."""
+        import horovod_tpu as hvd
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+        hvd.init()
+        step, (p, s, b) = self._make_step(hvd)
+        tuner = step._fn
+        assert tuner._hvd_tuning
+        best = hvd.autotune.tune_step_fusion(
+            step, (p, s, b), thresholds=(1111, 2222), iters=1)
+        assert best in (1111, 2222)
+        assert hvd.autotune.tuned_threshold() == best
+        assert not tuner._hvd_tuning  # disarmed: cannot re-pin later
+        for _ in range(10):
+            p, s, _loss = step(p, s, b)
+        assert hvd.autotune.tuned_threshold() == best
+
     def test_hvdrun_autotune_reaches_compiled_path(self, tmp_path):
         """hvdrun --autotune: the flag lands as HOROVOD_AUTOTUNE=1 in the
         workers and the compiled-path tuner pins the SAME decision on
