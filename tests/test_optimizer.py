@@ -128,6 +128,35 @@ def test_int8_training_loss_matches_uncompressed(hvd):
     assert abs(quant - base) <= 1e-2 * l0, (base, quant, l0)
 
 
+def test_int8_hierarchical_mesh(hvd):
+    """Compression.int8 inside a step shard_mapped over the hierarchical
+    (cross, local) mesh: lax.all_to_all/all_gather accept the tuple axis
+    and the quantized mean still lands within the blockwise bound."""
+    from horovod_tpu.parallel.hierarchical import (
+        HIERARCHICAL_AXES, hierarchical_mesh,
+    )
+
+    mesh = hierarchical_mesh(cross_size=2)
+    params = {"w": jnp.zeros((600,))}
+    gw = np.random.RandomState(7).randn(8, 600).astype(np.float32)
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(1.0), compression=hvd.Compression.int8)
+
+    def step(g):
+        g = jax.tree.map(lambda a: a[0], g)
+        state = opt.init(params)
+        updates, _ = opt.update(g, state, params)
+        return updates
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=P(HIERARCHICAL_AXES), out_specs=P(),
+        check_vma=False))
+    updates = f({"w": gw})
+    tol = 2.0 * np.abs(gw).max() / 127.0
+    np.testing.assert_allclose(
+        np.asarray(updates["w"]), -gw.mean(0), atol=tol)
+
+
 def test_int8_compressor_rejects_plain_wire_use(hvd):
     import pytest as _pytest
 
