@@ -30,10 +30,28 @@ as long as the headline ResNet row was measured.
 
 from __future__ import annotations
 
+import contextlib
 import json
+import os
 import statistics
 import sys
 import time
+
+
+@contextlib.contextmanager
+def _forced_wire():
+    """Machinery-forced section scope: disable the n=1 short-circuit so
+    compression/bucketing/collective actually execute, restoring any
+    user-set value of the flag afterwards."""
+    prev = os.environ.get("HOROVOD_FORCE_WIRE_MACHINERY")
+    os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
+    try:
+        yield
+    finally:
+        if prev is None:
+            del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
+        else:
+            os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = prev
 
 
 # Substrings identifying transient infra errors (remote-compile tunnel
@@ -95,10 +113,16 @@ class _Emitter:
         print(json.dumps(self.record), flush=True)
 
 
-def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None):
+def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
+                overlap_spec=None):
     """sync_grads: None when `optimizer` already syncs (DistributedOptimizer);
     for the raw baseline it is the hand-written pmean a correct hand-rolled
-    DP step must do, so both sides do equivalent communication work."""
+    DP step must do, so both sides do equivalent communication work.
+
+    overlap_spec: a ReduceSpec (``hvd.reduce_spec_of``) switches the step
+    to the overlap scheduler's wire — gradients reduce per segment INSIDE
+    the backward pass — and ``optimizer`` must then be the BARE inner
+    optimizer (the spec's wire already did the reduction)."""
     import jax
     import optax
     from jax.sharding import PartitionSpec as P
@@ -107,6 +131,13 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None):
         x, y = batch
 
         def loss_of(p):
+            if overlap_spec is not None:
+                from horovod_tpu.parallel.data_parallel import (
+                    overlap_gradient_sync,
+                )
+
+                p = overlap_gradient_sync(
+                    p, overlap_spec, axis_name=axis_name)
             logits, updated = model.apply(
                 {"params": p, "batch_stats": batch_stats},
                 x,
@@ -342,8 +373,6 @@ def bench_bert(hvd, timing):
 
 
 def main() -> int:
-    import os
-
     import jax
 
     # Persistent compilation cache: the four large programs here dominate
@@ -366,6 +395,12 @@ def main() -> int:
     import horovod_tpu as hvd
     from horovod_tpu.models.lenet import cross_entropy_loss  # reuse CE
     from horovod_tpu.models.resnet import ResNet50
+
+    # --smoke: the pre-merge gate (tools/premerge.sh) — 2 timed steps per
+    # section on whatever backend is present, BERT and int8 rows skipped,
+    # so the full machinery (dist step, raw baseline, forced wire, overlap
+    # scheduler) compiles and runs in minutes on CPU.
+    smoke = "--smoke" in sys.argv[1:]
 
     t_start = time.perf_counter()
     emit = _Emitter()
@@ -429,6 +464,8 @@ def main() -> int:
         if on_tpu
         else dict(warmup=2, iters=5, repeats=2)
     )
+    if smoke:
+        timing = dict(warmup=1, iters=2, repeats=1)
 
     peak = _chip_peak_flops(jax.devices()[0]) if on_tpu else None
 
@@ -487,7 +524,7 @@ def main() -> int:
     # machinery-forced variant: under a tight budget the BERT MFU row is
     # worth more than the second efficiency ratio.
     bert = None
-    if not out_of_time():
+    if not smoke and not out_of_time():
         bert = _with_retry("bert", lambda: bench_bert(hvd, timing), errors,
                            allow_retry=single_controller)
         if bert is not None:
@@ -496,12 +533,9 @@ def main() -> int:
     # --- section 4: machinery-forced efficiency — disable the n=1
     # short-circuit so compression/bucketing/collective actually execute.
     def run_forced():
-        os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
-        try:
+        with _forced_wire():
             step = _build_step(model, dist_opt, mesh, axis, loss_fn)
             return _time_steps(step, fresh_state(dist_opt), batch, **timing)
-        finally:
-            del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
 
     if raw is not None and not out_of_time():
         forced = _with_retry("resnet_forced", run_forced, errors,
@@ -509,23 +543,48 @@ def main() -> int:
         if forced is not None:
             emit.update(vs_baseline_machinery=round(raw[0] / forced[0], 4))
 
+    # --- section 4b: overlap scheduler, machinery-forced — the segmented
+    # bucket scheduler issues each parameter segment's allreduce INSIDE
+    # the backward pass (identity-forward / reduce-backward boundaries),
+    # so ICI transfers pipeline against backward compute instead of
+    # serializing after it. Compare vs_baseline_machinery_overlap with
+    # vs_baseline_machinery: same wire, monolithic post-backward block.
+    def run_overlap():
+        with _forced_wire():
+            from horovod_tpu import reduce_spec_of
+            from horovod_tpu.ops.fusion import overlap_segments
+
+            spec = reduce_spec_of(dist_opt)
+            step = _build_step(model, spec.inner, mesh, axis, loss_fn,
+                               overlap_spec=spec)
+            timed = _time_steps(step, fresh_state(dist_opt), batch,
+                                **timing)
+            return timed, overlap_segments()
+
+    if raw is not None and not out_of_time():
+        overlap = _with_retry("resnet_overlap", run_overlap, errors,
+                              allow_retry=single_controller)
+        if overlap is not None:
+            (t_overlap, _), segments = overlap
+            emit.update(
+                vs_baseline_machinery_overlap=round(raw[0] / t_overlap, 4),
+                overlap_segments=segments,
+            )
+
     # --- section 5: int8 (EQuARX-style) wire, machinery-forced — the
     # quantize -> exchange -> dequant round trip demonstrably executes
     # even on one chip; the ratio shows what the int8 wire costs relative
     # to the raw step (on multi-chip meshes it buys halved ICI bytes).
     def run_int8():
-        os.environ["HOROVOD_FORCE_WIRE_MACHINERY"] = "1"
-        try:
+        with _forced_wire():
             int8_opt = hvd.DistributedOptimizer(
                 optax.sgd(0.1, momentum=0.9),
                 compression=hvd.Compression.int8,
             )
             step = _build_step(model, int8_opt, mesh, axis, loss_fn)
             return _time_steps(step, fresh_state(int8_opt), batch, **timing)
-        finally:
-            del os.environ["HOROVOD_FORCE_WIRE_MACHINERY"]
 
-    if raw is not None and not out_of_time():
+    if raw is not None and not smoke and not out_of_time():
         int8 = _with_retry("resnet_int8", run_int8, errors,
                            allow_retry=single_controller)
         if int8 is not None:

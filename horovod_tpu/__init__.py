@@ -13,6 +13,10 @@ Layer map (vs SURVEY.md §1): the user API here is L5; collectives compile
 to XLA HLOs over the device mesh (replacing L2b/L1's NCCL/MPI data plane).
 """
 
+from . import _jax_compat
+
+_jax_compat.install()
+
 from .version import __version__  # noqa: F401
 
 from .basics import (  # noqa: F401
@@ -73,7 +77,12 @@ from .process_sets import (  # noqa: F401
     remove_process_set,
 )
 from .compression import Compression  # noqa: F401
-from .optimizer import DistributedOptimizer, grad  # noqa: F401
+from .optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    ReduceSpec,
+    grad,
+    reduce_spec_of,
+)
 from .functions import (  # noqa: F401
     allgather_object,
     broadcast_object,
@@ -89,6 +98,10 @@ from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import data_parallel  # noqa: F401
+from .parallel.data_parallel import (  # noqa: F401
+    make_overlapped_train_step,
+    overlap_gradient_sync,
+)
 from .stall import fetch  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401
 from .timeline import start_timeline, stop_timeline  # noqa: F401

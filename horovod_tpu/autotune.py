@@ -82,7 +82,29 @@ class BayesianTuner:
 # bucketing inside the user's jitted step, so the tuner re-traces the SAME
 # step per candidate threshold, times a few steps, and pins the winner.
 
-_tuned: dict = {"threshold": None, "history": []}
+_tuned: dict = {"threshold": None, "segments": None, "aborted": False,
+                "history": []}
+
+
+def warmup_aborted() -> bool:
+    """True after a mid-warmup abort in THIS process (see
+    ``AutotuneStep._abort``): peers may have pinned a different
+    (broadcast) decision, so every factory-built step here refuses to
+    run — not just the tuner's own wrapper. Co-built steps and steps
+    built after the abort pass through ``maybe_autotune_step`` bare, so
+    the gate lives in the factory wrapper (``_StallWatchedStep``)."""
+    return _tuned["aborted"]
+
+
+def _poison_error():
+    from .exceptions import HorovodInternalError
+
+    return HorovodInternalError(
+        "autotune warmup aborted on this rank; peers may have pinned a "
+        "different (broadcast) decision, so this process's traced "
+        "collective sequences can no longer be trusted to match theirs "
+        "— treat the original mid-warmup exception as fatal and restart "
+        "the job")
 
 
 def tuned_threshold() -> int | None:
@@ -97,6 +119,19 @@ def set_tuned_threshold(threshold_bytes: int | None) -> None:
         None if threshold_bytes is None else int(threshold_bytes))
 
 
+def tuned_segments() -> int | None:
+    """The pinned overlap-scheduler segment count (None = untuned)."""
+    return _tuned["segments"]
+
+
+def set_tuned_segments(num_segments: int | None) -> None:
+    """Pin (or clear, with None) the overlap scheduler's segment count K.
+    Wins over ``HOROVOD_OVERLAP_SEGMENTS`` in
+    ``ops.fusion.overlap_segments``."""
+    _tuned["segments"] = (
+        None if num_segments is None else int(num_segments))
+
+
 def autotune_state() -> dict:
     """Introspection (parity: the native ``hvdrt_autotune_state``): the
     live threshold, whether a tuned decision is pinned, and the measured
@@ -106,12 +141,20 @@ def autotune_state() -> dict:
     return {
         "active": _tuned["threshold"] is not None,
         "fusion_threshold": fusion_threshold_bytes(),
+        "overlap_segments": _tuned["segments"],
         "samples": len(_tuned["history"]),
         "history": list(_tuned["history"]),
     }
 
 
 DEFAULT_THRESHOLDS = (256 * 1024, 4 * 1024 * 1024, 64 * 1024 * 1024)
+
+# Candidate segment counts K for the overlap scheduler's warmup grid.
+# Tuned JOINTLY with the fusion threshold (the per-segment bucket size and
+# the segment count trade against each other: more segments -> smaller
+# per-segment payloads -> a large threshold degenerates to one bucket per
+# segment anyway).
+DEFAULT_SEGMENT_CANDIDATES = (2, 4, 8)
 
 
 class AutotuneStep:
@@ -130,7 +173,10 @@ class AutotuneStep:
     the reference tunes during real training). After the last window the
     fastest candidate is pinned process-wide, the decision is logged
     (and appended to ``HOROVOD_AUTOTUNE_LOG`` as a JSON line), and the
-    wrapper becomes a passthrough.
+    wrapper becomes a passthrough. With ``segment_candidates`` (the
+    overlap scheduler's factory supplies them) the warmup grid is the
+    joint (fusion threshold, segment count K) product — the two knobs
+    trade against each other, so they are sampled and pinned together.
 
     Window timing ends in ONE value fetch of the smallest output leaf —
     ``block_until_ready`` can return early on tunneled backends; a value
@@ -142,11 +188,23 @@ class AutotuneStep:
     """
 
     def __init__(self, jitted, thresholds=None, iters: int = 3,
-                 clock=None):
+                 clock=None, segment_candidates=None):
         import time as _time
 
         self._fn = jitted
-        self._cands = list(thresholds or DEFAULT_THRESHOLDS)
+        self._tune_segments = segment_candidates is not None
+        if self._tune_segments:
+            # Joint (threshold, segments) grid: the overlap scheduler's
+            # ``segments`` axis. Both knobs change the traced program, so
+            # they pin together per window and broadcast together at finish.
+            self._cands = [
+                (int(t), int(s))
+                for s in segment_candidates
+                for t in (thresholds or DEFAULT_THRESHOLDS)
+            ]
+        else:
+            self._cands = list(thresholds or DEFAULT_THRESHOLDS)
+        self._poisoned = False
         self._iters = max(1, int(iters))
         self._win = 1 + self._iters  # 1 compile/settle call + timed calls
         self._calls = 0
@@ -168,29 +226,42 @@ class AutotuneStep:
         probe = min(leaves, key=lambda l: l.size)
         np.asarray(probe)  # value fetch: proves execution finished
 
+    def _pin(self, cand) -> None:
+        """Pin one candidate process-wide (threshold, or jointly
+        (threshold, segments) when the segments axis is tuned)."""
+        if self._tune_segments:
+            set_tuned_threshold(cand[0])
+            set_tuned_segments(cand[1])
+        else:
+            set_tuned_threshold(cand)
+
     def _finish(self) -> None:
         import json
         import os
 
         best = min(self._samples, key=lambda s: s[1])
-        decision = int(best[0])
+        decision = best[0]
+        if self._tune_segments:
+            decision = (int(decision[0]), int(decision[1]))
+        else:
+            decision = int(decision)
         from .process_world import rank as _prank
         from .process_world import size as _psize
 
         if _psize() > 1:
             from .process_world import broadcast_object_host
 
-            decision = int(broadcast_object_host(
-                decision, name="autotune/step-decision"))
+            decision = broadcast_object_host(
+                decision, name="autotune/step-decision")
         else:
             import jax
 
             if jax.process_count() > 1:
                 from .functions import broadcast_object
 
-                decision = int(broadcast_object(
-                    decision, name="autotune/step-decision"))
-        set_tuned_threshold(decision)
+                decision = broadcast_object(
+                    decision, name="autotune/step-decision")
+        self._pin(decision)
         _tuned["history"].extend(self._samples)
         if decision != self._cands[-1]:
             # The cache holds the LAST candidate's trace; only a
@@ -207,8 +278,10 @@ class AutotuneStep:
         self._hvd_tuning = False
         log = get_logger()
         log.info(
-            "autotune: pinned fusion_threshold=%d after %d warmup "
-            "windows %s", decision, len(self._samples),
+            "autotune: pinned %s=%s after %d warmup windows %s",
+            ("(fusion_threshold, overlap_segments)" if self._tune_segments
+             else "fusion_threshold"),
+            decision, len(self._samples),
             [(t, round(s, 5)) for t, s in self._samples])
         path = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
         # One writer only: the env propagates to every worker and the
@@ -224,7 +297,10 @@ class AutotuneStep:
             try:
                 with open(path, "a") as f:
                     f.write(json.dumps({
-                        "tunable": "fusion_threshold_bytes",
+                        "tunable": (
+                            "fusion_threshold_bytes+overlap_segments"
+                            if self._tune_segments
+                            else "fusion_threshold_bytes"),
                         "decision": decision,
                         "samples": self._samples,
                     }) + "\n")
@@ -234,16 +310,25 @@ class AutotuneStep:
 
     def _abort(self) -> None:
         """A window (or the finish exchange) raised: pin the FIRST
-        candidate and stop tuning. Not best-so-far: an abort may hit a
-        single rank (a local exception), so any sample-derived choice
-        could differ across ranks — and the threshold changes the traced
-        program, so divergent pins deadlock the next collective. The
-        first candidate is rank-identical by construction and needs no
-        agreement exchange (which could itself hang mid-exception). A
-        half-tuned process must never crash later training calls; the
-        exception itself still propagates to the caller."""
+        candidate, stop tuning, and POISON the wrapper. Not best-so-far:
+        an abort may hit a single rank (a local exception), so any
+        sample-derived choice could differ across ranks — and the
+        threshold changes the traced program, so divergent pins deadlock
+        the next collective. The first candidate is rank-identical by
+        construction and needs no agreement exchange (which could itself
+        hang mid-exception). The poison is PROCESS-WIDE
+        (:func:`warmup_aborted`): calls through this wrapper, through
+        co-built steps, and through factory steps built after the abort
+        all raise ``HorovodInternalError`` instead of training on —
+        surviving ranks keep sampling and later pin the broadcast
+        winner, so a rank that caught the exception and kept calling ANY
+        step would trace a DIFFERENT collective sequence and deadlock
+        the job silently (ADVICE r5). The original exception still
+        propagates to the caller."""
         decision = self._cands[0]
-        set_tuned_threshold(int(decision))
+        self._pin(decision)
+        self._poisoned = True
+        _tuned["aborted"] = True
         self._fn.clear_cache()
         for co in self._co_steps:
             try:
@@ -254,10 +339,12 @@ class AutotuneStep:
         self._hvd_tuning = False
         get_logger().warning(
             "autotune: aborted mid-warmup after %d sample(s); pinned the "
-            "rank-identical first candidate fusion_threshold=%d",
-            len(self._samples), decision)
+            "rank-identical first candidate %s and poisoned the tuned "
+            "step (further calls raise)", len(self._samples), decision)
 
     def __call__(self, *args, **kwargs):
+        if self._poisoned or warmup_aborted():
+            raise _poison_error()
         if not self._hvd_tuning:
             return self._fn(*args, **kwargs)
         idx, pos = divmod(self._calls, self._win)
@@ -267,7 +354,7 @@ class AutotuneStep:
                 # Window start: pin the candidate and force a re-trace.
                 # The call compiles + settles; timing starts after its
                 # fetch.
-                set_tuned_threshold(self._cands[idx])
+                self._pin(self._cands[idx])
                 self._fn.clear_cache()
                 out = self._fn(*args, **kwargs)
                 self._fetch_probe(out)
@@ -294,9 +381,13 @@ class AutotuneStep:
 _active_tuner: list = []  # at most one in-flight warmup tuner per process
 
 
-def maybe_autotune_step(jitted):
+def maybe_autotune_step(jitted, segment_candidates=None):
     """Wrap ``jitted`` in transparent warmup tuning when
     ``HOROVOD_AUTOTUNE=1`` (env or config) — the factory entry point.
+
+    ``segment_candidates`` (the overlap scheduler's factory passes
+    :data:`DEFAULT_SEGMENT_CANDIDATES`) switches the tuner to the joint
+    (threshold, segments) grid.
 
     At most ONE tuner is live per process: the threshold is
     process-global, so a second factory call before the first tuner
@@ -314,7 +405,7 @@ def maybe_autotune_step(jitted):
         # its cache when the winner lands and it re-traces tuned.
         _active_tuner[0]._co_steps.append(jitted)
         return jitted
-    tuner = AutotuneStep(jitted)
+    tuner = AutotuneStep(jitted, segment_candidates=segment_candidates)
     _active_tuner[:] = [tuner]
     return tuner
 

@@ -41,6 +41,55 @@ def fusion_threshold_bytes() -> int:
     return get_int("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024)
 
 
+def overlap_segments() -> int:
+    """Resolve the overlap scheduler's segment count K.
+
+    Precedence mirrors :func:`fusion_threshold_bytes`: a pinned autotune
+    decision (the transparent tuner's ``segments`` axis) wins over
+    ``HOROVOD_OVERLAP_SEGMENTS`` (default 4). K=1 degenerates to the
+    monolithic post-backward reduction.
+    """
+    from ..autotune import tuned_segments
+
+    tuned = tuned_segments()
+    if tuned is not None:
+        return max(1, tuned)
+    return max(1, get_int("HOROVOD_OVERLAP_SEGMENTS", 4))
+
+
+def segment_leaves(
+    leaves: Sequence[Any], num_segments: int
+) -> list[list[int]]:
+    """Split leaf indices into <= ``num_segments`` contiguous runs of
+    roughly equal bytes — the overlap scheduler's stable leaf→segment map.
+
+    The pytree flatten order is the model's layer order, so contiguous
+    runs are layer ranges; during backward the LAST run's gradients
+    materialize first, and its allreduce can overlap the earlier runs'
+    backward compute. Stability contract: the map depends only on the
+    leaves' shapes/dtypes/order (never on values or timing), so every
+    rank — and every retrace — derives the identical segmentation, which
+    the rank-identical collective sequence requires. Empty segments are
+    dropped (num_segments > len(leaves) just yields one leaf per run).
+    """
+    k = max(1, int(num_segments))
+    sizes = [int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+             for leaf in leaves]
+    total = sum(sizes)
+    if not sizes:
+        return []
+    if total <= 0 or k == 1:
+        return [list(range(len(sizes)))]
+    segments: list[list[int]] = [[] for _ in range(k)]
+    cum = 0
+    for i, nbytes in enumerate(sizes):
+        # Bucket by byte midpoint: monotone in i, so runs stay contiguous.
+        mid = cum + nbytes / 2.0
+        segments[min(k - 1, int(mid * k / total))].append(i)
+        cum += nbytes
+    return [s for s in segments if s]
+
+
 def bucket_leaves(
     leaves: Sequence[Any], threshold_bytes: int | None = None
 ) -> list[list[int]]:
@@ -85,9 +134,18 @@ def fused_allreduce(
     threshold_bytes: int | None = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    issue_reversed: bool = False,
 ) -> list[Any]:
-    """Allreduce a list of tensors with static bucketing (traced regime)."""
+    """Allreduce a list of tensors with static bucketing (traced regime).
+
+    ``issue_reversed`` emits the bucket collectives last-bucket-first —
+    the overlap scheduler's issue order: inside a backward pass the last
+    leaves' gradients materialize first, so reverse emission puts each
+    HLO next to the point its operands become ready (results are
+    identical either way; only the program order hint changes).
+    """
     tensors = [jnp.asarray(t) for t in tensors]
+    from ..profiler import annotate_collective
     from .collective_ops import Adasum
 
     if op == Adasum:
@@ -100,18 +158,23 @@ def fused_allreduce(
         ]
     buckets = bucket_leaves(tensors, threshold_bytes)
     out: list[Any] = [None] * len(tensors)
-    for bucket in buckets:
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
         if len(bucket) == 1:
             i = bucket[0]
-            out[i] = _reduce_bucket(
-                tensors[i], op, axis_name, prescale_factor, postscale_factor
-            )
+            with annotate_collective(f"allreduce.bucket{bi}"):
+                out[i] = _reduce_bucket(
+                    tensors[i], op, axis_name, prescale_factor,
+                    postscale_factor
+                )
             continue
         flats = [tensors[i].ravel() for i in bucket]
-        packed = jnp.concatenate(flats)
-        reduced = _reduce_bucket(
-            packed, op, axis_name, prescale_factor, postscale_factor
-        )
+        with annotate_collective(f"allreduce.bucket{bi}"):
+            packed = jnp.concatenate(flats)
+            reduced = _reduce_bucket(
+                packed, op, axis_name, prescale_factor, postscale_factor
+            )
         offset = 0
         for i in bucket:
             n = tensors[i].size

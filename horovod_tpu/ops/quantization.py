@@ -21,9 +21,14 @@ quantization cost (per-block scales; the round-trip is tolerance-tested
 in ``tests/test_optimizer.py``).
 
 Stochastic rounding is SELF-SEEDED: the rounding offset derives from a
-hash of each value's own bits (a step counter does not exist inside the
-optimizer's trace), so it varies with the data each step and is unbiased
-in expectation for values not exactly on a grid point; see ``_sround``.
+hash of each value's own bits, optionally salted with a caller-threaded
+step counter (``salt=``). Unsalted, the offset is deterministic per
+VALUE — a gradient element that repeats the same value across steps
+(constants, plateaued weights, zero-heavy layers) rounds the same
+direction every step, a persistent per-element bias; rounding is
+unbiased in expectation only over varying data. The
+``DistributedOptimizer`` threads its update counter as the salt so
+repeated values decorrelate across steps; see ``_sround``.
 """
 
 from __future__ import annotations
@@ -37,36 +42,46 @@ from jax import lax
 BLOCK = 1024  # elements per quantization scale (EQuARX blockwise scales)
 
 
-def _sround(x):
+def _sround(x, salt=None):
     """Stochastically round ``x`` (f32) to int8 in [-127, 127].
 
     The uniform offset comes from a multiplicative hash of the value's
-    own mantissa bits — deterministic per (value, step) but decorrelated
-    from the rounding residual, so E[round(x)] tracks x without needing
-    a PRNG key threaded through the optimizer trace."""
+    own mantissa bits, decorrelated from the rounding residual, so
+    E[round(x)] tracks x over varying data without a PRNG key threaded
+    through the optimizer trace. Unsalted the offset is deterministic
+    per VALUE: a value that repeats across steps rounds the same way
+    every time (a persistent bias for static data). ``salt`` — a
+    caller-threaded step counter (any integer scalar, traced or not) —
+    is folded into the hash so repeated values decorrelate across
+    steps; callers that can count steps should thread it."""
     bits = lax.bitcast_convert_type(x, jnp.uint32)
+    if salt is not None:
+        bits = bits ^ (jnp.asarray(salt).astype(jnp.uint32)
+                       * np.uint32(0x9E3779B9))
     h = bits * np.uint32(2654435761)
     h = h ^ (h >> 16)
     u = (h >> 8).astype(jnp.float32) * np.float32(2.0**-24)
     return jnp.clip(jnp.floor(x + u), -127, 127).astype(jnp.int8)
 
 
-def _quantize_blocks(flat_f32):
+def _quantize_blocks(flat_f32, salt=None):
     """[m] f32 -> (int8 [m], scales f32 [m/BLOCK]); m % BLOCK == 0."""
     rows = flat_f32.reshape(-1, BLOCK)
     scale = jnp.max(jnp.abs(rows), axis=1) / 127.0
     safe = jnp.where(scale == 0.0, 1.0, scale)
-    q = _sround(rows / safe[:, None])
+    q = _sround(rows / safe[:, None], salt)
     return q.reshape(-1), scale
 
 
 def int8_allreduce_flat(flat, axis_name: str, world_size: int,
                         op: str = "average", prescale_factor: float = 1.0,
-                        postscale_factor: float = 1.0):
+                        postscale_factor: float = 1.0, salt=None):
     """Quantized allreduce of a flat tensor inside a shard_map trace.
 
     ``world_size`` must be the axis size as a Python int (shapes depend
-    on it). Returns f32 with ``flat``'s shape; the caller casts back.
+    on it). ``salt`` is an optional caller-threaded step counter folded
+    into the stochastic-rounding hash (see :func:`_sround`). Returns f32
+    with ``flat``'s shape; the caller casts back.
     """
     n = int(world_size)
     m = int(flat.size)
@@ -78,14 +93,14 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
         # machinery-forced bench measures exactly this cost).
         pad = (-m) % BLOCK
         xp = jnp.pad(x, (0, pad))
-        q, scale = _quantize_blocks(xp)
+        q, scale = _quantize_blocks(xp, salt)
         out = (q.reshape(-1, BLOCK).astype(jnp.float32)
                * scale[:, None]).reshape(-1)[:m]
         return out * postscale_factor
     # Pad so each rank's chunk is whole blocks.
     chunk_elems = -(-m // (n * BLOCK)) * BLOCK
     xp = jnp.pad(x, (0, n * chunk_elems - m))
-    q, scale = _quantize_blocks(xp)
+    q, scale = _quantize_blocks(xp, salt)
     rows_per_chunk = chunk_elems // BLOCK
     q = q.reshape(n, rows_per_chunk, BLOCK)
     scale = scale.reshape(n, rows_per_chunk)
@@ -101,7 +116,7 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
     if op == "average":
         total = total / n
     # Requantize MY reduced chunk, share it with everyone.
-    q2, scale2 = _quantize_blocks(total.reshape(-1))
+    q2, scale2 = _quantize_blocks(total.reshape(-1), salt)
     gathered = lax.all_gather(
         q2.reshape(rows_per_chunk, BLOCK), axis_name)      # [n, r, B]
     gathered_scale = lax.all_gather(scale2, axis_name)     # [n, r]
@@ -120,13 +135,19 @@ def int8_fused_allreduce(
     threshold_bytes: int | None = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    salt=None,
+    issue_reversed: bool = False,
 ):
     """Bucketed int8 allreduce of a tensor list (the fusion-buffer role:
     same buckets as :func:`ops.fusion.fused_allreduce`, each bucket one
     quantized exchange). Non-float leaves ride an uncompressed allreduce
-    — quantizing integer tensors would corrupt them."""
+    — quantizing integer tensors would corrupt them. ``salt`` threads a
+    step counter into the stochastic rounding; ``issue_reversed`` emits
+    buckets last-first (the overlap scheduler's issue order — gradients
+    materialize in reverse layer order during backward)."""
     from .collective_ops import _allreduce_traced
     from .fusion import bucket_leaves
+    from ..profiler import annotate_collective
 
     tensors = [jnp.asarray(t) for t in tensors]
     out: list = [None] * len(tensors)
@@ -140,13 +161,17 @@ def int8_fused_allreduce(
     # the leaf dtype was, and bucketing pre-cast would split buckets at
     # every bf16/f32 boundary in a mixed-precision gradient list.
     floats = [tensors[i].ravel().astype(jnp.float32) for i in float_idx]
-    for bucket in bucket_leaves(floats, threshold_bytes):
+    buckets = bucket_leaves(floats, threshold_bytes)
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
         flats = [floats[j] for j in bucket]
         packed = flats[0] if len(bucket) == 1 else jnp.concatenate(flats)
-        reduced = int8_allreduce_flat(
-            packed, axis_name, world_size, op=op,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+        with annotate_collective(f"int8_allreduce.bucket{bi}"):
+            reduced = int8_allreduce_flat(
+                packed, axis_name, world_size, op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, salt=salt)
         offset = 0
         for j in bucket:
             i = float_idx[j]

@@ -49,8 +49,15 @@ def _reduce_grads(
     threshold_bytes,
     num_groups,
     world_size=None,
+    quant_salt=None,
+    issue_reversed=False,
 ):
     """Compress -> fused allreduce -> decompress over a gradient pytree.
+
+    ``quant_salt`` threads a step counter into the int8 path's stochastic
+    rounding (see ``ops.quantization._sround``); ``issue_reversed`` emits
+    bucket collectives last-first (the overlap scheduler's issue order —
+    results are identical, only HLO program order changes).
 
     When the process set is known (at trace time) to have exactly one
     member, the wire machinery — compression casts, bucket concat/split,
@@ -99,7 +106,8 @@ def _reduce_grads(
             leaves, axis_name, world_size, op=op,
             threshold_bytes=threshold_bytes,
             prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor)
+            postscale_factor=postscale_factor,
+            salt=quant_salt, issue_reversed=issue_reversed)
         return jax.tree.unflatten(treedef, reduced)
 
     leaves, treedef = jax.tree.flatten(grads)
@@ -118,6 +126,7 @@ def _reduce_grads(
         threshold_bytes=threshold_bytes,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
+        issue_reversed=issue_reversed,
     )
     restored = [
         compression.decompress(r, ctx) for r, ctx in zip(reduced, ctxs)
@@ -144,7 +153,42 @@ def _known_size(ps) -> int | None:
 class _AccumulationState(NamedTuple):
     inner_state: Any
     acc_grads: Any
-    counter: jnp.ndarray  # int32 scalar
+    counter: jnp.ndarray  # int32 scalar, monotonic (microstep count)
+
+
+class _SaltState(NamedTuple):
+    """int8 wrapper state: the inner optimizer state plus the update
+    counter threaded into stochastic rounding as the salt, so repeated
+    gradient values decorrelate across steps (ADVICE r5)."""
+
+    inner_state: Any
+    counter: jnp.ndarray  # uint32 scalar, increments per update
+
+
+class ReduceSpec(NamedTuple):
+    """The reduction configuration a DistributedOptimizer was built with,
+    attached to its ``update`` function so schedulers that must perform
+    the reduction THEMSELVES — the overlap scheduler issues it inside the
+    backward pass, per parameter segment — can reuse the exact same wire
+    (op, compression, scaling, bucketing) and the bare inner optimizer
+    for the update. Read it with :func:`reduce_spec_of`."""
+
+    inner: Any  # the wrapped optax GradientTransformation
+    op: str
+    compression: Any
+    prescale_factor: float
+    postscale_factor: float
+    process_set: Any
+    num_groups: int
+    fusion_threshold_bytes: int | None
+    backward_passes_per_step: int
+
+
+def reduce_spec_of(optimizer) -> ReduceSpec | None:
+    """The :class:`ReduceSpec` carried by a DistributedOptimizer-built
+    transformation, or None for a bare optax optimizer."""
+    return getattr(getattr(optimizer, "update", None),
+                   "_hvd_reduce_spec", None)
 
 
 def DistributedOptimizer(
@@ -179,7 +223,9 @@ def DistributedOptimizer(
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
-    def reduce_fn(grads):
+    int8 = getattr(compression, "marker", None) == "int8"
+
+    def reduce_fn(grads, salt=None):
         # Trace-time axis resolution: inside a step shard_mapped over the
         # hierarchical (cross, local) mesh the reduction takes the two-level
         # form automatically (HOROVOD_HIERARCHICAL_ALLREDUCE's consumer).
@@ -196,17 +242,42 @@ def DistributedOptimizer(
             fusion_threshold_bytes,
             num_groups,
             world_size=_known_size(ps),
+            quant_salt=salt,
         )
+
+    spec = ReduceSpec(
+        inner=optimizer,
+        op=op,
+        compression=compression,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        process_set=ps,
+        num_groups=num_groups,
+        fusion_threshold_bytes=fusion_threshold_bytes,
+        backward_passes_per_step=k,
+    )
 
     if k == 1:
 
         def init_fn(params):
-            return optimizer.init(params)
+            state = optimizer.init(params)
+            if int8:
+                # Step-counter salt for stochastic rounding: without it a
+                # gradient value that repeats across steps rounds the same
+                # direction every step (persistent quantization bias).
+                return _SaltState(state, jnp.zeros((), jnp.uint32))
+            return state
 
         def update_fn(grads, state, params=None):
+            if int8:
+                reduced = reduce_fn(grads, salt=state.counter)
+                updates, new_inner = optimizer.update(
+                    reduced, state.inner_state, params)
+                return updates, _SaltState(new_inner, state.counter + 1)
             reduced = reduce_fn(grads)
             return optimizer.update(reduced, state, params)
 
+        update_fn._hvd_reduce_spec = spec
         return optax.GradientTransformation(init_fn, update_fn)
 
     # backward_passes_per_step > 1: accumulate locally, allreduce on the
@@ -220,13 +291,17 @@ def DistributedOptimizer(
 
     def update_acc(grads, state, params=None):
         acc = jax.tree.map(jnp.add, state.acc_grads, grads)
+        # Monotonic microstep count (boundary = every k-th): the window
+        # index (count // k) doubles as the int8 rounding salt, which a
+        # counter that reset each window could not provide.
         count = state.counter + 1
-        is_boundary = count >= k
+        is_boundary = (count % k) == 0
 
         def at_boundary(operand):
             acc_g, inner = operand
             mean_g = jax.tree.map(lambda g: g / k, acc_g)
-            reduced = reduce_fn(mean_g)
+            salt = (count // k).astype(jnp.uint32) if int8 else None
+            reduced = reduce_fn(mean_g, salt=salt)
             updates, new_inner = optimizer.update(reduced, inner, params)
             return updates, new_inner, jax.tree.map(jnp.zeros_like, acc_g)
 
@@ -238,9 +313,10 @@ def DistributedOptimizer(
         updates, new_inner, new_acc = jax.lax.cond(
             is_boundary, at_boundary, between, (acc, state.inner_state)
         )
-        new_counter = jnp.where(is_boundary, 0, count)
-        return updates, _AccumulationState(new_inner, new_acc, new_counter)
+        return updates, _AccumulationState(new_inner, new_acc, count)
 
+    init_acc._hvd_reduce_spec = spec
+    update_acc._hvd_reduce_spec = spec
     return optax.GradientTransformation(init_acc, update_acc)
 
 

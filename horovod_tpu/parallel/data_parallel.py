@@ -93,6 +93,16 @@ class _StallWatchedStep:
         return bool(_active_tuner and _active_tuner[0]._hvd_tuning)
 
     def __call__(self, *args, **kwargs):
+        from ..autotune import _poison_error, warmup_aborted
+
+        if warmup_aborted():
+            # A mid-warmup autotune abort poisons EVERY factory step in
+            # the process, not just the tuner's wrapper: co-built steps
+            # and steps built post-abort pass through maybe_autotune_step
+            # bare, but all of them route through this wrapper — and all
+            # of them would trace collective sequences that may diverge
+            # from peers that pinned the broadcast winner.
+            raise _poison_error()
         if self._every > 0 and not self._tuning_live():
             cross = self._cross_rank_available()
             n = self._step_number(cross)
@@ -121,6 +131,45 @@ class _StallWatchedStep:
         if item == "_fn":  # guard: lookup before __init__ must not recurse
             raise AttributeError(item)
         return getattr(self._fn, item)
+
+
+def _resolve_mesh_axis(mesh, axis_name, hierarchical):
+    """Shared factory plumbing: resolve (mesh, axis_name) from the
+    explicit arguments, the ``hierarchical`` request, or the env flag
+    (``HOROVOD_HIERARCHICAL_ALLREDUCE``). See :func:`make_train_step`
+    for the argument contract."""
+    from .. import basics
+
+    from_env = hierarchical is None
+    if from_env:
+        cfg = basics._state.config
+        hierarchical = bool(cfg and cfg.hierarchical_allreduce)
+    if hierarchical and mesh is not None:
+        if not from_env:
+            raise ValueError(
+                "pass either hierarchical=... or mesh=, not both (an "
+                "explicit mesh defines its own axes)"
+            )
+        # Env flag + explicit mesh: the explicit mesh wins, loudly.
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "HOROVOD_HIERARCHICAL_ALLREDUCE is set but the step factory "
+            "got an explicit mesh; using the explicit mesh (flat reduction)"
+        )
+        hierarchical = False
+    if hierarchical:
+        from .hierarchical import HIERARCHICAL_AXES, hierarchical_mesh
+
+        factors = (hierarchical if isinstance(hierarchical, tuple)
+                   else (None, None))
+        mesh = hierarchical_mesh(*factors)
+        axis_name = HIERARCHICAL_AXES
+    if mesh is None:
+        mesh = basics.global_mesh()
+    if axis_name is None:
+        axis_name = basics.global_axis_name()
+    return mesh, axis_name
 
 
 def make_train_step(
@@ -156,36 +205,7 @@ def make_train_step(
     """
     import optax
 
-    from .. import basics
-
-    from_env = hierarchical is None
-    if from_env:
-        cfg = basics._state.config
-        hierarchical = bool(cfg and cfg.hierarchical_allreduce)
-    if hierarchical and mesh is not None:
-        if not from_env:
-            raise ValueError(
-                "pass either hierarchical=... or mesh=, not both (an "
-                "explicit mesh defines its own axes)"
-            )
-        # Env flag + explicit mesh: the explicit mesh wins, loudly.
-        from ..utils.logging import get_logger
-
-        get_logger().warning(
-            "HOROVOD_HIERARCHICAL_ALLREDUCE is set but make_train_step got "
-            "an explicit mesh; using the explicit mesh (flat reduction)"
-        )
-        hierarchical = False
-    if hierarchical:
-        from .hierarchical import HIERARCHICAL_AXES, hierarchical_mesh
-
-        factors = hierarchical if isinstance(hierarchical, tuple) else (None, None)
-        mesh = hierarchical_mesh(*factors)
-        axis_name = HIERARCHICAL_AXES
-    if mesh is None:
-        mesh = basics.global_mesh()
-    if axis_name is None:
-        axis_name = basics.global_axis_name()
+    mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
 
     def spmd_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -211,6 +231,245 @@ def make_train_step(
     return _StallWatchedStep(
         maybe_autotune_step(jax.jit(sharded, donate_argnums=donate_argnums)),
         "train_step")
+
+
+def _segment_sync(leaves, seg_index, spec, axis_name, salt):
+    """Identity-forward / reduce-backward boundary for ONE segment.
+
+    The forward pass returns the segment's leaves unchanged; the
+    custom-vjp backward reduces the segment's COTANGENTS through the
+    exact wire the DistributedOptimizer was built with (op, compression,
+    scaling, bucketing — via ``optimizer._reduce_grads``). Because the
+    boundary sits inside the differentiated function, the collective is
+    emitted at the point in the backward pass where this segment's
+    gradients finish accumulating — for late-layer segments that is
+    EARLY in the backward, so XLA's latency-hiding scheduler can overlap
+    the transfer with the remaining layers' backward compute.
+
+    ``salt`` (the int8 stochastic-rounding step counter) rides the
+    forward as a residual rather than a closure: custom-vjp rules must
+    not close over tracers, and its cotangent is the usual float0
+    placeholder for integer primals.
+    """
+    import numpy as np
+
+    from ..optimizer import _known_size, _reduce_grads
+    from ..profiler import annotate_collective
+
+    def reduce_cts(cts, s):
+        with annotate_collective(f"overlap.segment{seg_index}"):
+            return _reduce_grads(
+                list(cts),
+                spec.op,
+                axis_name,
+                spec.compression,
+                spec.prescale_factor,
+                spec.postscale_factor,
+                spec.fusion_threshold_bytes,
+                spec.num_groups,
+                world_size=_known_size(spec.process_set),
+                quant_salt=s,
+                issue_reversed=True,
+            )
+
+    if salt is None:
+
+        @jax.custom_vjp
+        def ident(ls):
+            return list(ls)
+
+        def fwd(ls):
+            return list(ls), None
+
+        def bwd(_, cts):
+            return (reduce_cts(cts, None),)
+
+        ident.defvjp(fwd, bwd)
+        return ident(list(leaves))
+
+    @jax.custom_vjp
+    def ident_salted(ls, s):
+        return list(ls)
+
+    def fwd_salted(ls, s):
+        return list(ls), s
+
+    def bwd_salted(s, cts):
+        return (reduce_cts(cts, s),
+                np.zeros(np.shape(s), jax.dtypes.float0))
+
+    ident_salted.defvjp(fwd_salted, bwd_salted)
+    return ident_salted(list(leaves), salt)
+
+
+def overlap_gradient_sync(
+    params,
+    spec,
+    axis_name=None,
+    num_segments: int | None = None,
+    salt=None,
+):
+    """Wrap a parameter pytree so its gradients are reduced SEGMENT BY
+    SEGMENT inside the backward pass — the communication-overlap
+    scheduler's core primitive.
+
+    The pytree's leaves are split into K contiguous byte-balanced
+    segments (``ops.fusion.segment_leaves`` — layer order, so the last
+    segment's gradients materialize first during backprop) and each
+    segment gets an identity-forward / reduce-backward custom-vjp
+    boundary. Differentiating through the wrapped tree yields gradients
+    that are ALREADY reduced, with each segment's collective issued at
+    the point its gradients finish accumulating instead of after a
+    global post-backward barrier.
+
+    Must be applied INSIDE the differentiated function::
+
+        spec = hvd.reduce_spec_of(dist_optimizer)
+
+        def loss_of(p):
+            return loss_fn(hvd.overlap_gradient_sync(p, spec), batch)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)  # reduced
+        updates, st = spec.inner.update(grads, inner_state, params)
+
+    Args:
+      params: the parameter pytree being differentiated.
+      spec: a :class:`horovod_tpu.optimizer.ReduceSpec` (from
+        ``reduce_spec_of``) naming the wire to issue per segment.
+      axis_name: collective axis (name or hierarchical ``(cross,
+        local)`` tuple); defaults to the trace-time resolution for the
+        spec's process set, exactly like the DistributedOptimizer.
+      num_segments: segment count K; defaults to the autotuned /
+        ``HOROVOD_OVERLAP_SEGMENTS`` value
+        (``ops.fusion.overlap_segments``). K=1 degenerates to the
+        monolithic single-boundary reduction.
+      salt: optional int8 stochastic-rounding step counter (see
+        ``ops.quantization._sround``).
+    """
+    from ..ops.fusion import overlap_segments, segment_leaves
+
+    if axis_name is None:
+        from ..ops.collective_ops import _effective_traced_axis
+
+        axis_name = (_effective_traced_axis(spec.process_set)
+                     or spec.process_set.axis_name)
+    k = num_segments if num_segments is not None else overlap_segments()
+    leaves, treedef = jax.tree.flatten(params)
+    new_leaves = list(leaves)
+    for si, idx in enumerate(segment_leaves(leaves, k)):
+        synced = _segment_sync(
+            [leaves[i] for i in idx], si, spec, axis_name, salt)
+        for i, s in zip(idx, synced):
+            new_leaves[i] = s
+    return jax.tree.unflatten(treedef, new_leaves)
+
+
+def make_overlapped_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer,
+    mesh=None,
+    axis_name: str | None = None,
+    donate: bool = True,
+    loss_is_averaged: bool = True,
+    hierarchical: bool | tuple | None = None,
+    num_segments: int | None = None,
+):
+    """Build a jitted SPMD train step whose gradient allreduces OVERLAP
+    the backward pass — the compiled realization of Horovod's headline
+    optimization (the reference's background thread starts reducing
+    early-ready gradients while later layers still differentiate).
+
+    Same contract as :func:`make_train_step`, with two differences:
+
+    - ``optimizer`` MUST be a ``hvd.DistributedOptimizer``-wrapped
+      transformation: its attached :class:`ReduceSpec` tells the
+      scheduler which wire (op / compression / scaling / bucketing) to
+      issue per segment, and the step applies the bare inner optimizer
+      to the already-reduced gradients.
+    - ``num_segments`` fixes the segment count K; by default it follows
+      the autotuned decision (the transparent tuner gains a joint
+      (threshold, segments) grid under ``HOROVOD_AUTOTUNE=1``) or
+      ``HOROVOD_OVERLAP_SEGMENTS``.
+
+    The parameter pytree is split into K contiguous byte-balanced
+    segments (reverse-topological issue: during backward the LAST
+    segment's gradients materialize first, and its collective is
+    emitted right there), so ICI/DCN transfer of segment *i* runs
+    concurrently with backward compute of segments *< i* instead of
+    serializing after the full backward. Hierarchical (cross, local)
+    meshes compose per segment: each segment's buckets take the
+    two-level reduce-scatter → cross-allreduce → allgather form,
+    including the int8-compressed exchange.
+    """
+    import optax
+
+    from ..optimizer import _SaltState, reduce_spec_of
+
+    spec = reduce_spec_of(optimizer)
+    if spec is None:
+        raise ValueError(
+            "make_overlapped_train_step requires a DistributedOptimizer-"
+            "wrapped optimizer (its ReduceSpec tells the scheduler which "
+            "wire to issue per segment); got a bare transformation")
+    if spec.backward_passes_per_step != 1:
+        raise ValueError(
+            "the overlap scheduler does not compose with "
+            "backward_passes_per_step > 1: accumulation defers the "
+            "reduction to every k-th microstep, so most steps have no "
+            "communication to overlap — use make_train_step")
+    int8 = getattr(spec.compression, "marker", None) == "int8"
+    mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
+
+    def spmd_step(params, opt_state, batch):
+        from ..ops.collective_ops import _effective_traced_axis
+
+        effective = (_effective_traced_axis(spec.process_set)
+                     or spec.process_set.axis_name)
+        if int8:
+            inner_state, salt = opt_state.inner_state, opt_state.counter
+        else:
+            inner_state, salt = opt_state, None
+
+        def loss_of(p):
+            synced = overlap_gradient_sync(
+                p, spec, axis_name=effective,
+                num_segments=num_segments, salt=salt)
+            return loss_fn(synced, batch)
+
+        loss, grads = jax.value_and_grad(loss_of)(params)
+        # Gradients arrive REDUCED (the segment collectives ran inside
+        # the backward), so the bare inner optimizer applies them. Each
+        # leaf's update depends only on its own reduced gradient, so in
+        # the compiled program segment i's update can proceed while
+        # segment i-1 is still reducing — the monolithic path's global
+        # post-backward barrier (one concat depending on every gradient)
+        # does not exist here.
+        updates, new_inner = spec.inner.update(grads, inner_state, params)
+        new_params = optax.apply_updates(params, updates)
+        new_state = _SaltState(new_inner, salt + 1) if int8 else new_inner
+        if loss_is_averaged:
+            loss = jax.lax.pmean(loss, axis_name)
+        return new_params, new_state, loss
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    from ..autotune import DEFAULT_SEGMENT_CANDIDATES, maybe_autotune_step
+
+    # The transparent tuner gains the segments axis only when K floats;
+    # an explicit num_segments is the user's decision, threshold-only.
+    seg_cands = (None if num_segments is not None
+                 else DEFAULT_SEGMENT_CANDIDATES)
+    return _StallWatchedStep(
+        maybe_autotune_step(
+            jax.jit(sharded, donate_argnums=donate_argnums),
+            segment_candidates=seg_cands),
+        "overlapped_train_step")
 
 
 def shard_batch(batch, mesh=None, axis_name: str | None = None):

@@ -137,9 +137,20 @@ def hierarchical_allreduce(
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     # Each device keeps 1/local_n of the payload for the slow-axis hop.
-    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
-    shard = lax.psum(shard, cross_axis)
-    full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
+    # The three legs are named so a profile shows which leg of which
+    # segment/bucket overlaps which slice of backward compute — the
+    # overlap scheduler issues this composition once PER SEGMENT, and
+    # the legs keep their relative order within each segment while
+    # different segments' legs interleave freely by dataflow.
+    from ..profiler import annotate_collective
+
+    with annotate_collective("hier.reduce_scatter_local"):
+        shard = lax.psum_scatter(
+            flat, local_axis, scatter_dimension=0, tiled=True)
+    with annotate_collective("hier.allreduce_cross"):
+        shard = lax.psum(shard, cross_axis)
+    with annotate_collective("hier.allgather_local"):
+        full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
     if pad:
         full = full[: flat.size - pad]
     out = full.reshape(shape)

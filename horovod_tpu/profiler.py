@@ -12,6 +12,9 @@ activities against GPU kernels. Here the same role is played by
   framework annotations appear above the TPU op stream — one merged view.
 - Programmatic: ``hvd.profiler.start(logdir)`` / ``hvd.profiler.stop()``,
   and :func:`trace` as a with-block for scoped capture.
+- :func:`annotate_collective` names in-trace collective regions (segment
+  allreduces, fusion buckets, hierarchical legs) so comm/compute overlap
+  is visible against the TPU op stream in the captured trace.
 """
 
 from __future__ import annotations
@@ -60,6 +63,28 @@ def maybe_start_from_env() -> None:
             # Profiler not supported on this backend (e.g. some tunneled
             # dev setups) — never fail init over observability.
             pass
+
+
+def annotate_collective(name: str):
+    """Name the ops traced inside the scope (``jax.named_scope``) so each
+    collective region is identifiable in xprof traces and HLO dumps.
+
+    This is the compiled-regime counterpart of the host timeline's
+    ``activity`` ranges (which cannot see inside a jitted program): the
+    overlap scheduler wraps every segment allreduce, the fusion pass every
+    bucket, and the hierarchical reduction each of its three legs, so a
+    profile of the step shows exactly which transfer overlaps which slice
+    of backward compute. Safe anywhere — outside a trace the scope only
+    prefixes op names of whatever gets traced next, and a backend without
+    named-scope support degrades to a no-op."""
+    import contextlib
+
+    import jax
+
+    try:
+        return jax.named_scope(f"hvd.{name}")
+    except Exception:  # pragma: no cover — annotation is best-effort
+        return contextlib.nullcontext()
 
 
 class trace:

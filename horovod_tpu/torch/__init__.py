@@ -1149,19 +1149,20 @@ class _DistributedOptimizer:
         for _, names in replies:
             active.update(names)
         # op=Average: the true mean over every pending microbatch
-        # globally (Sum wire, postscale 1/total). op=Sum: keep the
-        # window rule "sum over ranks of the per-rank mean" — each rank
-        # pre-divides its accumulator by ITS pass count, no postscale
-        # (a 1/total postscale would shrink the tail update ~size()×
-        # relative to every full window).
+        # globally — each rank pre-divides its LOCAL accumulator by the
+        # agreed global total BEFORE compression (sum of acc_r/total over
+        # ranks is exactly the mean, with per-rank pending counts free to
+        # differ). The division must precede the compression cast: a tail
+        # window of several accumulated passes can overflow an fp16 wire
+        # if the raw sum is cast first and only postscaled after the
+        # exchange (ADVICE r5); this local prescale subsumes the
+        # reference's predivide split — the full 1/total headroom lands
+        # before any wire dtype is involved. op=Sum: keep the window rule
+        # "sum over ranks of the per-rank mean" — each rank pre-divides
+        # its accumulator by ITS pass count, no postscale (a 1/total
+        # postscale would shrink the tail update ~size()× relative to
+        # every full window).
         kwargs = dict(op=Sum, process_set_id=_ps_id(self._ps))
-        if self._op == Average:
-            kwargs.update(postscale_factor=1.0 / total)
-            if self._predivide != 1.0:
-                # Keep the reference's predivide split (fp16 overflow
-                # headroom): 1/f before the sum, f/total after.
-                kwargs.update(prescale_factor=1.0 / self._predivide,
-                              postscale_factor=self._predivide / total)
         for group in self._opt.param_groups:
             for p in group["params"]:
                 if not p.requires_grad or id(p) not in self._hooked:
@@ -1181,6 +1182,9 @@ class _DistributedOptimizer:
                     # count would over-weight params that got grads in
                     # only some tail passes.
                     src = src / float(pending or 1)
+                elif self._op == Average and acc is not None:
+                    # 1/total prescale before compression (see above).
+                    src = src / float(total)
                 wire, ctx = self._compression.compress(src)
                 h = _world().allreduce_async_(
                     _np_of(wire), name=f"grad.{self._param_name(p)}",

@@ -18,7 +18,12 @@ os.environ.setdefault(
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax: no jax_num_cpu_devices option; the XLA_FLAGS fallback
+    # above already forces the 8-device virtual mesh.
+    pass
 
 import pytest  # noqa: E402
 

@@ -242,7 +242,8 @@ class TestTorchElasticE2E:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1)
             import numpy as np
             import torch
             import horovod_tpu as hvd_core
@@ -328,7 +329,8 @@ class TestGenerationRelaunchE2E:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1)
             import numpy as np
             import torch
             import horovod_tpu.torch as hvd
@@ -476,7 +478,8 @@ class TestTensorFlowElasticE2E:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1)
             import numpy as np
             import tensorflow as tf
             import horovod_tpu.keras as hvdk

@@ -205,7 +205,8 @@ HOST_WORKER = textwrap.dedent(
     sys.path.insert(0, os.environ["REPO_ROOT"])
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    from horovod_tpu._jax_compat import force_cpu_devices
+    force_cpu_devices(4)
     import numpy as np
     import horovod_tpu as hvd
     from horovod_tpu.parallel.hierarchical import host_hierarchical_allreduce

@@ -1,0 +1,342 @@
+"""Communication-overlap scheduler: segment allreduces inside backward.
+
+Horovod's headline optimization (arXiv:1802.05799 §3) is running the
+gradient allreduce *concurrently with backprop*. The compiled analog
+(``make_overlapped_train_step`` / ``overlap_gradient_sync``) splits the
+parameter pytree into K contiguous byte-balanced segments and issues each
+segment's reduction through an identity-forward / reduce-backward
+custom-vjp boundary, so the collective HLOs anchor where their operands
+materialize instead of in one post-backward block. Asserted here:
+
+- the leaf→segment map is stable, contiguous, and covering;
+- the traced program really interleaves segment collectives with backward
+  compute (jaxpr ordering, contrasted against the monolithic path);
+- numerics match the monolithic DistributedOptimizer path — exactly for
+  the f32 wire, within quantization tolerance for the int8 wire over the
+  hierarchical (cross, local) mesh;
+- the salted stochastic rounding decorrelates repeated values across
+  steps, and a poisoned autotune wrapper refuses to train on.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops.fusion import segment_leaves
+
+
+class TestSegmentLeaves:
+    def test_contiguous_and_covering(self):
+        leaves = [jnp.zeros((s,), jnp.float32) for s in (7, 3, 9, 1, 4, 8)]
+        segs = segment_leaves(leaves, 3)
+        flat = [i for seg in segs for i in seg]
+        assert flat == list(range(len(leaves)))  # covering, in order
+        for seg in segs:
+            assert seg == list(range(seg[0], seg[0] + len(seg)))  # contiguous
+
+    def test_k1_is_monolithic(self):
+        leaves = [jnp.zeros((4,)), jnp.zeros((2,))]
+        assert segment_leaves(leaves, 1) == [[0, 1]]
+
+    def test_k_exceeding_leaves_gives_singletons(self):
+        leaves = [jnp.zeros((4,)), jnp.zeros((2,)), jnp.zeros((1,))]
+        segs = segment_leaves(leaves, 100)
+        assert segs == [[0], [1], [2]]  # empty runs dropped
+
+    def test_empty(self):
+        assert segment_leaves([], 4) == []
+
+    def test_stable_under_values(self):
+        # The map must depend only on shapes/dtypes/order (every rank and
+        # every retrace derives the identical segmentation): same-shaped
+        # leaves with different values segment identically.
+        a = [jnp.zeros((5, 5)), jnp.ones((3,)), jnp.zeros((7,))]
+        b = [jnp.full((5, 5), 9.0), jnp.zeros((3,)), jnp.ones((7,)) * -2]
+        assert segment_leaves(a, 2) == segment_leaves(b, 2)
+
+    def test_byte_balanced(self):
+        # Equal-sized leaves split into equal-count runs.
+        leaves = [jnp.zeros((10,), jnp.float32) for _ in range(6)]
+        assert segment_leaves(leaves, 3) == [[0, 1], [2, 3], [4, 5]]
+
+
+def _mlp_problem(n_layers=4, dim=8, batch=16):
+    rng = np.random.RandomState(0)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(dim, dim).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(dim).astype(np.float32)),
+        }
+        for i in range(n_layers)
+    }
+
+    def loss_fn(p, b):
+        x, y = b
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"layer{i}"]["w"] + p[f"layer{i}"]["b"])
+        return jnp.mean((h.sum(axis=-1) - y) ** 2)
+
+    x = rng.randn(batch, dim).astype(np.float32)
+    y = rng.randn(batch).astype(np.float32)
+    return params, (x, y), loss_fn
+
+
+class TestJaxprInterleaving:
+    """The scheduler's whole point, asserted on the traced program: the
+    segment collectives sit BETWEEN backward compute ops, where the
+    monolithic path's single reduction trails every differentiation op."""
+
+    def _positions(self, hvd, traced_grads, params, batch):
+        mesh = hvd.global_mesh()
+        sm = jax.shard_map(
+            traced_grads, mesh=mesh, in_specs=(P(), P("hvd")),
+            out_specs=P(), check_vma=False)
+        txt = str(jax.make_jaxpr(sm)(params, batch))
+        colls = [m.start() for m in re.finditer(r"\bpsum", txt)]
+        dots = [m.start() for m in re.finditer(r"\bdot_general", txt)]
+        assert colls and dots
+        return colls, dots
+
+    def test_segment_collectives_interleave_with_backward(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        spec = hvd.reduce_spec_of(hvd.DistributedOptimizer(optax.sgd(0.1)))
+        k = 3
+
+        def overlapped(p, b):
+            def loss_of(q):
+                return loss_fn(hvd.overlap_gradient_sync(
+                    q, spec, axis_name="hvd", num_segments=k), b)
+
+            return jax.grad(loss_of)(p)
+
+        colls, dots = self._positions(hvd, overlapped, params, batch)
+        # One collective per segment...
+        assert len(colls) == k
+        # ...and they are interleaved: the first reduction is issued
+        # before the last backward matmul, not after the full backward.
+        assert colls[0] < dots[-1]
+
+    def test_monolithic_collectives_trail_backward(self, hvd):
+        # The contrast that makes the interleaving assertion meaningful:
+        # the post-backward path's reduction comes after EVERY matmul.
+        params, batch, loss_fn = _mlp_problem()
+        spec = hvd.reduce_spec_of(hvd.DistributedOptimizer(optax.sgd(0.1)))
+
+        def monolithic(p, b):
+            from horovod_tpu.optimizer import _known_size, _reduce_grads
+
+            g = jax.grad(loss_fn)(p, b)
+            return _reduce_grads(
+                g, spec.op, "hvd", spec.compression, spec.prescale_factor,
+                spec.postscale_factor, spec.fusion_threshold_bytes,
+                spec.num_groups, world_size=_known_size(spec.process_set))
+
+        colls, dots = self._positions(hvd, monolithic, params, batch)
+        assert colls[0] > dots[-1]
+
+
+class TestOverlapEquivalence:
+    """Reordering WHEN reductions are issued must not change WHAT they
+    compute: the overlapped step and the monolithic step produce the
+    same parameters from the same state."""
+
+    def _one_step_each(self, hvd, dopt, hierarchical=None, num_segments=3):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        kw = dict(donate=False)
+        if hierarchical is not None:
+            kw["hierarchical"] = hierarchical
+        mono = dp.make_train_step(loss_fn, dopt, **kw)
+        over = dp.make_overlapped_train_step(
+            loss_fn, dopt, num_segments=num_segments, **kw)
+        if hierarchical is not None:
+            from horovod_tpu.parallel.hierarchical import hierarchical_mesh
+
+            m = hierarchical_mesh(*hierarchical)
+            rep = lambda t: dp.replicate(t, mesh=m)  # noqa: E731
+            sb = dp.shard_batch(batch, mesh=m, axis_name=m.axis_names)
+        else:
+            rep = dp.replicate
+            sb = dp.shard_batch(batch)
+        p1, _, l1 = mono(rep(params), rep(dopt.init(params)), sb)
+        p2, _, l2 = over(rep(params), rep(dopt.init(params)), sb)
+        return p1, p2, float(l1), float(l2)
+
+    def test_f32_flat_matches_monolithic(self, hvd):
+        dopt = hvd.DistributedOptimizer(optax.sgd(0.1))
+        p1, p2, l1, l2 = self._one_step_each(hvd, dopt)
+        assert l1 == pytest.approx(l2, rel=1e-6)
+        # Same wire, same per-leaf summation order — segmentation only
+        # moves the bucket concat boundaries, so parameters match to
+        # float-association noise (observed bitwise on the CPU mesh).
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7),
+            p1, p2)
+
+    def test_int8_hierarchical_matches_monolithic(self, hvd):
+        # The acceptance-criteria pairing: int8-compressed wire over the
+        # hierarchical (cross, local) mesh. Segment boundaries change the
+        # quantization block layout, so equality is to int8 tolerance.
+        dopt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), compression=hvd.Compression.int8)
+        p1, p2, l1, l2 = self._one_step_each(hvd, dopt, hierarchical=(2, 4))
+        assert l1 == pytest.approx(l2, rel=1e-6)  # loss precedes reduction
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=0.05, atol=0.02),
+            p1, p2)
+
+    def test_overlapped_loss_decreases(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        dopt = hvd.DistributedOptimizer(optax.sgd(0.1))
+        step = dp.make_overlapped_train_step(loss_fn, dopt, donate=False)
+        p = dp.replicate(params)
+        s = dp.replicate(dopt.init(params))
+        b = dp.shard_batch(batch)
+        losses = []
+        for _ in range(3):
+            p, s, loss = step(p, s, b)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_requires_distributed_optimizer(self, hvd):
+        with pytest.raises(ValueError, match="DistributedOptimizer"):
+            hvd.make_overlapped_train_step(
+                lambda p, b: jnp.sum(p), optax.sgd(0.1))
+
+    def test_rejects_gradient_accumulation(self, hvd):
+        dopt = hvd.DistributedOptimizer(
+            optax.sgd(0.1), backward_passes_per_step=4)
+        with pytest.raises(ValueError, match="backward_passes_per_step"):
+            hvd.make_overlapped_train_step(lambda p, b: jnp.sum(p), dopt)
+
+
+class TestSaltedRounding:
+    def test_salt_decorrelates_repeated_values(self):
+        # The same block quantized under different step salts must not
+        # round every element the same direction (the unsalted persistent
+        # per-value bias ADVICE r5 flagged); identical salts stay
+        # deterministic (rank-identical wire requirement).
+        from horovod_tpu.ops.quantization import _sround
+
+        x = jnp.full((256,), 46.5, jnp.float32)  # exactly between grids
+        q0 = np.asarray(_sround(x, salt=jnp.uint32(0)))
+        q0b = np.asarray(_sround(x, salt=jnp.uint32(0)))
+        np.testing.assert_array_equal(q0, q0b)
+        qs = [int(np.asarray(_sround(x, salt=jnp.uint32(s)))[0])
+              for s in range(16)]
+        assert {46, 47} == set(qs)  # steps round BOTH directions
+        # ...and without a persistent bias: the across-step mean tracks
+        # the value (the property the unsalted hash only had over
+        # varying data).
+        assert abs(np.mean(qs) - 46.5) < 0.3
+
+    def test_distributed_optimizer_threads_salt(self, hvd):
+        # The int8 DistributedOptimizer's state carries the step counter
+        # and increments it per update (the salt source) — on both the
+        # monolithic and overlapped step paths.
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem(n_layers=1)
+        for make in (dp.make_train_step, dp.make_overlapped_train_step):
+            dopt = hvd.DistributedOptimizer(
+                optax.sgd(0.1), compression=hvd.Compression.int8)
+            state = dopt.init(params)
+            assert int(state.counter) == 0
+            step = make(loss_fn, dopt, donate=False)
+            _, s1, _ = step(dp.replicate(params), dp.replicate(state),
+                            dp.shard_batch(batch))
+            assert int(s1.counter) == 1
+
+
+def test_transparent_autotune_joint_segments_grid(hvd, monkeypatch):
+    """HOROVOD_AUTOTUNE=1 on the overlapped factory tunes (fusion
+    threshold, segment count) JOINTLY: an injected cost model that favors
+    the largest K must pin that K (and `overlap_segments` follows it)."""
+    from horovod_tpu import autotune as at
+    from horovod_tpu.ops.fusion import overlap_segments
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE", "1")
+    hvd.init()
+    dp = hvd.data_parallel
+    params, batch, loss_fn = _mlp_problem(n_layers=2)
+    dopt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    step = dp.make_overlapped_train_step(loss_fn, dopt, donate=False)
+    tuner = step._fn
+    assert isinstance(tuner, at.AutotuneStep) and tuner._tune_segments
+    assert len(tuner._cands) == (
+        len(at.DEFAULT_SEGMENT_CANDIDATES) * len(at.DEFAULT_THRESHOLDS))
+    t = {"now": 0.0}
+
+    def clock():  # more segments -> cheaper, deterministically
+        t["now"] += 2.0 - (at.tuned_segments() or 0) / 10.0
+        return t["now"]
+
+    tuner._clock = clock
+    try:
+        p = dp.replicate(params)
+        s = dp.replicate(dopt.init(params))
+        b = dp.shard_batch(batch)
+        for _ in range(len(tuner._cands) * (1 + tuner._iters)):
+            p, s, _ = step(p, s, b)
+        assert not tuner._hvd_tuning  # warmup over, decision pinned
+        assert at.tuned_segments() == max(at.DEFAULT_SEGMENT_CANDIDATES)
+        assert overlap_segments() == at.tuned_segments()
+        assert at.autotune_state()["overlap_segments"] == at.tuned_segments()
+        p, s, loss = step(p, s, b)  # passthrough after pin, still trains
+        assert np.isfinite(float(loss))
+    finally:
+        at.set_tuned_threshold(None)
+        at.set_tuned_segments(None)
+        at._tuned["history"].clear()
+
+
+def test_poisoned_autotune_step_raises(hvd):
+    # A mid-warmup abort pins the rank-identical first candidate and then
+    # refuses further calls — through the tuner's own wrapper AND through
+    # every other factory-built step in the process (co-built steps pass
+    # through maybe_autotune_step bare): peers that finished warmup
+    # pinned the broadcast winner, so continuing anywhere here would
+    # trace a divergent collective sequence and deadlock the job
+    # (ADVICE r5).
+    from horovod_tpu import autotune as at
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    calls = []
+
+    class _Boom:
+        def __call__(self, x):
+            calls.append(x)
+            raise RuntimeError("window exploded")
+
+        def clear_cache(self):
+            pass
+
+    tuner = at.AutotuneStep(_Boom(), iters=1)
+    try:
+        with pytest.raises(RuntimeError, match="window exploded"):
+            tuner(1.0)
+        assert not tuner._hvd_tuning
+        assert at.warmup_aborted()
+        with pytest.raises(HorovodInternalError):
+            tuner(2.0)
+        assert calls == [1.0]  # the post-abort call never reached the step
+        # The process-wide gate: an unrelated factory step (e.g. an eval
+        # co-step, or one built after the abort) refuses to run too.
+        params, batch, loss_fn = _mlp_problem(n_layers=1)
+        dopt = hvd.DistributedOptimizer(optax.sgd(0.1))
+        other = hvd.data_parallel.make_train_step(
+            loss_fn, dopt, donate=False)
+        with pytest.raises(HorovodInternalError):
+            other(None, None, None)
+    finally:
+        # Don't leak the abort pin/poison to other tests.
+        at.set_tuned_threshold(None)
+        at._tuned["aborted"] = False

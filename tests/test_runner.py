@@ -283,7 +283,8 @@ class TestStaticLaunch:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 2)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(2)
             import numpy as np
             import horovod_tpu as hvd
 
@@ -416,7 +417,8 @@ class TestNativePortWiring:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 2)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(2)
             import numpy as np
             import horovod_tpu as hvd
             from horovod_tpu.parallel.hierarchical import (
@@ -470,7 +472,8 @@ class TestPerProcessEagerIdiom:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 2)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(2)
             import numpy as np
             import horovod_tpu as hvd
 
@@ -560,7 +563,8 @@ class TestPerProcessSubsetCollectives:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1)
             import numpy as np
             import horovod_tpu as hvd
 
@@ -618,7 +622,8 @@ class TestElasticTrainStepMultiProcess:
             import jax
             jax.config.update("jax_platforms", "cpu")
             pid = int(os.environ["HOROVOD_PROCESS_ID"])
-            jax.config.update("jax_num_cpu_devices", 1 if pid == 0 else 3)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1 if pid == 0 else 3)
             import numpy as np
             import jax.numpy as jnp
             import optax

@@ -198,7 +198,8 @@ class TestEstimatorMultiProcess:
             os.environ["JAX_PLATFORMS"] = "cpu"
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 1)
+            from horovod_tpu._jax_compat import force_cpu_devices
+            force_cpu_devices(1)
             import numpy as np
             import flax.linen as nn
             import optax

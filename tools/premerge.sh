@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Default pre-merge check: the tier-1 test suite (ROADMAP.md's verify
+# command, verbatim) followed by a 2-step CPU smoke of bench.py — the
+# bench exercises the full machinery (DistributedOptimizer wire, raw
+# baseline, forced-wire, overlap scheduler) end to end, which unit tests
+# alone do not. Run from anywhere; exits nonzero if either gate fails.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== premerge gate 1/2: tier-1 tests =="
+t1log="$(mktemp "${TMPDIR:-/tmp}/_t1.XXXXXX.log")"  # per-run: concurrent
+trap 'rm -f "$t1log"' EXIT                          # premerges must not clobber
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee "$t1log"
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$t1log" \
+    | tr -cd . | wc -c)"
+# Failures whose root cause is the image, not the code: this jaxlib build
+# cannot run 2-process CPU collectives ("Multiprocess computations aren't
+# implemented on the CPU backend"), so the multi-controller launch tests
+# fail everywhere regardless of the diff. Anything NOT on this list fails
+# the gate.
+KNOWN_ENV_FAILURES='test_hvdrun_autotune_reaches_compiled_path|test_e2e_multiprocess_allreduce'
+if [ "$rc" -ne 0 ]; then
+    unexpected="$(grep -a '^FAILED' "$t1log" \
+        | grep -avE "$KNOWN_ENV_FAILURES" || true)"
+    if [ -n "$unexpected" ] || ! grep -qa '^FAILED' "$t1log"; then
+        echo "premerge: tier-1 tests failed (rc=$rc)" >&2
+        [ -n "$unexpected" ] && echo "$unexpected" >&2
+        exit "$rc"
+    fi
+    echo "premerge: only known-environmental failures; continuing"
+fi
+
+echo "== premerge gate 2/2: bench.py --smoke (CPU, 2 steps/section) =="
+if ! JAX_PLATFORMS=cpu python bench.py --smoke; then
+    echo "premerge: bench smoke failed" >&2
+    exit 1
+fi
+echo "premerge: all gates passed"
