@@ -93,6 +93,7 @@ from .functions import (  # noqa: F401
     to_local,
 )
 from . import autotune  # noqa: F401
+from . import faults  # noqa: F401
 from . import profiler  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401

@@ -82,6 +82,10 @@ def _maybe_init_distributed() -> None:
         ctx = elastic_worker.get_worker_context()
         ctx.apply_to_env(ctx.fetch_assignment())
         ctx.start_polling()
+        # Liveness plane: publish heartbeats so the driver can tell a hung
+        # host (SIGSTOP'd, wedged VM) from a slow one — popen.poll() alone
+        # cannot. No-op when HOROVOD_ELASTIC_HEARTBEAT_INTERVAL <= 0.
+        ctx.start_heartbeat()
 
     coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
     nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)

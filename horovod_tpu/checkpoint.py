@@ -20,6 +20,36 @@ from typing import Any
 
 import jax
 
+from . import faults
+from .utils.env import get_float, get_int
+from .utils.logging import get_logger
+from .utils.retry import call_with_retries
+
+
+def _save_with_retries(attempt, what: str) -> None:
+    """Run one durable-write attempt under the shared bounded-retry policy.
+
+    A job that survives preemption must also survive a transient storage
+    blip (GCS 5xx, NFS hiccup): retry HOROVOD_CHECKPOINT_RETRIES times
+    with exponential backoff before letting the failure kill the job.
+    Every attempt passes through the ``checkpoint.save`` injection point.
+    """
+
+    def one_attempt():
+        if faults.fire(faults.CHECKPOINT_SAVE):
+            raise faults.InjectedFault(f"checkpoint save dropped: {what}")
+        return attempt()
+
+    call_with_retries(
+        one_attempt,
+        attempts=max(1, get_int("HOROVOD_CHECKPOINT_RETRIES", 3)),
+        base_delay=get_float("HOROVOD_CHECKPOINT_RETRY_BACKOFF", 0.5),
+        on_retry=lambda n, e: get_logger().warning(
+            "checkpoint save of %s failed (attempt %d: %s); retrying",
+            what, n, e,
+        ),
+    )
+
 
 class Checkpointer:
     """Orbax-backed checkpoint manager for train state pytrees."""
@@ -42,10 +72,20 @@ class Checkpointer:
         Async by default: returns once the on-device arrays are snapshotted;
         the write to storage overlaps subsequent steps (the TPU-idiomatic
         equivalent of the reference's rank-0 torch.save which blocked the
-        loop)."""
+        loop). The DISPATCH retries transient blips
+        (HOROVOD_CHECKPOINT_RETRIES × HOROVOD_CHECKPOINT_RETRY_BACKOFF).
+        In async mode a storage failure during the BACKGROUND write is
+        outside this retry scope: it surfaces later, unretried, from
+        wait_until_finished / the next save. Where the storage is flaky
+        enough that the write itself needs retrying, construct the
+        Checkpointer with async_save=False so the whole write happens
+        inside the retried dispatch."""
         import orbax.checkpoint as ocp
 
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        _save_with_retries(
+            lambda: self._mgr.save(step, args=ocp.args.StandardSave(state)),
+            what=f"step {step}",
+        )
         if wait:
             self._mgr.wait_until_finished()
 
@@ -82,14 +122,32 @@ class Checkpointer:
 
 def save_on_rank_0(path: str, tree: Any) -> None:
     """The reference idiom (`if hvd.rank() == 0: torch.save(...)`) for small
-    host-side objects; pairs with ``load_and_broadcast``."""
+    host-side objects; pairs with ``load_and_broadcast``. The write retries
+    transient storage blips and lands atomically (tmp + rename), so a
+    failure mid-write can never leave a truncated checkpoint behind."""
     import pickle
 
     from . import basics
 
-    if basics.rank() == 0:
-        with open(path, "wb") as f:
-            pickle.dump(jax.tree.map(lambda x: jax.device_get(x), tree), f)
+    if basics.rank() != 0:
+        return
+    # Serialize once outside the retry loop: only the I/O is transient.
+    data = pickle.dumps(jax.tree.map(lambda x: jax.device_get(x), tree))
+
+    def write():
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)  # no orphaned partial files on failure
+            except OSError:
+                pass
+            raise
+
+    _save_with_retries(write, what=path)
 
 
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:

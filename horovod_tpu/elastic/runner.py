@@ -21,6 +21,8 @@ executable-cache flush, handled in ``shutdown()``).
 from __future__ import annotations
 
 import functools
+import signal
+import threading
 
 from .. import basics
 from ..exceptions import (
@@ -29,6 +31,39 @@ from ..exceptions import (
     RemovedFromWorldError,
 )
 from ..utils.logging import get_logger
+
+# Preemption drain: SIGTERM (the cloud's preemption notice, and the elastic
+# driver's first termination signal) flips this event; the NEXT
+# ``state.commit()`` — i.e. right after a consistent snapshot — raises
+# ``RemovedFromWorldError`` so the worker exits cleanly with EXIT_REMOVED
+# instead of being SIGKILLed mid-step with an uncommitted epoch.
+_drain = threading.Event()
+
+
+def drain_requested() -> bool:
+    return _drain.is_set()
+
+
+def _install_drain_handler() -> None:
+    """Arm the SIGTERM→drain contract (main thread only; signal module
+    refuses handlers elsewhere, and workers embedded in a host process —
+    Ray/Spark actors — must not steal its handlers)."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+    log = get_logger()
+
+    def _on_sigterm(signum, frame):
+        if not _drain.is_set():
+            _drain.set()
+            log.info(
+                "elastic: SIGTERM (preemption notice) — draining: final "
+                "commit, then clean EXIT_REMOVED"
+            )
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # exotic host environments: best-effort
+        pass
 
 
 def run(func):
@@ -48,6 +83,7 @@ def run(func):
 
         log = get_logger()
         notification_manager.init()
+        _install_drain_handler()
         skip_sync = False
         needs_reset = False
         backoff = 0.5

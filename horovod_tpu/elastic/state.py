@@ -27,6 +27,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from ..exceptions import RemovedFromWorldError
 from ..functions import broadcast_object, broadcast_parameters
 
 
@@ -52,10 +53,20 @@ class State:
         """Surface pending driver notifications as HostsUpdatedInterrupt.
 
         Called from commit() (as in the reference: commit is the safe point
-        to interrupt, since it just snapshotted a consistent state).
+        to interrupt, since it just snapshotted a consistent state). The
+        same safe point serves the SIGTERM drain: a preemption notice
+        surfaces HERE — right after the snapshot — as
+        ``RemovedFromWorldError``, so the elastic loop exits cleanly with
+        EXIT_REMOVED instead of dying mid-step.
         """
-        from .runner import notification_manager
+        from ..runner.elastic.worker import record_commit
+        from .runner import drain_requested, notification_manager
 
+        record_commit()  # heartbeat piggyback: commits count as progress
+        if drain_requested():
+            raise RemovedFromWorldError(
+                "SIGTERM drain: state committed; leaving the world cleanly"
+            )
         notification_manager.check_host_updates()
 
     def commit(self) -> None:

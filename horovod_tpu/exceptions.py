@@ -44,6 +44,19 @@ class RemovedFromWorldError(HorovodTpuError):
     """
 
 
+class HostDiscoveryFailedError(HorovodTpuError):
+    """Host discovery failed too many consecutive times.
+
+    Raised by ``HostManager.update_available_hosts`` once the discovery
+    source (script, cloud API) has failed ``HOROVOD_ELASTIC_DISCOVERY_FAILURES``
+    polls in a row. Unlike a single blip — which the driver logs and
+    retries — a sustained streak means the driver is flying blind: it can
+    neither admit recovered hosts nor drop preempted ones, so continuing
+    would silently freeze the elastic world. The driver lets this
+    propagate and fails the job with the cause attached.
+    """
+
+
 class NotInitializedError(HorovodTpuError):
     """An API that requires ``init()`` was called before initialization."""
 

@@ -1,0 +1,226 @@
+"""Deterministic fault-injection harness (the chaos plane).
+
+The reference grew its elastic robustness through a fault-injection test
+pattern (``test/integration/elastic_common.py``: mutate the discovery file,
+kill workers by behavior flag). This module generalizes that into named
+**injection points** wired through the control plane's hot paths:
+
+- ``kv.request``       — every rendezvous KV client request attempt
+- ``discovery.poll``   — every ``HostManager.update_available_hosts`` poll
+- ``worker.step``      — every stall-watched step / fetch dispatch
+- ``heartbeat.send``   — every worker heartbeat publish
+- ``checkpoint.save``  — every durable checkpoint write attempt
+
+Each point can be armed (via API or env) to **drop**, **delay**, **raise**,
+or **hang** on the Nth hit, for a window of consecutive hits — deterministic
+by construction, so chaos tests assert exact trajectories instead of racing
+``kill -9`` against a scheduler.
+
+API::
+
+    from horovod_tpu import faults
+    faults.inject("kv.request", "raise", at=3, count=2)  # 3rd+4th hit fail
+    faults.fire("kv.request")   # called by the instrumented site
+
+Env (reaches subprocess workers; parsed lazily on first ``fire``)::
+
+    HOROVOD_FAULTS="kv.request=raise@3x2;worker.step=hang:30;heartbeat.send=drop@1x999"
+
+Spec grammar: ``point=mode[:arg]@N[xC]`` — arm on the Nth hit (1-based,
+default 1) for C consecutive hits (default 1); ``arg`` is seconds for
+``delay``/``hang``. Points are cheap no-ops when nothing is armed.
+
+Process-level helpers (``suspend``/``resume``/``kill_process``) wrap the
+signals subprocess chaos tests need: SIGSTOP simulates the hung-but-alive
+TPU VM (the failure ``stall.py`` documents — invisible to ``popen.poll``),
+SIGKILL the crashed one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+import time
+
+ENV_SPEC = "HOROVOD_FAULTS"
+
+# Canonical injection-point names (call sites use these constants).
+KV_REQUEST = "kv.request"
+DISCOVERY_POLL = "discovery.poll"
+WORKER_STEP = "worker.step"
+HEARTBEAT_SEND = "heartbeat.send"
+CHECKPOINT_SAVE = "checkpoint.save"
+
+_MODES = ("drop", "delay", "raise", "hang")
+_DEFAULT_HANG_S = 3600.0
+_DEFAULT_DELAY_S = 0.1
+
+
+class InjectedFault(OSError):
+    """Raised by an armed ``raise`` fault.
+
+    Subclasses OSError so every retry/backoff path treats it exactly like
+    the transient I/O failure it impersonates.
+    """
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    point: str
+    mode: str                      # drop | delay | raise | hang
+    arg: float | None = None       # seconds for delay/hang
+    at: int = 1                    # 1-based hit index the fault arms on
+    count: int = 1                 # consecutive hits it stays armed for
+
+    def armed_for(self, hit: int) -> bool:
+        return self.at <= hit < self.at + self.count
+
+
+def parse_spec(spec: str) -> list[FaultSpec]:
+    """Parse the ``HOROVOD_FAULTS`` grammar; invalid entries raise."""
+    out: list[FaultSpec] = []
+    for entry in spec.replace(",", ";").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        point, _, rhs = entry.partition("=")
+        if not rhs:
+            raise ValueError(f"fault spec {entry!r}: missing '=mode'")
+        mode_arg, _, window = rhs.partition("@")
+        mode, _, arg = mode_arg.partition(":")
+        if mode not in _MODES:
+            raise ValueError(
+                f"fault spec {entry!r}: unknown mode {mode!r} "
+                f"(expected one of {_MODES})"
+            )
+        at, count = 1, 1
+        if window:
+            n, _, c = window.partition("x")
+            at = int(n)
+            count = int(c) if c else 1
+        out.append(FaultSpec(
+            point=point.strip(),
+            mode=mode,
+            arg=float(arg) if arg else None,
+            at=at,
+            count=count,
+        ))
+    return out
+
+
+class _Registry:
+    """Armed faults + per-point hit/fire counters (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._specs: dict[str, FaultSpec] = {}
+        self._hits: dict[str, int] = {}
+        self._fired: dict[str, int] = {}
+        self._env_loaded = False
+
+    def _load_env_locked(self) -> None:
+        if self._env_loaded:
+            return
+        self._env_loaded = True
+        spec = os.environ.get(ENV_SPEC, "")
+        if not spec:
+            return
+        for s in parse_spec(spec):
+            # API-armed faults win over the env (tests layer on top).
+            self._specs.setdefault(s.point, s)
+
+    def inject(self, point: str, mode: str, arg: float | None = None,
+               at: int = 1, count: int = 1) -> None:
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}")
+        with self._lock:
+            self._specs[point] = FaultSpec(point, mode, arg, at, count)
+            self._hits.pop(point, None)
+            self._fired.pop(point, None)
+
+    def clear(self, point: str) -> None:
+        with self._lock:
+            self._specs.pop(point, None)
+
+    def reset(self) -> None:
+        """Drop every armed fault and counter; re-read env on next fire."""
+        with self._lock:
+            self._specs.clear()
+            self._hits.clear()
+            self._fired.clear()
+            self._env_loaded = False
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def fired(self, point: str) -> int:
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    def active(self) -> dict[str, FaultSpec]:
+        with self._lock:
+            self._load_env_locked()
+            return dict(self._specs)
+
+    def fire(self, point: str) -> bool:
+        """One hit at an injection point.
+
+        Returns True when the caller must DROP the operation (skip it with
+        that call site's drop semantics), False to proceed. ``delay``/
+        ``hang`` sleep here then proceed; ``raise`` raises InjectedFault.
+        """
+        with self._lock:
+            self._load_env_locked()
+            hit = self._hits.get(point, 0) + 1
+            self._hits[point] = hit  # counted even unarmed: tests assert
+            spec = self._specs.get(point)  # exact attempt trajectories
+            if spec is None or not spec.armed_for(hit):
+                return False
+            self._fired[point] = self._fired.get(point, 0) + 1
+        # Actions run OUTSIDE the lock (sleeps must not serialize peers).
+        if spec.mode == "drop":
+            return True
+        if spec.mode == "delay":
+            time.sleep(spec.arg if spec.arg is not None else _DEFAULT_DELAY_S)
+            return False
+        if spec.mode == "hang":
+            time.sleep(spec.arg if spec.arg is not None else _DEFAULT_HANG_S)
+            return False
+        raise InjectedFault(f"injected fault at {point!r} (hit {hit})")
+
+
+_registry = _Registry()
+
+# Module-level facade — what call sites and tests use.
+inject = _registry.inject
+clear = _registry.clear
+reset = _registry.reset
+hits = _registry.hits
+fired = _registry.fired
+active = _registry.active
+fire = _registry.fire
+
+
+# -- process-level chaos helpers (subprocess tests) --------------------------
+
+def suspend(pid: int) -> None:
+    """SIGSTOP a process: hung-but-alive, the hang ``popen.poll`` cannot
+    see — only the heartbeat liveness plane catches it."""
+    os.kill(pid, signal.SIGSTOP)
+
+
+def resume(pid: int) -> None:
+    os.kill(pid, signal.SIGCONT)
+
+
+def kill_process(pid: int, sig: int = signal.SIGKILL) -> None:
+    os.kill(pid, sig)
+
+
+def self_suspend() -> None:
+    """A worker SIGSTOPs itself — the deterministic in-process way for a
+    chaos-test worker to become a hung host at an exact step."""
+    os.kill(os.getpid(), signal.SIGSTOP)

@@ -5,3 +5,14 @@
 # success (which would end the whole job) nor failure (which would
 # blacklist a healthy host).
 EXIT_REMOVED = 202
+
+# Exit code for a worker that gave up on a lost driver: the rendezvous KV
+# stayed unreachable past HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT. Distinct
+# from EXIT_REMOVED so an operator (or a supervising scheduler) can tell
+# "the driver dropped me" from "the driver vanished" at a glance.
+EXIT_DRIVER_LOST = 203
+
+# Consecutive KV poll failures before the worker escalates its logging
+# from debug to warning (the first couple of blips are routine — a driver
+# mid-reconfiguration answers late; a streak is a signal).
+POLL_FAILURE_WARN_AFTER = 3

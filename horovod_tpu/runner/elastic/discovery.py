@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Callable, Sequence
 
+from ... import faults
+from ...exceptions import HostDiscoveryFailedError
 from ..hosts import HostInfo
 
 
@@ -117,10 +119,20 @@ class HostManager:
         discovery: HostDiscovery,
         valid_sizes: Callable[[int], bool] | None = None,
         cooldown_s: float | None = None,
+        max_discovery_failures: int | None = None,
     ):
-        from ...utils.env import get_float
+        from ...utils.env import get_float, get_int
 
         self._discovery = discovery
+        # A single discovery blip is routine (script timeout, cloud API
+        # hiccup) and the driver retries it; a STREAK of
+        # HOROVOD_ELASTIC_DISCOVERY_FAILURES consecutive failures means
+        # the driver is blind to the fleet and must fail loudly instead
+        # of freezing the elastic world forever. 0 disables escalation.
+        self._max_discovery_failures = (
+            get_int("HOROVOD_ELASTIC_DISCOVERY_FAILURES", 10)
+            if max_discovery_failures is None else max_discovery_failures)
+        self._discovery_failures = 0
         self._lock = threading.Lock()
         self._current: dict[str, int] = {}
         # host -> blacklist timestamp. With a cooldown
@@ -137,8 +149,31 @@ class HostManager:
         self._valid = valid_sizes or (lambda n: n >= 1)
 
     def update_available_hosts(self) -> bool:
-        """Poll discovery; returns True if the usable host set changed."""
-        found = self._discovery.find_available_hosts_and_slots()
+        """Poll discovery; returns True if the usable host set changed.
+
+        Raises :class:`HostDiscoveryFailedError` after
+        ``max_discovery_failures`` CONSECUTIVE poll failures (one success
+        resets the streak); below that the underlying exception propagates
+        so the caller can log-and-retry as before.
+        """
+        try:
+            if faults.fire(faults.DISCOVERY_POLL):
+                return False  # injected drop: this poll never happened
+            found = self._discovery.find_available_hosts_and_slots()
+        except HostDiscoveryFailedError:
+            raise
+        except Exception as e:
+            self._discovery_failures += 1
+            if (self._max_discovery_failures > 0
+                    and self._discovery_failures
+                    >= self._max_discovery_failures):
+                raise HostDiscoveryFailedError(
+                    f"host discovery failed {self._discovery_failures} "
+                    f"consecutive times (last: {e}); the elastic driver "
+                    "cannot see the fleet — giving up"
+                ) from e
+            raise
+        self._discovery_failures = 0
         with self._lock:
             # 'before' is the PRE-PRUNE view — the world the caller last
             # acted on. A cooldown expiry must read as a change whether

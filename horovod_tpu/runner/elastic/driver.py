@@ -26,11 +26,14 @@ import json
 import os
 import time
 
+from ...exceptions import HostDiscoveryFailedError
+from ...utils.env import get_float
 from ...utils.logging import get_logger
 from ..exec_utils import (
     WorkerProc,
     build_worker_env,
     launch_worker,
+    terminate_worker,
     terminate_workers,
 )
 from ..hosts import HostInfo, get_host_assignments
@@ -38,7 +41,10 @@ from ..http.kv_server import RendezvousServer
 from ..network import coordinator_addr, driver_addr, free_port
 from .discovery import FixedHostDiscovery, HostDiscoveryScript, HostManager
 
-from .constants import EXIT_REMOVED  # noqa: E402  (re-export for driver users)
+from .constants import (  # noqa: E402  (EXIT_REMOVED re-exported for users)
+    EXIT_DRIVER_LOST,
+    EXIT_REMOVED,
+)
 
 WORLD_SCOPE = "world"
 
@@ -68,12 +74,25 @@ class ElasticDriver:
         os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
         self._server = RendezvousServer()
         self._workers: dict[str, WorkerProc] = {}
+        self._launched_at: dict[str, float] = {}  # host -> monotonic launch
+        self._driver_lost_counts: dict[str, int] = {}  # consecutive rc=203
         self._world_hosts: list[HostInfo] = []
         self._coord_port: int = 0
         self._native_port: int = 0
         self._shutdown = False
         self._min_np = settings.min_np or 1
         self._max_np = settings.max_np
+        # Liveness plane: a host silent for hb_timeout seconds is declared
+        # dead (hung, not crashed — popen.poll() cannot see it) and is
+        # killed/blacklisted like a failure. 0 disables enforcement (a
+        # worker that never heartbeats — plain scripts — stays safe by
+        # default). A host that has NEVER heartbeated gets hb_grace from
+        # its launch instead, covering interpreter/framework startup.
+        self._hb_timeout = get_float("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", 0.0)
+        self._hb_grace = get_float(
+            "HOROVOD_ELASTIC_HEARTBEAT_GRACE",
+            max(10.0 * self._hb_timeout, 60.0),
+        )
 
     # -- world formation -----------------------------------------------------
 
@@ -84,6 +103,8 @@ class ElasticDriver:
         while True:
             try:
                 self._manager.update_available_hosts()
+            except HostDiscoveryFailedError:
+                raise  # sustained streak: the driver is blind — fail loudly
             except Exception as e:  # discovery script hiccup: retry
                 self._log.warning("elastic: discovery failed (%s); retrying", e)
             hosts = self._manager.pick_world(
@@ -166,6 +187,12 @@ class ElasticDriver:
                 "elastic: launching worker on %s (process %d/%d, v%d)",
                 a.hostname, a.rank, a.size, version,
             )
+            # Fresh liveness record per launch: a relaunched host must
+            # neither inherit its predecessor's recent heartbeat (masking
+            # a hung start) nor its silence (instant condemnation) — it
+            # gets the never-heartbeated grace window from launch instead.
+            self._server.clear_heartbeat(a.hostname)
+            self._launched_at[a.hostname] = time.monotonic()
             self._workers[a.hostname] = launch_worker(
                 a, self._settings.command, env,
                 ssh_port=self._settings.ssh_port, sink=self._sink,
@@ -184,6 +211,8 @@ class ElasticDriver:
         leaving = [n for n in self._workers if n not in keep]
         for name in leaving:
             self._log.info("elastic: removing worker on %s", name)
+            self._server.clear_heartbeat(name)
+            self._launched_at.pop(name, None)
         terminate_workers([self._workers.pop(n) for n in leaving])
         version = self._publish_world(hosts)
         self._launch_missing_workers(version)
@@ -203,6 +232,35 @@ class ElasticDriver:
             terminate_workers(list(self._workers.values()))
             self._server.stop()
 
+    def _dead_by_heartbeat(self) -> list[tuple[str, str]]:
+        """Hosts the liveness plane has declared dead: (host, why) pairs.
+
+        A host is dead when its last heartbeat is older than hb_timeout,
+        or — if it has NEVER heartbeated — when hb_grace has elapsed since
+        its launch (interpreter startup, framework import). popen.poll()
+        cannot see either case: a SIGSTOP'd process, a wedged TPU VM, or a
+        livelocked trainer is still "running" to the OS.
+        """
+        if self._hb_timeout <= 0:
+            return []
+        dead: list[tuple[str, str]] = []
+        now = time.monotonic()
+        for name, w in self._workers.items():
+            if w.popen.poll() is not None:
+                continue  # exited: the reap path owns it
+            age = self._server.heartbeat_age(name)
+            if age is None:
+                launched = self._launched_at.get(name)
+                if launched is not None and now - launched >= self._hb_grace:
+                    dead.append((name, (
+                        f"no heartbeat within {self._hb_grace:.0f}s "
+                        "grace of launch")))
+            elif age >= self._hb_timeout:
+                dead.append((name, (
+                    f"heartbeat silent for {age:.0f}s "
+                    f"(timeout {self._hb_timeout:.0f}s)")))
+        return dead
+
     def _monitor(self) -> int:
         last_poll = 0.0
         while True:
@@ -215,6 +273,8 @@ class ElasticDriver:
             for name, w in finished.items():
                 rc = w.popen.returncode
                 del self._workers[name]
+                self._launched_at.pop(name, None)
+                self._server.clear_heartbeat(name)
                 if rc == 0:
                     # Success on any worker ⇒ the job completed (reference
                     # semantics: the training function returned).
@@ -225,10 +285,56 @@ class ElasticDriver:
                     # not a failure, not job completion.
                     self._log.info("elastic: removed worker on %s exited", name)
                     continue
+                if rc == EXIT_DRIVER_LOST:
+                    # The worker gave up on an unreachable rendezvous KV.
+                    # If we are here to see it, the driver process is alive
+                    # — a partition or KV fault, i.e. a CONTROL-PLANE
+                    # problem, not a host problem: relaunch the worker but
+                    # do not poison the blacklist with a healthy host.
+                    # Capped: a PERSISTENT per-host KV fault (firewalled
+                    # port) must not churn the whole fleet through a
+                    # reconfiguration every driver-loss deadline forever —
+                    # after 3 consecutive 203s the host is blacklisted
+                    # like any failure.
+                    n = self._driver_lost_counts.get(name, 0) + 1
+                    self._driver_lost_counts[name] = n
+                    if n <= 3:
+                        self._log.error(
+                            "elastic: worker on %s lost the rendezvous KV "
+                            "(rc=%d, %d consecutive) — control-plane "
+                            "fault, not a host fault; relaunching without "
+                            "blacklisting", name, rc, n,
+                        )
+                        need_reconfigure = True
+                        continue
+                    self._log.error(
+                        "elastic: worker on %s lost the rendezvous KV %d "
+                        "consecutive times — persistent; blacklisting",
+                        name, n,
+                    )
+                    del self._driver_lost_counts[name]
+                    self._manager.blacklist(name)
+                    need_reconfigure = True
+                    continue
+                self._driver_lost_counts.pop(name, None)
                 self._log.warning(
                     "elastic: worker on %s failed (rc=%d); blacklisting",
                     name, rc,
                 )
+                self._manager.blacklist(name)
+                need_reconfigure = True
+            # 1b. Liveness plane: kill + blacklist hosts the heartbeat
+            # deadline has condemned (hung, not crashed — invisible to the
+            # reap above). terminate_worker escalates SIGTERM→SIGKILL, and
+            # SIGKILL lands even on a SIGSTOP'd process.
+            for name, why in self._dead_by_heartbeat():
+                self._log.warning(
+                    "elastic: worker on %s is hung (%s); killing and "
+                    "blacklisting", name, why,
+                )
+                terminate_worker(self._workers.pop(name))
+                self._launched_at.pop(name, None)
+                self._server.clear_heartbeat(name)
                 self._manager.blacklist(name)
                 need_reconfigure = True
             if need_reconfigure:
@@ -239,6 +345,8 @@ class ElasticDriver:
                 last_poll = time.time()
                 try:
                     changed = self._manager.update_available_hosts()
+                except HostDiscoveryFailedError:
+                    raise  # sustained streak: fail the job loudly
                 except Exception as e:
                     self._log.warning("elastic: discovery failed: %s", e)
                     changed = False

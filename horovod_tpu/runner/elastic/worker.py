@@ -1,4 +1,5 @@
-"""Worker-side elastic plumbing: world-version polling + assignment fetch.
+"""Worker-side elastic plumbing: world-version polling, heartbeats,
+assignment fetch.
 
 Parity with ``horovod/runner/elastic/worker.py`` (``WorkerNotificationClient``
 / ``WorkerNotificationService``), inverted for the KV-polling contract (see
@@ -6,6 +7,22 @@ Parity with ``horovod/runner/elastic/worker.py`` (``WorkerNotificationClient``
 worker, workers poll the rendezvous KV's world version — a bump arms
 ``notification_manager`` so the next ``state.commit()`` raises
 ``HostsUpdatedInterrupt`` (SURVEY.md §4.4 recovery loop).
+
+Liveness plane (the hung-host gap): alongside the poller, each worker
+publishes a heartbeat to ``PUT /heartbeat/<host>`` every
+``HOROVOD_ELASTIC_HEARTBEAT_INTERVAL`` seconds, piggybacking its step and
+commit counters. The driver's monitor declares a host dead after
+``HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT`` of silence — a SIGSTOP'd process, a
+wedged TPU VM, or a livelocked trainer all stop heartbeating (every thread
+freezes), which ``popen.poll()`` alone can never see.
+
+Driver-loss escalation: the poll loop counts consecutive KV failures,
+raises its logging to ``warning`` after ``POLL_FAILURE_WARN_AFTER``, and —
+once failures have been continuous for
+``HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT`` seconds — exits the process with
+``EXIT_DRIVER_LOST`` instead of polling a dead driver forever (the main
+thread may be wedged in a collective precisely because the world died, so
+the poller owns the exit).
 """
 
 from __future__ import annotations
@@ -13,26 +30,61 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
+from ... import faults
 from ...elastic.runner import notification_manager
+from ...utils.env import get_float
 from ...utils.logging import get_logger
-from ..http.kv_server import KVClient
+from ..http.kv_server import HEARTBEAT_SCOPE, KVClient
+from .constants import EXIT_DRIVER_LOST, POLL_FAILURE_WARN_AFTER
 
 
 def elastic_enabled() -> bool:
     return os.environ.get("HOROVOD_ELASTIC", "") == "1"
 
 
+class _HeartbeatCounters:
+    """Process-wide progress counters piggybacked on every heartbeat, so
+    the driver's liveness record doubles as a progress trace."""
+
+    __slots__ = ("steps", "commits")
+
+    def __init__(self):
+        self.steps = 0
+        self.commits = 0
+
+
+_counters = _HeartbeatCounters()
+
+
+def record_step() -> None:
+    _counters.steps += 1
+
+
+def record_commit() -> None:
+    _counters.commits += 1
+
+
 class ElasticWorkerContext:
     """This worker's view of the elastic world, refreshed per epoch."""
 
-    def __init__(self):
+    def __init__(self, on_driver_lost=None):
         addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
         port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
         self.hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
         self.client = KVClient(addr, port)
+        # Dedicated heartbeat client: ONE attempt, short timeout. The beat
+        # loop itself is the retry — a beat that inherited the full KV
+        # retry budget (3 × 10s timeout + backoff) could block the sender
+        # past the driver's heartbeat deadline and get a healthy worker
+        # killed for the very silence the budget was absorbing.
+        self._hb_client = KVClient(addr, port, timeout=2.0, retries=1)
         self.version = int(os.environ.get("HOROVOD_WORLD_VERSION", "0"))
+        self.consecutive_poll_failures = 0
+        self._on_driver_lost = on_driver_lost or self._exit_driver_lost
         self._poller: threading.Thread | None = None
+        self._heartbeater: threading.Thread | None = None
         self._stop = threading.Event()
 
     def fetch_assignment(self, version: int | None = None) -> dict:
@@ -88,27 +140,111 @@ class ElasticWorkerContext:
             return True
         return False
 
+    # -- poll loop (with driver-loss escalation) -----------------------------
+
+    def _exit_driver_lost(self, silent_s: float) -> None:
+        get_logger().error(
+            "elastic: rendezvous KV unreachable for %.0fs "
+            "(%d consecutive poll failures) — driver lost; exiting %d",
+            silent_s, self.consecutive_poll_failures, EXIT_DRIVER_LOST,
+        )
+        # os._exit, not sys.exit: this runs on the poller thread while the
+        # main thread may be wedged in a collective whose peers died with
+        # the driver — a SystemExit there would never be seen.
+        os._exit(EXIT_DRIVER_LOST)
+
     def start_polling(self, interval: float = 1.0) -> None:
         if self._poller is not None:
             return
+        lost_timeout = get_float("HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT", 300.0)
 
         def loop():
+            log = get_logger()
+            first_failure: float | None = None
             while not self._stop.wait(interval):
                 try:
                     self.check_for_update()
                 except Exception as e:  # KV unreachable: driver died/restarting
-                    get_logger().debug("elastic poll failed: %s", e)
+                    now = time.monotonic()
+                    if first_failure is None:
+                        first_failure = now
+                    self.consecutive_poll_failures += 1
+                    n = self.consecutive_poll_failures
+                    if n >= POLL_FAILURE_WARN_AFTER:
+                        log.warning(
+                            "elastic poll failed (%d consecutive, "
+                            "driver silent %.0fs): %s",
+                            n, now - first_failure, e,
+                        )
+                    else:
+                        log.debug("elastic poll failed: %s", e)
+                    if (lost_timeout > 0
+                            and now - first_failure >= lost_timeout):
+                        self._on_driver_lost(now - first_failure)
+                else:
+                    if self.consecutive_poll_failures >= \
+                            POLL_FAILURE_WARN_AFTER:
+                        log.info(
+                            "elastic: rendezvous KV reachable again after "
+                            "%d failed polls", self.consecutive_poll_failures,
+                        )
+                    self.consecutive_poll_failures = 0
+                    first_failure = None
 
         self._poller = threading.Thread(
             target=loop, name="hvd-elastic-poll", daemon=True
         )
         self._poller.start()
 
+    # -- heartbeat sender ----------------------------------------------------
+
+    def send_heartbeat(self) -> bool:
+        """Publish one heartbeat; returns False when dropped/failed.
+
+        Failures are swallowed (the poll loop owns driver-loss escalation;
+        a missed heartbeat only matters to the DRIVER's deadline)."""
+        if faults.fire(faults.HEARTBEAT_SEND):
+            return False  # injected drop: silence, exactly like a hang
+        payload = json.dumps({
+            "steps": _counters.steps,
+            "commits": _counters.commits,
+            "time": time.time(),
+        }).encode()
+        try:
+            self._hb_client.put(HEARTBEAT_SCOPE, self.hostname, payload)
+            return True
+        except Exception as e:
+            get_logger().debug("elastic: heartbeat send failed: %s", e)
+            return False
+
+    def start_heartbeat(self, interval: float | None = None) -> None:
+        if self._heartbeater is not None:
+            return
+        if interval is None:
+            interval = get_float("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", 2.0)
+        if interval <= 0:
+            return  # explicitly disabled
+
+        def loop():
+            # First beat immediately: the driver's never-heartbeated grace
+            # window should cover process startup, not the first interval.
+            self.send_heartbeat()
+            while not self._stop.wait(interval):
+                self.send_heartbeat()
+
+        self._heartbeater = threading.Thread(
+            target=loop, name="hvd-elastic-heartbeat", daemon=True
+        )
+        self._heartbeater.start()
+
     def stop_polling(self) -> None:
         self._stop.set()
         if self._poller:
             self._poller.join(timeout=5)
             self._poller = None
+        if self._heartbeater:
+            self._heartbeater.join(timeout=5)
+            self._heartbeater = None
 
 
 _context: ElasticWorkerContext | None = None

@@ -27,13 +27,22 @@ No key set = open dev mode.
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
+from ... import faults
+from ...utils.env import get_float, get_int
+from ...utils.retry import call_with_retries
 from .. import secret as _secret
 
 AUTH_HEADER = "X-Hvd-Auth"
+
+# Liveness scope: workers PUT /heartbeat/<host>; the server records the
+# RECEIVE time (server clock — worker clocks don't enter the liveness
+# decision, so skew/NTP steps on preempted VMs can't fake death or life).
+HEARTBEAT_SCOPE = "heartbeat"
 
 
 def _auth_payload(method: str, path: str, body: bytes) -> bytes:
@@ -96,6 +105,10 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         with self.server.lock:  # type: ignore[attr-defined]
             self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
+            if scope == HEARTBEAT_SCOPE:
+                # Liveness plane: stamp the receive time on the SERVER
+                # clock (driver-side monotonic; worker clocks irrelevant).
+                self.server.hb_times[key] = time.monotonic()  # type: ignore[attr-defined]
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
@@ -121,6 +134,7 @@ class RendezvousServer:
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.version = 0  # type: ignore[attr-defined]
+        self._httpd.hb_times = {}  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
         self._httpd.secret = _secret.current_key()  # type: ignore[attr-defined]
@@ -163,6 +177,36 @@ class RendezvousServer:
             self._httpd.version = version  # type: ignore[attr-defined]
             return version
 
+    # -- heartbeat liveness plane -------------------------------------------
+
+    def heartbeat_ages(self) -> dict[str, float]:
+        """Seconds since each host's last heartbeat (server clock)."""
+        now = time.monotonic()
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return {h: now - t
+                    for h, t in self._httpd.hb_times.items()}  # type: ignore[attr-defined]
+
+    def heartbeat_age(self, host: str) -> float | None:
+        """Seconds since `host`'s last heartbeat, or None if never seen."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            t = self._httpd.hb_times.get(host)  # type: ignore[attr-defined]
+        return None if t is None else time.monotonic() - t
+
+    def heartbeat_payload(self, host: str) -> bytes | None:
+        """The host's last heartbeat body (JSON: step/commit counters)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(  # type: ignore[attr-defined]
+                HEARTBEAT_SCOPE, {}).get(host)
+
+    def clear_heartbeat(self, host: str) -> None:
+        """Forget a host's liveness record (worker relaunch/removal): a
+        stale timestamp must neither mask a hung relaunch nor instantly
+        condemn a fresh one."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.hb_times.pop(host, None)  # type: ignore[attr-defined]
+            self._httpd.store.get(  # type: ignore[attr-defined]
+                HEARTBEAT_SCOPE, {}).pop(host, None)
+
     def stop(self) -> None:
         self._httpd.shutdown()
         if self._thread:
@@ -172,18 +216,45 @@ class RendezvousServer:
 
 class KVClient:
     """Worker-side client for the rendezvous KV server. Signs every
-    request with the job secret when HOROVOD_SECRET_KEY is set."""
+    request with the job secret when HOROVOD_SECRET_KEY is set.
 
-    def __init__(self, addr: str, port: int, timeout: float = 10.0):
+    Every request retries transient transport failures with bounded
+    exponential backoff + jitter (``HOROVOD_KV_RETRIES`` attempts, base
+    ``HOROVOD_KV_RETRY_BACKOFF`` seconds): a driver mid-restart or a
+    network blip below the retry budget is fully absorbed, while a dead
+    driver still surfaces as an exception the caller's escalation path
+    (``worker.start_polling``) can act on — never an unbounded silent
+    retry. HTTP status answers (404 = no value, 403 = bad auth) are
+    answers, not blips, and propagate immediately.
+    """
+
+    def __init__(self, addr: str, port: int, timeout: float = 10.0,
+                 retries: int | None = None, backoff: float | None = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        self._retries = (get_int("HOROVOD_KV_RETRIES", 3)
+                         if retries is None else retries)
+        self._backoff = (get_float("HOROVOD_KV_RETRY_BACKOFF", 0.1)
+                         if backoff is None else backoff)
 
     def _request(self, method: str, path: str, body: bytes | None = None):
-        req = Request(f"{self._base}{path}", data=body, method=method)
-        tag = _secret.sign(_auth_payload(method, path, body or b""))
-        if tag:
-            req.add_header(AUTH_HEADER, tag)
-        return urlopen(req, timeout=self._timeout)
+        def attempt():
+            if faults.fire(faults.KV_REQUEST):
+                # drop: the request never happened — to the caller that is
+                # a transport failure, so surface it as one (and retry).
+                raise faults.InjectedFault(f"kv request dropped: {path}")
+            req = Request(f"{self._base}{path}", data=body, method=method)
+            tag = _secret.sign(_auth_payload(method, path, body or b""))
+            if tag:
+                req.add_header(AUTH_HEADER, tag)
+            return urlopen(req, timeout=self._timeout)
+
+        return call_with_retries(
+            attempt,
+            attempts=max(1, self._retries),
+            base_delay=self._backoff,
+            give_up_on=(HTTPError,),
+        )
 
     def put(self, scope: str, key: str, value: bytes) -> None:
         with self._request("PUT", f"/{scope}/{key}", value):

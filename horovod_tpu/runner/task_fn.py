@@ -9,7 +9,8 @@ the driver is done (or after ``--ttl`` seconds as a safety net).
 from __future__ import annotations
 
 import argparse
-import time
+import signal
+import threading
 
 from .driver_service import TaskService
 
@@ -23,7 +24,16 @@ def main() -> int:
     svc = TaskService(port=args.port)
     port = svc.start()
     print(f"HVD_TASK_SERVICE_PORT={port}", flush=True)
-    time.sleep(args.ttl)
+    # SIGTERM (driver teardown / preemption notice) ends the TTL wait
+    # immediately and exits 0 — a probe service has nothing to drain, so
+    # an interruptible wait is the whole graceful-shutdown story (the old
+    # time.sleep forced the driver to wait out SIGKILL escalation).
+    done = threading.Event()
+    try:
+        signal.signal(signal.SIGTERM, lambda *_: done.set())
+    except ValueError:  # not the main thread (embedded use): TTL only
+        pass
+    done.wait(args.ttl)
     svc.stop()
     return 0
 

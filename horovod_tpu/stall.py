@@ -24,6 +24,7 @@ import contextlib
 import threading
 import time
 
+from . import faults
 from .utils.env import get_float
 from .utils.logging import get_logger
 
@@ -168,6 +169,17 @@ def watch(name: str | None = None, timeout_s: float | None = None,
 
     from .process_world import size as _proc_size
 
+    # Chaos plane: the `worker.step` injection point fires on every
+    # watched dispatch — `hang`/`delay` wedge this controller right here
+    # (the liveness/stall planes must catch it), `raise` fails the step.
+    # The drop return is meaningless for a step and ignored.
+    faults.fire(faults.WORKER_STEP)
+    from .runner.elastic.worker import elastic_enabled, record_step
+
+    if elastic_enabled():
+        # Heartbeat piggyback: count watched steps so the driver's
+        # liveness record doubles as a progress trace.
+        record_step()
     if timeout_s is None:
         shutdown_s = get_float("HOROVOD_STALL_SHUTDOWN_TIME", 0.0)
         timeout_s = shutdown_s if shutdown_s > 0 else 1e9

@@ -1,0 +1,83 @@
+"""Bounded retry with exponential backoff + jitter.
+
+The one retry policy the control plane shares: rendezvous KV requests
+(``runner/http/kv_server.py — KVClient``), durable checkpoint writes
+(``checkpoint.py``), and anything else that talks to a service that can
+blip. Bounded by construction — the unbounded-silent-retry loops this
+replaces are exactly what let a dead driver hang a worker forever.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Iterable, TypeVar
+
+T = TypeVar("T")
+
+
+def call_with_retries(
+    fn: Callable[[], T],
+    *,
+    attempts: int = 3,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+    retry_on: tuple[type[BaseException], ...] = (Exception,),
+    give_up_on: tuple[type[BaseException], ...] = (),
+    deadline_s: float | None = None,
+    on_retry: Callable[[int, BaseException], None] | None = None,
+) -> T:
+    """Call ``fn`` up to ``attempts`` times.
+
+    Backoff before attempt k+1 is ``min(max_delay, base_delay * 2**(k-1))``
+    scaled by a uniform ``1 ± jitter`` factor (jitter decorrelates a fleet
+    of workers hammering a recovering driver). ``give_up_on`` exceptions
+    propagate immediately (e.g. an HTTP 404 is an answer, not a blip);
+    ``deadline_s`` bounds total wall time regardless of attempts left.
+    """
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    start = time.monotonic()
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except give_up_on:
+            raise
+        except retry_on as e:
+            if attempt >= attempts:
+                raise
+            if deadline_s is not None and \
+                    time.monotonic() - start >= deadline_s:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay *= 1.0 + random.uniform(-jitter, jitter)
+            time.sleep(max(0.0, delay))
+    raise AssertionError("unreachable")
+
+
+def retrying(**retry_kwargs) -> Callable[[Callable[..., T]], Callable[..., T]]:
+    """Decorator form: ``@retrying(attempts=5, base_delay=0.5)``."""
+    def deco(fn: Callable[..., T]) -> Callable[..., T]:
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return call_with_retries(
+                lambda: fn(*args, **kwargs), **retry_kwargs)
+        return wrapped
+    return deco
+
+
+def iter_backoff(
+    attempts: int,
+    base_delay: float = 0.1,
+    max_delay: float = 5.0,
+    jitter: float = 0.5,
+) -> Iterable[float]:
+    """The bare delay schedule (for loops that retry inline)."""
+    for attempt in range(1, attempts):
+        delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+        yield max(0.0, delay * (1.0 + random.uniform(-jitter, jitter)))

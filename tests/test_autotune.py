@@ -296,7 +296,8 @@ class TestTransparentAutotune:
             p, s, _loss = step(p, s, b)
         assert hvd.autotune.tuned_threshold() == best
 
-    def test_hvdrun_autotune_reaches_compiled_path(self, tmp_path):
+    def test_hvdrun_autotune_reaches_compiled_path(
+            self, tmp_path, require_multiprocess_cpu_collectives):
         """hvdrun --autotune: the flag lands as HOROVOD_AUTOTUNE=1 in the
         workers and the compiled-path tuner pins the SAME decision on
         every rank (rank 0 broadcasts — the threshold changes the traced

@@ -68,6 +68,37 @@ class TestHostManager:
         assert m.update_available_hosts() is True   # a came BACK
         assert [h.hostname for h in m.usable_hosts()] == ["a", "b"]
 
+    def test_blacklist_cooldown_readmission_under_churn(self):
+        """Cooldown expiry racing a JOIN: host a fails and is blacklisted;
+        while its cooldown runs out, a brand-new host c appears in
+        discovery. The next poll must report a change (driving exactly one
+        reconfiguration), and the next world must re-admit a AND admit c
+        with stable ranks: the still-running host b keeps position 0, the
+        returner and the joiner append behind it."""
+
+        class MutableDiscovery(FixedHostDiscovery):
+            def set_hosts(self, hosts):
+                self._hosts = {h.hostname: h.slots for h in hosts}
+
+        d = MutableDiscovery([HostInfo("a", 1), HostInfo("b", 1)])
+        m = HostManager(d, cooldown_s=0.2)
+        m.update_available_hosts()
+        assert [h.hostname for h in m.pick_world([], None)] == ["a", "b"]
+
+        m.blacklist("a")  # a's worker failed
+        assert [h.hostname for h in m.pick_world(["a", "b"], None)] == ["b"]
+        assert m.update_available_hosts() is False  # steady state, a banned
+
+        # Churn: c joins discovery while a's cooldown expires.
+        d.set_hosts([HostInfo("a", 1), HostInfo("b", 1), HostInfo("c", 1)])
+        time.sleep(0.25)
+        assert m.update_available_hosts() is True
+        world = m.pick_world(["b"], max_np=None)
+        assert [h.hostname for h in world] == ["b", "a", "c"]
+        # And the change signal is edge-triggered: no further churn, no
+        # further reconfigurations.
+        assert m.update_available_hosts() is False
+
     def test_pick_world_stability_and_cap(self):
         m = HostManager(
             FixedHostDiscovery(

@@ -1,0 +1,810 @@
+"""Chaos suite: the fault-injection harness (`horovod_tpu/faults.py`) and
+the robustness layer it drives — bounded KV retries, the heartbeat liveness
+plane, driver-loss escalation, discovery-failure escalation, checkpoint
+retries, and the SIGTERM drain.
+
+Determinism contract: every failure here is *injected* (named injection
+points armed on exact hit counts, or SIGSTOP at an exact epoch), so the
+tests assert exact trajectories — which hit failed, which retry absorbed
+it, which exit code surfaced — instead of racing kill -9 against a
+scheduler."""
+
+import json
+import logging
+import os
+import pickle
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.runner.elastic.constants import (
+    EXIT_DRIVER_LOST,
+    EXIT_REMOVED,
+    POLL_FAILURE_WARN_AFTER,
+)
+from horovod_tpu.runner.http.kv_server import (
+    HEARTBEAT_SCOPE,
+    KVClient,
+    RendezvousServer,
+)
+from horovod_tpu.utils.retry import call_with_retries, iter_backoff, retrying
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts and ends with a disarmed chaos plane."""
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# -- the harness itself ------------------------------------------------------
+
+
+class TestFaultSpecGrammar:
+    def test_full_grammar(self):
+        specs = faults.parse_spec(
+            "kv.request=raise@3x2; worker.step=hang:30; "
+            "heartbeat.send=drop@1x999,discovery.poll=delay:0.5"
+        )
+        by_point = {s.point: s for s in specs}
+        assert by_point["kv.request"].mode == "raise"
+        assert (by_point["kv.request"].at, by_point["kv.request"].count) == (3, 2)
+        assert by_point["worker.step"].arg == 30.0
+        assert by_point["heartbeat.send"].count == 999
+        assert by_point["discovery.poll"].arg == 0.5
+
+    def test_defaults(self):
+        (s,) = faults.parse_spec("kv.request=raise")
+        assert (s.at, s.count, s.arg) == (1, 1, None)
+
+    def test_invalid_entries_raise(self):
+        with pytest.raises(ValueError):
+            faults.parse_spec("kv.request")  # no mode
+        with pytest.raises(ValueError):
+            faults.parse_spec("kv.request=explode")  # unknown mode
+        with pytest.raises(ValueError):
+            faults.parse_spec("kv.request=raise@x")  # bad window
+
+    def test_armed_window(self):
+        (s,) = faults.parse_spec("p=raise@3x2")
+        assert [s.armed_for(h) for h in (1, 2, 3, 4, 5)] == [
+            False, False, True, True, False]
+
+
+class TestFire:
+    def test_unarmed_is_noop(self):
+        assert faults.fire("kv.request") is False
+        assert faults.hits("kv.request") == 1
+        assert faults.fired("kv.request") == 0
+
+    def test_raise_on_nth_hit_window(self):
+        faults.inject("p", "raise", at=2, count=2)
+        assert faults.fire("p") is False            # hit 1: below window
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p")                        # hit 2
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p")                        # hit 3
+        assert faults.fire("p") is False            # hit 4: past window
+        assert faults.fired("p") == 2
+
+    def test_drop_returns_true(self):
+        faults.inject("p", "drop")
+        assert faults.fire("p") is True
+        assert faults.fire("p") is False
+
+    def test_delay_sleeps_then_proceeds(self):
+        faults.inject("p", "delay", arg=0.05)
+        t0 = time.monotonic()
+        assert faults.fire("p") is False
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_injected_fault_is_oserror(self):
+        # Retry paths treat the impersonated blip like any transient I/O
+        # failure — only if the exception type cooperates.
+        assert issubclass(faults.InjectedFault, OSError)
+
+    def test_env_arming_and_reset(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_SPEC, "p=raise@2")
+        faults.reset()  # forget state; re-read env lazily on next fire
+        assert faults.fire("p") is False
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("p")
+        monkeypatch.delenv(faults.ENV_SPEC)
+        faults.reset()
+        assert faults.fire("p") is False  # disarmed again
+
+    def test_api_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_SPEC, "p=raise@1x99")
+        faults.reset()
+        faults.inject("p", "drop")  # test layers over the env spec
+        assert faults.fire("p") is True
+
+
+class TestRetryHelper:
+    def test_bounded_attempts_then_raise(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError("blip")
+
+        with pytest.raises(OSError):
+            call_with_retries(flaky, attempts=3, base_delay=0.001)
+        assert len(calls) == 3
+
+    def test_absorbs_failures_below_budget(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("blip")
+            return "ok"
+
+        assert call_with_retries(flaky, attempts=3, base_delay=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_give_up_on_propagates_immediately(self):
+        calls = []
+
+        def answer():
+            calls.append(1)
+            raise KeyError("an answer, not a blip")
+
+        with pytest.raises(KeyError):
+            call_with_retries(
+                answer, attempts=5, base_delay=0.001, give_up_on=(KeyError,))
+        assert len(calls) == 1
+
+    def test_on_retry_hook_sees_each_failure(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise OSError("blip")
+            return 42
+
+        out = call_with_retries(
+            flaky, attempts=5, base_delay=0.001,
+            on_retry=lambda n, e: seen.append((n, str(e))))
+        assert out == 42
+        assert [n for n, _ in seen] == [1, 2]
+
+    def test_decorator_form(self):
+        calls = []
+
+        @retrying(attempts=2, base_delay=0.001)
+        def fn():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("blip")
+            return "done"
+
+        assert fn() == "done"
+
+    def test_backoff_schedule_is_bounded(self):
+        delays = list(iter_backoff(6, base_delay=0.1, max_delay=0.4, jitter=0))
+        assert delays == [0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+# -- KV client retries against a real rendezvous server ----------------------
+
+
+@pytest.fixture()
+def kv_server():
+    server = RendezvousServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+class TestKVClientRetries:
+    def test_faults_below_budget_fully_absorbed(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port, retries=3,
+                          backoff=0.01)
+        faults.inject(faults.KV_REQUEST, "raise", at=1, count=2)
+        client.put("s", "k", b"v")  # attempts 1+2 injected, 3 lands
+        assert faults.fired(faults.KV_REQUEST) == 2
+        faults.clear(faults.KV_REQUEST)
+        assert client.get("s", "k") == b"v"
+
+    def test_faults_above_budget_surface(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port, retries=3,
+                          backoff=0.01)
+        faults.inject(faults.KV_REQUEST, "raise", at=1, count=99)
+        with pytest.raises(faults.InjectedFault):
+            client.put("s", "k", b"v")
+        assert faults.fired(faults.KV_REQUEST) == 3  # bounded, not forever
+
+    def test_http_answers_are_not_retried(self, kv_server):
+        # A 404 is an answer (no value), not a transport blip: exactly one
+        # attempt, no backoff burned.
+        client = KVClient("127.0.0.1", kv_server.port, retries=3,
+                          backoff=0.01)
+        assert client.get("s", "missing") is None
+        assert faults.hits(faults.KV_REQUEST) == 1
+
+    def test_injected_drop_retries_like_transport_loss(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port, retries=2,
+                          backoff=0.01)
+        faults.inject(faults.KV_REQUEST, "drop", at=1, count=1)
+        client.put("s", "k2", b"v2")  # dropped once, retried, landed
+        assert client.get("s", "k2") == b"v2"
+
+
+# -- heartbeat liveness plane (unit) -----------------------------------------
+
+
+class TestHeartbeatPlane:
+    @pytest.fixture()
+    def worker_ctx(self, kv_server, monkeypatch):
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(kv_server.port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        return ElasticWorkerContext()
+
+    def test_heartbeat_records_server_time_and_counters(
+            self, kv_server, worker_ctx):
+        from horovod_tpu.runner.elastic import worker as worker_mod
+
+        worker_mod.record_step()
+        worker_mod.record_commit()
+        assert worker_ctx.send_heartbeat() is True
+        age = kv_server.heartbeat_age("hostA")
+        assert age is not None and age < 5.0
+        payload = json.loads(kv_server.heartbeat_payload("hostA"))
+        assert payload["steps"] >= 1 and payload["commits"] >= 1
+        assert kv_server.heartbeat_age("hostB") is None  # never seen
+
+    def test_injected_drop_means_silence(self, kv_server, worker_ctx):
+        faults.inject(faults.HEARTBEAT_SEND, "drop", at=1, count=999)
+        assert worker_ctx.send_heartbeat() is False
+        assert kv_server.heartbeat_age("hostA") is None
+
+    def test_clear_heartbeat_forgets_liveness_and_payload(
+            self, kv_server, worker_ctx):
+        assert worker_ctx.send_heartbeat() is True
+        kv_server.clear_heartbeat("hostA")
+        assert kv_server.heartbeat_age("hostA") is None
+        assert kv_server.heartbeat_payload("hostA") is None
+
+    def test_heartbeat_ages_snapshot(self, kv_server, worker_ctx):
+        assert worker_ctx.send_heartbeat() is True
+        ages = kv_server.heartbeat_ages()
+        assert set(ages) == {"hostA"} and ages["hostA"] < 5.0
+
+
+# -- worker poll loop escalation (unit) --------------------------------------
+
+
+class TestPollEscalation:
+    def test_warns_after_streak_and_calls_driver_lost(self, monkeypatch):
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+        from horovod_tpu.runner.network import free_port
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(free_port()))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        monkeypatch.setenv("HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT", "0.4")
+        monkeypatch.setenv("HOROVOD_KV_RETRIES", "1")
+        lost = []
+        ctx = ElasticWorkerContext(on_driver_lost=lost.append)
+        ctx.start_polling(interval=0.05)
+        deadline = time.time() + 10
+        while time.time() < deadline and not lost:
+            time.sleep(0.05)
+        ctx.stop_polling()
+        assert lost, "driver-loss deadline never fired"
+        assert lost[0] >= 0.4  # reported silence covers the deadline
+        assert ctx.consecutive_poll_failures >= POLL_FAILURE_WARN_AFTER
+
+    def test_success_resets_streak(self, kv_server, monkeypatch):
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(kv_server.port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        ctx = ElasticWorkerContext()
+        ctx.consecutive_poll_failures = 7
+        ctx.start_polling(interval=0.05)
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and ctx.consecutive_poll_failures != 0):
+            time.sleep(0.05)
+        ctx.stop_polling()
+        assert ctx.consecutive_poll_failures == 0
+
+
+# -- discovery escalation (unit) ---------------------------------------------
+
+
+class TestDiscoveryEscalation:
+    def test_consecutive_failures_become_fatal(self):
+        from horovod_tpu.exceptions import HostDiscoveryFailedError
+        from horovod_tpu.runner.elastic.discovery import (
+            HostDiscovery,
+            HostManager,
+        )
+
+        class Flaky(HostDiscovery):
+            def __init__(self):
+                self.fail = True
+
+            def find_available_hosts_and_slots(self):
+                if self.fail:
+                    raise OSError("cloud API down")
+                return {"a": 1}
+
+        d = Flaky()
+        m = HostManager(d, max_discovery_failures=3)
+        for _ in range(2):  # below the budget: the blip propagates as-is
+            with pytest.raises(OSError):
+                m.update_available_hosts()
+        with pytest.raises(HostDiscoveryFailedError):  # streak hits 3
+            m.update_available_hosts()
+        # One success resets the streak entirely.
+        d.fail = False
+        assert m.update_available_hosts() is True
+        d.fail = True
+        with pytest.raises(OSError):  # back to streak position 1
+            m.update_available_hosts()
+
+    def test_injected_poll_faults(self):
+        from horovod_tpu.exceptions import HostDiscoveryFailedError
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHostDiscovery,
+            HostManager,
+        )
+        from horovod_tpu.runner.hosts import HostInfo
+
+        m = HostManager(FixedHostDiscovery([HostInfo("a", 1)]),
+                        max_discovery_failures=2)
+        faults.inject(faults.DISCOVERY_POLL, "drop", at=1, count=1)
+        assert m.update_available_hosts() is False  # poll never happened
+        faults.inject(faults.DISCOVERY_POLL, "raise", at=1, count=99)
+        with pytest.raises(faults.InjectedFault):
+            m.update_available_hosts()
+        with pytest.raises(HostDiscoveryFailedError):
+            m.update_available_hosts()
+
+
+# -- worker.step injection point ---------------------------------------------
+
+
+class TestWorkerStepInjection:
+    def test_raise_fails_the_watched_step(self):
+        from horovod_tpu import stall
+
+        faults.inject(faults.WORKER_STEP, "raise")
+        with pytest.raises(faults.InjectedFault):
+            with stall.watch(name="chaos", cross_rank=False):
+                pass
+
+    def test_step_counter_feeds_heartbeat(self, monkeypatch):
+        from horovod_tpu import stall
+        from horovod_tpu.runner.elastic import worker as worker_mod
+
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        before = worker_mod._counters.steps
+        with stall.watch(name="counted", cross_rank=False):
+            pass
+        assert worker_mod._counters.steps == before + 1
+
+
+# -- checkpoint retries ------------------------------------------------------
+
+
+class TestCheckpointRetries:
+    def test_save_on_rank_0_absorbs_blips_below_budget(
+            self, tmp_path, monkeypatch):
+        from horovod_tpu.checkpoint import save_on_rank_0
+
+        monkeypatch.setenv("HOROVOD_CHECKPOINT_RETRY_BACKOFF", "0.01")
+        path = str(tmp_path / "ckpt.pkl")
+        faults.inject(faults.CHECKPOINT_SAVE, "raise", at=1, count=2)
+        save_on_rank_0(path, {"w": np.ones(3, np.float32)})
+        assert faults.fired(faults.CHECKPOINT_SAVE) == 2
+        with open(path, "rb") as f:
+            tree = pickle.load(f)
+        assert np.allclose(tree["w"], 1.0)
+
+    def test_save_on_rank_0_exhausted_leaves_no_partial_file(
+            self, tmp_path, monkeypatch):
+        from horovod_tpu.checkpoint import save_on_rank_0
+
+        monkeypatch.setenv("HOROVOD_CHECKPOINT_RETRY_BACKOFF", "0.01")
+        path = str(tmp_path / "ckpt.pkl")
+        faults.inject(faults.CHECKPOINT_SAVE, "raise", at=1, count=99)
+        with pytest.raises(faults.InjectedFault):
+            save_on_rank_0(path, {"w": np.ones(3, np.float32)})
+        assert not os.path.exists(path)  # atomic: no truncated checkpoint
+
+    def test_checkpointer_save_retries(self, tmp_path, monkeypatch):
+        pytest.importorskip("orbax.checkpoint")
+        from horovod_tpu.checkpoint import Checkpointer
+
+        monkeypatch.setenv("HOROVOD_CHECKPOINT_RETRY_BACKOFF", "0.01")
+        ckpt = Checkpointer(str(tmp_path / "ck"), async_save=False)
+        faults.inject(faults.CHECKPOINT_SAVE, "raise", at=1, count=1)
+        ckpt.save(0, {"w": np.ones(3, np.float32)}, wait=True)
+        assert faults.fired(faults.CHECKPOINT_SAVE) == 1
+        assert ckpt.latest_step() == 0
+        ckpt.close()
+
+
+# -- SIGTERM drain -----------------------------------------------------------
+
+
+class TestSigtermDrain:
+    def test_drain_surfaces_after_commit_snapshot(self):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import runner as elastic_runner
+        from horovod_tpu.exceptions import RemovedFromWorldError
+
+        state = ObjectState(epoch=3)
+        elastic_runner._drain.set()
+        try:
+            with pytest.raises(RemovedFromWorldError):
+                state.commit()
+            # The snapshot landed BEFORE the interrupt: nothing to lose.
+            assert state._saved["epoch"] == 3
+        finally:
+            elastic_runner._drain.clear()
+
+    def test_sigterm_drains_to_exit_removed(self, tmp_path):
+        """End to end, real signal: a worker mid-training receives SIGTERM
+        (a preemption notice), finishes its commit, and exits EXIT_REMOVED
+        — not SIGKILL, not a traceback."""
+        script = tmp_path / "drain_worker.py"
+        script.write_text(f"""
+import os, sys, time
+sys.path.insert(0, {REPO_ROOT!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ObjectState, run as elastic_run
+
+hvd.init()
+state = ObjectState(epoch=0)
+
+@elastic_run
+def train(state):
+    while True:
+        time.sleep(0.05)
+        state.epoch += 1
+        state.commit()
+        print("epoch=%d" % state.epoch, flush=True)
+
+train(state)
+""")
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 120
+            saw_epoch = False
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "epoch=" in line:
+                    saw_epoch = True
+                    break
+            assert saw_epoch, "worker never reached its first commit"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+            assert rc == EXIT_REMOVED, rc
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+
+# -- driver loss: worker exits EXIT_DRIVER_LOST ------------------------------
+
+
+class TestDriverLost:
+    def test_worker_exits_driver_lost_when_kv_dies(self, tmp_path):
+        """The real poller against a real rendezvous KV: the server stops
+        (driver killed) and the worker exits EXIT_DRIVER_LOST within the
+        configured deadline instead of polling a corpse forever."""
+        server = RendezvousServer()
+        server.start()
+        script = tmp_path / "lost_worker.py"
+        script.write_text(f"""
+import os, sys, time
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+ctx = ElasticWorkerContext()
+ctx.start_polling(interval=0.1)
+print("POLLING", flush=True)
+time.sleep(120)
+sys.exit(5)  # the poller should have exited the process long before
+""")
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_RENDEZVOUS_PORT": str(server.port),
+            "HOROVOD_HOSTNAME": "hostA",
+            "HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT": "2.0",
+            "HOROVOD_KV_RETRIES": "1",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.time() + 120
+            polling = False
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "POLLING" in line:
+                    polling = True
+                    break
+            assert polling, "worker never started polling"
+            time.sleep(0.5)  # a few healthy polls first
+            t0 = time.monotonic()
+            server.stop()  # the driver "dies"
+            rc = proc.wait(timeout=60)
+            elapsed = time.monotonic() - t0
+            assert rc == EXIT_DRIVER_LOST, rc
+            # Deadline 2s + poll/retry slack: well inside the bound.
+            assert elapsed < 30, elapsed
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+
+    def test_exit_driver_lost_relaunches_without_blacklisting(self, tmp_path):
+        """A worker exiting EXIT_DRIVER_LOST reports a control-plane fault,
+        not a host fault: the driver must relaunch on the SAME host instead
+        of blacklisting it (with one host and min_np=1, a blacklist would
+        strand the job in a below-min_np timeout)."""
+        from horovod_tpu.runner.elastic.driver import run_elastic
+        from horovod_tpu.runner.launch import Settings
+
+        worker = tmp_path / "lost_once_worker.py"
+        worker.write_text(f"""
+import os, sys
+marker = os.environ["TEST_TMP"] + "/lost_once"
+if not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit({EXIT_DRIVER_LOST})
+print("second life on %s ok" % os.environ["HOROVOD_HOSTNAME"], flush=True)
+""")
+        script, _ = _write_discovery(tmp_path, ["localhost"])
+        settings = Settings(
+            num_proc=1,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=False,
+            elastic=True,
+            min_np=1,
+            max_np=None,
+            discovery_script=script,
+            elastic_timeout=10.0,
+            env={"TEST_TMP": str(tmp_path)},
+        )
+        lines = []
+        assert run_elastic(settings, sink=lines.append) == 0
+        assert any("second life on localhost ok" in l for l in lines), lines
+
+
+# -- end-to-end chaos with the real ElasticDriver ----------------------------
+
+
+def _write_discovery(tmp_path, hosts):
+    import stat
+
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("\n".join(hosts) + "\n")
+    script = tmp_path / "discover.sh"
+    script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    return str(script), hosts_file
+
+
+class TestKVFaultAbsorptionE2E:
+    def test_injected_kv_faults_below_budget_job_completes(self, tmp_path):
+        """HOROVOD_FAULTS reaches the subprocess worker via env; two
+        injected transport failures on its first KV request are absorbed
+        by the client's retry budget and the job completes rc=0."""
+        from horovod_tpu.runner.elastic.driver import run_elastic
+        from horovod_tpu.runner.launch import Settings
+
+        worker = tmp_path / "kv_worker.py"
+        worker.write_text(f"""
+import os, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu import faults
+from horovod_tpu.runner.http.kv_server import KVClient
+
+host = os.environ["HOROVOD_HOSTNAME"]
+client = KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                  int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
+v = client.world_version()  # first logical request: eats both injections
+a = client.get("world/%d" % v, host)
+assert a is not None, "no assignment"
+print("absorbed=%d ok v=%d" % (faults.fired(faults.KV_REQUEST), v),
+      flush=True)
+""")
+        script, _ = _write_discovery(tmp_path, ["localhost"])
+        settings = Settings(
+            num_proc=1,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=False,
+            elastic=True,
+            min_np=1,
+            max_np=None,
+            discovery_script=script,
+            elastic_timeout=20.0,
+            env={
+                "HOROVOD_FAULTS": "kv.request=raise@1x2",
+                "HOROVOD_KV_RETRY_BACKOFF": "0.01",
+            },
+        )
+        lines = []
+        assert run_elastic(settings, sink=lines.append) == 0
+        assert any("absorbed=2 ok" in l for l in lines), lines
+
+
+class TestHungWorkerLiveness:
+    """The gap this PR closes, end to end: a SIGSTOP'd worker (hung, not
+    crashed — invisible to popen.poll) is declared dead by the heartbeat
+    deadline, killed, blacklisted; the survivor takes the internal error,
+    restores its last commit, re-forms the world, and finishes with loss
+    continuity against the exact expected schedule."""
+
+    @pytest.mark.slow
+    def test_sigstopped_worker_detected_killed_blacklisted(
+            self, tmp_path, monkeypatch):
+        import re
+
+        torch = pytest.importorskip("torch")
+
+        from horovod_tpu.runner.elastic.driver import run_elastic
+        from horovod_tpu.runner.launch import Settings
+        from horovod_tpu.utils.logging import get_logger
+
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "3.0")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", "0.3")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_GRACE", "90")
+        worker = tmp_path / "hung_worker.py"
+        worker.write_text(f"""
+import os, sys
+sys.path.insert(0, {REPO_ROOT!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import numpy as np
+import torch
+import horovod_tpu.torch as hvd
+from horovod_tpu import faults
+from horovod_tpu.elastic import run as elastic_run
+from horovod_tpu.torch.elastic import TorchState
+
+host = os.environ["HOROVOD_HOSTNAME"]
+
+torch.manual_seed(0)
+model = torch.nn.Linear(4, 1, bias=False)
+opt = hvd.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.05),
+    named_parameters=model.named_parameters())
+state = TorchState(model=model, optimizer=opt, epoch=0)
+
+@elastic_run
+def train(state):
+    while state.epoch < 5:
+        if host == "localhost" and state.epoch == 2:
+            print("host=%s HANGING (SIGSTOP) at epoch 2" % host,
+                  flush=True)
+            faults.self_suspend()  # hung, not crashed
+        r = hvd.rank()
+        x = torch.from_numpy(np.random.RandomState(
+            100 * state.epoch + r).randn(8, 4).astype(np.float32))
+        opt.zero_grad()
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        print("rank=%d epoch=%d np=%d loss=%.6f" % (
+            r, state.epoch, hvd.size(), float(loss)), flush=True)
+        state.epoch += 1
+        state.commit()
+    return state.epoch
+
+done = train(state)
+print("host=%s finished at epoch %d" % (host, done), flush=True)
+""")
+        script, _ = _write_discovery(tmp_path, ["localhost", "127.0.0.1"])
+        settings = Settings(
+            num_proc=2,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=True,
+            elastic=True,
+            min_np=1,
+            max_np=2,
+            discovery_script=script,
+            elastic_timeout=60.0,
+            env={},
+        )
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda rec: records.append(rec.getMessage())
+        logger = get_logger()
+        logger.addHandler(handler)
+        lines = []
+        try:
+            rc = run_elastic(settings, sink=lines.append)
+        finally:
+            logger.removeHandler(handler)
+        text = "\n".join(lines)
+        assert rc == 0, text
+        assert "HANGING (SIGSTOP) at epoch 2" in text, text
+        assert any("finished at epoch 5" in l for l in lines), text
+        # The liveness plane — not the reaper — made the call.
+        assert any("is hung" in m and "blacklisting" in m
+                   for m in records), records
+
+        # Loss continuity: epochs 0-1 averaged across both ranks, epochs
+        # 2-4 solo on the survivor (it can never pass epoch 2's collective
+        # while the peer is suspended, so the switch point is exact).
+        torch.manual_seed(0)
+        m = torch.nn.Linear(4, 1, bias=False)
+        sgd = torch.optim.SGD(m.parameters(), lr=0.05)
+        expected = {}
+        for e in (0, 1):
+            grads = []
+            for r in range(2):
+                x = torch.from_numpy(np.random.RandomState(
+                    100 * e + r).randn(8, 4).astype(np.float32))
+                sgd.zero_grad()
+                loss = (m(x) ** 2).mean()
+                expected[(e, r)] = float(loss.detach())
+                loss.backward()
+                grads.append([p.grad.clone() for p in m.parameters()])
+            with torch.no_grad():
+                for p, g0, g1 in zip(m.parameters(), *grads):
+                    p.grad = (g0 + g1) / 2
+            sgd.step()
+        for e in (2, 3, 4):
+            x = torch.from_numpy(np.random.RandomState(
+                100 * e).randn(8, 4).astype(np.float32))
+            sgd.zero_grad()
+            loss = (m(x) ** 2).mean()
+            expected[(e, 0)] = float(loss.detach())
+            loss.backward()
+            sgd.step()
+
+        seen = {}
+        for line in text.splitlines():
+            match = re.search(
+                r"rank=(\d+) epoch=(\d+) np=(\d+) loss=([0-9.]+)", line)
+            if match:
+                r, e, np_, l = (int(match.group(1)), int(match.group(2)),
+                                int(match.group(3)), float(match.group(4)))
+                seen[(e, r)] = (np_, l)
+        for (e, r), want in expected.items():
+            assert (e, r) in seen, ((e, r), sorted(seen))
+            got_np, got = seen[(e, r)]
+            assert got_np == (2 if e < 2 else 1), (e, r, got_np)
+            assert abs(got - want) < 1e-4, (e, r, got, want)

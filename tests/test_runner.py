@@ -270,7 +270,8 @@ class TestStaticLaunch:
         assert "XLA:TPU" in out and "elastic" in out
 
     @pytest.mark.slow
-    def test_e2e_multiprocess_allreduce(self, tmp_path):
+    def test_e2e_multiprocess_allreduce(
+            self, tmp_path, require_multiprocess_cpu_collectives):
         """Full stack: hvdrun → 2 processes → jax.distributed world →
         cross-process eager allreduce (the launcher analog of the
         reference's `horovodrun -np 2 python -c "hvd.allreduce(...)"`)."""

@@ -1,13 +1,14 @@
 #!/usr/bin/env bash
 # Default pre-merge check: the tier-1 test suite (ROADMAP.md's verify
-# command, verbatim) followed by a 2-step CPU smoke of bench.py — the
-# bench exercises the full machinery (DistributedOptimizer wire, raw
-# baseline, forced-wire, overlap scheduler) end to end, which unit tests
-# alone do not. Run from anywhere; exits nonzero if either gate fails.
+# command, verbatim), the fault-injection smoke lane (chaos coverage must
+# not silently rot), then a 2-step CPU smoke of bench.py — the bench
+# exercises the full machinery (DistributedOptimizer wire, raw baseline,
+# forced-wire, overlap scheduler) end to end, which unit tests alone do
+# not. Run from anywhere; exits nonzero if any gate fails.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== premerge gate 1/2: tier-1 tests =="
+echo "== premerge gate 1/3: tier-1 tests =="
 t1log="$(mktemp "${TMPDIR:-/tmp}/_t1.XXXXXX.log")"  # per-run: concurrent
 trap 'rm -f "$t1log"' EXIT                          # premerges must not clobber
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -33,7 +34,18 @@ if [ "$rc" -ne 0 ]; then
     echo "premerge: only known-environmental failures; continuing"
 fi
 
-echo "== premerge gate 2/2: bench.py --smoke (CPU, 2 steps/section) =="
+echo "== premerge gate 2/3: fault-injection smoke (chaos lane) =="
+# The FULL chaos file, slow marks included: the e2e liveness/recovery
+# tests are the acceptance proof for the robustness layer and must not
+# rot just because tier-1 deselects @slow.
+if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_faults.py -q --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly; then
+    echo "premerge: fault-injection smoke failed" >&2
+    exit 1
+fi
+
+echo "== premerge gate 3/3: bench.py --smoke (CPU, 2 steps/section) =="
 if ! JAX_PLATFORMS=cpu python bench.py --smoke; then
     echo "premerge: bench smoke failed" >&2
     exit 1
