@@ -51,6 +51,7 @@ from .exceptions import (  # noqa: F401
     HorovodTpuError,
     HostsUpdatedInterrupt,
     NotInitializedError,
+    RecoveryExhaustedError,
 )
 from .ops import (  # noqa: F401
     Adasum,
@@ -92,6 +93,7 @@ from .functions import (  # noqa: F401
     masked_average,
     to_local,
 )
+from . import abort  # noqa: F401
 from . import autotune  # noqa: F401
 from . import faults  # noqa: F401
 from . import profiler  # noqa: F401

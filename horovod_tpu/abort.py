@@ -1,0 +1,239 @@
+"""Coordinated abort: turn stall/liveness *detection* into cluster-wide
+*recovery*.
+
+The dominant real-world hang at pod scale is a wedged collective: one host
+dies or diverges and every healthy survivor blocks forever inside a native
+allreduce / ``jax.block_until_ready`` with no one to tell it to stop. The
+heartbeat liveness plane and the stall inspector can *detect* that state
+(PR 2), but detection that ends in a log line leaves the survivors wedged.
+
+This module is the recovery signal between the two planes:
+
+- The rendezvous KV carries a monotonic **world generation** (the epoch
+  version the elastic driver bumps on every reconfiguration) plus an
+  ``abort/<generation>`` record. The **driver** posts it whenever it
+  kills/blacklists a host or reaps an unclean exit; any **worker** whose
+  stall inspector crosses ``HOROVOD_STALL_SHUTDOWN_TIME`` posts it too —
+  detection from *either* plane triggers recovery *everywhere*.
+- Every worker runs a lightweight abort monitor (dedicated 1-attempt/
+  2s-timeout KV client, started with the elastic poll loop) that mirrors
+  the remote flag into process-local state here.
+- Every blocking site — ``NativeWorld.synchronize``, ``stall.watch`` /
+  ``hvd.fetch``, factory train steps — calls :func:`raise_if_aborted`
+  while it waits, converting the wedge into ``HorovodInternalError``
+  within one poll interval. That exception is exactly what the elastic
+  ``@hvd.elastic.run`` loop already knows how to recover from
+  (restore → re-rendezvous → continue), so survivors self-heal instead of
+  hanging.
+
+Abort records are keyed by generation and **consumed once**: the elastic
+loop calls :func:`consume` when it eats the failure, and
+:func:`joined_generation` when a worker (re-)joins a world epoch, so a
+record from the pre-recovery world can never re-abort the re-formed one.
+The ``abort.poll`` injection point lets the chaos lane delay propagation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+from . import faults
+from .utils.env import get_float
+from .utils.logging import get_logger
+
+ABORT_SCOPE = "abort"
+
+
+def poll_interval() -> float:
+    """How often blocking sites and the monitor check the abort flag.
+
+    Bounds the unblock latency of a wedged survivor: detection-to-recovery
+    is at most the detector's deadline plus this interval."""
+    return get_float("HOROVOD_ABORT_POLL_INTERVAL", 0.5)
+
+
+def current_generation() -> int:
+    """The generation of the world this process is actually IN.
+
+    The elastic worker context's *joined* version is the source of truth:
+    the generation of the epoch the worker last fetched an assignment
+    for. (Not the freshest version its poller has observed — a survivor
+    wedged in world g's collectives is still in world g even after g+1
+    was announced, and its abort posts/polls must key on g.) The env
+    contract is the fallback for processes that never built a context."""
+    from .runner.elastic import worker as elastic_worker
+
+    ctx = elastic_worker._context
+    if ctx is not None:
+        return ctx.joined_version
+    try:
+        return int(os.environ.get("HOROVOD_WORLD_VERSION", "0") or 0)
+    except ValueError:
+        return 0
+
+
+class _AbortState:
+    """Process-wide abort flag (thread-safe). One instance per process."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+        self._reason = ""
+        self._generation = -1
+        self._record: bytes | None = None
+        self._consumed: bytes | None = None
+
+    def trigger(self, reason: str, generation: int,
+                record: bytes | None = None) -> bool:
+        """Locally arm the abort. Returns False when this exact record was
+        already consumed (a survivor must not re-abort on the same record
+        it just recovered from)."""
+        with self._lock:
+            if record is not None and record == self._consumed:
+                return False
+            if self._event.is_set():
+                # Already armed; first reason wins — but track the LATEST
+                # observed record so consume() marks what the monitor will
+                # keep polling (two hosts posting for the same generation
+                # overwrite each other in the KV; consuming only the first
+                # would let the survivor's record re-abort us post-recovery).
+                if record is not None:
+                    self._record = record
+                return True
+            self._reason = reason
+            self._generation = generation
+            self._record = record
+            self._event.set()
+        get_logger().error(
+            "coordinated abort (world generation %d): %s — unblocking and "
+            "entering elastic recovery", generation, reason,
+        )
+        return True
+
+    def consume(self) -> None:
+        """Eat the armed abort (the elastic loop caught its
+        HorovodInternalError): clear the local flag and remember the
+        record so the monitor does not re-trigger on it."""
+        with self._lock:
+            if self._record is not None:
+                self._consumed = self._record
+            self._record = None
+            self._event.clear()
+
+    def mark_stale(self, record: bytes) -> None:
+        """Remember ``record`` as consumed without ever arming: used when
+        (re-)joining a generation whose abort record predates the join —
+        it describes a failure the re-formed world already recovered
+        from, not one this worker must act on."""
+        with self._lock:
+            self._consumed = record
+
+    def is_aborted(self) -> bool:
+        return self._event.is_set()
+
+    def snapshot(self) -> tuple[str, int]:
+        with self._lock:
+            return self._reason, self._generation
+
+    def reset(self) -> None:
+        """Full reset (tests only): forget the flag AND the consumed
+        record."""
+        with self._lock:
+            self._event.clear()
+            self._reason = ""
+            self._generation = -1
+            self._record = None
+            self._consumed = None
+
+
+_state = _AbortState()
+
+is_aborted = _state.is_aborted
+consume = _state.consume
+reset = _state.reset
+
+
+def trigger_local(reason: str, generation: int | None = None) -> None:
+    """Arm the abort from in-process detection (stall inspector shutdown)
+    without any KV round trip."""
+    gen = current_generation() if generation is None else generation
+    _state.trigger(reason, gen)
+
+
+def raise_if_aborted() -> None:
+    """The hook every blocking site polls: converts an armed abort into
+    the elastic recovery exception. Cheap (one Event check) when nothing
+    is armed."""
+    if _state.is_aborted():
+        from .exceptions import HorovodInternalError
+
+        reason, gen = _state.snapshot()
+        raise HorovodInternalError(
+            f"coordinated abort (world generation {gen}): {reason}"
+        )
+
+
+def joined_generation(generation: int,
+                      stale_record: bytes | None = None) -> None:
+    """A worker (re-)joined world epoch ``generation``: any abort armed
+    for the pre-recovery world is moot — consume it so the re-formed
+    world starts clean. ``stale_record`` (the abort record already
+    present for this generation at join time, if any — stall-only
+    recoveries rejoin the SAME generation and its record is never
+    deleted) is marked consumed so it cannot spuriously re-abort the
+    worker that just recovered from it."""
+    _state.consume()
+    if stale_record is not None:
+        _state.mark_stale(stale_record)
+
+
+def poll_once(client, generation: int | None = None) -> bool:
+    """One abort-flag poll against the rendezvous KV.
+
+    ``client`` should be a dedicated lightweight KVClient (1 attempt,
+    short timeout) — the poll must never inherit a fat retry budget that
+    would stretch the unblock latency it exists to bound. Returns True
+    when an abort was (already or newly) armed for this generation.
+    """
+    if faults.fire(faults.ABORT_POLL):
+        return False  # injected drop: propagation delayed this round
+    gen = current_generation() if generation is None else generation
+    record = client.get(ABORT_SCOPE, str(gen))
+    if record is None:
+        return _state.is_aborted()
+    try:
+        reason = json.loads(record).get("reason", "unknown")
+    except (ValueError, AttributeError):
+        reason = record.decode(errors="replace")
+    return _state.trigger(str(reason), gen, record=record)
+
+
+def post(reason: str, generation: int | None = None) -> None:
+    """Worker-side abort posting (the stall inspector's shutdown path):
+    publish ``abort/<generation>`` so every peer's monitor picks it up,
+    then arm the local flag. Best-effort on the network side — a worker
+    whose KV is unreachable still unblocks itself locally."""
+    gen = current_generation() if generation is None else generation
+    record = json.dumps({
+        "reason": reason,
+        "host": os.environ.get("HOROVOD_HOSTNAME", socket.gethostname()),
+        "time": time.time(),
+    }).encode()
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    port = os.environ.get("HOROVOD_RENDEZVOUS_PORT", "")
+    if addr and port:
+        try:
+            from .runner.http.kv_server import KVClient
+
+            KVClient(addr, int(port), timeout=2.0, retries=1).put(
+                ABORT_SCOPE, str(gen), record)
+        except Exception as e:  # noqa: BLE001 — local unblock still happens
+            get_logger().warning(
+                "could not post coordinated abort to the rendezvous KV "
+                "(%s); peers will rely on their own detection", e,
+            )
+    _state.trigger(reason, gen, record=record)

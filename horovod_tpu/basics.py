@@ -132,7 +132,7 @@ def _exchange_coordinator_port(coord: str, proc_id: int) -> str:
     port = int(os.environ.get("HOROVOD_RENDEZVOUS_PORT", "-1") or -1)
     if not addr or port < 0:
         return coord  # manual launch: trust the env as given
-    from .runner.http.kv_server import KVClient
+    from .runner.http.kv_server import KVClient, env_generation
     from .runner.network import free_port, routable_addr
 
     host = coord.rsplit(":", 1)[0]
@@ -142,7 +142,9 @@ def _exchange_coordinator_port(coord: str, proc_id: int) -> str:
         host = routable_addr()
     version = os.environ.get("HOROVOD_WORLD_VERSION", "static")
     scope = f"coord/{version}"
-    kv = KVClient(addr, port)
+    # Generation-fenced: a zombie rank 0 resumed from a pre-abort world
+    # must not republish a stale coordinator endpoint.
+    kv = KVClient(addr, port, generation_fn=env_generation)
     if proc_id == 0:
         chosen = f"{host}:{free_port()}"
         kv.put(scope, "addr", chosen.encode())

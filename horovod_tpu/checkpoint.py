@@ -15,15 +15,53 @@ in-memory commit/restore cycle.
 
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import Any
 
 import jax
 
 from . import faults
+from .exceptions import CheckpointCorruptError
 from .utils.env import get_float, get_int
 from .utils.logging import get_logger
 from .utils.retry import call_with_retries
+
+# Integrity footer for rank-0 pickle checkpoints: payload ‖ sha256(payload)
+# ‖ magic. pickle.load ignores trailing bytes, so footered files stay
+# readable by plain pickle, and pre-footer files (no magic) load as-is.
+_CKPT_MAGIC = b"HVDCKSM1"
+_FOOTER_LEN = 32 + len(_CKPT_MAGIC)
+
+
+def _with_footer(payload: bytes) -> bytes:
+    return payload + hashlib.sha256(payload).digest() + _CKPT_MAGIC
+
+
+def _read_verified(path: str) -> Any:
+    """Load a rank-0 pickle checkpoint, verifying the checksum footer.
+
+    Raises :class:`CheckpointCorruptError` when the footer is present but
+    the digest does not match the payload (truncated/torn/bit-rotted
+    write). Every read passes through the ``checkpoint.restore``
+    injection point so the chaos lane can force the fallback path.
+    """
+    import pickle
+
+    if faults.fire(faults.CHECKPOINT_RESTORE):
+        raise faults.InjectedFault(f"checkpoint restore dropped: {path}")
+    with open(path, "rb") as f:
+        blob = f.read()
+    if len(blob) >= _FOOTER_LEN and blob.endswith(_CKPT_MAGIC):
+        payload = blob[:-_FOOTER_LEN]
+        digest = blob[-_FOOTER_LEN:-len(_CKPT_MAGIC)]
+        if hashlib.sha256(payload).digest() != digest:
+            raise CheckpointCorruptError(
+                f"checkpoint {path} failed its integrity check "
+                "(checksum footer does not match payload)"
+            )
+        return pickle.loads(payload)
+    return pickle.loads(blob)  # pre-footer checkpoint: accepted as-is
 
 
 def _save_with_retries(attempt, what: str) -> None:
@@ -94,18 +132,46 @@ class Checkpointer:
 
         Every process restores cooperatively (orbax reads shards local to
         each host) — the sharded-native form of the reference's
-        rank-0-load + broadcast_parameters resume."""
+        rank-0-load + broadcast_parameters resume.
+
+        Integrity fallback (latest-step restores only): when the newest
+        retained step is truncated/corrupt/unreadable, fall back through
+        the older retained steps with a loud warning instead of crashing
+        resume — losing one save interval beats losing the job. An
+        EXPLICIT ``step`` is restored exactly or not at all (the caller
+        asked for that step, not "whatever works"). Every attempt passes
+        through the ``checkpoint.restore`` injection point.
+        """
         import orbax.checkpoint as ocp
 
-        if step is None:
-            step = self.latest_step()
-            if step is None:
-                raise FileNotFoundError(f"no checkpoints in {self._dir}")
         if template is not None:
             args = ocp.args.StandardRestore(template)
         else:
             args = ocp.args.StandardRestore()
-        return self._mgr.restore(step, args=args)
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(self.all_steps(), reverse=True)
+            if not candidates:
+                raise FileNotFoundError(f"no checkpoints in {self._dir}")
+        log = get_logger()
+        last_err: Exception | None = None
+        for i, s in enumerate(candidates):
+            try:
+                if faults.fire(faults.CHECKPOINT_RESTORE):
+                    raise faults.InjectedFault(
+                        f"checkpoint restore dropped: step {s}")
+                return self._mgr.restore(s, args=args)
+            except Exception as e:  # noqa: BLE001 — try the older steps
+                last_err = e
+                if i + 1 < len(candidates):
+                    log.error(
+                        "checkpoint step %d failed to restore (%s); "
+                        "falling back to previous retained step %d",
+                        s, e, candidates[i + 1],
+                    )
+        assert last_err is not None
+        raise last_err
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -124,7 +190,13 @@ def save_on_rank_0(path: str, tree: Any) -> None:
     """The reference idiom (`if hvd.rank() == 0: torch.save(...)`) for small
     host-side objects; pairs with ``load_and_broadcast``. The write retries
     transient storage blips and lands atomically (tmp + rename), so a
-    failure mid-write can never leave a truncated checkpoint behind."""
+    failure mid-write can never leave a truncated checkpoint behind.
+
+    Integrity + retention: the payload carries a sha256 checksum footer
+    (verified on load), and the previous good checkpoint is rotated to
+    ``<path>.prev`` — so a checkpoint that corrupts AFTER the write (bit
+    rot, torn storage) costs one step of progress on resume, not the job.
+    """
     import pickle
 
     from . import basics
@@ -132,13 +204,18 @@ def save_on_rank_0(path: str, tree: Any) -> None:
     if basics.rank() != 0:
         return
     # Serialize once outside the retry loop: only the I/O is transient.
-    data = pickle.dumps(jax.tree.map(lambda x: jax.device_get(x), tree))
+    data = _with_footer(
+        pickle.dumps(jax.tree.map(lambda x: jax.device_get(x), tree)))
 
     def write():
         tmp = f"{path}.tmp"
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
+            # Rotate AFTER the new data is safely on disk: the previous
+            # good checkpoint is never the casualty of a failed write.
+            if os.path.exists(path):
+                os.replace(path, f"{path}.prev")
             os.replace(tmp, path)
         except BaseException:
             try:
@@ -152,14 +229,47 @@ def save_on_rank_0(path: str, tree: Any) -> None:
 
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
     """Rank 0 loads; everyone receives via broadcast_object (resume parity
-    with ``hvd.broadcast_object(torch.load(...))``)."""
-    import pickle
+    with ``hvd.broadcast_object(torch.load(...))``).
 
+    Integrity: the checksum footer is verified; a truncated/corrupt
+    checkpoint falls back to the previous retained one (``<path>.prev``)
+    with a loud warning instead of crashing resume. Both unreadable →
+    ``None`` is broadcast (same as a missing checkpoint)."""
     from . import basics
     from .functions import broadcast_object
 
     obj = None
-    if basics.rank() == root_rank and os.path.exists(path):
-        with open(path, "rb") as f:
-            obj = pickle.load(f)
+    if basics.rank() == root_rank:
+        log = get_logger()
+        prev = f"{path}.prev"
+        need_prev = False
+        if os.path.exists(path):
+            try:
+                obj = _read_verified(path)
+            except Exception as e:  # noqa: BLE001 — corrupt ≠ fatal
+                log.error(
+                    "checkpoint %s is corrupt/unreadable (%s); falling "
+                    "back to the previous retained checkpoint", path, e,
+                )
+                need_prev = True
+        elif os.path.exists(prev):
+            # A crash between save_on_rank_0's two renames leaves no file
+            # at `path` while .prev holds the last good checkpoint.
+            log.error(
+                "checkpoint %s is missing but %s exists (crash between "
+                "rotation and install); falling back", path, prev,
+            )
+            need_prev = True
+        if need_prev and os.path.exists(prev):
+            try:
+                obj = _read_verified(prev)
+                log.warning(
+                    "resumed from previous retained checkpoint %s — "
+                    "one step of progress was lost", prev,
+                )
+            except Exception as pe:  # noqa: BLE001
+                log.error(
+                    "previous retained checkpoint %s is also unreadable "
+                    "(%s); resuming without a checkpoint", prev, pe,
+                )
     return broadcast_object(obj, root_rank=root_rank)

@@ -28,8 +28,10 @@ from .. import basics
 from ..exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
+    RecoveryExhaustedError,
     RemovedFromWorldError,
 )
+from ..utils.env import get_float, get_int
 from ..utils.logging import get_logger
 
 # Preemption drain: SIGTERM (the cloud's preemption notice, and the elastic
@@ -72,6 +74,26 @@ def run(func):
     The wrapped function receives a ``State`` first argument; it is retried
     until it returns, with restore/sync + world re-initialization between
     attempts, mirroring the reference's retry loop.
+
+    Recovery follows an **escalation ladder** keyed on consecutive
+    ``HorovodInternalError`` failures with no progress (no commit landed
+    in between):
+
+    1. in-memory ``state.restore()`` to the last commit (the cheap,
+       common case — a peer died mid-step);
+    2. full re-rendezvous + ``state.sync()`` from rank 0, *skipping* the
+       local restore (the local snapshot itself may be part of the
+       problem);
+    3. durable restore via :meth:`State.register_durable_restore` (the
+       orbax/pickle checkpoint layer) when registered, else rung 1 again.
+
+    A **storm breaker** caps the ladder: after
+    ``HOROVOD_RECOVERY_MAX_ATTEMPTS`` consecutive no-progress failures
+    (default 10; 0 disables) the loop raises
+    :class:`RecoveryExhaustedError` instead of livelocking through
+    abort/recover cycles forever, with exponential backoff (capped at
+    ``HOROVOD_RECOVERY_BACKOFF_MAX`` seconds) between attempts so a
+    flapping host cannot saturate the control plane.
     """
 
     @functools.wraps(func)
@@ -86,11 +108,14 @@ def run(func):
         _install_drain_handler()
         skip_sync = False
         needs_reset = False
-        backoff = 0.5
         first_init_failure = None
         init_retry_limit_s = float(
             os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600") or 600
         )
+        max_recovery = get_int("HOROVOD_RECOVERY_MAX_ATTEMPTS", 10)
+        recovery_backoff_max = get_float("HOROVOD_RECOVERY_BACKOFF_MAX", 5.0)
+        consecutive_failures = 0
+        commits_before_attempt = 0
         while True:
             # World (re-)formation runs INSIDE the retry scope: init() can
             # itself fail transiently during an elastic reconfiguration
@@ -122,17 +147,76 @@ def run(func):
                         state.on_reset()
                         needs_reset = False
                 first_init_failure = None
-                backoff = 0.5
                 if not skip_sync:
                     state.sync()
+                from ..runner.elastic.worker import _counters
+
+                # Snapshot taken AFTER sync (which commits internally):
+                # only commits the training function itself lands count as
+                # progress for the storm breaker below.
+                commits_before_attempt = _counters.commits
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
-                log.warning("elastic: internal failure (%s); restoring", e)
-                if basics.is_initialized():
-                    state.restore()
+                from .. import abort, stall
+                from ..runner.elastic.worker import _counters
+
+                # Progress (a commit landed inside the attempt) resets the
+                # storm breaker: distinct one-off failures across a long
+                # job are routine churn, not a livelock.
+                if _counters.commits > commits_before_attempt:
+                    consecutive_failures = 0
+                consecutive_failures += 1
+                # Re-baseline NOW, not only at the next post-sync snapshot:
+                # a failure raised before that snapshot (sync itself
+                # failing) must compare against this failure's counter, or
+                # an earlier attempt's commits would read as fresh progress
+                # on every retry and the breaker would never trip.
+                commits_before_attempt = _counters.commits
+                # This failure consumed any armed coordinated abort, and
+                # the inspector's verdict with it — the next attempt gets
+                # a clean slate (a re-abort in the NEW world re-arms both).
+                abort.consume()
+                stall.get_inspector().failed = False
+                if max_recovery > 0 and consecutive_failures >= max_recovery:
+                    log.error(
+                        "elastic: %d consecutive recovery attempts with no "
+                        "progress (HOROVOD_RECOVERY_MAX_ATTEMPTS=%d); "
+                        "giving up", consecutive_failures, max_recovery,
+                    )
+                    raise RecoveryExhaustedError(
+                        f"{consecutive_failures} consecutive recovery "
+                        f"attempts failed with no progress (last: {e})"
+                    ) from e
+                rung = min(consecutive_failures, 3)
+                if rung == 1:
+                    log.warning(
+                        "elastic: internal failure (%s); restoring last "
+                        "commit (recovery rung 1)", e)
+                    if basics.is_initialized():
+                        state.restore()
+                elif rung == 2:
+                    log.warning(
+                        "elastic: internal failure (%s); escalating to full "
+                        "re-rendezvous + sync from rank 0, skipping local "
+                        "restore (recovery rung 2)", e)
+                else:
+                    log.warning(
+                        "elastic: internal failure (%s); escalating to "
+                        "durable checkpoint restore (recovery rung 3)", e)
+                    restored = False
+                    try:
+                        restored = state.restore_durable()
+                    except Exception as ce:  # noqa: BLE001 — fall through
+                        log.error(
+                            "elastic: durable restore failed (%s); falling "
+                            "back to the in-memory commit", ce)
+                    if not restored and basics.is_initialized():
+                        state.restore()
                 skip_sync = False
-                time.sleep(min(backoff, 5.0))
-                backoff *= 2
+                time.sleep(min(
+                    0.5 * (2 ** (consecutive_failures - 1)),
+                    recovery_backoff_max,
+                ))
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-syncing")
                 skip_sync = e.skip_sync

@@ -40,10 +40,34 @@ class State:
 
     def __init__(self, **kwargs):
         self._reset_callbacks: list[Callable[[], None]] = []
+        self._durable_restore_fn: Callable[[], None] | None = None
         self._kwargs = kwargs
 
     def register_reset_callbacks(self, callbacks) -> None:
         self._reset_callbacks.extend(callbacks)
+
+    def register_durable_restore(self, fn: Callable[[], None]) -> None:
+        """Arm recovery-ladder rung 3: ``fn`` reloads this state's fields
+        from the durable checkpoint layer (``horovod_tpu.checkpoint`` —
+        ``Checkpointer.restore`` / ``load_and_broadcast``). The elastic
+        loop calls it only after both the in-memory restore AND the
+        re-rendezvous+sync rungs failed consecutively::
+
+            ckpt = Checkpointer("gs://...", max_to_keep=3)
+            def reload():
+                tree = ckpt.restore()
+                state.params, state.opt_state = tree["params"], tree["opt"]
+            state.register_durable_restore(reload)
+        """
+        self._durable_restore_fn = fn
+
+    def restore_durable(self) -> bool:
+        """Run the registered durable restore; False when none is armed
+        (the ladder then falls back to the in-memory commit)."""
+        if self._durable_restore_fn is None:
+            return False
+        self._durable_restore_fn()
+        return True
 
     def on_reset(self) -> None:
         for cb in self._reset_callbacks:

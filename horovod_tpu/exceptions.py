@@ -25,6 +25,28 @@ class HorovodInternalError(HorovodTpuError):
     """
 
 
+class RecoveryExhaustedError(HorovodTpuError):
+    """The elastic recovery storm breaker tripped.
+
+    Raised by ``hvd.elastic.run`` after ``HOROVOD_RECOVERY_MAX_ATTEMPTS``
+    consecutive ``HorovodInternalError`` recoveries with no progress (no
+    commit landed between failures): a flapping host or a persistently
+    broken world must fail the job loudly instead of spinning in an
+    abort/recover livelock forever. The last recovery failure is attached
+    as ``__cause__``.
+    """
+
+
+class CheckpointCorruptError(HorovodTpuError):
+    """A durable checkpoint failed its integrity check.
+
+    Raised by the checkpoint layer when a rank-0 pickle checkpoint's
+    checksum footer does not match its payload (truncated write, bit rot,
+    torn storage). ``load_and_broadcast`` catches it and falls back to the
+    previous retained checkpoint instead of crashing resume.
+    """
+
+
 class HostsUpdatedInterrupt(HorovodTpuError):
     """Raised when the elastic driver reports a host-set change.
 

@@ -5,11 +5,17 @@ pattern (``test/integration/elastic_common.py``: mutate the discovery file,
 kill workers by behavior flag). This module generalizes that into named
 **injection points** wired through the control plane's hot paths:
 
-- ``kv.request``       — every rendezvous KV client request attempt
-- ``discovery.poll``   — every ``HostManager.update_available_hosts`` poll
-- ``worker.step``      — every stall-watched step / fetch dispatch
-- ``heartbeat.send``   — every worker heartbeat publish
-- ``checkpoint.save``  — every durable checkpoint write attempt
+- ``kv.request``         — every rendezvous KV client request attempt
+- ``kv.fence``           — every generation-fenced KV write; firing (drop
+  semantics) makes the client send a STALE generation, impersonating a
+  zombie worker from the pre-abort world
+- ``discovery.poll``     — every ``HostManager.update_available_hosts`` poll
+- ``worker.step``        — every stall-watched step / fetch dispatch
+- ``heartbeat.send``     — every worker heartbeat publish
+- ``abort.poll``         — every coordinated-abort flag poll; drop/delay
+  simulate delayed abort propagation
+- ``checkpoint.save``    — every durable checkpoint write attempt
+- ``checkpoint.restore`` — every durable checkpoint read/restore attempt
 
 Each point can be armed (via API or env) to **drop**, **delay**, **raise**,
 or **hang** on the Nth hit, for a window of consecutive hits — deterministic
@@ -48,10 +54,13 @@ ENV_SPEC = "HOROVOD_FAULTS"
 
 # Canonical injection-point names (call sites use these constants).
 KV_REQUEST = "kv.request"
+KV_FENCE = "kv.fence"
 DISCOVERY_POLL = "discovery.poll"
 WORKER_STEP = "worker.step"
 HEARTBEAT_SEND = "heartbeat.send"
+ABORT_POLL = "abort.poll"
 CHECKPOINT_SAVE = "checkpoint.save"
+CHECKPOINT_RESTORE = "checkpoint.restore"
 
 _MODES = ("drop", "delay", "raise", "hang")
 _DEFAULT_HANG_S = 3600.0

@@ -254,12 +254,14 @@ def _exchange_native_endpoint(proc_id: int, fallback_port: int):
         "HOROVOD_COORDINATOR_ADDR", "127.0.0.1").rsplit(":", 1)[0]
     if not kv_addr or kv_port < 0:
         return coord_host, fallback_port
-    from ..runner.http.kv_server import KVClient
+    from ..runner.http.kv_server import KVClient, env_generation
     from ..runner.network import free_port, routable_addr
 
     version = os.environ.get("HOROVOD_WORLD_VERSION", "static")
     scope = f"native/{version}"
-    kv = KVClient(kv_addr, kv_port)
+    # Generation-fenced: a zombie rank 0 must not republish a stale
+    # native-coordinator endpoint into the re-formed world's rendezvous.
+    kv = KVClient(kv_addr, kv_port, generation_fn=env_generation)
     if proc_id == 0:
         host = routable_addr()
         port = free_port()  # free on rank 0's host, where the bind happens

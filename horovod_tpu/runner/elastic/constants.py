@@ -12,6 +12,14 @@ EXIT_REMOVED = 202
 # "the driver dropped me" from "the driver vanished" at a glance.
 EXIT_DRIVER_LOST = 203
 
+# Exit code for a worker whose stall inspector crossed the shutdown
+# deadline but whose MAIN THREAD never acted on the interrupt (wedged in
+# an uninterruptible C/XLA call — signal handlers only run between Python
+# bytecodes). The inspector's deadman timer hard-exits with this code so
+# the driver reaps, blacklists, and re-forms the world without the host;
+# its heartbeats alone would have kept it looking alive forever.
+EXIT_STALL_ABANDONED = 204
+
 # Consecutive KV poll failures before the worker escalates its logging
 # from debug to warning (the first couple of blips are routine — a driver
 # mid-reconfiguration answers late; a streak is a signal).

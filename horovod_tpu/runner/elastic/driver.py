@@ -261,6 +261,18 @@ class ElasticDriver:
                     f"(timeout {self._hb_timeout:.0f}s)")))
         return dead
 
+    def _post_abort(self, reason: str) -> None:
+        """Post the coordinated-abort record for the CURRENT generation
+        (the dying world) before `_reconfigure` bumps it: survivors wedged
+        in a collective with the dead peer poll the flag and convert the
+        wedge into HorovodInternalError → elastic recovery, instead of
+        blocking forever inside a native allreduce no one will complete."""
+        gen = self._server.post_abort(reason)
+        self._log.warning(
+            "elastic: posting coordinated abort for world generation %d "
+            "(%s)", gen, reason,
+        )
+
     def _monitor(self) -> int:
         last_poll = 0.0
         while True:
@@ -305,6 +317,8 @@ class ElasticDriver:
                             "fault, not a host fault; relaunching without "
                             "blacklisting", name, rc, n,
                         )
+                        self._post_abort(
+                            f"worker on {name} exited EXIT_DRIVER_LOST")
                         need_reconfigure = True
                         continue
                     self._log.error(
@@ -313,6 +327,9 @@ class ElasticDriver:
                         name, n,
                     )
                     del self._driver_lost_counts[name]
+                    self._post_abort(
+                        f"worker on {name} lost the rendezvous KV "
+                        f"{n} consecutive times; blacklisted")
                     self._manager.blacklist(name)
                     need_reconfigure = True
                     continue
@@ -321,6 +338,8 @@ class ElasticDriver:
                     "elastic: worker on %s failed (rc=%d); blacklisting",
                     name, rc,
                 )
+                self._post_abort(
+                    f"worker on {name} failed with rc={rc}; blacklisted")
                 self._manager.blacklist(name)
                 need_reconfigure = True
             # 1b. Liveness plane: kill + blacklist hosts the heartbeat
@@ -332,6 +351,10 @@ class ElasticDriver:
                     "elastic: worker on %s is hung (%s); killing and "
                     "blacklisting", name, why,
                 )
+                # Abort FIRST, kill second: survivors wedged with the hung
+                # peer should already be polling the flag when the SIGKILL
+                # lands, whichever unblocks them first.
+                self._post_abort(f"worker on {name} is hung ({why}); killed")
                 terminate_worker(self._workers.pop(name))
                 self._launched_at.pop(name, None)
                 self._server.clear_heartbeat(name)

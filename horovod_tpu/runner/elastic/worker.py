@@ -32,7 +32,7 @@ import os
 import threading
 import time
 
-from ... import faults
+from ... import abort, faults
 from ...elastic.runner import notification_manager
 from ...utils.env import get_float
 from ...utils.logging import get_logger
@@ -73,18 +73,35 @@ class ElasticWorkerContext:
         addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
         port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
         self.hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
-        self.client = KVClient(addr, port)
+        # Every client stamps writes with this worker's live generation
+        # view, so the server's fence can reject a zombie's replays (a
+        # SIGSTOP'd-through-recovery worker resumes with a stale version).
+        gen_fn = lambda: self.version  # noqa: E731
+        self.client = KVClient(addr, port, generation_fn=gen_fn)
         # Dedicated heartbeat client: ONE attempt, short timeout. The beat
         # loop itself is the retry — a beat that inherited the full KV
         # retry budget (3 × 10s timeout + backoff) could block the sender
         # past the driver's heartbeat deadline and get a healthy worker
         # killed for the very silence the budget was absorbing.
-        self._hb_client = KVClient(addr, port, timeout=2.0, retries=1)
+        self._hb_client = KVClient(addr, port, timeout=2.0, retries=1,
+                                   generation_fn=gen_fn)
+        # Dedicated abort-poll client, same 1-attempt/2s discipline: the
+        # abort poll bounds wedged survivors' unblock latency and must
+        # never stretch it by inheriting the fat retry budget.
+        self._abort_client = KVClient(addr, port, timeout=2.0, retries=1,
+                                      generation_fn=gen_fn)
         self.version = int(os.environ.get("HOROVOD_WORLD_VERSION", "0"))
+        # The generation this worker last actually JOINED (fetch_assignment)
+        # — distinct from `version`, which the poll loop advances the
+        # moment the driver bumps the epoch. The abort monitor must poll
+        # the JOINED generation: a survivor wedged in world g's collectives
+        # is still in world g even after its poller has seen g+1 announced.
+        self.joined_version = self.version
         self.consecutive_poll_failures = 0
         self._on_driver_lost = on_driver_lost or self._exit_driver_lost
         self._poller: threading.Thread | None = None
         self._heartbeater: threading.Thread | None = None
+        self._abort_poller: threading.Thread | None = None
         self._stop = threading.Event()
 
     def fetch_assignment(self, version: int | None = None) -> dict:
@@ -111,9 +128,22 @@ class ElasticWorkerContext:
                 f"host {self.hostname!r} has no assignment in world v{v}"
             )
         self.version = v
+        self.joined_version = v
         # Joining the latest epoch satisfies any pending hosts-updated
-        # notification — clearing it avoids a spurious second teardown.
+        # notification — clearing it avoids a spurious second teardown —
+        # and moots any abort armed for the pre-recovery generation. An
+        # abort record ALREADY posted for this generation (stall-only
+        # recoveries rejoin the same generation; records are never
+        # deleted) describes the failure we just recovered from, so it is
+        # pre-consumed — only a record posted AFTER this join re-aborts.
         notification_manager.clear()
+        try:
+            from ..http.kv_server import ABORT_SCOPE
+
+            stale = self.client.get(ABORT_SCOPE, str(v))
+        except Exception:  # noqa: BLE001 — best-effort staleness marking
+            stale = None
+        abort.joined_generation(v, stale_record=stale)
         return json.loads(raw)
 
     def apply_to_env(self, assignment: dict) -> None:
@@ -195,6 +225,36 @@ class ElasticWorkerContext:
             target=loop, name="hvd-elastic-poll", daemon=True
         )
         self._poller.start()
+        self.start_abort_monitor()
+
+    # -- coordinated-abort monitor -------------------------------------------
+
+    def start_abort_monitor(self, interval: float | None = None) -> None:
+        """Mirror the KV's ``abort/<generation>`` flag into process-local
+        state (``horovod_tpu.abort``) so every blocking site — native
+        synchronize, stall.watch, fetch — can convert a wedge into
+        ``HorovodInternalError`` within one poll interval. Started with
+        the poll loop; rides a dedicated 1-attempt/2s client."""
+        if self._abort_poller is not None:
+            return
+        if interval is None:
+            interval = abort.poll_interval()
+        if interval <= 0:
+            return  # explicitly disabled
+
+        def loop():
+            log = get_logger()
+            while not self._stop.wait(interval):
+                try:
+                    abort.poll_once(self._abort_client,
+                                    generation=self.joined_version)
+                except Exception as e:  # KV unreachable: the poll loop
+                    log.debug("abort poll failed: %s", e)  # owns escalation
+
+        self._abort_poller = threading.Thread(
+            target=loop, name="hvd-elastic-abort", daemon=True
+        )
+        self._abort_poller.start()
 
     # -- heartbeat sender ----------------------------------------------------
 
@@ -245,6 +305,9 @@ class ElasticWorkerContext:
         if self._heartbeater:
             self._heartbeater.join(timeout=5)
             self._heartbeater = None
+        if self._abort_poller:
+            self._abort_poller.join(timeout=5)
+            self._abort_poller = None
 
 
 _context: ElasticWorkerContext | None = None

@@ -15,6 +15,23 @@ Protocol: ``PUT /scope/key`` (body = value bytes), ``GET /scope/key``
 increasing ``version`` is bumped by ``reset()`` on elastic reconfiguration;
 workers read it at ``GET /_version``.
 
+World generation & coordinated abort: the epoch ``version`` doubles as the
+monotonic **world generation**. Two mechanisms hang off it:
+
+- **Abort records** (``abort/<generation>`` scope): the elastic driver
+  posts one (``post_abort``) whenever it kills/blacklists a host or reaps
+  an unclean worker exit, and a worker's stall inspector posts one when a
+  stall crosses its shutdown deadline. Workers poll the record for *their*
+  generation (``horovod_tpu.abort``) and convert a wedged collective into
+  ``HorovodInternalError`` → elastic recovery.
+- **Generation fencing**: a write (PUT/DELETE) carrying
+  ``X-Hvd-Generation`` older than the current generation is rejected with
+  409. A zombie worker from the pre-abort world (SIGSTOP'd through a
+  recovery, then resumed) replays its buffered writes with its stale
+  generation and corrupts nothing — the re-formed world's rendezvous and
+  heartbeat records stay authoritative. Clients without the header (plain
+  tooling, static launches) are not fenced.
+
 Authentication (parity: ``horovod/runner/common/util/secret.py`` — the
 reference HMAC-signs driver↔task traffic): when ``HOROVOD_SECRET_KEY`` is
 set (the launcher generates one per job and ships it in the worker env
@@ -26,9 +43,11 @@ No key set = open dev mode.
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
 from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
@@ -38,11 +57,28 @@ from ...utils.retry import call_with_retries
 from .. import secret as _secret
 
 AUTH_HEADER = "X-Hvd-Auth"
+GENERATION_HEADER = "X-Hvd-Generation"
 
 # Liveness scope: workers PUT /heartbeat/<host>; the server records the
 # RECEIVE time (server clock — worker clocks don't enter the liveness
 # decision, so skew/NTP steps on preempted VMs can't fake death or life).
 HEARTBEAT_SCOPE = "heartbeat"
+
+# Coordinated-abort scope: one record per world generation, posted by the
+# driver (host kill/blacklist/unclean exit) or a worker's stall inspector.
+ABORT_SCOPE = "abort"
+
+
+def env_generation() -> int | None:
+    """The launcher-written world generation, or None outside elastic
+    worlds (static/manual launches are never fenced)."""
+    import os
+
+    raw = os.environ.get("HOROVOD_WORLD_VERSION", "")
+    try:
+        return int(raw)
+    except ValueError:
+        return None
 
 
 def _auth_payload(method: str, path: str, body: bytes) -> bytes:
@@ -95,6 +131,26 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._reply(404, b"")
         self._reply(200, val)
 
+    def _fence_check_locked(self) -> bytes | None:
+        """Generation fence (call under the server lock): a write stamped
+        with a generation older than the current world generation is a
+        zombie from a pre-abort world — reject it so it cannot corrupt the
+        re-formed world's records. Returns the 409 body, or None to
+        proceed. Writes without the header are unfenced (plain clients)."""
+        raw = self.headers.get(GENERATION_HEADER)
+        if raw is None:
+            return None
+        try:
+            gen = int(raw)
+        except ValueError:
+            return b"bad generation header"
+        current = self.server.version  # type: ignore[attr-defined]
+        if gen < current:
+            self.server.fenced += 1  # type: ignore[attr-defined]
+            return (f"stale generation {gen} rejected "
+                    f"(world at generation {current})").encode()
+        return None
+
     def do_PUT(self):  # noqa: N802
         scope, key = self._split()
         if key is None:
@@ -104,11 +160,16 @@ class _KVHandler(BaseHTTPRequestHandler):
         if not self._authenticate(body):
             return
         with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
-            if scope == HEARTBEAT_SCOPE:
-                # Liveness plane: stamp the receive time on the SERVER
-                # clock (driver-side monotonic; worker clocks irrelevant).
-                self.server.hb_times[key] = time.monotonic()  # type: ignore[attr-defined]
+            rejected = self._fence_check_locked()
+            if rejected is None:
+                self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
+                if scope == HEARTBEAT_SCOPE:
+                    # Liveness plane: stamp the receive time on the SERVER
+                    # clock (driver-side monotonic; worker clocks
+                    # irrelevant).
+                    self.server.hb_times[key] = time.monotonic()  # type: ignore[attr-defined]
+        if rejected is not None:
+            return self._reply(409, rejected)
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
@@ -116,7 +177,11 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         scope = self.path.strip("/")
         with self.server.lock:  # type: ignore[attr-defined]
-            self.server.store.pop(scope, None)  # type: ignore[attr-defined]
+            rejected = self._fence_check_locked()
+            if rejected is None:
+                self.server.store.pop(scope, None)  # type: ignore[attr-defined]
+        if rejected is not None:
+            return self._reply(409, rejected)
         self._reply(200, b"")
 
     def _reply(self, code: int, body: bytes):
@@ -134,6 +199,7 @@ class RendezvousServer:
         self._httpd.store = {}  # type: ignore[attr-defined]
         self._httpd.lock = threading.Lock()  # type: ignore[attr-defined]
         self._httpd.version = 0  # type: ignore[attr-defined]
+        self._httpd.fenced = 0  # type: ignore[attr-defined]
         self._httpd.hb_times = {}  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
@@ -147,6 +213,18 @@ class RendezvousServer:
     @property
     def version(self) -> int:
         return self._httpd.version  # type: ignore[attr-defined]
+
+    @property
+    def generation(self) -> int:
+        """The monotonic world generation (alias of the epoch version:
+        both bump together on every world re-formation)."""
+        return self._httpd.version  # type: ignore[attr-defined]
+
+    @property
+    def fenced_writes(self) -> int:
+        """How many stale-generation writes the fence has rejected."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.fenced  # type: ignore[attr-defined]
 
     def start(self) -> int:
         self._thread = threading.Thread(
@@ -176,6 +254,27 @@ class RendezvousServer:
                 store.pop(f"{scope_prefix}/{stale}", None)
             self._httpd.version = version  # type: ignore[attr-defined]
             return version
+
+    # -- coordinated abort plane --------------------------------------------
+
+    def post_abort(self, reason: str, generation: int | None = None) -> int:
+        """Post the abort record for a world generation (default: the
+        current one). Every worker of that generation polls it and
+        converts its current wedge into ``HorovodInternalError``; posted
+        BEFORE the driver bumps the generation so survivors still at the
+        dying generation see it. Returns the generation posted for."""
+        record = json.dumps({"reason": reason, "time": time.time()}).encode()
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            gen = (self._httpd.version  # type: ignore[attr-defined]
+                   if generation is None else generation)
+            self._httpd.store.setdefault(  # type: ignore[attr-defined]
+                ABORT_SCOPE, {})[str(gen)] = record
+        return gen
+
+    def abort_record(self, generation: int) -> bytes | None:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return self._httpd.store.get(  # type: ignore[attr-defined]
+                ABORT_SCOPE, {}).get(str(generation))
 
     # -- heartbeat liveness plane -------------------------------------------
 
@@ -224,18 +323,26 @@ class KVClient:
     network blip below the retry budget is fully absorbed, while a dead
     driver still surfaces as an exception the caller's escalation path
     (``worker.start_polling``) can act on — never an unbounded silent
-    retry. HTTP status answers (404 = no value, 403 = bad auth) are
-    answers, not blips, and propagate immediately.
+    retry. HTTP status answers (404 = no value, 403 = bad auth, 409 =
+    fenced stale-generation write) are answers, not blips, and propagate
+    immediately.
+
+    ``generation_fn`` (elastic workers pass their live world-generation
+    view) stamps every write with ``X-Hvd-Generation`` so the server's
+    fence can reject zombies from a pre-abort world; ``None`` (or a fn
+    returning ``None``) leaves writes unfenced.
     """
 
     def __init__(self, addr: str, port: int, timeout: float = 10.0,
-                 retries: int | None = None, backoff: float | None = None):
+                 retries: int | None = None, backoff: float | None = None,
+                 generation_fn: Callable[[], int | None] | None = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
         self._retries = (get_int("HOROVOD_KV_RETRIES", 3)
                          if retries is None else retries)
         self._backoff = (get_float("HOROVOD_KV_RETRY_BACKOFF", 0.1)
                          if backoff is None else backoff)
+        self._generation_fn = generation_fn
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         def attempt():
@@ -247,6 +354,15 @@ class KVClient:
             tag = _secret.sign(_auth_payload(method, path, body or b""))
             if tag:
                 req.add_header(AUTH_HEADER, tag)
+            if self._generation_fn is not None and method in ("PUT",
+                                                              "DELETE"):
+                gen = self._generation_fn()
+                if gen is not None:
+                    if faults.fire(faults.KV_FENCE):
+                        # Chaos: impersonate a zombie from the pre-abort
+                        # world — the server must 409 this write.
+                        gen -= 1
+                    req.add_header(GENERATION_HEADER, str(gen))
             return urlopen(req, timeout=self._timeout)
 
         return call_with_retries(
@@ -281,3 +397,14 @@ class KVClient:
     def world_version(self) -> int:
         with self._request("GET", "/_version") as r:
             return int(r.read())
+
+    def abort_posted(self, generation: int) -> dict | None:
+        """The abort record for a world generation, or None. Decoded JSON
+        (``{"reason", "time", ...}``); raw text falls back to a dict."""
+        raw = self.get(ABORT_SCOPE, str(generation))
+        if raw is None:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return {"reason": raw.decode(errors="replace")}

@@ -24,6 +24,7 @@ import fcntl
 import os
 import subprocess
 import threading
+import time
 from typing import Any
 
 import numpy as np
@@ -372,19 +373,48 @@ class NativeWorld:
         return self._lib.hvdrt_poll(handle) == 1
 
     def synchronize(self, handle: int, timeout_s: float = 600.0) -> np.ndarray:
-        rc = self._lib.hvdrt_wait(handle, timeout_s)
-        if rc != 0:
-            if self._lib.hvdrt_poll(handle) == 0:
-                # Still in flight: the C++ side holds raw pointers into the
-                # numpy buffers — keep our references alive and surface the
-                # timeout without freeing them.
+        """Block until the handle completes, polling the coordinated-abort
+        flag between bounded native waits.
+
+        The wait is chunked at the abort poll interval so a wedged
+        collective (a peer SIGSTOP'd/partitioned mid-negotiation — sockets
+        open, nothing moving) converts into ``HorovodInternalError``
+        within one interval of the abort being posted, instead of blocking
+        the full ``timeout_s``. On abort/timeout while the op is still in
+        flight the numpy buffers stay pinned (the C++ side holds raw
+        pointers until the op or the world dies); elastic recovery frees
+        them at the next world teardown. ``timeout_s < 0`` waits without a
+        deadline (still abort-pollable).
+        """
+        from .. import abort
+
+        deadline = (time.monotonic() + timeout_s) if timeout_s >= 0 else None
+        chunk = max(0.05, abort.poll_interval())
+        while True:
+            step = chunk if deadline is None else min(
+                chunk, max(deadline - time.monotonic(), 0.0))
+            rc = self._lib.hvdrt_wait(handle, step)
+            if rc == 0:
+                break
+            pending = self._lib.hvdrt_poll(handle)
+            if pending == 1:
+                # Completed between the chunk timeout and the poll:
+                # collect its real status.
+                rc = self._lib.hvdrt_wait(handle, 1.0)
+                break
+            if pending != 0:
+                # Handle gone: the wait consumed a terminal FAILURE status
+                # (hvdrt_wait erases completed handles) — rc is final.
+                break
+            # Genuinely still in flight: a posted abort converts this
+            # wedge into the elastic recovery exception (buffers kept
+            # alive, see above).
+            abort.raise_if_aborted()
+            if deadline is not None and time.monotonic() >= deadline:
                 raise NativeRuntimeError(
                     f"synchronize timed out after {timeout_s}s; the op is "
                     "still pending (buffers kept alive)"
                 )
-            if self._lib.hvdrt_poll(handle) == 1:
-                # Completed between the timeout and now: collect its status.
-                rc = self._lib.hvdrt_wait(handle, 1.0)
         with self._inflight_lock:
             _, out = self._inflight.pop(handle, (None, None))
         if rc != 0:

@@ -24,7 +24,7 @@ import contextlib
 import threading
 import time
 
-from . import faults
+from . import abort, faults
 from .utils.env import get_float
 from .utils.logging import get_logger
 
@@ -50,8 +50,10 @@ class StallInspector:
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._warned: set[int] = set()
+        self._last_warned: dict[int, float] = {}
         self.failed = False  # set when a stall passed the shutdown threshold
+        self.failure_reason = ""
+        self._failed_at: float | None = None
 
     # -- ticket API (called by dispatch sites) ------------------------------
 
@@ -66,7 +68,7 @@ class StallInspector:
     def end(self, ticket: int) -> None:
         with self._lock:
             self._outstanding.pop(ticket, None)
-            self._warned.discard(ticket)
+            self._last_warned.pop(ticket, None)
 
     # -- watchdog -----------------------------------------------------------
 
@@ -78,21 +80,31 @@ class StallInspector:
             self._thread.start()
 
     def check_once(self, now: float | None = None) -> list[str]:
-        """One inspection pass; returns names of stalled operations."""
+        """One inspection pass; returns names of stalled operations.
+
+        A stalled ticket is RE-warned every ``warning_s`` with its
+        escalating age (not once-and-silent): a long hang must stay
+        visible in logs, not vanish after the first report."""
         now = time.monotonic() if now is None else now
         stalled = []
         with self._lock:
             for ticket, (name, start) in self._outstanding.items():
                 age = now - start
-                if age >= self.warning_s and ticket not in self._warned:
-                    stalled.append(f"{name} (outstanding {age:.0f}s)")
-                    self._warned.add(ticket)
+                if age < self.warning_s:
+                    continue
+                last = self._last_warned.get(ticket)
+                if last is not None and now - last < self.warning_s:
+                    continue
+                self._last_warned[ticket] = now
+                stalled.append(f"{name} (outstanding {age:.0f}s)")
         if stalled:
             get_logger().warning(
                 "Stall detected: one or more collectives have been "
                 "outstanding for over %.0fs — this usually means a rank "
-                "diverged (conditional collective) or a host hung: %s",
+                "diverged (conditional collective) or a host hung "
+                "(world generation %d): %s",
                 self.warning_s,
+                abort.current_generation(),
                 "; ".join(stalled),
             )
         return stalled
@@ -101,6 +113,8 @@ class StallInspector:
         interval = max(self.warning_s / 4.0, 0.25)
         while not self._stop.wait(interval):
             self.check_once()
+            if self.failed:
+                self._check_deadman()
             if self.shutdown_s > 0 and not self.failed:
                 with self._lock:
                     oldest = min(
@@ -108,19 +122,74 @@ class StallInspector:
                         default=None,
                     )
                 if oldest is not None and time.monotonic() - oldest >= self.shutdown_s:
-                    get_logger().error(
-                        "Stall exceeded HOROVOD_STALL_SHUTDOWN_TIME=%.0fs; "
-                        "interrupting the main thread (the reference shuts "
-                        "the job down at this point)",
-                        self.shutdown_s,
+                    age = time.monotonic() - oldest
+                    reason = (
+                        f"stall exceeded HOROVOD_STALL_SHUTDOWN_TIME="
+                        f"{self.shutdown_s:.0f}s (oldest op outstanding "
+                        f"{age:.0f}s)"
                     )
-                    # A daemon thread cannot raise into the trainer; flag the
-                    # failure (observed by the elastic loop / collectives)
-                    # and interrupt the main thread so the hang breaks.
+                    get_logger().error(
+                        "%s; posting the coordinated abort and "
+                        "interrupting the main thread (surfaces as "
+                        "HorovodInternalError → elastic recovery)",
+                        reason,
+                    )
+                    self.failure_reason = reason
                     self.failed = True
-                    import _thread
+                    self._failed_at = time.monotonic()
+                    # Cluster-wide: publish abort/<generation> so every
+                    # peer's monitor unblocks too — detection on ONE host
+                    # must recover the WHOLE job, not log-and-hang.
+                    # Local: a daemon thread cannot raise into the
+                    # trainer; deliver SIGINT to the MAIN thread and let
+                    # watch()/the elastic loop convert the resulting
+                    # KeyboardInterrupt into HorovodInternalError.
+                    # pthread_kill, not interrupt_main: interrupt_main
+                    # only sets a flag checked between bytecodes, which a
+                    # main thread blocked inside a C call (time.sleep, a
+                    # socket wait) never reaches — a real signal EINTRs
+                    # the call so the wedge breaks NOW, not whenever the
+                    # C call happens to return.
+                    abort.post(reason)
+                    import signal as _signal
 
-                    _thread.interrupt_main()
+                    try:
+                        _signal.pthread_kill(
+                            threading.main_thread().ident, _signal.SIGINT)
+                    except Exception:  # exotic platform: flag-only fallback
+                        import _thread
+
+                        _thread.interrupt_main()
+
+    def _check_deadman(self) -> None:
+        """After the shutdown interrupt fired: if the wedged op is STILL
+        outstanding past HOROVOD_STALL_EXIT_GRACE, the main thread never
+        acted on the signal — it is blocked in an uninterruptible C/XLA
+        call (CPython runs signal handlers only between bytecodes) while
+        the daemon heartbeat thread keeps this host looking alive to the
+        driver. Hard-exit so the driver reaps, blacklists, and re-forms
+        the world without us; lingering would hang the whole job."""
+        grace = get_float("HOROVOD_STALL_EXIT_GRACE", 30.0)
+        if grace <= 0 or self._failed_at is None:
+            return
+        if time.monotonic() - self._failed_at < grace:
+            return
+        with self._lock:
+            still_wedged = bool(self._outstanding)
+        if not still_wedged:
+            self._failed_at = None  # the interrupt landed; all clear
+            return
+        import os
+
+        from .runner.elastic.constants import EXIT_STALL_ABANDONED
+
+        get_logger().error(
+            "stall shutdown fired %.0fs ago but the main thread never "
+            "surfaced it (wedged in an uninterruptible call); exiting %d "
+            "so the driver re-forms the world without this host",
+            grace, EXIT_STALL_ABANDONED,
+        )
+        os._exit(EXIT_STALL_ABANDONED)
 
     def stop(self) -> None:
         self._stop.set()
@@ -169,6 +238,10 @@ def watch(name: str | None = None, timeout_s: float | None = None,
 
     from .process_world import size as _proc_size
 
+    # A pending coordinated abort fails the step up front: dispatching a
+    # new collective into an aborted world would only wedge again — raise
+    # the recovery exception before announcing anything.
+    abort.raise_if_aborted()
     # Chaos plane: the `worker.step` injection point fires on every
     # watched dispatch — `hang`/`delay` wedge this controller right here
     # (the liveness/stall planes must catch it), `raise` fails the step.
@@ -197,10 +270,27 @@ def watch(name: str | None = None, timeout_s: float | None = None,
         tag = name or "step"
     ticket = inspector.begin(f"{label}[{tag}]")
     try:
-        yield
-        if handle is not None:
-            world.synchronize(handle, timeout_s=timeout_s)
-            handle = None
+        try:
+            yield
+            if handle is not None:
+                world.synchronize(handle, timeout_s=timeout_s)
+                handle = None
+        except KeyboardInterrupt:
+            # The inspector's shutdown path can only interrupt_main from
+            # its daemon thread; re-shape that interrupt (or an
+            # abort-concurrent one) into the elastic recovery exception so
+            # the @hvd.elastic.run loop restores and continues instead of
+            # dying on a bare KeyboardInterrupt. A user's real Ctrl-C —
+            # no stall failure, no abort armed — passes through untouched.
+            if inspector.failed or abort.is_aborted():
+                from .exceptions import HorovodInternalError
+
+                raise HorovodInternalError(
+                    "stall shutdown: "
+                    + (inspector.failure_reason
+                       or "stall exceeded the shutdown deadline")
+                ) from None
+            raise
     finally:
         inspector.end(ticket)
         if handle is not None:

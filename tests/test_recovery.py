@@ -1,0 +1,935 @@
+"""Recovery suite: the coordinated abort & generation-fenced recovery plane.
+
+PR 2 built detection (heartbeat liveness, stall inspector, fault
+injection); this suite proves the recovery half: detection from either
+plane posts ``abort/<generation>`` on the rendezvous KV, every blocking
+site converts the wedge into ``HorovodInternalError`` within a bounded
+interval, the elastic loop climbs the escalation ladder (restore →
+re-rendezvous+sync → durable checkpoint) under a storm breaker, and a
+resumed zombie's stale-generation KV writes are provably rejected.
+
+Every test runs under a hard wall-clock circuit breaker (`faulthandler`):
+a regression that re-introduces an unbounded hang dumps all stacks and
+kills the process instead of eating the CI gate's whole budget.
+"""
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from urllib.error import HTTPError
+
+import numpy as np
+import pytest
+
+from horovod_tpu import abort, faults, stall
+from horovod_tpu.exceptions import (
+    HorovodInternalError,
+    RecoveryExhaustedError,
+)
+from horovod_tpu.runner.http.kv_server import (
+    ABORT_SCOPE,
+    KVClient,
+    RendezvousServer,
+)
+from horovod_tpu.utils.logging import get_logger
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Hard per-test wall-clock cap: the whole POINT of this layer is that
+# nothing blocks unboundedly, so a test that does is itself the failure.
+HARD_TIMEOUT_S = float(os.environ.get("HOROVOD_TEST_HARD_TIMEOUT", "300"))
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    """Wall-clock circuit breaker: dump every thread's stack and kill the
+    process if a single test exceeds HARD_TIMEOUT_S — a reintroduced
+    unbounded hang must fail the gate fast, not time it out."""
+    import faulthandler
+
+    faulthandler.dump_traceback_later(HARD_TIMEOUT_S, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    """Every test starts and ends with disarmed chaos AND abort planes."""
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    faults.reset()
+    abort.reset()
+    yield
+    faults.reset()
+    abort.reset()
+
+
+@pytest.fixture()
+def kv_server():
+    server = RendezvousServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def log_records():
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda rec: records.append(rec.getMessage())
+    logger = get_logger()
+    logger.addHandler(handler)
+    yield records
+    logger.removeHandler(handler)
+
+
+def _wait_until(cond, timeout=15.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- the abort plane itself --------------------------------------------------
+
+
+class TestAbortPlane:
+    def test_post_and_poll_roundtrip(self, kv_server):
+        gen = kv_server.post_abort("peer died")
+        assert gen == kv_server.generation
+        client = KVClient("127.0.0.1", kv_server.port)
+        rec = client.abort_posted(gen)
+        assert rec is not None and rec["reason"] == "peer died"
+        assert client.abort_posted(gen + 1) is None  # keyed by generation
+        assert kv_server.abort_record(gen) is not None
+
+    def test_poll_once_arms_local_state(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        assert abort.poll_once(client, generation=0) is False
+        kv_server.post_abort("host x hung")
+        assert abort.poll_once(client, generation=0) is True
+        assert abort.is_aborted()
+        with pytest.raises(HorovodInternalError, match="coordinated abort"):
+            abort.raise_if_aborted()
+
+    def test_consume_prevents_retrigger_on_same_record(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        kv_server.post_abort("first failure")
+        assert abort.poll_once(client, generation=0) is True
+        abort.consume()  # the elastic loop ate the failure
+        # The SAME record must not re-abort the recovered worker...
+        assert abort.poll_once(client, generation=0) is False
+        assert not abort.is_aborted()
+        # ...but a genuinely NEW abort (fresh record) must.
+        time.sleep(0.01)  # distinct record timestamp
+        kv_server.post_abort("second failure")
+        assert abort.poll_once(client, generation=0) is True
+        assert abort.is_aborted()
+
+    def test_monitor_thread_propagates(self, kv_server, monkeypatch):
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(kv_server.port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        ctx = ElasticWorkerContext()
+        ctx.start_polling(interval=0.05)
+        try:
+            assert not abort.is_aborted()
+            kv_server.post_abort("driver killed the wedged host")
+            assert _wait_until(abort.is_aborted), \
+                "abort monitor never propagated the flag"
+        finally:
+            ctx.stop_polling()
+
+    def test_abort_poll_injection_delays_propagation(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        kv_server.post_abort("slow news")
+        faults.inject(faults.ABORT_POLL, "drop", at=1, count=3)
+        for _ in range(3):  # injected drops: the flag is there, unseen
+            assert abort.poll_once(client, generation=0) is False
+        assert faults.fired(faults.ABORT_POLL) == 3
+        assert abort.poll_once(client, generation=0) is True  # caught up
+
+    def test_joined_generation_clears_stale_abort(self):
+        abort.trigger_local("old world died", generation=3)
+        assert abort.is_aborted()
+        abort.joined_generation(4)  # we live in the re-formed world now
+        assert not abort.is_aborted()
+
+    def test_join_time_record_is_stale_but_newer_ones_arent(self, kv_server):
+        """Stall-only recoveries rejoin the SAME generation, whose abort
+        record is never deleted: the record present at join time must not
+        re-abort the worker that just recovered from it — but a record
+        posted AFTER the join must."""
+        client = KVClient("127.0.0.1", kv_server.port)
+        kv_server.post_abort("the failure we just recovered from")
+        rec = kv_server.abort_record(0)
+        abort.joined_generation(0, stale_record=rec)
+        assert abort.poll_once(client, generation=0) is False
+        assert not abort.is_aborted()
+        time.sleep(0.01)  # distinct record timestamp
+        kv_server.post_abort("a genuinely new failure")
+        assert abort.poll_once(client, generation=0) is True
+        assert abort.is_aborted()
+
+    def test_latest_observed_record_wins_consume(self, kv_server):
+        """Two hosts posting for the same generation overwrite each other
+        in the KV; consume() must mark the LATEST observed record, or the
+        survivor's record re-aborts us right after recovery."""
+        client = KVClient("127.0.0.1", kv_server.port)
+        kv_server.post_abort("host A's report")
+        assert abort.poll_once(client, generation=0) is True
+        time.sleep(0.01)
+        kv_server.post_abort("host B's report")  # overwrites in the KV
+        assert abort.poll_once(client, generation=0) is True  # still armed
+        abort.consume()
+        # B's record was the last observed: it must not re-trigger.
+        assert abort.poll_once(client, generation=0) is False
+        assert not abort.is_aborted()
+
+    def test_watch_refuses_dispatch_into_aborted_world(self):
+        abort.trigger_local("wedged elsewhere", generation=0)
+        with pytest.raises(HorovodInternalError, match="coordinated abort"):
+            with stall.watch(name="doomed", cross_rank=False):
+                pytest.fail("body must not run in an aborted world")
+
+    def test_completed_native_op_unaffected_by_abort(self, hvd):
+        """An op that already COMPLETED returns its result even under an
+        armed abort — the conversion targets wedges, not finished work
+        (dropping a completed reduction would corrupt the restore)."""
+        pytest.importorskip("horovod_tpu.runtime")
+        from horovod_tpu.runner.network import free_port
+        from horovod_tpu.runtime import NativeWorld
+
+        world = NativeWorld(0, 1, "127.0.0.1", free_port())
+        try:
+            handle = world.allreduce_async_(
+                np.ones(4, np.float32), name="abort.done", op="sum")
+            assert _wait_until(lambda: world.poll(handle), timeout=10.0)
+            abort.trigger_local("late abort", generation=0)
+            out = world.synchronize(handle, timeout_s=10.0)
+            assert np.allclose(out, 1.0)
+        finally:
+            world.shutdown()
+
+
+# -- generation fencing -------------------------------------------------------
+
+
+class TestGenerationFencing:
+    def test_stale_write_rejected_store_untouched(self, kv_server):
+        kv_server.reset()  # world moved to generation 1
+        zombie = KVClient("127.0.0.1", kv_server.port,
+                          generation_fn=lambda: 0)
+        with pytest.raises(HTTPError) as err:
+            zombie.put("scratch", "k", b"from the old world")
+        assert err.value.code == 409
+        assert kv_server.fenced_writes == 1
+        reader = KVClient("127.0.0.1", kv_server.port)
+        assert reader.get("scratch", "k") is None  # nothing corrupted
+
+    def test_current_generation_write_accepted(self, kv_server):
+        kv_server.reset()
+        client = KVClient("127.0.0.1", kv_server.port,
+                          generation_fn=lambda: kv_server.generation)
+        client.put("scratch", "k", b"fresh")
+        assert client.get("scratch", "k") == b"fresh"
+        assert kv_server.fenced_writes == 0
+
+    def test_unfenced_clients_unaffected(self, kv_server):
+        kv_server.reset()
+        kv_server.reset()  # generation 2; plain clients carry no header
+        plain = KVClient("127.0.0.1", kv_server.port)
+        plain.put("scratch", "k", b"manual launch")
+        assert plain.get("scratch", "k") == b"manual launch"
+
+    def test_kv_fence_injection_simulates_zombie(self, kv_server):
+        kv_server.reset()  # generation 1
+        client = KVClient("127.0.0.1", kv_server.port,
+                          generation_fn=lambda: kv_server.generation)
+        faults.inject(faults.KV_FENCE, "drop", at=1, count=1)
+        with pytest.raises(HTTPError) as err:  # injected stale generation
+            client.put("scratch", "k", b"zombie impersonation")
+        assert err.value.code == 409
+        assert faults.fired(faults.KV_FENCE) == 1
+        client.put("scratch", "k", b"healthy again")  # window passed
+        assert client.get("scratch", "k") == b"healthy again"
+
+    def test_zombie_heartbeat_rejected(self, kv_server, monkeypatch):
+        """A resumed zombie must not fake liveness for a host the
+        re-formed world relaunched: its stale-generation heartbeat is
+        fenced and the liveness record stays empty."""
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(kv_server.port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        monkeypatch.setenv("HOROVOD_WORLD_VERSION", "0")
+        ctx = ElasticWorkerContext()
+        kv_server.reset()  # the world re-formed while the zombie slept
+        assert ctx.send_heartbeat() is False
+        assert kv_server.heartbeat_age("hostA") is None
+        assert kv_server.fenced_writes == 1
+
+
+# -- stall inspector: re-warn + shutdown conversion ---------------------------
+
+
+class TestStallRewarn:
+    def test_rewarns_every_interval_with_escalating_age(self, log_records):
+        ins = stall.StallInspector(warning_s=0.05, shutdown_s=0.0)
+        ticket = ins.begin("allreduce.wedged")
+        try:
+            time.sleep(0.06)
+            first = ins.check_once()
+            assert len(first) == 1
+            assert ins.check_once() == []  # within the re-warn interval
+            time.sleep(0.06)
+            second = ins.check_once()  # re-warned, not once-and-silent
+            assert len(second) == 1
+            age1 = float(first[0].rsplit("outstanding ", 1)[1].split("s")[0])
+            age2 = float(second[0].rsplit("outstanding ", 1)[1].split("s")[0])
+            assert age2 >= age1  # escalating age stays visible
+            assert any("world generation" in m for m in log_records)
+        finally:
+            ins.end(ticket)
+            ins.stop()
+
+
+class TestStallShutdownConversion:
+    def test_shutdown_surfaces_as_internal_error(self, monkeypatch):
+        """The reference's stall shutdown used to interrupt_main (a bare
+        KeyboardInterrupt); now the watch boundary re-shapes it into
+        HorovodInternalError — the exception the elastic loop recovers
+        from — and posts the coordinated abort for peers."""
+        ins = stall.StallInspector(warning_s=0.1, shutdown_s=0.4)
+        monkeypatch.setattr(stall, "_inspector", ins)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(HorovodInternalError, match="stall shutdown"):
+                with stall.watch(name="diverged", cross_rank=False):
+                    time.sleep(30)  # the watchdog interrupts this
+            # The signal EINTRs the blocking C call: the wedge breaks at
+            # the shutdown deadline, not when the sleep happens to end.
+            assert time.monotonic() - t0 < 15, "wedge outlived the shutdown"
+            assert ins.failed
+            assert "HOROVOD_STALL_SHUTDOWN_TIME" in ins.failure_reason
+            assert abort.is_aborted()  # posted for peers (locally here)
+        finally:
+            ins.stop()
+
+    def test_real_ctrl_c_passes_through(self, monkeypatch):
+        """A user interrupt with no stall failure and no abort must stay
+        a KeyboardInterrupt — recovery must not eat real Ctrl-C."""
+        ins = stall.StallInspector(warning_s=60.0, shutdown_s=0.0)
+        monkeypatch.setattr(stall, "_inspector", ins)
+        try:
+            with pytest.raises(KeyboardInterrupt):
+                with stall.watch(name="user-interrupt", cross_rank=False):
+                    raise KeyboardInterrupt()
+        finally:
+            ins.stop()
+
+
+# -- checkpoint integrity -----------------------------------------------------
+
+
+class TestCheckpointIntegrity:
+    def test_footer_roundtrip(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"w": np.ones(3, np.float32), "step": 7})
+        tree = load_and_broadcast(path)
+        assert tree["step"] == 7 and np.allclose(tree["w"], 1.0)
+
+    def test_rotation_retains_previous_step(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        assert os.path.exists(path) and os.path.exists(path + ".prev")
+
+    def test_corrupt_checkpoint_falls_back_one_step(
+            self, tmp_path, hvd, log_records):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        # Bit-rot the live checkpoint's payload (footer intact).
+        blob = bytearray(open(path, "rb").read())
+        blob[5] ^= 0xFF
+        open(path, "wb").write(bytes(blob))
+        tree = load_and_broadcast(path)
+        assert tree == {"step": 1}  # previous retained step, not a crash
+        assert any("corrupt" in m for m in log_records)
+        assert any("previous retained checkpoint" in m for m in log_records)
+
+    def test_truncated_checkpoint_falls_back(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        blob = open(path, "rb").read()
+        open(path, "wb").write(blob[:10])  # torn mid-payload
+        assert load_and_broadcast(path) == {"step": 1}
+
+    def test_injected_restore_fault_drives_fallback(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        faults.inject(faults.CHECKPOINT_RESTORE, "raise", at=1, count=1)
+        assert load_and_broadcast(path) == {"step": 1}
+        assert faults.fired(faults.CHECKPOINT_RESTORE) == 1
+
+    def test_missing_current_falls_back_to_prev(self, tmp_path, hvd):
+        """A crash between save_on_rank_0's two renames leaves no file at
+        `path` while .prev holds the last good checkpoint — resume must
+        use it, not silently restart from scratch."""
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        os.unlink(path)  # the crash window: rotated but never installed
+        assert load_and_broadcast(path) == {"step": 1}
+
+    def test_both_generations_corrupt_resumes_empty(self, tmp_path, hvd):
+        from horovod_tpu.checkpoint import load_and_broadcast, save_on_rank_0
+
+        path = str(tmp_path / "ckpt.pkl")
+        save_on_rank_0(path, {"step": 1})
+        save_on_rank_0(path, {"step": 2})
+        for p in (path, path + ".prev"):
+            blob = bytearray(open(p, "rb").read())
+            blob[5] ^= 0xFF
+            open(p, "wb").write(bytes(blob))
+        assert load_and_broadcast(path) is None  # missing semantics
+
+    def test_checkpointer_falls_back_to_previous_retained_step(
+            self, tmp_path, monkeypatch):
+        pytest.importorskip("orbax.checkpoint")
+        from horovod_tpu.checkpoint import Checkpointer
+
+        monkeypatch.setenv("HOROVOD_CHECKPOINT_RETRY_BACKOFF", "0.01")
+        ckpt = Checkpointer(str(tmp_path / "ck"), async_save=False)
+        ckpt.save(0, {"w": np.zeros(3, np.float32)}, wait=True)
+        ckpt.save(1, {"w": np.ones(3, np.float32)}, wait=True)
+        faults.inject(faults.CHECKPOINT_RESTORE, "raise", at=1, count=1)
+        tree = ckpt.restore()  # newest step injected-corrupt → previous
+        assert np.allclose(tree["w"], 0.0)
+        assert faults.fired(faults.CHECKPOINT_RESTORE) == 1
+        ckpt.close()
+
+    def test_checkpointer_explicit_step_does_not_fall_back(
+            self, tmp_path, monkeypatch):
+        pytest.importorskip("orbax.checkpoint")
+        from horovod_tpu.checkpoint import Checkpointer
+
+        monkeypatch.setenv("HOROVOD_CHECKPOINT_RETRY_BACKOFF", "0.01")
+        ckpt = Checkpointer(str(tmp_path / "ck"), async_save=False)
+        ckpt.save(0, {"w": np.zeros(3, np.float32)}, wait=True)
+        ckpt.save(1, {"w": np.ones(3, np.float32)}, wait=True)
+        faults.inject(faults.CHECKPOINT_RESTORE, "raise", at=1, count=1)
+        with pytest.raises(faults.InjectedFault):
+            ckpt.restore(step=1)  # the caller asked for THIS step
+        ckpt.close()
+
+
+# -- the recovery escalation ladder + storm breaker ---------------------------
+
+
+class TestRecoveryLadder:
+    def test_storm_breaker_trips_after_max_attempts(self, hvd, monkeypatch):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_MAX_ATTEMPTS", "3")
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        attempts = []
+
+        @elastic_run
+        def train(st):
+            attempts.append(1)
+            raise HorovodInternalError("flapping host")
+
+        with pytest.raises(RecoveryExhaustedError, match="3 consecutive"):
+            train(ObjectState(step=0))
+        assert len(attempts) == 3  # bounded, not an abort/recover livelock
+        assert hvd.is_initialized()  # later tests get a live world
+
+    def test_commit_progress_resets_breaker(self, hvd, monkeypatch):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_MAX_ATTEMPTS", "3")
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        attempts = []
+        state = ObjectState(step=0)
+
+        @elastic_run
+        def train(st):
+            attempts.append(1)
+            if len(attempts) <= 4:
+                st.step += 1
+                st.commit()  # real progress between failures
+                raise HorovodInternalError("one-off blip")
+            return "done"
+
+        # 4 failures > max_attempts=3, but each made progress: no trip.
+        assert train(state) == "done"
+        assert len(attempts) == 5
+
+    def test_ladder_escalates_restore_sync_durable(self, hvd, monkeypatch):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        calls = []
+
+        class SpyState(ObjectState):
+            def restore(self):
+                calls.append("restore")
+                super().restore()
+
+            def sync(self):
+                calls.append("sync")
+                super().sync()
+
+        state = SpyState(step=0)
+        state.register_durable_restore(lambda: calls.append("durable"))
+        failures = []
+
+        @elastic_run
+        def train(st):
+            if len(failures) < 3:
+                failures.append(1)
+                raise HorovodInternalError("boom")
+            return "recovered"
+
+        assert train(state) == "recovered"
+        # Rung 1: in-memory restore. Rung 2: NO local restore (sync-only
+        # re-rendezvous). Rung 3: durable checkpoint restore.
+        assert calls.count("restore") == 1
+        assert calls.count("durable") == 1
+        assert calls.count("sync") == 4  # before every attempt
+
+    def test_storm_breaker_trips_when_sync_itself_fails(
+            self, hvd, monkeypatch):
+        """Failures raised BEFORE the post-sync snapshot (sync itself
+        failing) must still advance the breaker — a prior attempt's
+        commits must not read as fresh progress on every retry."""
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_MAX_ATTEMPTS", "3")
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        syncs = []
+
+        class FailingSyncState(ObjectState):
+            def sync(self):
+                syncs.append(1)
+                if len(syncs) >= 2:
+                    raise HorovodInternalError("rank-0 flapping mid-sync")
+                super().sync()
+
+        state = FailingSyncState(step=0)
+
+        @elastic_run
+        def train(st):
+            st.step += 1
+            st.commit()  # progress inside the attempt...
+            raise HorovodInternalError("then the step fails")
+
+        # Attempt 1: sync ok, func commits then fails (cf=1, re-baselined).
+        # Attempts 2+: sync fails before any snapshot — the breaker must
+        # still count them and trip at 3, not livelock forever.
+        with pytest.raises(RecoveryExhaustedError):
+            train(state)
+        assert len(syncs) == 3
+
+    def test_abort_state_consumed_by_recovery(self, hvd, monkeypatch):
+        """An armed abort is consumed by the failure it caused: the next
+        attempt must not instantly re-raise on the stale flag."""
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        attempts = []
+
+        @elastic_run
+        def train(st):
+            attempts.append(1)
+            if len(attempts) == 1:
+                abort.trigger_local("stall shutdown on this host",
+                                    generation=0)
+                abort.raise_if_aborted()
+            # Second attempt: a clean world — dispatching a watched step
+            # must succeed.
+            with stall.watch(name="clean", cross_rank=False):
+                pass
+            return "done"
+
+        assert train(ObjectState(step=0)) == "done"
+        assert len(attempts) == 2
+        assert not abort.is_aborted()
+
+
+# -- end-to-end: the wedged survivor unblocks via the abort flag --------------
+
+
+def _read_lines_async(proc, sink):
+    def pump():
+        for line in proc.stdout:
+            sink.append(line.rstrip("\n"))
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    return t
+
+
+def _wait_for_line(lines, needle, timeout=120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(needle in l for l in lines):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _wait_stopped(pid, timeout=30.0):
+    """Block until the process is in SIGSTOP state ('T' in /proc stat)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                if f.read().rsplit(")", 1)[1].split()[0] in ("T", "t"):
+                    return True
+        except OSError:
+            return False
+        time.sleep(0.05)
+    return False
+
+
+class TestStallDeadmanExit:
+    def test_unresponsive_main_thread_hard_exits(self, tmp_path):
+        """When the shutdown SIGINT can never land (main thread wedged in
+        an uninterruptible call — simulated by ignoring SIGINT), the
+        inspector's deadman timer must hard-exit EXIT_STALL_ABANDONED so
+        the driver reaps the host instead of its heartbeats keeping the
+        wedge alive forever."""
+        from horovod_tpu.runner.elastic.constants import EXIT_STALL_ABANDONED
+
+        script = tmp_path / "deadman.py"
+        script.write_text(f"""
+import os, signal, sys, time
+sys.path.insert(0, {REPO_ROOT!r})
+os.environ["HOROVOD_STALL_CHECK_TIME"] = "0.2"
+os.environ["HOROVOD_STALL_SHUTDOWN_TIME"] = "0.5"
+os.environ["HOROVOD_STALL_EXIT_GRACE"] = "1.0"
+signal.signal(signal.SIGINT, signal.SIG_IGN)  # the uninterruptible wedge
+from horovod_tpu import stall
+
+with stall.watch(name="unkillable", cross_rank=False):
+    time.sleep(600)
+print("UNEXPECTED: wedge survived", flush=True)
+sys.exit(5)
+""")
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, str(script)], capture_output=True, text=True,
+            timeout=60,
+        )
+        assert proc.returncode == EXIT_STALL_ABANDONED, (
+            proc.returncode, proc.stdout, proc.stderr)
+        assert time.monotonic() - t0 < 30
+        assert "never surfaced it" in proc.stderr, proc.stderr
+
+
+class TestZombieFencingE2E:
+    def test_resumed_zombie_writes_rejected(self, tmp_path):
+        """SIGSTOP through a recovery, then resume — exactly what the
+        faults harness produces. The zombie's first KV write on resume
+        carries the pre-abort generation and must bounce off the fence
+        with 409, leaving the re-formed world's records untouched."""
+        server = RendezvousServer()
+        server.start()
+        script = tmp_path / "zombie.py"
+        script.write_text(f"""
+import os, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from urllib.error import HTTPError
+from horovod_tpu import faults
+from horovod_tpu.runner.http.kv_server import KVClient
+
+gen = int(os.environ["HOROVOD_WORLD_VERSION"])
+client = KVClient(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                  int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+                  retries=1, generation_fn=lambda: gen)
+client.put("scratch", "k", b"first life")
+print("PUT1 OK", flush=True)
+faults.self_suspend()
+# Resumed as a zombie: the world moved on while we were frozen.
+try:
+    client.put("scratch", "k", b"zombie corruption")
+    print("ZOMBIE WRITE ACCEPTED", flush=True)
+    sys.exit(7)
+except HTTPError as e:
+    print("ZOMBIE FENCED code=%d" % e.code, flush=True)
+    sys.exit(0 if e.code == 409 else 8)
+""")
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+            "HOROVOD_RENDEZVOUS_PORT": str(server.port),
+            "HOROVOD_WORLD_VERSION": "0",
+        })
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        lines = []
+        _read_lines_async(proc, lines)
+        try:
+            assert _wait_for_line(lines, "PUT1 OK"), lines
+            assert _wait_stopped(proc.pid), "worker never self-suspended"
+            # The world recovers without the frozen worker: generation
+            # bumps, abort posted for the old one.
+            server.reset()
+            server.post_abort("hostA hung; world re-formed", generation=0)
+            faults.resume(proc.pid)
+            rc = proc.wait(timeout=60)
+            assert rc == 0, (rc, lines)
+            assert any("ZOMBIE FENCED code=409" in l for l in lines), lines
+            assert server.fenced_writes == 1
+            # reset() cleared the store; the zombie re-created nothing.
+            assert KVClient("127.0.0.1", server.port).get(
+                "scratch", "k") is None
+        finally:
+            if proc.poll() is None:
+                faults.resume(proc.pid)
+                proc.kill()
+            proc.stdout.close()
+            server.stop()
+
+
+class TestAbortUnblocksWedgedSurvivorE2E:
+    """THE tentpole proof, with no driver in the loop so the unblock path
+    is unambiguous: rank 0 SIGSTOPs itself mid-world (sockets stay open —
+    no peer-closed error can ever fire), rank 1 wedges inside a native
+    allreduce rank 0 will never join, and the ONLY thing that can unblock
+    rank 1 is the abort flag posted to the rendezvous KV. It must convert
+    the wedge into HorovodInternalError within a bounded interval."""
+
+    @pytest.mark.slow
+    def test_survivor_unblocks_within_bounded_interval(self, tmp_path):
+        from horovod_tpu.runner.network import free_port
+
+        server = RendezvousServer()
+        server.start()
+        native_port = free_port()
+        script = tmp_path / "wedged.py"
+        script.write_text(f"""
+import os, sys, time
+sys.path.insert(0, {REPO_ROOT!r})
+import numpy as np
+from horovod_tpu import faults
+from horovod_tpu.exceptions import HorovodInternalError
+from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+from horovod_tpu.runtime import NativeWorld
+
+rank = int(sys.argv[1])
+ctx = ElasticWorkerContext()       # poll loop + abort monitor
+ctx.start_polling(interval=0.1)
+world = NativeWorld(rank, 2, "127.0.0.1", {native_port})
+for step in range(2):
+    out = world.allreduce(np.ones(4, np.float32),
+                          name="step.%d" % step, op="sum")
+    assert float(out[0]) == 2.0, out
+    print("rank=%d step=%d ok" % (rank, step), flush=True)
+if rank == 0:
+    print("rank=0 SUSPENDING", flush=True)
+    faults.self_suspend()          # hung mid-world; sockets stay open
+    time.sleep(600)
+    sys.exit(9)
+try:
+    world.allreduce(np.ones(4, np.float32), name="step.2", op="sum")
+    print("rank=1 UNEXPECTED COMPLETION", flush=True)
+    sys.exit(7)
+except HorovodInternalError as e:
+    print("rank=1 ABORTED: %s" % e, flush=True)
+    sys.exit(0)
+""")
+        def spawn(rank, host):
+            env = dict(os.environ)
+            env.update({
+                "HOROVOD_RENDEZVOUS_ADDR": "127.0.0.1",
+                "HOROVOD_RENDEZVOUS_PORT": str(server.port),
+                "HOROVOD_HOSTNAME": host,
+                "HOROVOD_WORLD_VERSION": "0",
+                "HOROVOD_ABORT_POLL_INTERVAL": "0.2",
+            })
+            return subprocess.Popen(
+                [sys.executable, str(script), str(rank)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+
+        p0 = spawn(0, "hostA")
+        p1 = spawn(1, "hostB")
+        lines0, lines1 = [], []
+        _read_lines_async(p0, lines0)
+        _read_lines_async(p1, lines1)
+        try:
+            assert _wait_for_line(lines0, "SUSPENDING"), (lines0, lines1)
+            assert _wait_for_line(lines1, "step=1 ok"), (lines0, lines1)
+            time.sleep(1.0)  # let rank 1 enter the step-2 wedge
+            assert p1.poll() is None, lines1  # wedged, as designed
+            t0 = time.monotonic()
+            server.post_abort("hostA hung mid-collective; recover")
+            rc = p1.wait(timeout=30)
+            elapsed = time.monotonic() - t0
+            assert rc == 0, (rc, lines1)
+            # Bound: abort poll interval (0.2s) + monitor interval +
+            # slack. 10s is generous; "forever" is the regression.
+            assert elapsed < 10.0, elapsed
+            assert any("ABORTED" in l and "coordinated abort" in l
+                       for l in lines1), lines1
+        finally:
+            for p in (p0, p1):
+                if p.poll() is None:
+                    try:
+                        faults.resume(p.pid)
+                    except OSError:
+                        pass
+                    p.kill()
+                p.stdout.close()
+            server.stop()
+
+
+class TestDriverRecoveryE2E:
+    """The full loop with the real ElasticDriver: a SIGSTOP'd worker is
+    condemned by the liveness plane, the driver posts the coordinated
+    abort and bumps the generation, the survivor recovers through the
+    elastic loop and finishes all epochs at the new generation."""
+
+    @pytest.mark.slow
+    def test_sigstop_recovery_re_forms_world_at_bumped_generation(
+            self, tmp_path, monkeypatch, log_records):
+        torch = pytest.importorskip("torch")  # noqa: F841
+        from horovod_tpu.runner.elastic.driver import run_elastic
+        from horovod_tpu.runner.launch import Settings
+
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "3.0")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", "0.3")
+        monkeypatch.setenv("HOROVOD_ELASTIC_HEARTBEAT_GRACE", "90")
+        monkeypatch.setenv("HOROVOD_ABORT_POLL_INTERVAL", "0.2")
+        worker = tmp_path / "recover_worker.py"
+        worker.write_text(f"""
+import os, sys
+sys.path.insert(0, {REPO_ROOT!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import numpy as np
+import torch
+import horovod_tpu.torch as hvd
+from horovod_tpu import faults
+from horovod_tpu.elastic import run as elastic_run
+from horovod_tpu.torch.elastic import TorchState
+
+host = os.environ["HOROVOD_HOSTNAME"]
+
+torch.manual_seed(0)
+model = torch.nn.Linear(4, 1, bias=False)
+opt = hvd.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.05),
+    named_parameters=model.named_parameters())
+state = TorchState(model=model, optimizer=opt, epoch=0)
+
+@elastic_run
+def train(state):
+    while state.epoch < 5:
+        if host == "localhost" and state.epoch == 2:
+            print("host=%s HANGING (SIGSTOP) at epoch 2" % host, flush=True)
+            faults.self_suspend()
+        r = hvd.rank()
+        x = torch.from_numpy(np.random.RandomState(
+            100 * state.epoch + r).randn(8, 4).astype(np.float32))
+        opt.zero_grad()
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        print("rank=%d epoch=%d np=%d gen=%s loss=%.6f" % (
+            r, state.epoch, hvd.size(),
+            os.environ.get("HOROVOD_WORLD_VERSION", "?"), float(loss)),
+            flush=True)
+        state.epoch += 1
+        state.commit()
+    return state.epoch
+
+done = train(state)
+print("host=%s finished at epoch %d" % (host, done), flush=True)
+""")
+        import stat
+
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost\n127.0.0.1\n")
+        discover = tmp_path / "discover.sh"
+        discover.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+        discover.chmod(discover.stat().st_mode | stat.S_IEXEC)
+        settings = Settings(
+            num_proc=2,
+            hosts=[],
+            command=[sys.executable, str(worker)],
+            cpu_mode=True,
+            elastic=True,
+            min_np=1,
+            max_np=2,
+            discovery_script=str(discover),
+            elastic_timeout=60.0,
+            env={},
+        )
+        lines = []
+        rc = run_elastic(settings, sink=lines.append)
+        text = "\n".join(lines)
+        assert rc == 0, text
+        assert "HANGING (SIGSTOP) at epoch 2" in text, text
+        assert any("finished at epoch 5" in l for l in lines), text
+        # The driver posted the coordinated abort for the dying world.
+        assert any("posting coordinated abort" in m for m in log_records), \
+            log_records
+        # Generation fencing of the recovery: epochs before the hang ran
+        # at generation g with np=2; the survivor's epochs after recovery
+        # run at a strictly HIGHER generation with np=1.
+        import re
+
+        seen = {}
+        for line in text.splitlines():
+            match = re.search(
+                r"rank=\d+ epoch=(\d+) np=(\d+) gen=(\d+)", line)
+            if match:
+                e, np_, gen = (int(match.group(1)), int(match.group(2)),
+                               int(match.group(3)))
+                seen.setdefault(e, []).append((np_, gen))
+        for e in range(5):
+            assert e in seen, (e, sorted(seen))
+        pre = {g for e in (0, 1) for _, g in seen[e]}
+        post = {g for e in (2, 3, 4) for _, g in seen[e]}
+        assert len(pre) == 1 and len(post) == 1, (pre, post)
+        assert max(post) > max(pre), (pre, post)  # generation g → g+1
+        assert all(n == 2 for e in (0, 1) for n, _ in seen[e]), seen
+        assert all(n == 1 for e in (2, 3, 4) for n, _ in seen[e]), seen

@@ -34,14 +34,20 @@ if [ "$rc" -ne 0 ]; then
     echo "premerge: only known-environmental failures; continuing"
 fi
 
-echo "== premerge gate 2/3: fault-injection smoke (chaos lane) =="
-# The FULL chaos file, slow marks included: the e2e liveness/recovery
-# tests are the acceptance proof for the robustness layer and must not
-# rot just because tier-1 deselects @slow.
-if ! timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
-    tests/test_faults.py -q --continue-on-collection-errors \
+echo "== premerge gate 2/3: fault-injection + recovery (chaos lane) =="
+# The FULL chaos files, slow marks included: the e2e liveness/abort/
+# recovery tests are the acceptance proof for the robustness layer and
+# must not rot just because tier-1 deselects @slow. test_recovery.py
+# additionally arms a HARD per-test wall-clock breaker (faulthandler
+# dump+exit after HOROVOD_TEST_HARD_TIMEOUT, default 300s): a regression
+# that re-introduces an unbounded hang fails THAT test fast with every
+# thread's stack dumped, instead of silently eating the lane's budget.
+if ! timeout -k 10 900 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
+    python -m pytest \
+    tests/test_faults.py tests/test_recovery.py -q \
+    --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
-    echo "premerge: fault-injection smoke failed" >&2
+    echo "premerge: fault-injection/recovery chaos lane failed" >&2
     exit 1
 fi
 
