@@ -82,8 +82,14 @@ from .optimizer import (  # noqa: F401
     DistributedOptimizer,
     ReduceSpec,
     grad,
+    init_sharded_state,
     reduce_spec_of,
+    reshard_opt_state,
+    resolve_sync_mode,
+    sharded_step_update,
+    unshard_opt_state,
 )
+from .ops.collective_ops import cache_stats  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object,
     broadcast_object,
@@ -102,8 +108,10 @@ from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401
 from .parallel import data_parallel  # noqa: F401
 from .parallel.data_parallel import (  # noqa: F401
+    DeferredParams,
     make_overlapped_train_step,
     overlap_gradient_sync,
+    shard_state,
 )
 from .stall import fetch  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401

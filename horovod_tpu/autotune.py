@@ -21,7 +21,7 @@ Usage::
 from __future__ import annotations
 
 import ctypes
-from typing import Callable, Sequence
+from typing import Any, Callable, Sequence
 
 from .utils.logging import get_logger
 
@@ -82,8 +82,8 @@ class BayesianTuner:
 # bucketing inside the user's jitted step, so the tuner re-traces the SAME
 # step per candidate threshold, times a few steps, and pins the winner.
 
-_tuned: dict = {"threshold": None, "segments": None, "aborted": False,
-                "history": []}
+_tuned: dict = {"threshold": None, "segments": None, "sync_mode": None,
+                "aborted": False, "history": []}
 
 
 def warmup_aborted() -> bool:
@@ -132,6 +132,29 @@ def set_tuned_segments(num_segments: int | None) -> None:
         None if num_segments is None else int(num_segments))
 
 
+def tuned_sync_mode() -> str | None:
+    """The pinned gradient sync mode (None = untuned; env/default rule).
+
+    Consulted by ``optimizer.resolve_sync_mode`` at DistributedOptimizer
+    CONSTRUCTION — the mode fixes the optimizer-state layout, so (unlike
+    the threshold/segments axes, which re-trace in place) a pin only
+    affects optimizers built after it lands."""
+    return _tuned["sync_mode"]
+
+
+def set_tuned_sync_mode(sync_mode: str | None) -> None:
+    """Pin (or clear, with None) the gradient sync mode. Wins over
+    ``HOROVOD_SYNC_MODE`` in ``optimizer.resolve_sync_mode``."""
+    if sync_mode is not None:
+        from .optimizer import _VALID_SYNC_MODES
+
+        if sync_mode not in _VALID_SYNC_MODES:
+            raise ValueError(
+                f"unknown sync_mode {sync_mode!r}; expected one of "
+                f"{_VALID_SYNC_MODES}")
+    _tuned["sync_mode"] = sync_mode
+
+
 def autotune_state() -> dict:
     """Introspection (parity: the native ``hvdrt_autotune_state``): the
     live threshold, whether a tuned decision is pinned, and the measured
@@ -142,6 +165,7 @@ def autotune_state() -> dict:
         "active": _tuned["threshold"] is not None,
         "fusion_threshold": fusion_threshold_bytes(),
         "overlap_segments": _tuned["segments"],
+        "sync_mode": _tuned["sync_mode"],
         "samples": len(_tuned["history"]),
         "history": list(_tuned["history"]),
     }
@@ -188,18 +212,29 @@ class AutotuneStep:
     """
 
     def __init__(self, jitted, thresholds=None, iters: int = 3,
-                 clock=None, segment_candidates=None):
+                 clock=None, segment_candidates=None,
+                 sync_mode_candidates=None):
         import time as _time
 
         self._fn = jitted
         self._tune_segments = segment_candidates is not None
-        if self._tune_segments:
-            # Joint (threshold, segments) grid: the overlap scheduler's
-            # ``segments`` axis. Both knobs change the traced program, so
-            # they pin together per window and broadcast together at finish.
+        self._tune_sync = sync_mode_candidates is not None
+        if self._tune_segments or self._tune_sync:
+            # Joint grid over the axes present — (threshold[, segments]
+            # [, sync_mode]). Every axis changes the traced program, so
+            # they pin together per window and broadcast together at
+            # finish. The sync_mode axis carries the caveat in
+            # :func:`tuned_sync_mode`: the mode fixes the optimizer-state
+            # LAYOUT, so only a step whose callable re-reads the pin per
+            # trace (a factory rebuilt per window, or a mode-agnostic
+            # harness like tune_step_sync_mode's) can ride this axis —
+            # the stock factories tune threshold/segments only.
             self._cands = [
-                (int(t), int(s))
-                for s in segment_candidates
+                (int(t),)
+                + ((int(s),) if self._tune_segments else ())
+                + ((str(m),) if self._tune_sync else ())
+                for m in (sync_mode_candidates or (None,))
+                for s in (segment_candidates or (None,))
                 for t in (thresholds or DEFAULT_THRESHOLDS)
             ]
         else:
@@ -214,6 +249,14 @@ class AutotuneStep:
         self._co_steps: list = []  # steps built mid-warmup: re-trace at pin
         self._hvd_tuning = True  # stall watch skips while tuning
 
+    def _axes_name(self) -> str:
+        axes = ["fusion_threshold_bytes"]
+        if self._tune_segments:
+            axes.append("overlap_segments")
+        if self._tune_sync:
+            axes.append("sync_mode")
+        return "+".join(axes)
+
     def _fetch_probe(self, out) -> None:
         import jax
         import numpy as np
@@ -227,13 +270,19 @@ class AutotuneStep:
         np.asarray(probe)  # value fetch: proves execution finished
 
     def _pin(self, cand) -> None:
-        """Pin one candidate process-wide (threshold, or jointly
-        (threshold, segments) when the segments axis is tuned)."""
-        if self._tune_segments:
-            set_tuned_threshold(cand[0])
-            set_tuned_segments(cand[1])
-        else:
+        """Pin one candidate process-wide: the threshold, plus jointly
+        the segments and/or sync_mode axes when tuned."""
+        if not (self._tune_segments or self._tune_sync):
             set_tuned_threshold(cand)
+            return
+        cand = tuple(cand)
+        set_tuned_threshold(cand[0])
+        i = 1
+        if self._tune_segments:
+            set_tuned_segments(cand[i])
+            i += 1
+        if self._tune_sync:
+            set_tuned_sync_mode(cand[i])
 
     def _finish(self) -> None:
         import json
@@ -241,8 +290,9 @@ class AutotuneStep:
 
         best = min(self._samples, key=lambda s: s[1])
         decision = best[0]
-        if self._tune_segments:
-            decision = (int(decision[0]), int(decision[1]))
+        if isinstance(decision, tuple):
+            decision = tuple(
+                x if isinstance(x, str) else int(x) for x in decision)
         else:
             decision = int(decision)
         from .process_world import rank as _prank
@@ -279,9 +329,7 @@ class AutotuneStep:
         log = get_logger()
         log.info(
             "autotune: pinned %s=%s after %d warmup windows %s",
-            ("(fusion_threshold, overlap_segments)" if self._tune_segments
-             else "fusion_threshold"),
-            decision, len(self._samples),
+            self._axes_name(), decision, len(self._samples),
             [(t, round(s, 5)) for t, s in self._samples])
         path = os.environ.get("HOROVOD_AUTOTUNE_LOG", "")
         # One writer only: the env propagates to every worker and the
@@ -297,10 +345,7 @@ class AutotuneStep:
             try:
                 with open(path, "a") as f:
                     f.write(json.dumps({
-                        "tunable": (
-                            "fusion_threshold_bytes+overlap_segments"
-                            if self._tune_segments
-                            else "fusion_threshold_bytes"),
+                        "tunable": self._axes_name(),
                         "decision": decision,
                         "samples": self._samples,
                     }) + "\n")
@@ -381,13 +426,17 @@ class AutotuneStep:
 _active_tuner: list = []  # at most one in-flight warmup tuner per process
 
 
-def maybe_autotune_step(jitted, segment_candidates=None):
+def maybe_autotune_step(jitted, segment_candidates=None,
+                        sync_mode_candidates=None):
     """Wrap ``jitted`` in transparent warmup tuning when
     ``HOROVOD_AUTOTUNE=1`` (env or config) — the factory entry point.
 
     ``segment_candidates`` (the overlap scheduler's factory passes
     :data:`DEFAULT_SEGMENT_CANDIDATES`) switches the tuner to the joint
-    (threshold, segments) grid.
+    (threshold, segments) grid; ``sync_mode_candidates`` adds the
+    sync_mode axis (see :func:`tuned_sync_mode` for its layout caveat —
+    the stock factories do not pass it; :func:`tune_step_sync_mode` is
+    the mode-agnostic harness).
 
     At most ONE tuner is live per process: the threshold is
     process-global, so a second factory call before the first tuner
@@ -405,9 +454,67 @@ def maybe_autotune_step(jitted, segment_candidates=None):
         # its cache when the winner lands and it re-traces tuned.
         _active_tuner[0]._co_steps.append(jitted)
         return jitted
-    tuner = AutotuneStep(jitted, segment_candidates=segment_candidates)
+    tuner = AutotuneStep(jitted, segment_candidates=segment_candidates,
+                         sync_mode_candidates=sync_mode_candidates)
     _active_tuner[:] = [tuner]
     return tuner
+
+
+def tune_step_sync_mode(
+    build_step: Callable[[str], Callable[[], Any]],
+    sync_modes: Sequence[str] = ("allreduce", "sharded"),
+    iters: int = 3,
+) -> str:
+    """Explicit warmup tuning of the gradient sync mode.
+
+    The sync_mode axis cannot ride the transparent per-step tuner for a
+    stock factory step: the mode fixes the optimizer-state LAYOUT
+    (monolithic pytree vs sharded stacked rows), so one jitted step
+    cannot re-trace between modes against the same state arguments.
+    This harness sidesteps that by letting the caller rebuild the whole
+    (optimizer, state, step) world per mode::
+
+        def build(mode):
+            opt = hvd.DistributedOptimizer(optax.adam(1e-3),
+                                           sync_mode=mode)
+            step = hvd.data_parallel.make_train_step(loss_fn, opt)
+            state = make_state_for(opt)          # replicate / shard_state
+            return lambda: step(*state.feed())   # one timed step
+
+    The fastest mode is pinned via :func:`set_tuned_sync_mode` (so
+    optimizers built afterwards with ``sync_mode=None`` inherit it) and
+    returned. Abort semantics match the step tuner: an exception
+    mid-sweep pins the rank-identical FIRST mode before re-raising, so a
+    partially-sampled decision can never diverge across ranks.
+    """
+    import time as _time
+
+    import jax
+
+    log = get_logger()
+    results: list[tuple[str, float]] = []
+    try:
+        for mode in sync_modes:
+            run = build_step(mode)
+            out = run()  # compile + settle
+            jax.block_until_ready(out)
+            t0 = _time.perf_counter()
+            for _ in range(max(1, iters)):
+                out = run()
+            jax.block_until_ready(out)
+            seconds = (_time.perf_counter() - t0) / max(1, iters)
+            results.append((mode, seconds))
+            log.info("autotune sync_mode: %s -> %.6fs/step", mode, seconds)
+    except Exception:
+        set_tuned_sync_mode(sync_modes[0])
+        log.warning(
+            "autotune sync_mode: aborted mid-sweep; pinned the "
+            "rank-identical first candidate %r", sync_modes[0])
+        raise
+    best = min(results, key=lambda p: p[1])[0]
+    set_tuned_sync_mode(best)
+    log.info("autotune sync_mode: pinned %r", best)
+    return best
 
 
 def tune_step_fusion(

@@ -227,6 +227,57 @@ def save_on_rank_0(path: str, tree: Any) -> None:
     _save_with_retries(write, what=path)
 
 
+def save_state_on_rank_0(path: str, optimizer, params: Any,
+                         opt_state: Any, **extras: Any) -> None:
+    """Rank-0 train-state checkpoint whose on-disk layout is
+    sync_mode-INDEPENDENT: a ``sync_mode='sharded'`` optimizer's state is
+    gathered to the monolithic layout before the write (gather-on-save),
+    so checkpoints written under either mode are byte-interchangeable —
+    a sharded job can resume a monolithic checkpoint and vice versa (the
+    load side re-shards; see :func:`load_state_and_broadcast`). The
+    gather is pure host math (the stacked rows already hold every rank's
+    shard): no collective, no extra wire.
+    """
+    from .optimizer import reduce_spec_of, unshard_opt_state
+
+    spec = reduce_spec_of(optimizer)
+    if spec is not None and getattr(spec, "sync_mode", None) == "sharded":
+        # Deliberately NOT gated on rank 0: in a multi-controller world
+        # the state's stacked rows span non-addressable devices and the
+        # unshard is a COLLECTIVE allgather — every process must reach
+        # it. Single-controller worlds have no other ranks to spare the
+        # transient full-state materialization anyway.
+        opt_state = unshard_opt_state(spec, opt_state, params)
+    save_on_rank_0(path, {"params": params, "opt_state": opt_state,
+                          **extras})
+
+
+def load_state_and_broadcast(path: str, optimizer, root_rank: int = 0,
+                             world_size: int | None = None) -> Any | None:
+    """Resume counterpart of :func:`save_state_on_rank_0`: rank 0 loads
+    the monolithic-layout checkpoint, everyone receives it, and — when
+    ``optimizer`` was built with ``sync_mode='sharded'`` — the optimizer
+    state is re-sharded for the CURRENT world (ownership is a pure
+    function of the world size and parameter shapes, so a checkpoint
+    written at N ranks restores cleanly at M). Returns the state dict
+    (``params`` / ``opt_state`` / extras) or None when no checkpoint is
+    readable."""
+    from .optimizer import reduce_spec_of, reshard_opt_state
+
+    obj = load_and_broadcast(path, root_rank)
+    if obj is None:
+        return None
+    spec = reduce_spec_of(optimizer)
+    if spec is not None and getattr(spec, "sync_mode", None) == "sharded":
+        n = world_size
+        if n is None:
+            n = spec.process_set.size()
+        obj = dict(obj)
+        obj["opt_state"] = reshard_opt_state(
+            spec, obj["opt_state"], obj["params"], n)
+    return obj
+
+
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
     """Rank 0 loads; everyone receives via broadcast_object (resume parity
     with ``hvd.broadcast_object(torch.load(...))``).

@@ -147,7 +147,12 @@ def run(func):
                         state.on_reset()
                         needs_reset = False
                 first_init_failure = None
-                if not skip_sync:
+                # A skip-sync host update normally keeps in-memory state,
+                # but a state whose layout is world-shaped (the sharded
+                # optimizer's stacked shards) must re-shard for the new
+                # world regardless — needs_world_sync() flags it.
+                if not skip_sync or getattr(
+                        state, "needs_world_sync", lambda: False)():
                     state.sync()
                 from ..runner.elastic.worker import _counters
 

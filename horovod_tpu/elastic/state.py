@@ -73,6 +73,15 @@ class State:
         for cb in self._reset_callbacks:
             cb()
 
+    def needs_world_sync(self) -> bool:
+        """True when this state's layout is stale for the CURRENT world
+        and the elastic loop must run ``sync()`` even on a
+        skip-sync re-rendezvous (``HostsUpdatedInterrupt.skip_sync``).
+        Base states carry no world-shaped layout; the sharded-optimizer
+        TpuState overrides this (its stacked optimizer state has a
+        leading world axis that a resize invalidates)."""
+        return False
+
     def check_host_updates(self) -> None:
         """Surface pending driver notifications as HostsUpdatedInterrupt.
 
@@ -139,17 +148,60 @@ class TpuState(State):
 
         state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
                                      epoch=0, batch=0)
+
+    ``sharded_optimizer``: pass the ``sync_mode='sharded'``
+    DistributedOptimizer whose stacked state ``opt_state`` holds. Across
+    an elastic world resize, shard ownership is a pure function of the
+    NEW world size and the parameter shapes, so ``sync()`` (which always
+    runs during re-rendezvous) gathers the old world's shards to the
+    monolithic layout, broadcasts rank-0's copy, and re-shards for the
+    current world — recovery and the escalation ladder keep working with
+    no extra coordination. :meth:`needs_world_sync` flags a stale
+    leading world axis so even a skip-sync host update re-shards.
     """
 
-    def __init__(self, params=None, opt_state=None, **extras):
+    def __init__(self, params=None, opt_state=None, sharded_optimizer=None,
+                 **extras):
         super().__init__()
         self.params = params
         self.opt_state = opt_state
+        self._sharded_spec = None
+        if sharded_optimizer is not None:
+            from ..optimizer import reduce_spec_of
+
+            spec = reduce_spec_of(sharded_optimizer)
+            if spec is None or getattr(spec, "sync_mode", None) != "sharded":
+                raise ValueError(
+                    "sharded_optimizer must be a DistributedOptimizer "
+                    "built with sync_mode='sharded'")
+            self._sharded_spec = spec
         for k, v in extras.items():
             setattr(self, k, v)
         self._extras = list(extras.keys())
         self._saved: dict[str, Any] | None = None
         self.commit()
+
+    def _state_world_size(self) -> int | None:
+        """Leading world-axis length of the stacked sharded state (every
+        array leaf carries it by construction), or None without one."""
+        if self._sharded_spec is None or self.opt_state is None:
+            return None
+        leaves = jax.tree.leaves(self.opt_state)
+        return int(np.shape(leaves[0])[0]) if leaves else None
+
+    def needs_world_sync(self) -> bool:
+        if self._sharded_spec is None or self.opt_state is None:
+            return False
+        from .. import basics
+
+        if not basics.is_initialized():
+            return False
+        if not self._looks_sharded():
+            # A monolithic layout mid-run (rung-3 durable restore from a
+            # gather-on-save checkpoint): sync() re-shards it.
+            return True
+        n = self._state_world_size()
+        return n is not None and n != basics.size()
 
     def commit(self) -> None:
         self._saved = {
@@ -168,11 +220,58 @@ class TpuState(State):
 
     def sync(self) -> None:
         self.params = broadcast_parameters(self.params, root_rank=0)
-        self.opt_state = broadcast_parameters(self.opt_state, root_rank=0)
+        if self._sharded_spec is not None and self.opt_state is not None:
+            # Re-shard for the CURRENT world: gather the stacked shards
+            # to the monolithic layout (pure host math — the rows hold
+            # every rank's shard), broadcast rank-0's copy like any other
+            # state, then re-derive ownership from the new world size.
+            # Also heals a rung-3 durable restore that installed a
+            # monolithic-layout opt_state: unshard of an already-full
+            # state is skipped by layout detection below.
+            from .. import basics
+            from ..optimizer import reshard_opt_state, unshard_opt_state
+
+            full = self.opt_state
+            if self._looks_sharded():
+                full = unshard_opt_state(
+                    self._sharded_spec, self.opt_state, self.params)
+            full = broadcast_parameters(full, root_rank=0)
+            self.opt_state = reshard_opt_state(
+                self._sharded_spec, full, self.params, basics.size())
+        else:
+            self.opt_state = broadcast_parameters(
+                self.opt_state, root_rank=0)
         extras = broadcast_object({k: getattr(self, k) for k in self._extras})
         for k, v in extras.items():
             setattr(self, k, v)
         self.commit()
+
+    def _looks_sharded(self) -> bool:
+        """Distinguish the stacked sharded layout from a monolithic one
+        (e.g. installed by a rung-3 durable restore from a gather-on-save
+        checkpoint) so ``sync()`` knows whether an unshard is due.
+
+        Exact, not heuristic: the monolithic layout IS
+        ``spec.inner.init(params)``'s layout, so the state is monolithic
+        iff every leaf shape matches that template's. In the one
+        coincidental case where a sharded state's every leaf happens to
+        match (a parameter whose leading dim equals the world size),
+        the two layouts are element-identical — row r of ``(n, s)`` is
+        slice r — so skipping the unshard is still correct."""
+        from ..optimizer import _SaltState
+
+        state = self.opt_state
+        if isinstance(state, _SaltState):
+            if np.ndim(state.counter) == 0:
+                return False  # monolithic _SaltState.counter is scalar
+            state = state.inner_state
+        # eval_shape: the template's SHAPES without allocating the full
+        # monolithic state (2x params for Adam) on the recovery path.
+        template = jax.eval_shape(self._sharded_spec.inner.init,
+                                  self.params)
+        t_shapes = [np.shape(l) for l in jax.tree.leaves(template)]
+        s_shapes = [np.shape(l) for l in jax.tree.leaves(state)]
+        return t_shapes != s_shapes
 
 
 class ExtrasState(State):

@@ -28,6 +28,7 @@ Reduce op constants mirror ``horovod/common/common.h``'s ``ReduceOp``.
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Any, Sequence
 
@@ -37,6 +38,37 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .executable_cache import global_cache
+
+# Per-kind eager-dispatch counters (allreduce/allgather/broadcast/...):
+# the observability counterpart of the reference's per-op timeline
+# counts. Compiled-regime collectives are invisible here by design —
+# they are HLOs inside the user's step; these count the EAGER surface
+# whose executables ride the cache below. Read via :func:`cache_stats`.
+_dispatch_counts: "collections.Counter[str]" = collections.Counter()
+
+
+def cache_stats() -> dict:
+    """Executable-cache and eager-dispatch counters.
+
+    Parity: the reference's response-cache hit statistics
+    (``response_cache.cc``) surfaced through the timeline. Returns::
+
+        {"executable_cache": {"hits", "misses", "size", "capacity"},
+         "eager_dispatch": {kind: count, ...}}
+
+    Also surfaced in ``hvd.profiler.summary()`` and emitted once per run
+    by ``bench.py``.
+    """
+    cache = global_cache()
+    return {
+        "executable_cache": {
+            "hits": cache.hits,
+            "misses": cache.misses,
+            "size": len(cache),
+            "capacity": cache.capacity,
+        },
+        "eager_dispatch": dict(_dispatch_counts),
+    }
 
 # -- Reduce ops (parity: horovod.torch.mpi_ops Average/Sum/Adasum/Min/Max) ---
 
@@ -286,6 +318,7 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     from ..timeline import activity, mark_cycle
 
     mark_cycle()
+    _dispatch_counts[kind] += 1
     cache = global_cache()
     misses_before = cache.misses
     compiled = cache.get_or_build(key, build)

@@ -28,24 +28,46 @@ class ExecutableCache:
             collections.OrderedDict()
         )
         self._lock = threading.Lock()
+        # In-flight builds, keyed like entries: concurrent misses on the
+        # same key must not each pay a full XLA compile (seconds) nor
+        # each count a miss — the first caller builds, the rest wait on
+        # its event and read the landed entry (single-flight).
+        self._building: dict[Hashable, threading.Event] = {}
         self.hits = 0
         self.misses = 0
 
     def get_or_build(self, key: Hashable, build: Callable[[], Any]) -> Any:
-        with self._lock:
-            if key in self._entries:
-                self._entries.move_to_end(key)
-                self.hits += 1
-                return self._entries[key]
+        while True:
+            with self._lock:
+                if key in self._entries:
+                    self._entries.move_to_end(key)
+                    self.hits += 1
+                    return self._entries[key]
+                pending = self._building.get(key)
+                if pending is None:
+                    done = self._building[key] = threading.Event()
+                    break
+            # Another thread is compiling this key: wait it out, then
+            # re-check — its entry lands as our hit. If the builder
+            # FAILED (event set, no entry), the loop elects us builder.
+            pending.wait()
         # Build outside the lock: XLA compiles can take seconds and must not
-        # serialize unrelated lookups. A racing duplicate build is benign.
-        value = build()
+        # serialize unrelated lookups.
+        try:
+            value = build()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            done.set()  # wake waiters; one of them retries the build
+            raise
         with self._lock:
             self.misses += 1
             self._entries[key] = value
             self._entries.move_to_end(key)
             while len(self._entries) > self.capacity:
                 self._entries.popitem(last=False)
+            self._building.pop(key, None)
+        done.set()
         return value
 
     def clear(self) -> None:

@@ -206,6 +206,148 @@ def fused_allreduce_pytree(
     return jax.tree.unflatten(treedef, reduced)
 
 
+def shard_ownership(leaves: Sequence[Any], world_size: int) -> list[int]:
+    """Per-leaf shard sizes for the sharded sync mode's ownership map.
+
+    Rank ``r`` owns elements ``[r*s : (r+1)*s]`` of every leaf's flat view
+    zero-padded to ``world_size * s``, where ``s = ceil(size / world_size)``
+    — so ownership is byte-balanced per leaf and every rank's owned bytes
+    total ``~1/world_size`` of the model. Same stability contract as
+    :func:`segment_leaves`: the map depends only on the leaves'
+    shapes/order and the world size (never on values, timing, or rank),
+    so every rank — and every retrace — derives the identical ownership,
+    which the rank-identical collective sequence and the sharded
+    optimizer-state layout both require. Being PER-LEAF (not per-bucket)
+    makes the map independent of the fusion threshold and the overlap
+    segment count: wire grouping can change (autotune, K) without
+    invalidating optimizer state sharded under a different grouping.
+    """
+    n = max(1, int(world_size))
+    return [max(1, -(-int(leaf.size) // n)) for leaf in leaves]
+
+
+def _pack_shard_rows(leaves, shard_sizes, world_size):
+    """Pack same-dtype leaves into one ``(world_size, R)`` block whose row
+    ``r`` is the concatenation of rank r's per-leaf owned slices — the
+    layout under which a tiled reduce-scatter of the flattened block hands
+    each rank exactly its owned slices, contiguously."""
+    n = world_size
+    rows = [
+        jnp.pad(leaf.ravel(), (0, n * s - int(leaf.size))).reshape(n, s)
+        for leaf, s in zip(leaves, shard_sizes)
+    ]
+    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=1)
+
+
+def _split_shard_row(row, shard_sizes):
+    """Inverse of one row of :func:`_pack_shard_rows`: split a rank's
+    contiguous owned run back into per-leaf 1-D shards."""
+    out = []
+    offset = 0
+    for s in shard_sizes:
+        out.append(row[offset:offset + s])
+        offset += s
+    return out
+
+
+def fused_reducescatter(
+    tensors: Sequence[Any],
+    op,
+    axis_name: str,
+    world_size: int,
+    threshold_bytes: int | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    issue_reversed: bool = False,
+) -> list[Any]:
+    """Reduce a tensor list across ``axis_name`` keeping only the locally
+    owned shard of each tensor — the gradient half of the sharded sync
+    mode (an allreduce is reduce-scatter + allgather; this emits just the
+    first half, so only ~half the wire time sits on the gradient critical
+    path).
+
+    Buckets ride :func:`bucket_leaves` exactly like :func:`fused_allreduce`
+    (same-dtype, threshold-capped); within each bucket the leaves are
+    packed in the :func:`_pack_shard_rows` interleaved layout so ONE tiled
+    ``psum_scatter`` per bucket hands every rank its per-leaf owned slices
+    (ownership map: :func:`shard_ownership`). Returns one 1-D shard per
+    input tensor, length ``shard_ownership(tensors, world_size)[i]``.
+    """
+    from jax import lax
+
+    from ..profiler import annotate_collective
+    from .collective_ops import Average, Sum
+
+    if op not in (Sum, Average):
+        raise ValueError(f"fused_reducescatter supports Sum/Average, got {op!r}")
+    n = int(world_size)
+    tensors = [jnp.asarray(t) for t in tensors]
+    sizes = shard_ownership(tensors, n)
+    scale = postscale_factor / n if op == Average else postscale_factor
+    out: list[Any] = [None] * len(tensors)
+    buckets = bucket_leaves(tensors, threshold_bytes)
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
+        bucket_sizes = [sizes[i] for i in bucket]
+        with annotate_collective(f"reducescatter.bucket{bi}"):
+            flat = _pack_shard_rows(
+                [tensors[i] for i in bucket], bucket_sizes, n).ravel()
+            if prescale_factor != 1.0:
+                flat = flat * jnp.asarray(prescale_factor, flat.dtype)
+            row = lax.psum_scatter(
+                flat, axis_name, scatter_dimension=0, tiled=True)
+            if scale != 1.0:
+                row = row * jnp.asarray(scale, row.dtype)
+        for i, shard in zip(bucket, _split_shard_row(row, bucket_sizes)):
+            out[i] = shard
+    return out
+
+
+def fused_allgather_shards(
+    shards: Sequence[Any],
+    templates: Sequence[Any],
+    axis_name: str,
+    world_size: int,
+    threshold_bytes: int | None = None,
+    issue_reversed: bool = False,
+) -> list[Any]:
+    """Inverse of :func:`fused_reducescatter`: every rank contributes its
+    per-leaf owned shards and receives the full tensors (template shapes,
+    shard dtype — callers cast). This is the parameter half of the sharded
+    sync mode: issued on *updated parameters*, it sits off the gradient
+    critical path where XLA can overlap it with neighboring compute.
+
+    Bucketing follows ``bucket_leaves(templates)`` so the grouping is
+    derived from the same static facts on every rank.
+    """
+    from jax import lax
+
+    from ..profiler import annotate_collective
+
+    n = int(world_size)
+    templates = list(templates)
+    sizes = shard_ownership(templates, n)
+    out: list[Any] = [None] * len(templates)
+    buckets = bucket_leaves(templates, threshold_bytes)
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
+        bucket_sizes = [sizes[i] for i in bucket]
+        row = (shards[bucket[0]] if len(bucket) == 1
+               else jnp.concatenate([shards[i] for i in bucket]))
+        with annotate_collective(f"allgather.bucket{bi}"):
+            full = lax.all_gather(row, axis_name, axis=0, tiled=True)
+        grid = full.reshape(n, -1)
+        offset = 0
+        for i, s in zip(bucket, bucket_sizes):
+            t = templates[i]
+            out[i] = (grid[:, offset:offset + s]
+                      .reshape(-1)[: int(t.size)].reshape(t.shape))
+            offset += s
+    return out
+
+
 def pad_to_multiple(x, multiple: int, axis: int = 0):
     """Zero-pad `x` along `axis` to a multiple of `multiple`.
 

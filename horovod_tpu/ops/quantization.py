@@ -127,6 +127,153 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
     return out
 
 
+def _reduce_scattered_rows(rows, axis_name, n, op, salt):
+    """Quantized exchange of a ``(n, R')`` block (``R' % BLOCK == 0``):
+    each rank ends with row ``r`` REDUCED — the first half of the EQuARX
+    allreduce (quantize → all_to_all → dequant-sum), with no requant/
+    all_gather tail. Returns the reduced f32 row of length ``R'``."""
+    rows_per_chunk = rows.shape[1] // BLOCK
+    q, scale = _quantize_blocks(rows.reshape(-1), salt)
+    q = q.reshape(n, rows_per_chunk, BLOCK)
+    scale = scale.reshape(n, rows_per_chunk)
+    recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True).reshape(n, rows_per_chunk, BLOCK)
+    recv_scale = lax.all_to_all(
+        scale[:, :, None], axis_name, split_axis=0, concat_axis=0,
+        tiled=True).reshape(n, rows_per_chunk)
+    total = jnp.sum(recv.astype(jnp.float32)
+                    * recv_scale[:, :, None], axis=0)
+    if op == "average":
+        total = total / n
+    return total.reshape(-1)
+
+
+def int8_fused_reducescatter(
+    tensors,
+    axis_name: str,
+    world_size: int,
+    op: str = "average",
+    threshold_bytes: int | None = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    salt=None,
+    issue_reversed: bool = False,
+):
+    """Int8 gradient half of the sharded sync mode: same buckets and
+    per-leaf ownership map as ``fusion.fused_reducescatter``, but the
+    exchange is the quantized all_to_all + local dequant-sum (the first
+    half of :func:`int8_allreduce_flat`, which is itself reduce-scatter +
+    allgather in EQuARX form). Each rank keeps only its owned per-leaf
+    slices as f32 1-D shards (callers cast). Non-float leaves ride an
+    uncompressed allreduce and are sliced locally."""
+    from .collective_ops import _allreduce_traced
+    from .fusion import (
+        _pack_shard_rows,
+        _split_shard_row,
+        bucket_leaves,
+        shard_ownership,
+    )
+    from ..profiler import annotate_collective
+
+    n = int(world_size)
+    tensors = [jnp.asarray(t) for t in tensors]
+    sizes = shard_ownership(tensors, n)
+    out: list = [None] * len(tensors)
+    float_idx = [i for i, t in enumerate(tensors)
+                 if jnp.issubdtype(t.dtype, jnp.floating)]
+    for i, t in enumerate(tensors):
+        if i not in float_idx:
+            full = _allreduce_traced(
+                t, op, axis_name, prescale_factor, postscale_factor)
+            s = sizes[i]
+            padded = jnp.pad(full.ravel(), (0, n * s - int(full.size)))
+            r = lax.axis_index(axis_name)
+            out[i] = lax.dynamic_slice(padded, (r * s,), (s,))
+    floats = [tensors[i].ravel().astype(jnp.float32) for i in float_idx]
+    float_sizes = [sizes[i] for i in float_idx]
+    buckets = bucket_leaves(floats, threshold_bytes)
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
+        bucket_sizes = [float_sizes[j] for j in bucket]
+        rows = _pack_shard_rows(
+            [floats[j] for j in bucket], bucket_sizes, n)
+        if prescale_factor != 1.0:
+            rows = rows * prescale_factor
+        R = rows.shape[1]
+        pad = (-R) % BLOCK
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        with annotate_collective(f"int8_reducescatter.bucket{bi}"):
+            row = _reduce_scattered_rows(rows, axis_name, n, op, salt)[:R]
+        if postscale_factor != 1.0:
+            row = row * postscale_factor
+        for j, shard in zip(bucket, _split_shard_row(row, bucket_sizes)):
+            out[float_idx[j]] = shard
+    return out
+
+
+def int8_fused_allgather_shards(
+    shards,
+    templates,
+    axis_name: str,
+    world_size: int,
+    threshold_bytes: int | None = None,
+    salt=None,
+    issue_reversed: bool = False,
+):
+    """Int8 parameter half of the sharded sync mode: requantize MY
+    updated per-leaf shards (one contiguous row per bucket), all_gather
+    int8 + scales (the second half of the EQuARX exchange), dequantize,
+    and unpack to full tensors (template shapes, f32 — callers cast).
+    Non-float templates all_gather uncompressed."""
+    from .fusion import bucket_leaves, shard_ownership
+    from ..profiler import annotate_collective
+
+    n = int(world_size)
+    templates = list(templates)
+    sizes = shard_ownership(templates, n)
+    out: list = [None] * len(templates)
+    # dtype via the attribute, not jnp.asarray: templates may be
+    # ShapeDtypeStructs (the deferred-gather path passes shape specs).
+    float_idx = [i for i, t in enumerate(templates)
+                 if jnp.issubdtype(jnp.dtype(t.dtype), jnp.floating)]
+    for i, t in enumerate(templates):
+        if i not in float_idx:
+            full = lax.all_gather(shards[i], axis_name, axis=0, tiled=True)
+            out[i] = full[: int(t.size)].reshape(t.shape)
+    f_templates = [templates[i] for i in float_idx]
+    f_sizes = [sizes[i] for i in float_idx]
+    buckets = bucket_leaves(f_templates, threshold_bytes)
+    for bi, bucket in (
+            reversed(list(enumerate(buckets))) if issue_reversed
+            else enumerate(buckets)):
+        bucket_sizes = [f_sizes[j] for j in bucket]
+        row = (shards[float_idx[bucket[0]]] if len(bucket) == 1
+               else jnp.concatenate(
+                   [shards[float_idx[j]] for j in bucket]))
+        row = row.astype(jnp.float32)
+        R = int(row.size)
+        pad = (-R) % BLOCK
+        if pad:
+            row = jnp.pad(row, (0, pad))
+        q, scale = _quantize_blocks(row, salt)
+        with annotate_collective(f"int8_allgather.bucket{bi}"):
+            gathered = lax.all_gather(
+                q.reshape(-1, BLOCK), axis_name)           # [n, r, B]
+            gathered_scale = lax.all_gather(scale, axis_name)  # [n, r]
+        grid = (gathered.astype(jnp.float32)
+                * gathered_scale[:, :, None]).reshape(n, -1)[:, :R]
+        offset = 0
+        for j, s in zip(bucket, bucket_sizes):
+            i = float_idx[j]
+            t = templates[i]
+            out[i] = (grid[:, offset:offset + s]
+                      .reshape(-1)[: int(t.size)].reshape(t.shape))
+            offset += s
+    return out
+
+
 def int8_fused_allreduce(
     tensors,
     axis_name: str,

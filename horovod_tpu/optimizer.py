@@ -134,6 +134,207 @@ def _reduce_grads(
     return jax.tree.unflatten(treedef, restored)
 
 
+_VALID_SYNC_MODES = ("allreduce", "sharded")
+
+
+def resolve_sync_mode(sync_mode: str | None = None) -> str:
+    """Resolve the gradient sync mode: explicit argument > pinned autotune
+    decision (``autotune.set_tuned_sync_mode``) > ``HOROVOD_SYNC_MODE``
+    env > ``"allreduce"``.
+
+    Resolution happens at **optimizer construction** (not trace time, like
+    the fusion threshold): the mode fixes the optimizer-state layout
+    (monolithic full pytree vs sharded stacked rows), which ``init`` and
+    ``update`` must agree on — an already-built optimizer keeps its mode
+    even if a tuner pins a different one later.
+    """
+    if sync_mode is None:
+        from .autotune import tuned_sync_mode
+
+        sync_mode = tuned_sync_mode()
+    if sync_mode is None:
+        import os
+
+        env = os.environ.get("HOROVOD_SYNC_MODE", "").strip().lower()
+        sync_mode = env or "allreduce"
+    if sync_mode not in _VALID_SYNC_MODES:
+        raise ValueError(
+            f"unknown sync_mode {sync_mode!r}; expected one of "
+            f"{_VALID_SYNC_MODES}")
+    return sync_mode
+
+
+def _sharded_threshold(leaves, threshold_bytes, num_groups):
+    """The reference's num_groups contract applied to the sharded wire:
+    cap each bucket at total/num_groups bytes (same rule as the
+    allreduce path)."""
+    if num_groups and num_groups > 0:
+        total = sum(int(jnp.asarray(g).size)
+                    * jnp.dtype(jnp.asarray(g).dtype).itemsize
+                    for g in leaves)
+        return max(1, total // num_groups)
+    return threshold_bytes
+
+
+def _reducescatter_grads(
+    grads,
+    op,
+    axis_name,
+    compression,
+    prescale_factor,
+    postscale_factor,
+    threshold_bytes,
+    num_groups,
+    world_size,
+    quant_salt=None,
+    issue_reversed=False,
+):
+    """Compress -> fused reduce-scatter -> decompress over a gradient
+    pytree: the gradient half of ``sync_mode="sharded"``. An allreduce is
+    reduce-scatter + allgather; emitting only the first half here leaves
+    ~half the wire time on the gradient critical path — the allgather
+    moves to the *updated parameters* (:func:`_gather_param_shards`),
+    off that path.
+
+    Returns a pytree congruent to ``grads`` whose leaves are this rank's
+    owned 1-D shards (sizes per ``ops.fusion.shard_ownership``).
+    """
+    if isinstance(axis_name, (tuple, list)):
+        raise ValueError(
+            "sync_mode='sharded' does not compose with the hierarchical "
+            "(cross, local) mesh; use the flat axis (the two-level "
+            "reduction already reduce-scatters its local leg)")
+    if world_size is None:
+        raise ValueError(
+            "sync_mode='sharded' needs a known process-set size at trace "
+            "time (init() first)")
+    if op not in (collective_ops.Average, collective_ops.Sum):
+        raise ValueError(
+            f"sync_mode='sharded' supports op=Average/Sum, got {op!r}")
+    from .ops.fusion import fused_reducescatter
+
+    n = int(world_size)
+    leaves, treedef = jax.tree.flatten(grads)
+    if getattr(compression, "marker", None) == "int8":
+        from .ops.quantization import int8_fused_reducescatter
+
+        shards = int8_fused_reducescatter(
+            leaves, axis_name, n, op=op,
+            threshold_bytes=_sharded_threshold(
+                leaves, threshold_bytes, num_groups),
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            salt=quant_salt, issue_reversed=issue_reversed)
+        shards = [
+            s.astype(l.dtype)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating) else s
+            for s, l in zip(shards, leaves)
+        ]
+        return jax.tree.unflatten(treedef, shards)
+    compressed = [compression.compress(g) for g in leaves]
+    wire = [c[0] for c in compressed]
+    ctxs = [c[1] for c in compressed]
+    shards = fused_reducescatter(
+        wire, op, axis_name, n,
+        threshold_bytes=_sharded_threshold(wire, threshold_bytes, num_groups),
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+        issue_reversed=issue_reversed)
+    restored = [compression.decompress(s, ctx)
+                for s, ctx in zip(shards, ctxs)]
+    return jax.tree.unflatten(treedef, restored)
+
+
+def _local_shards(tree, axis_name, world_size):
+    """Slice this rank's owned shard out of every (replicated) leaf —
+    rank r's row of the zero-padded ``(n, s)`` flat view, per the
+    :func:`ops.fusion.shard_ownership` map. Traced-regime only (reads
+    ``lax.axis_index``)."""
+    from jax import lax
+
+    from .ops.fusion import shard_ownership
+
+    n = int(world_size)
+    leaves, treedef = jax.tree.flatten(tree)
+    sizes = shard_ownership(leaves, n)
+    r = lax.axis_index(axis_name)
+    out = []
+    for leaf, s in zip(leaves, sizes):
+        flat = jnp.pad(jnp.asarray(leaf).ravel(),
+                       (0, n * s - int(leaf.size)))
+        out.append(lax.dynamic_slice(flat, (r * s,), (s,)))
+    return jax.tree.unflatten(treedef, out)
+
+
+def _embed_shards(shards, templates, axis_name, world_size):
+    """Place each locally owned shard at its owner offset of a zeros
+    full-shape tensor (one per template leaf) — the overlap scheduler's
+    bridge into the sharded mode: custom-vjp cotangents must keep the
+    primal's shape, so the segment boundary's reduce-scatter result rides
+    a zero background and :func:`_local_shards` later recovers exactly
+    the shard."""
+    from jax import lax
+
+    from .ops.fusion import shard_ownership
+
+    n = int(world_size)
+    templates = [jnp.asarray(t) for t in templates]
+    sizes = shard_ownership(templates, n)
+    r = lax.axis_index(axis_name)
+    out = []
+    for tmpl, shard, s in zip(templates, shards, sizes):
+        full = jnp.zeros((n * s,), shard.dtype)
+        full = lax.dynamic_update_slice(full, shard, (r * s,))
+        out.append(full[: int(tmpl.size)]
+                   .reshape(tmpl.shape).astype(tmpl.dtype))
+    return out
+
+
+def _gather_param_shards(
+    shards,
+    templates,
+    compression,
+    axis_name,
+    world_size,
+    threshold_bytes=None,
+    num_groups=0,
+    quant_salt=None,
+):
+    """Allgather per-leaf shards back to full tensors through the
+    optimizer's wire (cast compression halves the allgather bytes; int8
+    rides the quantized gather — the second half of the EQuARX
+    exchange). ``templates`` is a pytree of full-shape leaves (arrays or
+    ShapeDtypeStructs); the result matches its structure/shapes/dtypes."""
+    n = int(world_size)
+    t_leaves, treedef = jax.tree.flatten(
+        templates, is_leaf=lambda x: hasattr(x, "shape"))
+    s_leaves = jax.tree.flatten(shards)[0]
+    if getattr(compression, "marker", None) == "int8":
+        from .ops.quantization import int8_fused_allgather_shards
+
+        full = int8_fused_allgather_shards(
+            s_leaves, t_leaves, axis_name, n,
+            threshold_bytes=_sharded_threshold(
+                t_leaves, threshold_bytes, num_groups),
+            salt=quant_salt)
+        full = [f.astype(t.dtype) for f, t in zip(full, t_leaves)]
+        return jax.tree.unflatten(treedef, full)
+    from .ops.fusion import fused_allgather_shards
+
+    compressed = [compression.compress(s) for s in s_leaves]
+    wire = [c[0] for c in compressed]
+    ctxs = [c[1] for c in compressed]
+    full = fused_allgather_shards(
+        wire, t_leaves, axis_name, n,
+        threshold_bytes=_sharded_threshold(
+            t_leaves, threshold_bytes, num_groups))
+    restored = [
+        compression.decompress(f, ctx).astype(t.dtype)
+        for f, ctx, t in zip(full, ctxs, t_leaves)
+    ]
+    return jax.tree.unflatten(treedef, restored)
+
+
 def _known_size(ps) -> int | None:
     """Process-set size if determinable at trace time, else None.
 
@@ -182,6 +383,7 @@ class ReduceSpec(NamedTuple):
     num_groups: int
     fusion_threshold_bytes: int | None
     backward_passes_per_step: int
+    sync_mode: str = "allreduce"
 
 
 def reduce_spec_of(optimizer) -> ReduceSpec | None:
@@ -189,6 +391,208 @@ def reduce_spec_of(optimizer) -> ReduceSpec | None:
     transformation, or None for a bare optax optimizer."""
     return getattr(getattr(optimizer, "update", None),
                    "_hvd_reduce_spec", None)
+
+
+def _spec_of(optimizer) -> ReduceSpec:
+    spec = (optimizer if isinstance(optimizer, ReduceSpec)
+            else reduce_spec_of(optimizer))
+    if spec is None:
+        raise ValueError(
+            "expected a DistributedOptimizer-built transformation (or its "
+            "ReduceSpec); got a bare optax optimizer")
+    return spec
+
+
+def init_sharded_state(optimizer, params, world_size: int | None = None):
+    """Materialize the sharded optimizer state for ``sync_mode="sharded"``:
+    rank r's shard-local inner state, stacked on a leading world axis.
+
+    Every array leaf of the monolithic state with ``size m`` becomes
+    ``(n, ceil(m/n))`` (rows = per-rank shards of the zero-padded flat
+    view, per ``ops.fusion.shard_ownership``); scalar leaves become
+    ``(n,)``. The factories shard the leading axis over the mesh
+    (``in_specs=P(axis)``), so each rank materializes only its ``1/n``
+    of the optimizer state — the ZeRO-1 memory win.
+    """
+    from .ops.fusion import shard_ownership
+
+    spec = _spec_of(optimizer)
+    n = int(world_size) if world_size else _known_size(spec.process_set)
+    if not n:
+        raise ValueError(
+            "init_sharded_state needs a known process-set size "
+            "(init() first, or pass world_size=)")
+    leaves, treedef = jax.tree.flatten(params)
+    sizes = shard_ownership(leaves, n)
+    padded = [
+        jnp.pad(jnp.asarray(l).ravel(), (0, n * s - int(l.size)))
+        .reshape(n, s)
+        for l, s in zip(leaves, sizes)
+    ]
+    per_rank = [
+        spec.inner.init(jax.tree.unflatten(treedef, [p[r] for p in padded]))
+        for r in range(n)
+    ]
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_rank)
+    if getattr(spec.compression, "marker", None) == "int8":
+        return _SaltState(stacked, jnp.zeros((n,), jnp.uint32))
+    return stacked
+
+
+def _gather_if_nonaddressable(tree):
+    """Replicate any jax.Array leaf whose shards span non-addressable
+    devices (a multi-controller world's P(axis)-sharded state): a jitted
+    identity with replicated out-sharding compiles to the allgather.
+    COLLECTIVE in that regime — every process must reach this call at
+    the same program point (unshard_opt_state's callers do: checkpoint
+    save and elastic sync run on all ranks). Fully-addressable leaves
+    (single-controller, or host numpy from a commit snapshot) pass
+    through untouched — the pure-host fast path."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _P
+
+    def gather(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            sharding = leaf.sharding
+            if not isinstance(sharding, NamedSharding):
+                raise ValueError(
+                    "cannot gather a non-addressable sharded state leaf "
+                    f"with sharding {sharding!r}; re-place it with "
+                    "data_parallel.shard_state (NamedSharding) first")
+            replicated = NamedSharding(sharding.mesh, _P())
+            return jax.jit(lambda x: x, out_shardings=replicated)(leaf)
+        return leaf
+
+    return jax.tree.map(gather, tree)
+
+
+def unshard_opt_state(optimizer, opt_state, params):
+    """Gather a sharded optimizer state back to the monolithic layout —
+    the exact pytree ``spec.inner.init(params)`` would have (so a
+    rank-0 checkpoint of it is layout-identical to a monolithic one).
+    Pure host/jnp math when the stacked rows are locally addressable
+    (single-controller worlds, host snapshots); in a multi-controller
+    world the P(axis)-sharded rows are first replicated via one compiled
+    allgather per leaf (collective — call on every process)."""
+    spec = _spec_of(optimizer)
+    state = _gather_if_nonaddressable(opt_state)
+    salted = isinstance(state, _SaltState)
+    counter = None
+    if salted:
+        counter = state.counter
+        state = state.inner_state
+    template = spec.inner.init(params)
+
+    def un(st, tmpl):
+        st = jnp.asarray(st)
+        tmpl = jnp.asarray(tmpl)
+        if tmpl.ndim == 0:
+            return st[0].astype(tmpl.dtype)
+        return (st.reshape(-1)[: int(tmpl.size)]
+                .reshape(tmpl.shape).astype(tmpl.dtype))
+
+    full = jax.tree.map(un, state, template)
+    if salted:
+        return _SaltState(full, jnp.asarray(counter)[0])
+    return full
+
+
+def reshard_opt_state(optimizer, full_state, params, world_size: int):
+    """Re-shard a monolithic-layout optimizer state for a (possibly new)
+    world size — the inverse of :func:`unshard_opt_state`. Shard
+    ownership is a pure function of the world size and the parameter
+    shapes, so an elastic resize re-derives the layout from the synced
+    full pytree with no extra coordination."""
+    spec = _spec_of(optimizer)
+    del params  # ownership derives from each state leaf's own size
+    n = int(world_size) if world_size else 0
+    if n < 1:
+        raise ValueError(
+            f"reshard_opt_state needs a positive world size, got "
+            f"{world_size!r} (init() first, or pass the size explicitly)")
+    state = full_state
+    salted = isinstance(state, _SaltState)
+    if salted:
+        state = full_state.inner_state
+
+    from .ops.fusion import shard_ownership
+
+    def re(fl):
+        fl = jnp.asarray(fl)
+        if fl.ndim == 0:
+            return jnp.zeros((n,), fl.dtype) + fl
+        (s,) = shard_ownership([fl], n)
+        return jnp.pad(fl.ravel(), (0, n * s - int(fl.size))).reshape(n, s)
+
+    sharded = jax.tree.map(re, state)
+    if salted:
+        counter = jnp.asarray(full_state.counter).astype(jnp.uint32)
+        return _SaltState(sharded, jnp.zeros((n,), jnp.uint32) + counter)
+    return sharded
+
+
+def sharded_step_update(spec, grads, local_state, params, axis_name=None,
+                        grads_are_shards: bool = False,
+                        gather: bool = True):
+    """One sharded-sync-mode optimizer step INSIDE a shard_map trace:
+    reduce-scatter the gradients (unless the overlap scheduler already
+    did), run the inner update only on the locally owned shard with the
+    shard-local state, then allgather the *updated parameter* shards —
+    issued immediately after the shard update, off the gradient critical
+    path, where XLA can overlap it with neighboring compute.
+
+    ``local_state`` is this rank's row of the stacked sharded state
+    (leading world axis stripped — the factories do this). With
+    ``grads_are_shards=True``, ``grads`` already holds the per-leaf owned
+    shards (the overlap scheduler's extraction). Returns
+    ``(new_params, new_local_state)`` — or, with ``gather=False``, the
+    still-sharded updated parameters (the deferred-allgather path gathers
+    them in its own program).
+
+    Numerical contract: for ELEMENTWISE inner optimizers (SGD/momentum,
+    Adam(W), RMSProp, ...) the result is the monolithic allreduce path's
+    within reduction-order tolerance. Inner transformations that reduce
+    ACROSS a leaf (global-norm clipping, LARS/LAMB trust ratios) see
+    only the local shard and will diverge — compose those outside, or
+    use sync_mode='allreduce'.
+    """
+    import optax
+
+    from .ops.collective_ops import _effective_traced_axis
+
+    if axis_name is None:
+        axis_name = (_effective_traced_axis(spec.process_set)
+                     or spec.process_set.axis_name)
+    n = _known_size(spec.process_set)
+    if n is None:
+        raise ValueError(
+            "sync_mode='sharded' needs a known process-set size at trace "
+            "time (init() first)")
+    int8 = getattr(spec.compression, "marker", None) == "int8"
+    if int8:
+        inner_local, salt = local_state.inner_state, local_state.counter
+    else:
+        inner_local, salt = local_state, None
+    if grads_are_shards:
+        grad_shards = grads
+    else:
+        grad_shards = _reducescatter_grads(
+            grads, spec.op, axis_name, spec.compression,
+            spec.prescale_factor, spec.postscale_factor,
+            spec.fusion_threshold_bytes, spec.num_groups,
+            world_size=n, quant_salt=salt)
+    param_shards = _local_shards(params, axis_name, n)
+    updates, new_inner = spec.inner.update(
+        grad_shards, inner_local, param_shards)
+    new_param_shards = optax.apply_updates(param_shards, updates)
+    new_local = _SaltState(new_inner, salt + 1) if int8 else new_inner
+    if not gather:
+        return new_param_shards, new_local
+    new_params = _gather_param_shards(
+        new_param_shards, params, spec.compression, axis_name, n,
+        spec.fusion_threshold_bytes, spec.num_groups, quant_salt=salt)
+    return new_params, new_local
 
 
 def DistributedOptimizer(
@@ -202,6 +606,7 @@ def DistributedOptimizer(
     process_set=None,
     num_groups: int = 0,
     fusion_threshold_bytes: int | None = None,
+    sync_mode: str | None = None,
 ):
     """Wrap an optax ``GradientTransformation`` so gradients are
     allreduce-averaged across the process set before the inner update.
@@ -209,6 +614,21 @@ def DistributedOptimizer(
     Returns an optax-compatible GradientTransformation. ``named_parameters``
     exists for reference-signature parity and is unused (pytree leaves are
     already named by their path).
+
+    ``sync_mode`` (default: autotune pin > ``HOROVOD_SYNC_MODE`` >
+    ``"allreduce"``) selects the gradient exchange:
+
+    - ``"allreduce"``: every rank allreduces every bucket and redundantly
+      runs the full inner update (the reference's contract).
+    - ``"sharded"`` (ZeRO-1 style): each bucket's allreduce is split into
+      its reduce-scatter + allgather halves — ranks update only their
+      owned shard (state from :func:`init_sharded_state`: ~1/n optimizer
+      compute and state memory per rank) and the allgather moves to the
+      *updated parameters*, off the gradient critical path. ``init``
+      returns the stacked sharded state; ``update`` must run inside a
+      shard_map with this rank's state row (the step factories handle
+      both). Needs an elementwise inner optimizer and op=Average/Sum;
+      see docs/perf.md.
     """
     import optax
 
@@ -222,6 +642,18 @@ def DistributedOptimizer(
     k = int(backward_passes_per_step)
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    sync_mode = resolve_sync_mode(sync_mode)
+    if sync_mode == "sharded":
+        if op not in (collective_ops.Average, collective_ops.Sum):
+            raise ValueError(
+                f"sync_mode='sharded' supports op=Average/Sum, got {op!r}")
+        if k != 1:
+            raise ValueError(
+                "sync_mode='sharded' does not compose with "
+                "backward_passes_per_step > 1: accumulation defers the "
+                "reduction, and the shard-local state would go stale "
+                "between boundaries — accumulate outside the optimizer "
+                "or use sync_mode='allreduce'")
 
     int8 = getattr(compression, "marker", None) == "int8"
 
@@ -255,7 +687,51 @@ def DistributedOptimizer(
         num_groups=num_groups,
         fusion_threshold_bytes=fusion_threshold_bytes,
         backward_passes_per_step=k,
+        sync_mode=sync_mode,
     )
+
+    if sync_mode == "sharded":
+
+        def init_sharded(params):
+            return init_sharded_state(spec, params)
+
+        def update_sharded(grads, state, params=None):
+            """Sharded update: expects this rank's ROW of the stacked
+            sharded state (the step factories strip the leading world
+            axis) and returns allgathered FULL updates — the optax
+            contract preserved — plus the new local state. The factories
+            skip this and gather the updated *parameters* directly
+            (:func:`sharded_step_update`), saving the full-tree apply."""
+            if params is None:
+                raise ValueError(
+                    "sync_mode='sharded' update needs params= (the "
+                    "shard-local update reads this rank's parameter "
+                    "shard)")
+            from .ops.collective_ops import _effective_traced_axis
+
+            effective = _effective_traced_axis(ps) or axis_name
+            n = _known_size(ps)
+            if int8:
+                inner_local, salt = state.inner_state, state.counter
+            else:
+                inner_local, salt = state, None
+            grad_shards = _reducescatter_grads(
+                grads, op, effective, compression, prescale_factor,
+                postscale_factor, fusion_threshold_bytes, num_groups,
+                world_size=n, quant_salt=salt)
+            param_shards = _local_shards(params, effective, n)
+            updates_sh, new_inner = optimizer.update(
+                grad_shards, inner_local, param_shards)
+            updates_full = _gather_param_shards(
+                updates_sh, params, compression, effective, n,
+                fusion_threshold_bytes, num_groups, quant_salt=salt)
+            if int8:
+                return updates_full, _SaltState(new_inner, salt + 1)
+            return updates_full, new_inner
+
+        init_sharded._hvd_reduce_spec = spec
+        update_sharded._hvd_reduce_spec = spec
+        return optax.GradientTransformation(init_sharded, update_sharded)
 
     if k == 1:
 

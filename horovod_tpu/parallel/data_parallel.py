@@ -172,6 +172,65 @@ def _resolve_mesh_axis(mesh, axis_name, hierarchical):
     return mesh, axis_name
 
 
+class DeferredParams:
+    """Handle over the sharded step's updated-parameter allgather (the
+    ``deferred_param_gather=True`` eager path).
+
+    The gather program is already DISPATCHED when the handle is returned
+    — jax's async dispatch runs the collective while the host does other
+    work between steps (data loading, metrics, checkpoint bookkeeping).
+    Touch :attr:`params` (or pass the handle straight back into the step)
+    to use the gathered tree; :meth:`block_until_ready` waits explicitly.
+    """
+
+    def __init__(self, params):
+        self._params = params
+
+    @property
+    def params(self):
+        return self._params
+
+    def block_until_ready(self):
+        jax.block_until_ready(self._params)
+        return self._params
+
+
+def _sharded_spec_of(optimizer):
+    """The optimizer's ReduceSpec when it was built with
+    ``sync_mode='sharded'``, else None."""
+    from ..optimizer import reduce_spec_of
+
+    spec = reduce_spec_of(optimizer)
+    if spec is not None and getattr(spec, "sync_mode", None) == "sharded":
+        return spec
+    return None
+
+
+def _check_flat_axis(axis_name, what: str):
+    if not isinstance(axis_name, str):
+        raise ValueError(
+            f"sync_mode='sharded' does not compose with the hierarchical "
+            f"(cross, local) mesh in {what}; use the flat axis (the "
+            f"two-level reduction already reduce-scatters its local leg)")
+
+
+def shard_state(tree, mesh=None, axis_name: str | None = None):
+    """Place a stacked sharded optimizer state (leading world axis, from
+    ``hvd.init_sharded_state`` / a sharded optimizer's ``init``) on the
+    mesh, sharded along that axis — so each rank holds only its 1/n of
+    the state. The sharded counterpart of :func:`replicate`."""
+    from jax.sharding import NamedSharding
+
+    from .. import basics
+
+    if mesh is None:
+        mesh = basics.global_mesh()
+    if axis_name is None:
+        axis_name = basics.global_axis_name()
+    sharding = NamedSharding(mesh, P(axis_name))
+    return jax.tree.map(partial(jax.device_put, device=sharding), tree)
+
+
 def make_train_step(
     loss_fn: Callable[..., Any],
     optimizer,
@@ -180,6 +239,7 @@ def make_train_step(
     donate: bool = True,
     loss_is_averaged: bool = True,
     hierarchical: bool | tuple | None = None,
+    deferred_param_gather: bool = False,
 ):
     """Build a jitted SPMD train step.
 
@@ -197,15 +257,38 @@ def make_train_step(
         mesh from host topology; a ``(cross, local)`` tuple → explicit
         factors. The DistributedOptimizer then reduces gradients
         reduce-scatter(ICI) → allreduce(DCN) → allgather(ICI).
+      deferred_param_gather: sharded sync mode only — split the step into
+        a core program (reduce-scatter + shard update, returning the
+        updated parameter SHARDS) and a separate allgather program whose
+        dispatched result rides a :class:`DeferredParams` handle; the
+        gather runs while the host does between-step work. The returned
+        step accepts either a full params pytree or the previous call's
+        handle.
 
     Returns:
       ``step(params, opt_state, batch) -> (params, opt_state, loss)``,
       compiled; ``batch`` is sharded along its leading axis, params/opt_state
-      replicated.
+      replicated. A ``sync_mode='sharded'`` DistributedOptimizer switches
+      the program to ZeRO-1 form: per-bucket reduce-scatter, shard-local
+      inner update (opt_state is the STACKED sharded layout from the
+      optimizer's ``init`` — place it with :func:`shard_state`), and an
+      allgather of the updated parameter shards issued off the gradient
+      critical path.
     """
     import optax
 
+    spec = _sharded_spec_of(optimizer)
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
+    if deferred_param_gather and spec is None:
+        raise ValueError(
+            "deferred_param_gather requires a DistributedOptimizer built "
+            "with sync_mode='sharded' (there is no parameter allgather to "
+            "defer in allreduce mode)")
+    if spec is not None:
+        _check_flat_axis(axis_name, "make_train_step")
+        return _make_sharded_train_step(
+            loss_fn, spec, mesh, axis_name, donate, loss_is_averaged,
+            deferred_param_gather)
 
     def spmd_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -233,6 +316,104 @@ def make_train_step(
         "train_step")
 
 
+def _make_sharded_train_step(loss_fn, spec, mesh, axis_name, donate,
+                             loss_is_averaged, deferred_param_gather):
+    """The sync_mode='sharded' program for :func:`make_train_step`:
+    reduce-scatter per bucket → inner update on the locally owned shard
+    (opt_state sharded over the axis, leading world dim stripped inside)
+    → allgather of the UPDATED PARAMETER shards. With
+    ``deferred_param_gather`` the allgather compiles as its own program
+    whose dispatch rides a :class:`DeferredParams` handle."""
+    from ..autotune import maybe_autotune_step
+    from ..optimizer import sharded_step_update
+
+    def spmd_step(params, opt_state, batch):
+        local_state = jax.tree.map(lambda a: a[0], opt_state)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        new_params, new_local = sharded_step_update(
+            spec, grads, local_state, params, axis_name=axis_name,
+            gather=not deferred_param_gather)
+        out_state = jax.tree.map(lambda a: a[None], new_local)
+        if deferred_param_gather:
+            # Updated params are still SHARDS here; stack them on the
+            # world axis for the separate gather program.
+            new_params = jax.tree.map(lambda a: a[None], new_params)
+        if loss_is_averaged:
+            loss = jax.lax.pmean(loss, axis_name)
+        return new_params, out_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    if not deferred_param_gather:
+        sharded = jax.shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(P(), P(axis_name), P(axis_name)),
+            out_specs=(P(), P(axis_name), P()),
+            check_vma=False,
+        )
+        return _StallWatchedStep(
+            maybe_autotune_step(
+                jax.jit(sharded, donate_argnums=donate_argnums)),
+            "train_step")
+
+    core = jax.jit(
+        jax.shard_map(
+            spmd_step,
+            mesh=mesh,
+            in_specs=(P(), P(axis_name), P(axis_name)),
+            out_specs=(P(axis_name), P(axis_name), P()),
+            check_vma=False,
+        ),
+        donate_argnums=donate_argnums,
+    )
+    gather_prog: dict = {}
+    int8 = getattr(spec.compression, "marker", None) == "int8"
+
+    def step(params, opt_state, batch):
+        if isinstance(params, DeferredParams):
+            params = params.params
+        templates = jax.tree.map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype), params)
+        shards, new_state, loss = core(params, opt_state, batch)
+        gj = gather_prog.get("jit")
+        if gj is None:
+            from ..optimizer import _gather_param_shards, _known_size
+
+            n = _known_size(spec.process_set)
+
+            def gather_spmd(stacked, counter=None):
+                local = jax.tree.map(lambda a: a[0], stacked)
+                # The core already advanced the counter; this step's
+                # quantization salt is the PRE-increment value, matching
+                # the non-deferred path's rounding exactly.
+                salt = counter[0] - 1 if int8 else None
+                return _gather_param_shards(
+                    local, templates, spec.compression, axis_name, n,
+                    spec.fusion_threshold_bytes, spec.num_groups,
+                    quant_salt=salt)
+
+            in_specs = ((P(axis_name), P(axis_name)) if int8
+                        else (P(axis_name),))
+            gj = gather_prog["jit"] = jax.jit(
+                jax.shard_map(
+                    gather_spmd,
+                    mesh=mesh,
+                    in_specs=in_specs,
+                    out_specs=P(),
+                    check_vma=False,
+                ),
+                # Donate only the shards: the int8 counter rides the
+                # live optimizer state.
+                donate_argnums=(0,) if donate else (),
+            )
+        args = (shards, new_state.counter) if int8 else (shards,)
+        return DeferredParams(gj(*args)), new_state, loss
+
+    # No transparent autotune here: the wrapper owns two programs and the
+    # tuner's clear_cache contract assumes one jitted callable.
+    return _StallWatchedStep(step, "train_step")
+
+
 def _segment_sync(leaves, seg_index, spec, axis_name, salt):
     """Identity-forward / reduce-backward boundary for ONE segment.
 
@@ -250,14 +431,42 @@ def _segment_sync(leaves, seg_index, spec, axis_name, salt):
     forward as a residual rather than a closure: custom-vjp rules must
     not close over tracers, and its cotangent is the usual float0
     placeholder for integer primals.
+
+    In the SHARDED sync mode the boundary's backward emits the segment's
+    reduce-scatter instead (still inside the backward pass, so it still
+    overlaps backward compute); the cotangent contract forces full
+    primal shapes, so each reduced shard rides a zero background at its
+    owner offset (``optimizer._embed_shards``) and the step extracts the
+    shards afterwards (``optimizer._local_shards`` — exact, since
+    non-owned positions are zeros it never reads).
     """
     import numpy as np
 
     from ..optimizer import _known_size, _reduce_grads
     from ..profiler import annotate_collective
 
+    sharded_mode = getattr(spec, "sync_mode", "allreduce") == "sharded"
+
     def reduce_cts(cts, s):
         with annotate_collective(f"overlap.segment{seg_index}"):
+            if sharded_mode:
+                from ..optimizer import _embed_shards, _reducescatter_grads
+
+                n = _known_size(spec.process_set)
+                shards = _reducescatter_grads(
+                    list(cts),
+                    spec.op,
+                    axis_name,
+                    spec.compression,
+                    spec.prescale_factor,
+                    spec.postscale_factor,
+                    spec.fusion_threshold_bytes,
+                    spec.num_groups,
+                    world_size=n,
+                    quant_salt=s,
+                    issue_reversed=True,
+                )
+                return _embed_shards(shards, list(cts), axis_name, n)
             return _reduce_grads(
                 list(cts),
                 spec.op,
@@ -418,14 +627,20 @@ def make_overlapped_train_step(
             "reduction to every k-th microstep, so most steps have no "
             "communication to overlap — use make_train_step")
     int8 = getattr(spec.compression, "marker", None) == "int8"
+    sharded_mode = getattr(spec, "sync_mode", "allreduce") == "sharded"
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
+    if sharded_mode:
+        _check_flat_axis(axis_name, "make_overlapped_train_step")
 
     def spmd_step(params, opt_state, batch):
         from ..ops.collective_ops import _effective_traced_axis
 
         effective = (_effective_traced_axis(spec.process_set)
                      or spec.process_set.axis_name)
-        if int8:
+        if sharded_mode:
+            local_state = jax.tree.map(lambda a: a[0], opt_state)
+            salt = local_state.counter if int8 else None
+        elif int8:
             inner_state, salt = opt_state.inner_state, opt_state.counter
         else:
             inner_state, salt = opt_state, None
@@ -437,6 +652,24 @@ def make_overlapped_train_step(
             return loss_fn(synced, batch)
 
         loss, grads = jax.value_and_grad(loss_of)(params)
+        if sharded_mode:
+            # Gradients arrive reduce-SCATTERED: each segment boundary's
+            # backward emitted its reducescatter inside backprop and
+            # placed this rank's shard on a zero background; slice the
+            # shards back out, update only the owned shard, and gather
+            # the updated PARAMETER shards — off the gradient path.
+            from ..optimizer import _known_size, _local_shards
+            from ..optimizer import sharded_step_update
+
+            grad_shards = _local_shards(
+                grads, effective, _known_size(spec.process_set))
+            new_params, new_local = sharded_step_update(
+                spec, grad_shards, local_state, params,
+                axis_name=effective, grads_are_shards=True)
+            new_state = jax.tree.map(lambda a: a[None], new_local)
+            if loss_is_averaged:
+                loss = jax.lax.pmean(loss, axis_name)
+            return new_params, new_state, loss
         # Gradients arrive REDUCED (the segment collectives ran inside
         # the backward), so the bare inner optimizer applies them. Each
         # leaf's update depends only on its own reduced gradient, so in
@@ -451,11 +684,12 @@ def make_overlapped_train_step(
             loss = jax.lax.pmean(loss, axis_name)
         return new_params, new_state, loss
 
+    opt_spec = P(axis_name) if sharded_mode else P()
     sharded = jax.shard_map(
         spmd_step,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis_name)),
-        out_specs=(P(), P(), P()),
+        in_specs=(P(), opt_spec, P(axis_name)),
+        out_specs=(P(), opt_spec, P()),
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
@@ -541,6 +775,13 @@ def make_elastic_train_step(
 
     from .. import basics
 
+    if _sharded_spec_of(optimizer) is not None:
+        raise ValueError(
+            "make_elastic_train_step does not support sync_mode='sharded' "
+            "(its cross-process leg reduces on the host plane, outside the "
+            "compiled shard domain); build the compiled step with "
+            "make_train_step and let hvd.elastic.TpuState(...,"
+            "sharded_optimizer=...) re-shard state across world changes")
     mesh = mesh or basics.global_mesh()
     axis = axis_name or basics.global_axis_name()
 

@@ -65,6 +65,22 @@ def maybe_start_from_env() -> None:
             pass
 
 
+def summary() -> dict:
+    """One-call observability snapshot: trace state plus the runtime
+    counters callers keep asking the timeline for — executable-cache
+    hits/misses/size and per-kind eager-dispatch counts
+    (``hvd.cache_stats()``). ``bench.py`` emits this once per run so
+    every benchmark record carries the cache behavior that produced it.
+    """
+    from .ops.collective_ops import cache_stats
+
+    return {
+        "trace_active": active(),
+        "trace_logdir": _active_logdir,
+        **cache_stats(),
+    }
+
+
 def annotate_collective(name: str):
     """Name the ops traced inside the scope (``jax.named_scope``) so each
     collective region is identifiable in xprof traces and HLO dumps.

@@ -253,3 +253,108 @@ class TestProfilerMerge:
             # API contract (no crash, active() toggles) is what we test.
             pass
         assert not prof.active()
+
+
+class TestExecutableCacheSingleFlight:
+    """Concurrent misses on one key must produce ONE build (XLA compiles
+    cost seconds) and ONE counted miss — the waiters ride the builder's
+    event and land as hits."""
+
+    def test_concurrent_misses_build_once(self):
+        import threading
+
+        from horovod_tpu.ops.executable_cache import ExecutableCache
+
+        cache = ExecutableCache(capacity=8)
+        builds = []
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow_build():
+            builds.append(1)
+            started.set()
+            release.wait(5.0)  # hold every concurrent caller in-flight
+            return "value"
+
+        results = []
+
+        def caller():
+            results.append(cache.get_or_build("k", slow_build))
+
+        threads = [threading.Thread(target=caller) for _ in range(5)]
+        threads[0].start()
+        assert started.wait(5.0)  # builder is inside build()
+        for t in threads[1:]:
+            t.start()
+        import time
+
+        time.sleep(0.05)  # let the waiters reach the event wait
+        release.set()
+        for t in threads:
+            t.join(5.0)
+        assert results == ["value"] * 5
+        assert len(builds) == 1  # single-flight: one compile
+        assert cache.misses == 1  # ...and one counted miss
+        assert cache.hits == 4  # waiters landed as hits
+
+    def test_failed_build_elects_next_builder(self):
+        import threading
+
+        from horovod_tpu.ops.executable_cache import ExecutableCache
+
+        cache = ExecutableCache(capacity=8)
+        attempts = []
+        first_in = threading.Event()
+        release = threading.Event()
+
+        def build():
+            attempts.append(1)
+            if len(attempts) == 1:
+                first_in.set()
+                release.wait(5.0)
+                raise RuntimeError("compile failed")
+            return "second"
+
+        out = {}
+
+        def first():
+            try:
+                cache.get_or_build("k", build)
+            except RuntimeError:
+                pass
+
+        def second():
+            out["v"] = cache.get_or_build("k", build)
+
+        t1 = threading.Thread(target=first)
+        t1.start()
+        assert first_in.wait(5.0)
+        t2 = threading.Thread(target=second)
+        t2.start()
+        release.set()
+        t1.join(5.0)
+        t2.join(5.0)
+        assert out["v"] == "second"  # waiter retried after the failure
+        assert len(attempts) == 2
+        assert cache.misses == 1  # only the successful build counts
+
+
+def test_cache_stats_counts_dispatches_and_cache(hvd):
+    stats0 = hvd.cache_stats()
+    n = hvd.size()
+    shape = (n, 7)  # unlikely to collide with other tests' signatures
+    x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.allreduce(x + 1, op=hvd.Sum)  # same signature: cache hit
+    stats = hvd.cache_stats()
+    assert (stats["eager_dispatch"].get("allreduce", 0)
+            - stats0["eager_dispatch"].get("allreduce", 0)) == 2
+    assert stats["executable_cache"]["hits"] > \
+        stats0["executable_cache"]["hits"]
+    assert stats["executable_cache"]["size"] >= 1
+    # profiler.summary surfaces the same counters.
+    import horovod_tpu.profiler as prof
+
+    summary = prof.summary()
+    assert summary["executable_cache"] == stats["executable_cache"]
+    assert "trace_active" in summary

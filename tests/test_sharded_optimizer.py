@@ -1,0 +1,706 @@
+"""Sharded-optimizer gradient sync (``sync_mode="sharded"``, ZeRO-1 style).
+
+An allreduce is reduce-scatter + allgather; sharded mode splits them:
+per-bucket reduce-scatter on the gradient path (still riding the overlap
+scheduler's custom-vjp segment boundaries), inner update only on the
+locally owned shard (state materialized sharded from init), and an
+allgather of the *updated parameters* off the gradient critical path.
+Asserted here:
+
+- the per-leaf shard-ownership map is stable (shape-only, rank-identical)
+  and the sharded step is stable across retraces;
+- ``fused_reducescatter``/``fused_allgather_shards`` (and the eager
+  ``reducescatter``/``grouped_reducescatter``) are parity with allreduce
+  across ops, scale factors, uneven leaf sizes (padding path), and
+  non-divisible world sizes;
+- sharded-vs-monolithic equivalence after K steps — params AND optimizer
+  state (unsharded) — including under the overlap scheduler and the int8
+  wire (quantization tolerance: block boundaries differ by layout);
+- elastic resize re-shard: world N→N-1 resumes with the same loss
+  trajectory as a fresh N-1 run from the synced state, and
+  ``TpuState(sharded_optimizer=...)`` re-shards in ``sync()``;
+- checkpoint round-trip monolithic↔sharded (gather-on-save layout);
+- the autotune sync_mode axis: joint grid, pinning, abort poisoning.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops.fusion import (
+    fused_allgather_shards,
+    fused_allreduce,
+    fused_reducescatter,
+    shard_ownership,
+)
+
+
+def _mlp_problem(n_layers=3, dim=8, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        f"layer{i}": {
+            "w": jnp.asarray(rng.randn(dim, dim).astype(np.float32)),
+            "b": jnp.asarray(rng.randn(dim).astype(np.float32)),
+        }
+        for i in range(n_layers)
+    }
+
+    def loss_fn(p, b):
+        x, y = b
+        h = x
+        for i in range(n_layers):
+            h = jnp.tanh(h @ p[f"layer{i}"]["w"] + p[f"layer{i}"]["b"])
+        return jnp.mean((h.sum(axis=-1) - y) ** 2)
+
+    x = rng.randn(batch, dim).astype(np.float32)
+    y = rng.randn(batch).astype(np.float32)
+    return params, (x, y), loss_fn
+
+
+def _get_or_add_ps(hvd, ranks):
+    """Process sets persist for the whole test session; re-adding the
+    same ranks raises, so look it up first."""
+    from horovod_tpu import process_sets as pss
+
+    for ps in pss._table.values():
+        if ps.ranks == sorted(ranks):
+            return ps
+    return hvd.add_process_set(ranks)
+
+
+def _assert_tree_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol),
+        a, b)
+
+
+class TestShardOwnership:
+    def test_byte_balanced_ceil(self):
+        leaves = [jnp.zeros((s,), jnp.float32) for s in (5, 13, 16, 3)]
+        assert shard_ownership(leaves, 8) == [1, 2, 2, 1]
+        assert shard_ownership(leaves, 3) == [2, 5, 6, 1]
+
+    def test_stable_under_values_and_rank(self):
+        # Shape-only: different values, identical map — the contract that
+        # lets every rank and every retrace derive the same ownership.
+        a = [jnp.zeros((5, 5)), jnp.ones((3,))]
+        b = [jnp.full((5, 5), 7.0), jnp.zeros((3,)) - 4]
+        assert shard_ownership(a, 8) == shard_ownership(b, 8)
+
+    def test_sharded_step_stable_across_retraces(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05),
+                                       sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, opt, donate=False)
+        p = dp.replicate(params)
+        s = dp.shard_state(opt.init(params))
+        b = dp.shard_batch(batch)
+        p1, s1, l1 = step(p, s, b)
+        step.clear_cache()  # force a retrace: the map must re-derive
+        p2, s2, l2 = step(p, s, b)
+        assert float(l1) == float(l2)
+        _assert_tree_close(p1, p2, rtol=0, atol=0)
+        _assert_tree_close(s1, s2, rtol=0, atol=0)
+
+
+class TestReducescatterParity:
+    """Satellite: reducescatter/grouped_reducescatter parity with
+    allreduce across ops, scale factors, uneven leaf sizes (padding
+    path), and non-divisible world sizes."""
+
+    def _roundtrip(self, hvd, mesh, axis, n, leaves, op, pre=1.0, post=1.0):
+        def rs_ag(ls):
+            shards = fused_reducescatter(
+                list(ls), op, axis, n, threshold_bytes=64,
+                prescale_factor=pre, postscale_factor=post)
+            return fused_allgather_shards(
+                shards, list(ls), axis, n, threshold_bytes=64)
+
+        def ar(ls):
+            return fused_allreduce(list(ls), op, axis,
+                                   prescale_factor=pre,
+                                   postscale_factor=post)
+
+        kw = dict(mesh=mesh, in_specs=(P(),), out_specs=P(),
+                  check_vma=False)
+        got = jax.jit(jax.shard_map(rs_ag, **kw))(leaves)
+        want = jax.jit(jax.shard_map(ar, **kw))(leaves)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("op", ["sum", "average"])
+    def test_fused_parity_uneven_leaves(self, hvd, op):
+        # Leaf sizes 5/13/3 are all non-divisible by 8 (and 3 < 8): the
+        # padding path runs for every leaf.
+        rng = np.random.RandomState(1)
+        leaves = [rng.randn(*s).astype(np.float32)
+                  for s in [(5,), (13,), (4, 4), (3,)]]
+        self._roundtrip(hvd, hvd.global_mesh(), "hvd", 8, leaves, op)
+
+    def test_fused_parity_scale_factors(self, hvd):
+        rng = np.random.RandomState(2)
+        leaves = [rng.randn(9).astype(np.float32),
+                  rng.randn(2, 3).astype(np.float32)]
+        self._roundtrip(hvd, hvd.global_mesh(), "hvd", 8, leaves,
+                        "sum", pre=0.5, post=3.0)
+        self._roundtrip(hvd, hvd.global_mesh(), "hvd", 8, leaves,
+                        "average", pre=2.0, post=0.25)
+
+    def test_fused_parity_non_divisible_world(self, hvd):
+        # World size 3: no leaf divides evenly, every shard is padded.
+        ps = _get_or_add_ps(hvd, [0, 1, 2])
+        rng = np.random.RandomState(3)
+        leaves = [rng.randn(7).astype(np.float32),
+                  rng.randn(4).astype(np.float32)]
+        self._roundtrip(hvd, ps.mesh, ps.axis_name, 3, leaves, "average")
+
+    def test_eager_reducescatter_parity_with_allreduce(self, hvd):
+        n = hvd.size()
+        x = np.random.RandomState(4).randn(n, n * 2, 3).astype(np.float32)
+        reduced = np.asarray(hvd.allreduce(x, op=hvd.Sum))[0]
+        out = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+        for r in range(n):
+            np.testing.assert_allclose(out[r], reduced[r * 2:(r + 1) * 2],
+                                       rtol=1e-5)
+
+    def test_eager_reducescatter_scale_factors(self, hvd):
+        n = hvd.size()
+        x = np.random.RandomState(5).randn(n, n, 2).astype(np.float32)
+        want = np.asarray(
+            hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5,
+                          postscale_factor=2.0))[0]
+        out = np.asarray(hvd.reducescatter(
+            x, op=hvd.Sum, prescale_factor=0.5, postscale_factor=2.0))
+        for r in range(n):
+            np.testing.assert_allclose(out[r], want[r:r + 1], rtol=1e-5)
+
+    def test_grouped_reducescatter_parity(self, hvd):
+        n = hvd.size()
+        rng = np.random.RandomState(6)
+        xs = [rng.randn(n, n, 2).astype(np.float32) for _ in range(3)]
+        outs = hvd.grouped_reducescatter(xs, op=hvd.Average)
+        wants = hvd.grouped_allreduce(xs, op=hvd.Average)
+        for out, want in zip(outs, wants):
+            out, want = np.asarray(out), np.asarray(want)[0]
+            for r in range(n):
+                np.testing.assert_allclose(out[r], want[r:r + 1],
+                                           rtol=1e-5)
+
+
+class TestShardedEquivalence:
+    """The numerical contract: sharded mode is bitwise-comparable to
+    monolithic allreduce mode within reduction-order tolerance — params
+    AND optimizer state — after K steps."""
+
+    def _run(self, hvd, make_step, opt, params, batch, steps, sharded):
+        dp = hvd.data_parallel
+        p = dp.replicate(params)
+        s = (dp.shard_state(opt.init(params)) if sharded
+             else dp.replicate(opt.init(params)))
+        b = dp.shard_batch(batch)
+        losses = []
+        for _ in range(steps):
+            p, s, loss = make_step(p, s, b)
+            losses.append(float(loss))
+        return p, s, losses
+
+    def test_matches_monolithic_params_and_state(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step_m = dp.make_train_step(loss_fn, mono, donate=False)
+        step_s = dp.make_train_step(loss_fn, shrd, donate=False)
+        pm, sm, lm = self._run(hvd, step_m, mono, params, batch, 3, False)
+        ps_, ss, ls = self._run(hvd, step_s, shrd, params, batch, 3, True)
+        assert lm == pytest.approx(ls, rel=1e-6)
+        _assert_tree_close(pm, ps_)
+        full = hvd.unshard_opt_state(shrd, jax.device_get(ss), params)
+        _assert_tree_close(jax.device_get(sm), full)
+
+    def test_matches_monolithic_under_overlap_scheduler(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step_m = dp.make_train_step(loss_fn, mono, donate=False)
+        step_o = dp.make_overlapped_train_step(
+            loss_fn, shrd, donate=False, num_segments=3)
+        pm, _, _ = self._run(hvd, step_m, mono, params, batch, 3, False)
+        po, so, _ = self._run(hvd, step_o, shrd, params, batch, 3, True)
+        _assert_tree_close(pm, po)
+
+    def test_int8_wire_matches_monolithic(self, hvd):
+        # Sharded layout changes the quantization block boundaries, so
+        # equality is to int8 tolerance (cf. test_overlap's int8 case).
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        m8 = hvd.DistributedOptimizer(
+            optax.sgd(0.05), compression=hvd.Compression.int8)
+        s8 = hvd.DistributedOptimizer(
+            optax.sgd(0.05), compression=hvd.Compression.int8,
+            sync_mode="sharded")
+        step_m = dp.make_train_step(loss_fn, m8, donate=False)
+        step_s = dp.make_train_step(loss_fn, s8, donate=False)
+        pm, _, _ = self._run(hvd, step_m, m8, params, batch, 2, False)
+        ps_, ss, _ = self._run(hvd, step_s, s8, params, batch, 2, True)
+        _assert_tree_close(pm, ps_, rtol=0.05, atol=0.04)
+        # The stochastic-rounding salt threads on the sharded path too:
+        # the stacked counter advanced once per step on every rank.
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(ss).counter), np.full((8,), 2))
+
+    def test_deferred_param_gather(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, shrd, donate=False)
+        step_d = dp.make_train_step(loss_fn, shrd, donate=False,
+                                    deferred_param_gather=True)
+        p, s, _ = self._run(hvd, step, shrd, params, batch, 2, True)
+        pd = dp.replicate(params)
+        sd = dp.shard_state(shrd.init(params))
+        b = dp.shard_batch(batch)
+        for _ in range(2):
+            pd, sd, _ = step_d(pd, sd, b)  # handle feeds straight back in
+        assert isinstance(pd, hvd.DeferredParams)
+        # Same math, different program split (the gather compiles
+        # separately), so equality is to float-association noise.
+        _assert_tree_close(p, pd.block_until_ready())
+        _assert_tree_close(s, sd)
+
+    def test_deferred_gather_int8_threads_salt(self, hvd):
+        # The deferred gather compiles as its own program; with int8 it
+        # must take the step counter so the requant salt matches the
+        # non-deferred path (quantization tolerance: the programs split
+        # differently, so borderline roundings may flip).
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        s8 = hvd.DistributedOptimizer(
+            optax.sgd(0.05), compression=hvd.Compression.int8,
+            sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, s8, donate=False)
+        step_d = dp.make_train_step(loss_fn, s8, donate=False,
+                                    deferred_param_gather=True)
+        b = dp.shard_batch(batch)
+        p1 = dp.replicate(params)
+        s1 = dp.shard_state(s8.init(params))
+        pd = dp.replicate(params)
+        sd = dp.shard_state(s8.init(params))
+        for _ in range(2):
+            p1, s1, _ = step(p1, s1, b)
+            pd, sd, _ = step_d(pd, sd, b)
+        _assert_tree_close(p1, pd.block_until_ready(),
+                           rtol=0.05, atol=0.04)
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(sd).counter), np.full((8,), 2))
+
+    def test_standalone_update_keeps_optax_contract(self, hvd):
+        """Users writing their own shard_map step call ``opt.update``
+        directly: it reduce-scatters, shard-updates, and allgathers FULL
+        updates (optax contract), taking this rank's state row."""
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem(n_layers=2)
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        mesh = hvd.global_mesh()
+
+        def spmd_s(p, st, b):
+            g = jax.grad(loss_fn)(p, b)
+            st_local = jax.tree.map(lambda a: a[0], st)
+            upd, new_local = shrd.update(g, st_local, p)
+            return (optax.apply_updates(p, upd),
+                    jax.tree.map(lambda a: a[None], new_local))
+
+        def spmd_m(p, st, b):
+            g = jax.grad(loss_fn)(p, b)
+            upd, new_st = mono.update(g, st, p)
+            return optax.apply_updates(p, upd), new_st
+
+        step_s = jax.jit(jax.shard_map(
+            spmd_s, mesh=mesh, in_specs=(P(), P("hvd"), P("hvd")),
+            out_specs=(P(), P("hvd")), check_vma=False))
+        step_m = jax.jit(jax.shard_map(
+            spmd_m, mesh=mesh, in_specs=(P(), P(), P("hvd")),
+            out_specs=(P(), P()), check_vma=False))
+        b = dp.shard_batch(batch)
+        ps_, ss = step_s(dp.replicate(params),
+                         dp.shard_state(shrd.init(params)), b)
+        pm, _ = step_m(dp.replicate(params),
+                       dp.replicate(mono.init(params)), b)
+        _assert_tree_close(pm, ps_)
+
+    def test_sharded_loss_decreases(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, shrd, donate=False)
+        _, _, losses = self._run(hvd, step, shrd, params, batch, 4, True)
+        assert losses[-1] < losses[0]
+
+
+class TestShardedGuards:
+    def test_rejects_adasum(self, hvd):
+        with pytest.raises(ValueError, match="Average/Sum"):
+            hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Adasum,
+                                     sync_mode="sharded")
+
+    def test_rejects_gradient_accumulation(self, hvd):
+        with pytest.raises(ValueError, match="backward_passes_per_step"):
+            hvd.DistributedOptimizer(optax.sgd(0.1),
+                                     backward_passes_per_step=2,
+                                     sync_mode="sharded")
+
+    def test_rejects_hierarchical_mesh(self, hvd):
+        shrd = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                        sync_mode="sharded")
+        with pytest.raises(ValueError, match="hierarchical"):
+            hvd.data_parallel.make_train_step(
+                lambda p, b: jnp.sum(p), shrd, hierarchical=(2, 4))
+
+    def test_rejects_elastic_factory(self, hvd):
+        shrd = hvd.DistributedOptimizer(optax.sgd(0.1),
+                                        sync_mode="sharded")
+        with pytest.raises(ValueError, match="sharded"):
+            hvd.data_parallel.make_elastic_train_step(
+                lambda p, b: jnp.sum(p), shrd)
+
+    def test_deferred_gather_requires_sharded(self, hvd):
+        mono = hvd.DistributedOptimizer(optax.sgd(0.1))
+        with pytest.raises(ValueError, match="deferred_param_gather"):
+            hvd.data_parallel.make_train_step(
+                lambda p, b: jnp.sum(p), mono, deferred_param_gather=True)
+
+    def test_env_resolution(self, hvd, monkeypatch):
+        from horovod_tpu.optimizer import resolve_sync_mode
+
+        assert resolve_sync_mode() == "allreduce"
+        monkeypatch.setenv("HOROVOD_SYNC_MODE", "sharded")
+        assert resolve_sync_mode() == "sharded"
+        assert resolve_sync_mode("allreduce") == "allreduce"  # explicit wins
+        monkeypatch.setenv("HOROVOD_SYNC_MODE", "zero3")
+        with pytest.raises(ValueError, match="zero3"):
+            resolve_sync_mode()
+
+
+class TestElasticReshard:
+    def test_unshard_reshard_roundtrip(self, hvd):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, shrd, donate=False)
+        p = dp.replicate(params)
+        s = dp.shard_state(shrd.init(params))
+        b = dp.shard_batch(batch)
+        p, s, _ = step(p, s, b)
+        full = hvd.unshard_opt_state(shrd, jax.device_get(s), params)
+        for n in (4, 3, 8):
+            re = hvd.reshard_opt_state(shrd, full, params, n)
+            assert all(np.shape(l)[0] == n
+                       for l in jax.tree.leaves(re))
+            back = hvd.unshard_opt_state(shrd, re, params)
+            _assert_tree_close(full, back, rtol=0, atol=0)
+
+    def test_resize_resumes_identical_trajectory(self, hvd):
+        """World 8 -> 4 mid-run: the re-sharded continuation matches a
+        fresh 4-rank run (monolithic, from the same synced full state)
+        step for step."""
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step8 = dp.make_train_step(loss_fn, shrd, donate=False)
+        p = dp.replicate(params)
+        s = dp.shard_state(shrd.init(params))
+        b = dp.shard_batch(batch)
+        for _ in range(2):
+            p, s, _ = step8(p, s, b)
+        synced_params = jax.device_get(p)
+        synced_full = hvd.unshard_opt_state(shrd, jax.device_get(s),
+                                            params)
+        # Re-shard for the shrunk world; ownership is a pure function of
+        # the new size, derived locally.
+        ps4 = _get_or_add_ps(hvd, [0, 1, 2, 3])
+        re4 = hvd.reshard_opt_state(shrd, synced_full, params, 4)
+        shrd4 = hvd.DistributedOptimizer(optax.adam(0.05),
+                                         sync_mode="sharded",
+                                         process_set=ps4)
+        mono4 = hvd.DistributedOptimizer(optax.adam(0.05),
+                                         process_set=ps4)
+        step_s4 = dp.make_train_step(loss_fn, shrd4, mesh=ps4.mesh,
+                                     axis_name=ps4.axis_name, donate=False)
+        step_m4 = dp.make_train_step(loss_fn, mono4, mesh=ps4.mesh,
+                                     axis_name=ps4.axis_name, donate=False)
+        x, y = batch
+        b4 = dp.shard_batch((x[:8], y[:8]), mesh=ps4.mesh,
+                            axis_name=ps4.axis_name)
+        sp = dp.replicate(synced_params, mesh=ps4.mesh)
+        sst = dp.shard_state(re4, mesh=ps4.mesh, axis_name=ps4.axis_name)
+        mp = dp.replicate(synced_params, mesh=ps4.mesh)
+        mst = dp.replicate(synced_full, mesh=ps4.mesh)
+        for _ in range(3):
+            sp, sst, l_s = step_s4(sp, sst, b4)
+            mp, mst, l_m = step_m4(mp, mst, b4)
+            assert float(l_s) == pytest.approx(float(l_m), rel=1e-6)
+        _assert_tree_close(mp, sp)
+
+    def test_tpu_state_sync_reshards_for_current_world(self, hvd):
+        from horovod_tpu.elastic.state import TpuState
+
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        full = hvd.unshard_opt_state(shrd, shrd.init(params), params)
+        stale = hvd.reshard_opt_state(shrd, full, params, 4)  # old world
+        state = TpuState(params=params, opt_state=stale,
+                         sharded_optimizer=shrd, epoch=7)
+        assert state.needs_world_sync()  # 4-row state in an 8-rank world
+        state.sync()
+        assert not state.needs_world_sync()
+        assert all(np.shape(l)[0] == hvd.size()
+                   for l in jax.tree.leaves(state.opt_state))
+        want = hvd.reshard_opt_state(shrd, full, params, hvd.size())
+        _assert_tree_close(state.opt_state, want, rtol=0, atol=0)
+        assert state.epoch == 7
+
+    def test_tpu_state_sync_reshards_monolithic_install(self, hvd):
+        # Rung-3 durable restore installs a monolithic-layout state (the
+        # gather-on-save checkpoint); sync() must detect and re-shard it.
+        from horovod_tpu.elastic.state import TpuState
+
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        full = hvd.unshard_opt_state(shrd, shrd.init(params), params)
+        state = TpuState(params=params, opt_state=full,
+                         sharded_optimizer=shrd)
+        assert state.needs_world_sync()
+        state.sync()
+        want = hvd.reshard_opt_state(shrd, full, params, hvd.size())
+        _assert_tree_close(state.opt_state, want, rtol=0, atol=0)
+
+    def test_tpu_state_requires_sharded_optimizer(self, hvd):
+        from horovod_tpu.elastic.state import TpuState
+
+        mono = hvd.DistributedOptimizer(optax.sgd(0.1))
+        with pytest.raises(ValueError, match="sync_mode='sharded'"):
+            TpuState(params={}, opt_state=None, sharded_optimizer=mono)
+
+
+class TestCheckpointRoundTrip:
+    def _trained(self, hvd, steps=2):
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        step = dp.make_train_step(loss_fn, shrd, donate=False)
+        p = dp.replicate(params)
+        s = dp.shard_state(shrd.init(params))
+        b = dp.shard_batch(batch)
+        for _ in range(steps):
+            p, s, _ = step(p, s, b)
+        return params, batch, loss_fn, shrd, step, p, s, b
+
+    def test_sharded_save_is_monolithic_layout(self, hvd, tmp_path):
+        from horovod_tpu.checkpoint import (
+            load_and_broadcast,
+            save_state_on_rank_0,
+        )
+
+        params, _, _, shrd, _, p, s, _ = self._trained(hvd)
+        path = str(tmp_path / "ckpt.pkl")
+        save_state_on_rank_0(path, shrd, jax.device_get(p),
+                             jax.device_get(s), step=2)
+        obj = load_and_broadcast(path)
+        # On disk: the exact monolithic layout (gather-on-save) — shapes
+        # match spec.inner.init, not the stacked rows.
+        template = hvd.reduce_spec_of(shrd).inner.init(params)
+        assert ([np.shape(l) for l in jax.tree.leaves(obj["opt_state"])]
+                == [np.shape(l) for l in jax.tree.leaves(template)])
+        want = hvd.unshard_opt_state(shrd, jax.device_get(s),
+                                     jax.device_get(p))
+        _assert_tree_close(obj["opt_state"], want, rtol=0, atol=0)
+        assert obj["step"] == 2
+
+    def test_round_trip_resumes_sharded(self, hvd, tmp_path):
+        from horovod_tpu.checkpoint import (
+            load_state_and_broadcast,
+            save_state_on_rank_0,
+        )
+
+        dp = hvd.data_parallel
+        (params, batch, loss_fn, shrd, step, p, s, b) = self._trained(hvd)
+        path = str(tmp_path / "ckpt.pkl")
+        save_state_on_rank_0(path, shrd, jax.device_get(p),
+                             jax.device_get(s))
+        obj = load_state_and_broadcast(path, shrd)
+        _assert_tree_close(obj["opt_state"], jax.device_get(s),
+                           rtol=0, atol=0)
+        # Resumed run continues identically to the uninterrupted one.
+        rp = dp.replicate(obj["params"])
+        rs = dp.shard_state(obj["opt_state"])
+        p1, s1, l1 = step(p, s, b)
+        p2, s2, l2 = step(rp, rs, b)
+        assert float(l1) == pytest.approx(float(l2), rel=1e-6)
+        _assert_tree_close(p1, p2)
+
+    def test_monolithic_checkpoint_resumes_sharded(self, hvd, tmp_path):
+        """Cross-mode: a checkpoint written by a MONOLITHIC job restores
+        into a sharded one (load re-shards) and the trajectories match."""
+        from horovod_tpu.checkpoint import (
+            load_state_and_broadcast,
+            save_state_on_rank_0,
+        )
+
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        step_m = dp.make_train_step(loss_fn, mono, donate=False)
+        pm = dp.replicate(params)
+        sm = dp.replicate(mono.init(params))
+        b = dp.shard_batch(batch)
+        for _ in range(2):
+            pm, sm, _ = step_m(pm, sm, b)
+        path = str(tmp_path / "mono.pkl")
+        save_state_on_rank_0(path, mono, jax.device_get(pm),
+                             jax.device_get(sm))
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        obj = load_state_and_broadcast(path, shrd)
+        step_s = dp.make_train_step(loss_fn, shrd, donate=False)
+        sp = dp.replicate(obj["params"])
+        ss = dp.shard_state(obj["opt_state"])
+        pm, sm, lm = step_m(pm, sm, b)
+        sp, ss, ls = step_s(sp, ss, b)
+        assert float(lm) == pytest.approx(float(ls), rel=1e-6)
+        _assert_tree_close(pm, sp)
+
+
+class TestAutotuneSyncModeAxis:
+    """The sync_mode axis in the joint warmup grid: candidates expand the
+    product, _pin pins the mode process-wide, and an abort pins the
+    rank-identical FIRST candidate with the usual poisoning."""
+
+    class _Step:
+        def __init__(self, fail_at=None):
+            self.calls = 0
+            self.fail_at = fail_at
+
+        def __call__(self, x):
+            self.calls += 1
+            if self.fail_at is not None and self.calls >= self.fail_at:
+                raise RuntimeError("window exploded")
+            return jnp.zeros(())
+
+        def clear_cache(self):
+            pass
+
+    def _cleanup(self):
+        from horovod_tpu import autotune as at
+
+        at.set_tuned_threshold(None)
+        at.set_tuned_segments(None)
+        at.set_tuned_sync_mode(None)
+        at._tuned["aborted"] = False
+        at._tuned["history"].clear()
+
+    def test_joint_grid_and_pin(self, hvd):
+        from horovod_tpu import autotune as at
+        from horovod_tpu.optimizer import resolve_sync_mode
+
+        tuner = at.AutotuneStep(
+            self._Step(), thresholds=(1024, 4096), iters=1,
+            segment_candidates=(2, 4),
+            sync_mode_candidates=("allreduce", "sharded"))
+        assert len(tuner._cands) == 2 * 2 * 2
+        assert all(len(c) == 3 for c in tuner._cands)
+        t = {"now": 0.0}
+
+        def clock():  # sharded windows are cheaper, deterministically
+            t["now"] += 1.0 if at.tuned_sync_mode() == "sharded" else 2.0
+            return t["now"]
+
+        tuner._clock = clock
+        try:
+            for _ in range(len(tuner._cands) * tuner._win):
+                tuner(1.0)
+            assert not tuner._hvd_tuning
+            assert at.tuned_sync_mode() == "sharded"
+            assert at.autotune_state()["sync_mode"] == "sharded"
+            # Optimizers built after the pin inherit the decision.
+            assert resolve_sync_mode() == "sharded"
+        finally:
+            self._cleanup()
+
+    def test_abort_pins_first_candidate_and_poisons(self, hvd):
+        from horovod_tpu import autotune as at
+        from horovod_tpu.exceptions import HorovodInternalError
+
+        tuner = at.AutotuneStep(
+            self._Step(fail_at=2), thresholds=(1024, 4096), iters=1,
+            sync_mode_candidates=("sharded", "allreduce"))
+        try:
+            tuner(1.0)  # window 0 settles fine
+            with pytest.raises(RuntimeError, match="window exploded"):
+                tuner(1.0)
+            # Rank-identical first candidate pinned, both axes.
+            assert at.tuned_threshold() == 1024
+            assert at.tuned_sync_mode() == "sharded"
+            assert at.warmup_aborted()
+            with pytest.raises(HorovodInternalError):
+                tuner(1.0)
+        finally:
+            self._cleanup()
+
+    def test_tune_step_sync_mode_explicit(self, hvd):
+        import time
+
+        from horovod_tpu import autotune as at
+
+        built = []
+
+        def build(mode):
+            built.append(mode)
+
+            def run():
+                if mode == "allreduce":
+                    time.sleep(0.03)
+                return jnp.zeros(())
+
+            return run
+
+        try:
+            best = at.tune_step_sync_mode(build, iters=1)
+            assert built == ["allreduce", "sharded"]
+            assert best == "sharded"
+            assert at.tuned_sync_mode() == "sharded"
+        finally:
+            self._cleanup()
+
+    def test_tune_step_sync_mode_abort_pins_first(self, hvd):
+        from horovod_tpu import autotune as at
+
+        def build(mode):
+            if mode == "sharded":
+                raise RuntimeError("boom")
+            return lambda: jnp.zeros(())
+
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                at.tune_step_sync_mode(build, iters=1)
+            assert at.tuned_sync_mode() == "allreduce"
+        finally:
+            self._cleanup()

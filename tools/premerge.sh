@@ -51,9 +51,55 @@ if ! timeout -k 10 900 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     exit 1
 fi
 
-echo "== premerge gate 3/3: bench.py --smoke (CPU, 2 steps/section) =="
-if ! JAX_PLATFORMS=cpu python bench.py --smoke; then
+echo "== premerge gate 3/3: bench.py --smoke perf lane (8-dev CPU mesh, 2 steps/section) =="
+blog="$(mktemp "${TMPDIR:-/tmp}/_bench.XXXXXX.log")"
+trap 'rm -f "$t1log" "$blog"' EXIT
+# The 8-device virtual mesh (the test harness's stand-in slice): on one
+# device the collectives compile to identities and the sharded mode has
+# no optimizer compute to shard away, so single-device ratios cannot
+# judge the sync modes against each other.
+if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python bench.py --smoke | tee "$blog"; then
     echo "premerge: bench smoke failed" >&2
+    exit 1
+fi
+# Perf lane: the machinery metrics must be PRESENT in the record (a bench
+# refactor silently dropping them reads as "no regression" forever), and
+# the sharded sync mode must not regress more than 2% below the
+# monolithic machinery ratio (both are vs the same raw baseline, so the
+# comparison cancels the baseline out).
+if ! python - "$blog" <<'EOF'
+import json
+import sys
+
+last = None
+with open(sys.argv[1]) as f:
+    for line in f:
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                last = json.loads(line)
+            except ValueError:
+                pass
+if last is None:
+    sys.exit("premerge perf lane: no JSON record in bench output")
+mono = last.get("vs_baseline_machinery")
+sharded = last.get("vs_baseline_machinery_sharded")
+if mono is None or sharded is None:
+    sys.exit(
+        "premerge perf lane: machinery metrics missing from bench record "
+        f"(vs_baseline_machinery={mono!r}, "
+        f"vs_baseline_machinery_sharded={sharded!r})")
+if sharded < mono * 0.98:
+    sys.exit(
+        f"premerge perf lane: sharded sync mode regressed "
+        f"{(1 - sharded / mono) * 100:.1f}% below the monolithic "
+        f"machinery ratio (sharded={sharded}, monolithic={mono}, "
+        f"allowed slack 2%)")
+print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded})")
+EOF
+then
+    echo "premerge: perf lane failed" >&2
     exit 1
 fi
 echo "premerge: all gates passed"
