@@ -102,6 +102,7 @@ from .functions import (  # noqa: F401
 from . import abort  # noqa: F401
 from . import autotune  # noqa: F401
 from . import faults  # noqa: F401
+from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401

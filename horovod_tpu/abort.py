@@ -153,8 +153,22 @@ class _AbortState:
 _state = _AbortState()
 
 is_aborted = _state.is_aborted
-consume = _state.consume
 reset = _state.reset
+
+
+def consume() -> None:
+    """Eat the armed abort (the elastic loop caught its
+    HorovodInternalError). Counts and journals only when something was
+    actually armed — the elastic loop calls this on EVERY internal
+    failure for hygiene, and most of those never had an abort."""
+    armed = _state.is_aborted()
+    reason, gen = _state.snapshot()
+    _state.consume()
+    if armed:
+        from . import metrics
+
+        metrics.ABORT_CONSUMES.inc()
+        metrics.event("abort_consumed", generation=gen, reason=reason)
 
 
 def trigger_local(reason: str, generation: int | None = None) -> None:
@@ -223,6 +237,11 @@ def post(reason: str, generation: int | None = None) -> None:
         "host": os.environ.get("HOROVOD_HOSTNAME", socket.gethostname()),
         "time": time.time(),
     }).encode()
+    from . import metrics
+
+    metrics.ABORT_POSTS.inc()
+    metrics.event("abort_posted", generation=gen, reason=reason,
+                  source="worker")
     addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
     port = os.environ.get("HOROVOD_RENDEZVOUS_PORT", "")
     if addr and port:

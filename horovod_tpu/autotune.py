@@ -86,6 +86,18 @@ _tuned: dict = {"threshold": None, "segments": None, "sync_mode": None,
                 "aborted": False, "history": []}
 
 
+def _record_trial(tunable: str, seconds: float) -> None:
+    """Metrics-plane record of one completed sampling window (the
+    observability counterpart of HOROVOD_AUTOTUNE_LOG). Best-effort."""
+    try:
+        from . import metrics
+
+        metrics.AUTOTUNE_TRIALS.inc(tunable=tunable)
+        metrics.AUTOTUNE_TRIAL_SECONDS.observe(seconds)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def warmup_aborted() -> bool:
     """True after a mid-warmup abort in THIS process (see
     ``AutotuneStep._abort``): peers may have pinned a different
@@ -410,6 +422,7 @@ class AutotuneStep:
                 self._fetch_probe(out)
                 dt = (self._clock() - self._t0) / self._iters
                 self._samples.append((self._cands[idx], dt))
+                _record_trial(self._axes_name(), dt)
                 if idx + 1 == len(self._cands):
                     self._finish()
             return out
@@ -504,6 +517,7 @@ def tune_step_sync_mode(
             jax.block_until_ready(out)
             seconds = (_time.perf_counter() - t0) / max(1, iters)
             results.append((mode, seconds))
+            _record_trial("sync_mode", seconds)
             log.info("autotune sync_mode: %s -> %.6fs/step", mode, seconds)
     except Exception:
         set_tuned_sync_mode(sync_modes[0])
@@ -591,6 +605,7 @@ def tune_step_fusion(
             seconds = measure(int(threshold))
             results.append((int(threshold), seconds))
             _tuned["history"].append((int(threshold), seconds))
+            _record_trial("fusion_threshold_bytes", seconds)
             log.info("autotune fusion: threshold=%d -> %.6fs/step",
                      int(threshold), seconds)
         best = min(results, key=lambda p: p[1])[0]

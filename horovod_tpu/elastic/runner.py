@@ -25,6 +25,7 @@ import signal
 import threading
 
 from .. import basics
+from .. import metrics as _metrics
 from ..exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
@@ -57,6 +58,7 @@ def _install_drain_handler() -> None:
     def _on_sigterm(signum, frame):
         if not _drain.is_set():
             _drain.set()
+            _metrics.event("drain_requested")
             log.info(
                 "elastic: SIGTERM (preemption notice) — draining: final "
                 "commit, then clean EXIT_REMOVED"
@@ -94,6 +96,17 @@ def run(func):
     abort/recover cycles forever, with exponential backoff (capped at
     ``HOROVOD_RECOVERY_BACKOFF_MAX`` seconds) between attempts so a
     flapping host cannot saturate the control plane.
+
+    **Observability** (docs/observability.md): the loop clocks every
+    phase into the goodput tracker — world formation + ``sync()`` as
+    ``rendezvous`` loss, ``restore()``/durable restore as ``restore``
+    loss, the inter-attempt sleep as ``backoff`` loss, time inside
+    ``func`` as productive — surfaced in ``hvd.profiler.summary()`` and
+    the ``hvd_goodput_*`` scrape counters; and journals every lifecycle
+    transition (world_synced, recovery rung, checkpoint fallback,
+    hosts_updated, removed_from_world, recovery_exhausted) to
+    ``HOROVOD_EVENT_LOG`` with the world generation stamped on each
+    record.
     """
 
     @functools.wraps(func)
@@ -116,7 +129,17 @@ def run(func):
         recovery_backoff_max = get_float("HOROVOD_RECOVERY_BACKOFF_MAX", 5.0)
         consecutive_failures = 0
         commits_before_attempt = 0
+        goodput = _metrics.goodput()
+        _metrics.event("elastic_run_start")
+
+        def _generation() -> int:
+            from .. import abort
+
+            return abort.current_generation()
+
         while True:
+            t_attempt = time.perf_counter()
+            run_started = None
             # World (re-)formation runs INSIDE the retry scope: init() can
             # itself fail transiently during an elastic reconfiguration
             # (driver mid-publish, KV briefly unreachable) and must retry,
@@ -154,17 +177,37 @@ def run(func):
                 if not skip_sync or getattr(
                         state, "needs_world_sync", lambda: False)():
                     state.sync()
+                _metrics.event("world_synced", generation=_generation(),
+                               np=basics.size(), skip_sync=skip_sync)
                 from ..runner.elastic.worker import _counters
 
                 # Snapshot taken AFTER sync (which commits internally):
                 # only commits the training function itself lands count as
                 # progress for the storm breaker below.
                 commits_before_attempt = _counters.commits
-                return func(state, *args, **kwargs)
+                # Formation + sync time is rendezvous loss; everything
+                # from here until func returns/raises is productive.
+                goodput.add_lost(
+                    "rendezvous", time.perf_counter() - t_attempt)
+                run_started = time.perf_counter()
+                try:
+                    return func(state, *args, **kwargs)
+                finally:
+                    # Covers return AND raise: time inside func counts as
+                    # productive either way (the un-committed tail of a
+                    # failed attempt is unknowable; documented caveat).
+                    goodput.add_productive(
+                        time.perf_counter() - run_started)
             except HorovodInternalError as e:
                 from .. import abort, stall
                 from ..runner.elastic.worker import _counters
 
+                if run_started is None:
+                    # The attempt died during formation/sync: that time
+                    # never reached the productive clock — it is
+                    # rendezvous loss.
+                    goodput.add_lost(
+                        "rendezvous", time.perf_counter() - t_attempt)
                 # Progress (a commit landed inside the attempt) resets the
                 # storm breaker: distinct one-off failures across a long
                 # job are routine churn, not a livelock.
@@ -188,11 +231,19 @@ def run(func):
                         "progress (HOROVOD_RECOVERY_MAX_ATTEMPTS=%d); "
                         "giving up", consecutive_failures, max_recovery,
                     )
+                    _metrics.event(
+                        "recovery_exhausted", generation=_generation(),
+                        failures=consecutive_failures, error=str(e)[:300])
                     raise RecoveryExhaustedError(
                         f"{consecutive_failures} consecutive recovery "
                         f"attempts failed with no progress (last: {e})"
                     ) from e
                 rung = min(consecutive_failures, 3)
+                _metrics.RECOVERIES.inc(rung=str(rung))
+                _metrics.event(
+                    "recovery", generation=_generation(), rung=rung,
+                    failures=consecutive_failures, error=str(e)[:300])
+                t_restore = time.perf_counter()
                 if rung == 1:
                     log.warning(
                         "elastic: internal failure (%s); restoring last "
@@ -215,21 +266,44 @@ def run(func):
                         log.error(
                             "elastic: durable restore failed (%s); falling "
                             "back to the in-memory commit", ce)
-                    if not restored and basics.is_initialized():
-                        state.restore()
+                    if not restored:
+                        _metrics.event(
+                            "checkpoint_fallback", generation=_generation(),
+                            durable_restored=False)
+                        if basics.is_initialized():
+                            state.restore()
+                    else:
+                        _metrics.event(
+                            "checkpoint_fallback", generation=_generation(),
+                            durable_restored=True)
+                goodput.add_lost(
+                    "restore", time.perf_counter() - t_restore)
                 skip_sync = False
+                t_backoff = time.perf_counter()
                 time.sleep(min(
                     0.5 * (2 ** (consecutive_failures - 1)),
                     recovery_backoff_max,
                 ))
+                goodput.add_lost(
+                    "backoff", time.perf_counter() - t_backoff)
             except HostsUpdatedInterrupt as e:
                 log.info("elastic: hosts updated; re-syncing")
+                if run_started is None:
+                    # sync() commits internally, and a pending host-change
+                    # notification surfaces there: formation time cut
+                    # short by the interrupt is still rendezvous loss.
+                    goodput.add_lost(
+                        "rendezvous", time.perf_counter() - t_attempt)
+                _metrics.event("hosts_updated", generation=_generation(),
+                               skip_sync=e.skip_sync)
                 skip_sync = e.skip_sync
             except RemovedFromWorldError:
                 # This host left the world: exit with the driver's sentinel
                 # code (not success, not a blacklisting failure).
                 from ..runner.elastic.constants import EXIT_REMOVED
 
+                _metrics.event("removed_from_world",
+                               generation=_generation())
                 log.info("elastic: removed from world; exiting")
                 sys.exit(EXIT_REMOVED)
             # Tear down; the next iteration re-forms the world.

@@ -47,7 +47,7 @@ from .executable_cache import global_cache
 _dispatch_counts: "collections.Counter[str]" = collections.Counter()
 
 
-def cache_stats() -> dict:
+def cache_stats(reset: bool = False) -> dict:
     """Executable-cache and eager-dispatch counters.
 
     Parity: the reference's response-cache hit statistics
@@ -58,9 +58,14 @@ def cache_stats() -> dict:
 
     Also surfaced in ``hvd.profiler.summary()`` and emitted once per run
     by ``bench.py``.
+
+    ``reset=True`` zeroes the hit/miss/dispatch counters AFTER collecting
+    them (cached executables stay cached) — tests and bench warmup phases
+    use it so counters do not leak across phases. The cluster metrics
+    registry resets separately via ``metrics.reset_for_testing()``.
     """
     cache = global_cache()
-    return {
+    stats = {
         "executable_cache": {
             "hits": cache.hits,
             "misses": cache.misses,
@@ -69,6 +74,10 @@ def cache_stats() -> dict:
         },
         "eager_dispatch": dict(_dispatch_counts),
     }
+    if reset:
+        _dispatch_counts.clear()
+        cache.reset_stats()
+    return stats
 
 # -- Reduce ops (parity: horovod.torch.mpi_ops Average/Sum/Adasum/Min/Max) ---
 
@@ -314,14 +323,36 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
         )
         return jax.jit(fn)
 
+    import time as _time
+
+    from .. import metrics as _metrics
     from ..stall import get_inspector
     from ..timeline import activity, mark_cycle
 
     mark_cycle()
     _dispatch_counts[kind] += 1
+    nbytes = int(x.size) * x.dtype.itemsize
+    _metrics.COLLECTIVE_DISPATCH.inc(kind=kind)
+    _metrics.COLLECTIVE_BYTES.observe(nbytes, kind=kind)
     cache = global_cache()
-    misses_before = cache.misses
-    compiled = cache.get_or_build(key, build)
+    # Attribution by THIS call's builder running, not by diffing the
+    # global miss counter — a concurrent miss on another key inside this
+    # call's window would otherwise count a spurious miss (and a bogus
+    # near-zero compile sample) against this dispatch.
+    build_info: dict = {}
+
+    def instrumented_build():
+        t_build = _time.perf_counter()
+        result = build()
+        build_info["compile_s"] = _time.perf_counter() - t_build
+        return result
+
+    compiled = cache.get_or_build(key, instrumented_build)
+    missed = "compile_s" in build_info
+    _metrics.CACHE_EVENTS.inc(outcome="miss" if missed else "hit")
+    if missed:
+        _metrics.COLLECTIVE_COMPILE.observe(build_info["compile_s"],
+                                            kind=kind)
     sharding = NamedSharding(mesh, P(axis))
     x = jax.device_put(x, sharding)
     # Eager ops are synchronous (reference parity: hvd.allreduce blocks;
@@ -329,6 +360,7 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     # ticket window is what lets the stall inspector see execution hangs,
     # not just dispatch.
     ticket = get_inspector().begin(f"{kind}[{x.shape}]")
+    t_exec = _time.perf_counter()
     try:
         with activity(
             kind,
@@ -336,11 +368,13 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
             args={
                 "shape": list(x.shape),
                 "dtype": str(x.dtype),
-                "cache": "miss" if cache.misses > misses_before else "hit",
+                "cache": "miss" if missed else "hit",
             },
         ):
             out = compiled(x)
             jax.block_until_ready(out)
+            _metrics.COLLECTIVE_LATENCY.observe(
+                _time.perf_counter() - t_exec, kind=kind)
             return out
     finally:
         get_inspector().end(ticket)

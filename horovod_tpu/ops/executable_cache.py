@@ -76,6 +76,14 @@ class ExecutableCache:
             self.hits = 0
             self.misses = 0
 
+    def reset_stats(self) -> None:
+        """Zero the hit/miss counters WITHOUT dropping entries — the
+        ``cache_stats(reset=True)`` contract (bench warmup must zero the
+        counters while keeping its warm executables)."""
+        with self._lock:
+            self.hits = 0
+            self.misses = 0
+
     def __len__(self) -> int:
         return len(self._entries)
 

@@ -79,6 +79,12 @@ def segment_leaves(
     if not sizes:
         return []
     if total <= 0 or k == 1:
+        try:
+            from .. import metrics
+
+            metrics.OVERLAP_SEGMENTS.set(1)
+        except Exception:  # noqa: BLE001
+            pass
         return [list(range(len(sizes)))]
     segments: list[list[int]] = [[] for _ in range(k)]
     cum = 0
@@ -87,7 +93,14 @@ def segment_leaves(
         mid = cum + nbytes / 2.0
         segments[min(k - 1, int(mid * k / total))].append(i)
         cum += nbytes
-    return [s for s in segments if s]
+    out = [s for s in segments if s]
+    try:
+        from .. import metrics
+
+        metrics.OVERLAP_SEGMENTS.set(len(out))
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
+    return out
 
 
 def bucket_leaves(

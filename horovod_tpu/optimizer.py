@@ -39,6 +39,36 @@ from .ops import collective_ops
 from .ops.fusion import fused_allreduce
 
 
+def _record_flush(sync_mode: str, wire_leaves, threshold_bytes,
+                  itemsize_override: int | None = None) -> None:
+    """Metrics-plane instrumentation of a gradient-sync flush.
+
+    Runs at TRACE time (the flush is traced machinery), so the counters
+    measure distinct compiled flushes and the histograms their static
+    wire bytes / bucket counts — the per-trace shape of the fusion
+    buffer, not a per-step rate (see docs/observability.md). Shapes are
+    static under tracing, so sizes are exact. ``itemsize_override``
+    keeps the bytes histogram honest for exchanges whose wire dtype is
+    not the leaves' dtype (int8: the leaves passed in are the f32
+    bucketing view, but the wire carries 1 byte/element). Never raises:
+    observability must not break tracing."""
+    try:
+        from . import metrics
+        from .ops.fusion import bucket_leaves
+
+        nbytes = sum(
+            int(w.size) * (itemsize_override
+                           if itemsize_override is not None
+                           else jnp.dtype(w.dtype).itemsize)
+            for w in wire_leaves)
+        nbuckets = len(bucket_leaves(wire_leaves, threshold_bytes))
+        metrics.GRAD_SYNC_FLUSHES.inc(sync_mode=sync_mode)
+        metrics.GRAD_SYNC_BYTES.observe(nbytes, sync_mode=sync_mode)
+        metrics.GRAD_SYNC_BUCKETS.observe(nbuckets, sync_mode=sync_mode)
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
+
+
 def _reduce_grads(
     grads,
     op,
@@ -102,6 +132,9 @@ def _reduce_grads(
             # total/num_groups bytes (sized on the f32 exchange view).
             total = sum(int(jnp.asarray(g).size) * 4 for g in leaves)
             threshold_bytes = max(1, total // num_groups)
+        # Bucketing rides the f32 exchange view; the wire itself is int8.
+        _record_flush("allreduce", leaves, threshold_bytes,
+                      itemsize_override=1)
         reduced = int8_fused_allreduce(
             leaves, axis_name, world_size, op=op,
             threshold_bytes=threshold_bytes,
@@ -119,6 +152,7 @@ def _reduce_grads(
         # each. Emulate by capping each bucket at total/num_groups bytes.
         total = sum(int(w.size) * jnp.dtype(w.dtype).itemsize for w in wire)
         threshold_bytes = max(1, total // num_groups)
+    _record_flush("allreduce", wire, threshold_bytes)
     reduced = fused_allreduce(
         wire,
         op=op,
@@ -218,10 +252,13 @@ def _reducescatter_grads(
     if getattr(compression, "marker", None) == "int8":
         from .ops.quantization import int8_fused_reducescatter
 
+        sharded_threshold = _sharded_threshold(
+            leaves, threshold_bytes, num_groups)
+        _record_flush("sharded", leaves, sharded_threshold,
+                      itemsize_override=1)
         shards = int8_fused_reducescatter(
             leaves, axis_name, n, op=op,
-            threshold_bytes=_sharded_threshold(
-                leaves, threshold_bytes, num_groups),
+            threshold_bytes=sharded_threshold,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             salt=quant_salt, issue_reversed=issue_reversed)
@@ -234,9 +271,11 @@ def _reducescatter_grads(
     compressed = [compression.compress(g) for g in leaves]
     wire = [c[0] for c in compressed]
     ctxs = [c[1] for c in compressed]
+    sharded_threshold = _sharded_threshold(wire, threshold_bytes, num_groups)
+    _record_flush("sharded", wire, sharded_threshold)
     shards = fused_reducescatter(
         wire, op, axis_name, n,
-        threshold_bytes=_sharded_threshold(wire, threshold_bytes, num_groups),
+        threshold_bytes=sharded_threshold,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
         issue_reversed=issue_reversed)

@@ -68,15 +68,19 @@ def maybe_start_from_env() -> None:
 def summary() -> dict:
     """One-call observability snapshot: trace state plus the runtime
     counters callers keep asking the timeline for — executable-cache
-    hits/misses/size and per-kind eager-dispatch counts
-    (``hvd.cache_stats()``). ``bench.py`` emits this once per run so
-    every benchmark record carries the cache behavior that produced it.
+    hits/misses/size, per-kind eager-dispatch counts
+    (``hvd.cache_stats()``), and the elastic goodput ledger (productive
+    vs. lost wall time, see ``horovod_tpu.metrics.GoodputTracker``).
+    ``bench.py`` emits this once per run so every benchmark record
+    carries the cache/goodput behavior that produced it.
     """
+    from . import metrics
     from .ops.collective_ops import cache_stats
 
     return {
         "trace_active": active(),
         "trace_logdir": _active_logdir,
+        "goodput": metrics.goodput().summary(),
         **cache_stats(),
     }
 

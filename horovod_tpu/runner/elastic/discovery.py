@@ -201,6 +201,13 @@ class HostManager:
             self._prune_blacklist_locked()
             return hostname in self._blacklist
 
+    def blacklist_count(self) -> int:
+        """Hosts currently blacklisted (cooldown-pruned) — the driver's
+        ``hvd_blacklisted_hosts`` scrape gauge."""
+        with self._lock:
+            self._prune_blacklist_locked()
+            return len(self._blacklist)
+
     def _prune_blacklist_locked(self) -> None:
         if self._cooldown_s <= 0:
             return

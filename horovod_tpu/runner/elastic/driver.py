@@ -26,6 +26,7 @@ import json
 import os
 import time
 
+from ... import metrics as _metrics
 from ...exceptions import HostDiscoveryFailedError
 from ...utils.env import get_float
 from ...utils.logging import get_logger
@@ -142,6 +143,14 @@ class ElasticDriver:
         }
         version = self._server.publish_epoch(WORLD_SCOPE, data)
         self._world_hosts = hosts
+        # Scrape gauges + lifecycle journal: one record per world epoch,
+        # stamped with the generation the epoch IS.
+        self._server.set_cluster_info(
+            world_np=len(hosts),
+            blacklisted=self._manager.blacklist_count())
+        _metrics.event(
+            "world_published", generation=version, np=len(hosts),
+            hosts=[h.hostname for h in hosts])
         return version
 
     def _launch_missing_workers(self, version: int) -> None:
@@ -220,6 +229,8 @@ class ElasticDriver:
     # -- main loop -----------------------------------------------------------
 
     def run(self) -> int:
+        _metrics.event("driver_start", generation=0,
+                       min_np=self._min_np, max_np=self._max_np)
         hosts = self._wait_for_available_slots(
             self._min_np, self._settings.elastic_timeout
         )
@@ -268,10 +279,20 @@ class ElasticDriver:
         wedge into HorovodInternalError → elastic recovery, instead of
         blocking forever inside a native allreduce no one will complete."""
         gen = self._server.post_abort(reason)
+        _metrics.event("abort_posted", generation=gen, reason=reason,
+                       source="driver")
         self._log.warning(
             "elastic: posting coordinated abort for world generation %d "
             "(%s)", gen, reason,
         )
+
+    def _blacklist(self, name: str, why: str) -> None:
+        """Blacklist + journal + refresh the scrape gauge in one place."""
+        self._manager.blacklist(name)
+        self._server.set_cluster_info(
+            blacklisted=self._manager.blacklist_count())
+        _metrics.event("blacklist", generation=self._server.generation,
+                       host=name, reason=why)
 
     def _monitor(self) -> int:
         last_poll = 0.0
@@ -287,10 +308,16 @@ class ElasticDriver:
                 del self._workers[name]
                 self._launched_at.pop(name, None)
                 self._server.clear_heartbeat(name)
+                _metrics.event("worker_exit",
+                               generation=self._server.generation,
+                               host=name, rc=rc)
                 if rc == 0:
                     # Success on any worker ⇒ the job completed (reference
                     # semantics: the training function returned).
                     self._log.info("elastic: worker on %s finished ok", name)
+                    _metrics.event("job_complete",
+                                   generation=self._server.generation,
+                                   host=name)
                     return 0
                 if rc == EXIT_REMOVED:
                     # Clean self-exit of a worker dropped from the world —
@@ -330,7 +357,8 @@ class ElasticDriver:
                     self._post_abort(
                         f"worker on {name} lost the rendezvous KV "
                         f"{n} consecutive times; blacklisted")
-                    self._manager.blacklist(name)
+                    self._blacklist(
+                        name, f"{n} consecutive EXIT_DRIVER_LOST exits")
                     need_reconfigure = True
                     continue
                 self._driver_lost_counts.pop(name, None)
@@ -340,7 +368,7 @@ class ElasticDriver:
                 )
                 self._post_abort(
                     f"worker on {name} failed with rc={rc}; blacklisted")
-                self._manager.blacklist(name)
+                self._blacklist(name, f"worker failed with rc={rc}")
                 need_reconfigure = True
             # 1b. Liveness plane: kill + blacklist hosts the heartbeat
             # deadline has condemned (hung, not crashed — invisible to the
@@ -355,10 +383,13 @@ class ElasticDriver:
                 # peer should already be polling the flag when the SIGKILL
                 # lands, whichever unblocks them first.
                 self._post_abort(f"worker on {name} is hung ({why}); killed")
+                _metrics.event("worker_hung",
+                               generation=self._server.generation,
+                               host=name, reason=why)
                 terminate_worker(self._workers.pop(name))
                 self._launched_at.pop(name, None)
                 self._server.clear_heartbeat(name)
-                self._manager.blacklist(name)
+                self._blacklist(name, f"hung: {why}")
                 need_reconfigure = True
             if need_reconfigure:
                 self._reconfigure()

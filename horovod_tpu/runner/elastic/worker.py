@@ -262,14 +262,29 @@ class ElasticWorkerContext:
         """Publish one heartbeat; returns False when dropped/failed.
 
         Failures are swallowed (the poll loop owns driver-loss escalation;
-        a missed heartbeat only matters to the DRIVER's deadline)."""
+        a missed heartbeat only matters to the DRIVER's deadline).
+
+        The heartbeat doubles as this worker's metrics publication: the
+        full instrument snapshot rides the PUT (``"metrics"`` key) so the
+        driver's ``GET /metrics`` serves a cluster-wide aggregate with
+        per-rank labels — no extra connection, no extra poll loop.
+        ``HOROVOD_METRICS_PIGGYBACK=0`` strips it (liveness-only beats)."""
         if faults.fire(faults.HEARTBEAT_SEND):
             return False  # injected drop: silence, exactly like a hang
-        payload = json.dumps({
+        body = {
             "steps": _counters.steps,
             "commits": _counters.commits,
+            "rank": os.environ.get("HOROVOD_RANK", "0"),
             "time": time.time(),
-        }).encode()
+        }
+        if os.environ.get("HOROVOD_METRICS_PIGGYBACK", "1") != "0":
+            try:
+                from ... import metrics as _metrics
+
+                body["metrics"] = _metrics.snapshot()
+            except Exception:  # noqa: BLE001 — liveness beats observability
+                pass
+        payload = json.dumps(body).encode()
         try:
             self._hb_client.put(HEARTBEAT_SCOPE, self.hostname, payload)
             return True

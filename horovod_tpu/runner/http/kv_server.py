@@ -39,6 +39,15 @@ block), every request carries ``X-Hvd-Auth: HMAC-SHA256(method\\npath\\n
 body)`` and the server rejects missing/invalid tags with 403 — a port
 scanner on the cluster network cannot read or poison the rendezvous state.
 No key set = open dev mode.
+
+Metrics plane: ``GET /metrics`` serves a Prometheus-text aggregate of the
+whole job — driver-side gauges (world generation/size, blacklisted hosts,
+fenced writes, per-host heartbeat ages) plus every worker's instrument
+snapshot, which workers piggyback on the heartbeat PUTs they already send
+(``runner/elastic/worker.py``), labeled per rank/host. The endpoint is
+exempt from HMAC auth by design: a standard Prometheus scraper cannot sign
+requests, and the data is read-only operational telemetry (it carries no
+rendezvous state a scraper could poison). See ``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -52,6 +61,7 @@ from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
 from ... import faults
+from ... import metrics as _metrics
 from ...utils.env import get_float, get_int
 from ...utils.retry import call_with_retries
 from .. import secret as _secret
@@ -114,6 +124,9 @@ class _KVHandler(BaseHTTPRequestHandler):
         return scope, key
 
     def do_GET(self):  # noqa: N802
+        if self.path == "/metrics":
+            # Unauthenticated by design: Prometheus scrapers can't HMAC.
+            return self._serve_metrics()
         if not self._authenticate():
             return
         store = self.server.store  # type: ignore[attr-defined]
@@ -184,11 +197,92 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._reply(409, rejected)
         self._reply(200, b"")
 
+    def _serve_metrics(self):
+        try:
+            body = _render_cluster_metrics(self.server).encode()
+        except Exception as e:  # noqa: BLE001 — scrape must not kill the KV
+            return self._reply(500, f"metrics render failed: {e}".encode())
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _reply(self, code: int, body: bytes):
         self.send_response(code)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+def _render_cluster_metrics(httpd) -> str:
+    """The driver's cluster-wide scrape: driver-plane gauges built from
+    live server state, then every worker snapshot found piggybacked on a
+    heartbeat payload, rendered with per-rank/host labels."""
+    with httpd.lock:
+        version = httpd.version
+        fenced = httpd.fenced
+        world_np = getattr(httpd, "world_np", 0)
+        blacklisted = getattr(httpd, "blacklisted", 0)
+        now = time.monotonic()
+        ages = {h: now - t for h, t in httpd.hb_times.items()}
+        payloads = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
+    driver_families = [
+        _metrics.make_family(
+            "hvd_world_generation", "gauge",
+            "Monotonic world generation (the rendezvous epoch version).",
+            [({}, version)]),
+        _metrics.make_family(
+            "hvd_world_size", "gauge",
+            "Hosts in the current world epoch (0 before the first "
+            "elastic publish).", [({}, world_np)]),
+        _metrics.make_family(
+            "hvd_blacklisted_hosts", "gauge",
+            "Hosts currently blacklisted by the elastic driver.",
+            [({}, blacklisted)]),
+        _metrics.make_family(
+            "hvd_fenced_writes_total", "counter",
+            "Stale-generation writes rejected by the generation fence.",
+            [({}, fenced)]),
+        _metrics.make_family(
+            "hvd_heartbeat_age_seconds", "gauge",
+            "Seconds since each host's last heartbeat (server clock).",
+            [({"host": h}, age) for h, age in sorted(ages.items())]),
+    ]
+    groups: list = [({}, driver_families)]
+    steps_samples: list = []
+    commit_samples: list = []
+    for host, raw in sorted(payloads.items()):
+        try:
+            payload = json.loads(raw)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if not isinstance(payload, dict):
+            continue
+        labels = {"host": host}
+        rank = payload.get("rank")
+        if rank is not None:
+            labels["rank"] = str(rank)
+        if isinstance(payload.get("steps"), (int, float)):
+            steps_samples.append((labels, payload["steps"]))
+        if isinstance(payload.get("commits"), (int, float)):
+            commit_samples.append((labels, payload["commits"]))
+        families = payload.get("metrics")
+        if isinstance(families, list):
+            families = [f for f in families
+                        if isinstance(f, dict) and "name" in f]
+            if families:
+                groups.append((labels, families))
+    driver_families.append(_metrics.make_family(
+        "hvd_worker_steps_total", "counter",
+        "Watched steps reported on each worker's last heartbeat.",
+        steps_samples))
+    driver_families.append(_metrics.make_family(
+        "hvd_worker_commits_total", "counter",
+        "State commits reported on each worker's last heartbeat.",
+        commit_samples))
+    return _metrics.render_families(groups)
 
 
 class RendezvousServer:
@@ -201,6 +295,8 @@ class RendezvousServer:
         self._httpd.version = 0  # type: ignore[attr-defined]
         self._httpd.fenced = 0  # type: ignore[attr-defined]
         self._httpd.hb_times = {}  # type: ignore[attr-defined]
+        self._httpd.world_np = 0  # type: ignore[attr-defined]
+        self._httpd.blacklisted = 0  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
         self._httpd.secret = _secret.current_key()  # type: ignore[attr-defined]
@@ -225,6 +321,22 @@ class RendezvousServer:
         """How many stale-generation writes the fence has rejected."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             return self._httpd.fenced  # type: ignore[attr-defined]
+
+    def set_cluster_info(self, world_np: int | None = None,
+                         blacklisted: int | None = None) -> None:
+        """Driver-side gauges for the ``/metrics`` scrape: the elastic
+        driver refreshes these on every world publish / blacklist, since
+        only it knows them (the server sees heartbeats, not topology)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            if world_np is not None:
+                self._httpd.world_np = int(world_np)  # type: ignore[attr-defined]
+            if blacklisted is not None:
+                self._httpd.blacklisted = int(blacklisted)  # type: ignore[attr-defined]
+
+    def metrics_text(self) -> str:
+        """The scrape body, rendered in-process (what ``GET /metrics``
+        serves over HTTP)."""
+        return _render_cluster_metrics(self._httpd)
 
     def start(self) -> int:
         self._thread = threading.Thread(

@@ -24,7 +24,7 @@ import contextlib
 import threading
 import time
 
-from . import abort, faults
+from . import abort, faults, metrics
 from .utils.env import get_float
 from .utils.logging import get_logger
 
@@ -63,12 +63,17 @@ class StallInspector:
             self._next_ticket += 1
             self._outstanding[ticket] = (name, time.monotonic())
             self._ensure_watchdog()
+            outstanding = len(self._outstanding)
+        metrics.STALL_TICKETS.inc()
+        metrics.STALL_OUTSTANDING.set(outstanding)
         return ticket
 
     def end(self, ticket: int) -> None:
         with self._lock:
             self._outstanding.pop(ticket, None)
             self._last_warned.pop(ticket, None)
+            outstanding = len(self._outstanding)
+        metrics.STALL_OUTSTANDING.set(outstanding)
 
     # -- watchdog -----------------------------------------------------------
 
@@ -98,6 +103,7 @@ class StallInspector:
                 self._last_warned[ticket] = now
                 stalled.append(f"{name} (outstanding {age:.0f}s)")
         if stalled:
+            metrics.STALL_WARNINGS.inc(len(stalled))
             get_logger().warning(
                 "Stall detected: one or more collectives have been "
                 "outstanding for over %.0fs — this usually means a rank "

@@ -2,6 +2,15 @@
 
 ``HOROVOD_LOG_LEVEL`` in {trace, debug, info, warning, error, fatal};
 ``HOROVOD_LOG_TIMESTAMP`` / ``HOROVOD_LOG_HIDE_TIME`` control the prefix.
+
+Every record is additionally prefixed with ``[rank/size g<generation>]``
+when the process runs inside a launched world (``HOROVOD_RANK`` set), the
+generation part appearing only in elastic worlds — so the interleaved
+stdout of a multi-worker job stays attributable per line without grepping
+hostnames, and a line from generation 3 cannot be mistaken for the re-formed
+generation 4's. The prefix re-reads the env per record: an elastic resize
+rewrites ``HOROVOD_RANK``/``HOROVOD_WORLD_VERSION`` in place, and the very
+next log line must carry the new identity.
 """
 
 from __future__ import annotations
@@ -24,6 +33,29 @@ logging.addLevelName(5, "TRACE")
 _logger: logging.Logger | None = None
 
 
+def rank_prefix() -> str:
+    """``"[rank/size g<generation>] "`` for launched workers, ``""``
+    elsewhere (single-process scripts keep clean logs)."""
+    rank = os.environ.get("HOROVOD_RANK")
+    if rank is None:
+        return ""
+    size = os.environ.get("HOROVOD_SIZE") or "?"
+    prefix = f"[{rank}/{size}"
+    if (os.environ.get("HOROVOD_ELASTIC") == "1"
+            or "HOROVOD_WORLD_VERSION" in os.environ):
+        prefix += f" g{os.environ.get('HOROVOD_WORLD_VERSION', '0') or '0'}"
+    return prefix + "] "
+
+
+class RankPrefixFormatter(logging.Formatter):
+    """Injects :func:`rank_prefix` as ``%(hvdctx)s`` — computed per
+    record, not per handler, so elastic identity changes show up live."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        record.hvdctx = rank_prefix()
+        return super().format(record)
+
+
 def get_logger() -> logging.Logger:
     global _logger
     if _logger is None:
@@ -33,10 +65,10 @@ def get_logger() -> logging.Logger:
         if not logger.handlers:
             handler = logging.StreamHandler(sys.stderr)
             if os.environ.get("HOROVOD_LOG_HIDE_TIME"):
-                fmt = "[%(levelname)s] %(message)s"
+                fmt = "[%(levelname)s] %(hvdctx)s%(message)s"
             else:
-                fmt = "%(asctime)s [%(levelname)s] %(message)s"
-            handler.setFormatter(logging.Formatter(fmt))
+                fmt = "%(asctime)s [%(levelname)s] %(hvdctx)s%(message)s"
+            handler.setFormatter(RankPrefixFormatter(fmt))
             logger.addHandler(handler)
         logger.propagate = False
         _logger = logger

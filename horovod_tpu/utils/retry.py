@@ -50,6 +50,12 @@ def call_with_retries(
             if deadline_s is not None and \
                     time.monotonic() - start >= deadline_s:
                 raise
+            try:
+                from .. import metrics
+
+                metrics.RETRIES.inc()
+            except Exception:  # noqa: BLE001 — counting never blocks retry
+                pass
             if on_retry is not None:
                 on_retry(attempt, e)
             delay = min(max_delay, base_delay * (2 ** (attempt - 1)))

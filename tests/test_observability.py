@@ -57,11 +57,18 @@ def test_stall_inspector_reports_outstanding():
     ins = StallInspector(warning_s=0.01, shutdown_s=0.0)
     ticket = ins.begin("allreduce.layer0")
     time.sleep(0.02)
-    stalled = ins.check_once()
+    # Deterministic clock: the first warning's log emission can take
+    # longer than warning_s under load, which would legitimately re-warn
+    # on the second (re-warn-every-warning_s contract) — pin `now` so the
+    # two passes observe the same instant.
+    now = time.monotonic()
+    stalled = ins.check_once(now=now)
     assert len(stalled) == 1
     assert "allreduce.layer0" in stalled[0]
-    # once warned, not re-reported
-    assert ins.check_once() == []
+    # within the warning window: not re-reported
+    assert ins.check_once(now=now) == []
+    # a full warning_s later: re-warned with escalating age
+    assert len(ins.check_once(now=now + 1.0)) == 1
     ins.end(ticket)
     ins.stop()
 
@@ -358,3 +365,576 @@ def test_cache_stats_counts_dispatches_and_cache(hvd):
     summary = prof.summary()
     assert summary["executable_cache"] == stats["executable_cache"]
     assert "trace_active" in summary
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide metrics plane (PR 5): registry primitives, the eager-dispatch
+# instruments, the /metrics scrape, the lifecycle journal, goodput, and the
+# rank-prefixed logging satellite.
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsPrimitives:
+    def test_counter_gauge_histogram_basics(self):
+        from horovod_tpu.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("t_requests_total", "help", ("kind",))
+        c.inc(kind="a")
+        c.inc(2, kind="a")
+        c.inc(kind="b")
+        g = reg.gauge("t_depth", "help")
+        g.set(7)
+        h = reg.histogram("t_lat_seconds", "help", (), (0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 100.0):
+            h.observe(v)
+        snap = {f["name"]: f for f in reg.snapshot()}
+        counts = {tuple(s["labels"].items()): s["value"]
+                  for s in snap["t_requests_total"]["samples"]}
+        assert counts[(("kind", "a"),)] == 3
+        assert counts[(("kind", "b"),)] == 1
+        assert snap["t_depth"]["samples"][0]["value"] == 7
+        hs = snap["t_lat_seconds"]["samples"][0]
+        assert hs["counts"] == [1, 2, 0]  # 100.0 only lands in +Inf
+        assert hs["count"] == 4
+        assert hs["sum"] == pytest.approx(101.05)
+
+    def test_label_schema_enforced(self):
+        from horovod_tpu.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("t_labeled_total", "h", ("kind",))
+        with pytest.raises(ValueError):
+            c.inc(wrong="x")
+        with pytest.raises(ValueError):
+            c.inc()  # missing the declared label
+        # Re-registration is idempotent with the same schema...
+        assert reg.counter("t_labeled_total", "h", ("kind",)) is c
+        # ...and refuses a conflicting one.
+        with pytest.raises(ValueError):
+            reg.gauge("t_labeled_total", "h")
+
+    def test_histogram_requires_buckets(self):
+        from horovod_tpu.metrics import Registry
+
+        with pytest.raises(ValueError):
+            Registry().histogram("t_h", "h", (), ())
+
+    def test_render_round_trips_through_validator(self):
+        from horovod_tpu.metrics import Registry, validate_prometheus_text
+
+        reg = Registry()
+        reg.counter("t_total", "with \"quotes\" and\nnewline",
+                    ("k",)).inc(k='va"l\nue')
+        reg.histogram("t_h_seconds", "h", ("k",), (0.5, 2.0)).observe(
+            1.0, k="x")
+        parsed = validate_prometheus_text(
+            reg.render(extra_labels={"rank": "3"}))
+        (labels, value), = parsed["t_total"]["samples"]
+        assert labels == {"k": 'va"l\nue', "rank": "3"}
+        assert value == 1
+
+    def test_backslash_label_values_round_trip(self):
+        """A literal backslash followed by 'n' (Windows path) must not
+        unescape into a newline — left-to-right scan, not chained
+        replaces."""
+        from horovod_tpu.metrics import Registry, validate_prometheus_text
+
+        reg = Registry()
+        reg.counter("t_bs_total", "h", ("p",)).inc(p="C:\\new")
+        (labels, _), = validate_prometheus_text(
+            reg.render())["t_bs_total"]["samples"]
+        assert labels == {"p": "C:\\new"}
+
+
+class TestPrometheusValidator:
+    def test_rejects_malformed_sample(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        with pytest.raises(ValueError, match="line 1"):
+            validate_prometheus_text('foo{bad 1\n')
+
+    def test_rejects_duplicate_series(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        with pytest.raises(ValueError, match="duplicate series"):
+            validate_prometheus_text('foo{a="1"} 1\nfoo{a="1"} 2\n')
+
+    def test_rejects_duplicate_type(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        with pytest.raises(ValueError, match="duplicate TYPE"):
+            validate_prometheus_text(
+                "# TYPE foo counter\n# TYPE foo gauge\n")
+
+    def test_rejects_non_cumulative_histogram(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\n'
+            'h_bucket{le="2"} 3\n'
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 1\n"
+            "h_count 5\n"
+        )
+        with pytest.raises(ValueError, match="not cumulative"):
+            validate_prometheus_text(text)
+
+    def test_rejects_histogram_missing_inf_bucket(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\n'
+            "h_sum 1\n"
+            "h_count 5\n"
+        )
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            validate_prometheus_text(text)
+
+    def test_rejects_inf_bucket_count_mismatch(self):
+        from horovod_tpu.metrics import validate_prometheus_text
+
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 1\n"
+            "h_count 7\n"
+        )
+        with pytest.raises(ValueError, match="_count"):
+            validate_prometheus_text(text)
+
+
+def test_eager_dispatch_populates_histograms(hvd):
+    """The acceptance path: a REAL eager allreduce lands in the dispatch
+    counter and the latency/byte histograms with exact counts/bytes."""
+    from horovod_tpu import metrics
+
+    metrics.reset_for_testing()
+    n = hvd.size()
+    x = np.random.RandomState(1).randn(n, 17).astype(np.float32)
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.allreduce(x + 1, op=hvd.Sum)
+    snap = {f["name"]: f for f in metrics.snapshot()}
+
+    def sample(fam, **labels):
+        for s in snap[fam]["samples"]:
+            if s["labels"] == labels:
+                return s
+        raise AssertionError(f"no {labels} sample in {snap[fam]}")
+
+    assert sample("hvd_collective_dispatch_total",
+                  kind="allreduce")["value"] == 2
+    lat = sample("hvd_collective_latency_seconds", kind="allreduce")
+    assert lat["count"] == 2 and lat["sum"] > 0
+    by = sample("hvd_collective_payload_bytes", kind="allreduce")
+    assert by["count"] == 2
+    assert by["sum"] == 2 * n * 17 * 4  # float32 stacked payload, exact
+    # One compile (miss) + one hit, mirrored in the cache-event counter.
+    assert sample("hvd_executable_cache_events_total",
+                  outcome="miss")["value"] >= 1
+    assert sample("hvd_executable_cache_events_total",
+                  outcome="hit")["value"] >= 1
+    compile_h = sample("hvd_collective_compile_seconds", kind="allreduce")
+    assert compile_h["count"] >= 1
+    # The whole snapshot renders to valid Prometheus text.
+    from horovod_tpu.metrics import validate_prometheus_text
+
+    validate_prometheus_text(metrics.render())
+
+
+def test_cache_stats_reset(hvd):
+    n = hvd.size()
+    hvd.allreduce(np.ones((n, 13), np.float32), op=hvd.Sum)
+    stats = hvd.cache_stats(reset=True)
+    assert stats["eager_dispatch"].get("allreduce", 0) >= 1
+    after = hvd.cache_stats()
+    assert after["eager_dispatch"] == {}
+    assert after["executable_cache"]["hits"] == 0
+    assert after["executable_cache"]["misses"] == 0
+    # Entries survive the counter reset: the same signature is a hit.
+    hvd.allreduce(np.ones((n, 13), np.float32), op=hvd.Sum)
+    assert hvd.cache_stats()["executable_cache"]["hits"] == 1
+
+
+def test_grad_sync_flush_instrumented(hvd):
+    """A traced DistributedOptimizer flush records trace-time wire bytes
+    and bucket counts under its sync_mode label (counts traces, not
+    steps — the documented contract)."""
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import metrics
+
+    metrics.reset_for_testing()
+    mesh = hvd.global_mesh()
+    params = {"w": np.ones((64,), np.float32),
+              "b": np.ones((32,), np.float32)}
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+
+    def step(g):
+        g = jax.tree.map(lambda a: a[0], g)  # strip the stacking axis
+        state = opt.init(params)
+        updates, _ = opt.update(g, state, params)
+        return updates
+
+    f = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=P("hvd"), out_specs=P(),
+        check_vma=False))
+    out = f({"w": np.ones((8, 64), np.float32),
+             "b": np.ones((8, 32), np.float32)})
+    jax.block_until_ready(out)
+    snap = {fam["name"]: fam for fam in metrics.snapshot()}
+    (fl,) = [s for s in snap["hvd_grad_sync_flushes_total"]["samples"]
+             if s["labels"] == {"sync_mode": "allreduce"}]
+    assert fl["value"] >= 1
+    (hb,) = [s for s in snap["hvd_grad_sync_bytes"]["samples"]
+             if s["labels"] == {"sync_mode": "allreduce"}]
+    # (64 + 32) float32 leaves per flush, exact per trace.
+    assert hb["sum"] == (64 + 32) * 4 * fl["value"]
+    (bk,) = [s for s in snap["hvd_grad_sync_buckets"]["samples"]
+             if s["labels"] == {"sync_mode": "allreduce"}]
+    assert bk["count"] == fl["value"]
+
+
+class TestClusterScrape:
+    """KV server /metrics: two fake worker snapshots ride heartbeat PUTs,
+    the scrape aggregates them with per-rank labels plus driver gauges,
+    and every line passes the strict validator."""
+
+    def _fake_snapshot(self, dispatches):
+        from horovod_tpu.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("hvd_collective_dispatch_total", "h", ("kind",))
+        c.inc(dispatches, kind="allreduce")
+        h = reg.histogram("hvd_collective_latency_seconds", "h", ("kind",),
+                          (0.01, 0.1, 1.0))
+        for _ in range(dispatches):
+            h.observe(0.05, kind="allreduce")
+        return reg.snapshot()
+
+    def test_scrape_end_to_end(self):
+        import json as _json
+        import urllib.request
+
+        from horovod_tpu.metrics import validate_prometheus_text
+        from horovod_tpu.runner.http.kv_server import (
+            KVClient, RendezvousServer,
+        )
+
+        server = RendezvousServer(host="127.0.0.1")
+        server.start()
+        try:
+            server.set_cluster_info(world_np=2, blacklisted=1)
+            client = KVClient("127.0.0.1", server.port)
+            for rank, host, n in ((0, "hostA", 3), (1, "hostB", 5)):
+                client.put("heartbeat", host, _json.dumps({
+                    "rank": rank, "steps": 10 * (rank + 1), "commits": rank,
+                    "metrics": self._fake_snapshot(n),
+                }).encode())
+            # A malformed heartbeat must not break the scrape.
+            client.put("heartbeat", "hostC", b"not json at all")
+            url = f"http://127.0.0.1:{server.port}/metrics"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                assert "text/plain" in r.headers["Content-Type"]
+                text = r.read().decode()
+            parsed = validate_prometheus_text(text)  # EVERY line, strictly
+            # Driver-plane gauges.
+            assert parsed["hvd_world_generation"]["samples"][0][1] == 0
+            assert parsed["hvd_world_size"]["samples"][0][1] == 2
+            assert parsed["hvd_blacklisted_hosts"]["samples"][0][1] == 1
+            assert parsed["hvd_fenced_writes_total"]["samples"][0][1] == 0
+            hosts = {l["host"]
+                     for l, _ in parsed["hvd_heartbeat_age_seconds"]["samples"]}
+            assert hosts == {"hostA", "hostB", "hostC"}
+            # Worker progress counters with host+rank labels.
+            steps = {l["rank"]: v
+                     for l, v in parsed["hvd_worker_steps_total"]["samples"]}
+            assert steps == {"0": 10, "1": 20}
+            # Per-rank collective series from the piggybacked snapshots.
+            dispatch = {
+                l["rank"]: v
+                for l, v in parsed["hvd_collective_dispatch_total"]["samples"]
+            }
+            assert dispatch == {"0": 3, "1": 5}
+            inf_counts = {
+                l["rank"]: v
+                for l, v in parsed["hvd_collective_latency_seconds"]["samples"]
+                if l.get("le") == "+Inf"
+            }
+            assert inf_counts == {"0": 3, "1": 5}
+        finally:
+            server.stop()
+
+    def test_scrape_unauthenticated_even_with_secret(self, monkeypatch):
+        """A Prometheus scraper cannot HMAC-sign: /metrics must answer
+        without auth while the KV surface stays 403-protected."""
+        import urllib.error
+        import urllib.request
+
+        from horovod_tpu.runner import secret as _secret
+        from horovod_tpu.runner.http.kv_server import RendezvousServer
+
+        monkeypatch.setenv(_secret.ENV_KEY, _secret.make_secret_key())
+        server = RendezvousServer(host="127.0.0.1")
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+                assert r.status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/_version", timeout=10)
+            assert ei.value.code == 403
+        finally:
+            server.stop()
+
+
+def test_heartbeat_piggybacks_metrics_snapshot(monkeypatch):
+    """The worker's ordinary heartbeat PUT carries the full instrument
+    snapshot, and the server's scrape renders it under this host's
+    labels — the cluster plane needs no extra connection."""
+    import json as _json
+
+    from horovod_tpu.metrics import validate_prometheus_text
+    from horovod_tpu.runner.elastic import worker as elastic_worker
+    from horovod_tpu.runner.http.kv_server import RendezvousServer
+
+    server = RendezvousServer(host="127.0.0.1")
+    server.start()
+    try:
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(server.port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hb-host")
+        monkeypatch.setenv("HOROVOD_RANK", "0")
+        ctx = elastic_worker.ElasticWorkerContext()
+        assert ctx.send_heartbeat()
+        payload = _json.loads(server.heartbeat_payload("hb-host"))
+        assert payload["rank"] == "0"
+        assert isinstance(payload["metrics"], list) and payload["metrics"]
+        names = {f["name"] for f in payload["metrics"]}
+        assert "hvd_goodput_productive_seconds_total" in names
+        parsed = validate_prometheus_text(server.metrics_text())
+        assert any(
+            l.get("host") == "hb-host"
+            for l, _ in
+            parsed["hvd_goodput_productive_seconds_total"]["samples"])
+        # Opt-out strips the snapshot but keeps the liveness beat.
+        monkeypatch.setenv("HOROVOD_METRICS_PIGGYBACK", "0")
+        assert ctx.send_heartbeat()
+        payload = _json.loads(server.heartbeat_payload("hb-host"))
+        assert "metrics" not in payload
+    finally:
+        server.stop()
+
+
+class TestLifecycleJournal:
+    def test_journal_abort_recover_replay(self, hvd, tmp_path, monkeypatch):
+        """A simulated abort→recover under @hvd.elastic.run leaves a
+        well-formed JSONL journal that replays the lifecycle in
+        generation order with both clocks stamped."""
+        import json as _json
+
+        from horovod_tpu import abort
+        from horovod_tpu.elastic import ObjectState
+
+        jpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(jpath))
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.05")
+        abort.reset()
+        calls = []
+        state = ObjectState(step=0)
+
+        @hvd.elastic.run
+        def train(st):
+            calls.append(1)
+            if len(calls) == 1:
+                abort.trigger_local("simulated wedge")
+                abort.raise_if_aborted()
+            return "done"
+
+        try:
+            assert train(state) == "done"
+        finally:
+            abort.reset()
+        records = [_json.loads(line)
+                   for line in jpath.read_text().splitlines()]
+        events = [r["event"] for r in records]
+        assert "elastic_run_start" in events
+        assert "abort_consumed" in events
+        assert "recovery" in events
+        assert events.count("world_synced") == 2  # initial + post-recovery
+        for r in records:
+            assert isinstance(r["generation"], int)
+            assert isinstance(r["t_wall"], float)
+            assert isinstance(r["t_mono"], float)
+        # Replays in order: monotonic clock strictly ordered, generations
+        # never regress.
+        monos = [r["t_mono"] for r in records]
+        assert monos == sorted(monos)
+        gens = [r["generation"] for r in records]
+        assert gens == sorted(gens)
+        rec = [r for r in records if r["event"] == "recovery"][0]
+        assert rec["rung"] == 1 and rec["failures"] == 1
+        # The abort flowed through the counters too.
+        snap = {f["name"]: f for f in hvd.metrics.snapshot()}
+        assert snap["hvd_abort_consumed_total"]["samples"][0]["value"] >= 1
+        assert any(s["labels"] == {"rung": "1"}
+                   for s in snap["hvd_recoveries_total"]["samples"])
+
+    def test_journal_disabled_without_env(self, monkeypatch):
+        from horovod_tpu import metrics
+
+        monkeypatch.delenv("HOROVOD_EVENT_LOG", raising=False)
+        assert metrics.journal() is None
+        metrics.event("should_be_dropped")  # must not raise
+
+    def test_journal_unopenable_path_never_raises(self, monkeypatch):
+        from horovod_tpu import metrics
+
+        monkeypatch.setenv(
+            "HOROVOD_EVENT_LOG", "/nonexistent-dir/nope/events.jsonl")
+        metrics.event("dropped")  # warns once, never raises
+        assert metrics.journal() is None
+
+
+def test_goodput_tracker_accounting():
+    from horovod_tpu.metrics import GoodputTracker
+
+    gp = GoodputTracker()
+    gp.add_productive(9.0)
+    gp.add_lost("rendezvous", 0.5)
+    gp.add_lost("restore", 0.25)
+    gp.add_lost("backoff", 0.25)
+    gp.add_productive(-1.0)  # ignored: clocks can't run backwards
+    s = gp.summary()
+    assert s["productive_s"] == 9.0
+    assert s["lost_total_s"] == 1.0
+    assert s["goodput_ratio"] == 0.9
+    gp.reset()
+    assert gp.summary()["goodput_ratio"] is None
+
+
+def test_elastic_run_accrues_goodput(hvd, monkeypatch):
+    """One failure+recovery cycle books rendezvous, restore, backoff AND
+    productive seconds — the accounting profiler.summary() surfaces."""
+    import time as _time
+
+    from horovod_tpu import metrics
+    from horovod_tpu.elastic import ObjectState
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.05")
+    gp = metrics.goodput()
+    before = gp.summary()
+    calls = []
+    state = ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(st):
+        calls.append(1)
+        _time.sleep(0.02)
+        if len(calls) == 1:
+            raise HorovodInternalError("boom")
+        return "ok"
+
+    assert train(state) == "ok"
+    after = gp.summary()
+    assert after["productive_s"] >= before["productive_s"] + 0.03
+    assert after["lost_s"]["backoff"] > before["lost_s"]["backoff"]
+    assert after["lost_s"]["rendezvous"] >= before["lost_s"]["rendezvous"]
+    import horovod_tpu.profiler as prof
+
+    assert prof.summary()["goodput"] == gp.summary()
+
+
+def test_log_records_carry_rank_generation_prefix(monkeypatch):
+    """Satellite: every log record is prefixed [rank/size g<generation>]
+    so interleaved multi-worker logs attribute without hostname greps."""
+    import logging as pylog
+
+    from horovod_tpu.utils.logging import RankPrefixFormatter, rank_prefix
+
+    monkeypatch.setenv("HOROVOD_RANK", "2")
+    monkeypatch.setenv("HOROVOD_SIZE", "8")
+    monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+    monkeypatch.setenv("HOROVOD_WORLD_VERSION", "3")
+    fmt = RankPrefixFormatter("[%(levelname)s] %(hvdctx)s%(message)s")
+    rec = pylog.LogRecord("horovod_tpu", pylog.INFO, __file__, 1,
+                          "hello", (), None)
+    assert fmt.format(rec) == "[INFO] [2/8 g3] hello"
+    # Elastic resize rewrites the env in place; the NEXT record must
+    # carry the new identity (per-record recompute, not cached).
+    monkeypatch.setenv("HOROVOD_WORLD_VERSION", "4")
+    rec2 = pylog.LogRecord("horovod_tpu", pylog.INFO, __file__, 1,
+                           "again", (), None)
+    assert fmt.format(rec2) == "[INFO] [2/8 g4] again"
+    # Non-elastic launched world: rank prefix without the generation.
+    monkeypatch.delenv("HOROVOD_ELASTIC")
+    monkeypatch.delenv("HOROVOD_WORLD_VERSION")
+    assert rank_prefix() == "[2/8] "
+    # Plain scripts keep clean logs.
+    monkeypatch.delenv("HOROVOD_RANK")
+    assert rank_prefix() == ""
+    assert get_logger_formats_with_prefix()
+
+
+def get_logger_formats_with_prefix():
+    """The live get_logger() handler must be wired to the prefixed
+    formatter (not just the class existing)."""
+    import horovod_tpu.utils.logging as hl
+
+    logger = hl.get_logger()
+    return all(isinstance(h.formatter, hl.RankPrefixFormatter)
+               for h in logger.handlers)
+
+
+def test_stall_tickets_counted():
+    from horovod_tpu import metrics
+    from horovod_tpu.stall import StallInspector
+
+    snap0 = {f["name"]: f for f in metrics.snapshot()}
+
+    def val(snap, name):
+        fam = snap.get(name, {"samples": []})
+        return sum(s["value"] for s in fam["samples"])
+
+    ins = StallInspector(warning_s=0.01, shutdown_s=0.0)
+    t = ins.begin("metrics.probe")
+    time.sleep(0.02)
+    ins.check_once()
+    ins.end(t)
+    ins.stop()
+    snap = {f["name"]: f for f in metrics.snapshot()}
+    assert val(snap, "hvd_stall_tickets_total") == \
+        val(snap0, "hvd_stall_tickets_total") + 1
+    assert val(snap, "hvd_stall_warnings_total") >= \
+        val(snap0, "hvd_stall_warnings_total") + 1
+    (g,) = snap["hvd_stall_outstanding"]["samples"]
+    assert g["value"] == 0  # ticket closed
+
+
+def test_kv_retries_counted(monkeypatch):
+    from horovod_tpu import metrics
+    from horovod_tpu.utils.retry import call_with_retries
+
+    def val():
+        for f in metrics.snapshot():
+            if f["name"] == "hvd_retries_total":
+                return sum(s["value"] for s in f["samples"])
+        return 0
+
+    before = val()
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("blip")
+        return "ok"
+
+    assert call_with_retries(flaky, attempts=3, base_delay=0.001) == "ok"
+    assert val() == before + 2  # two retries, the success is free

@@ -1,14 +1,17 @@
 #!/usr/bin/env bash
 # Default pre-merge check: the tier-1 test suite (ROADMAP.md's verify
 # command, verbatim), the fault-injection smoke lane (chaos coverage must
-# not silently rot), then a 2-step CPU smoke of bench.py — the bench
+# not silently rot), a 2-step CPU smoke of bench.py — the bench
 # exercises the full machinery (DistributedOptimizer wire, raw baseline,
 # forced-wire, overlap scheduler) end to end, which unit tests alone do
-# not. Run from anywhere; exits nonzero if any gate fails.
+# not — then a /metrics scrape of the bench run's instrument snapshot
+# through a live rendezvous KV server (the observability plane must not
+# silently rot either). Run from anywhere; exits nonzero if any gate
+# fails.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
-echo "== premerge gate 1/3: tier-1 tests =="
+echo "== premerge gate 1/4: tier-1 tests =="
 t1log="$(mktemp "${TMPDIR:-/tmp}/_t1.XXXXXX.log")"  # per-run: concurrent
 trap 'rm -f "$t1log"' EXIT                          # premerges must not clobber
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
@@ -34,7 +37,7 @@ if [ "$rc" -ne 0 ]; then
     echo "premerge: only known-environmental failures; continuing"
 fi
 
-echo "== premerge gate 2/3: fault-injection + recovery (chaos lane) =="
+echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # The FULL chaos files, slow marks included: the e2e liveness/abort/
 # recovery tests are the acceptance proof for the robustness layer and
 # must not rot just because tier-1 deselects @slow. test_recovery.py
@@ -51,14 +54,17 @@ if ! timeout -k 10 900 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     exit 1
 fi
 
-echo "== premerge gate 3/3: bench.py --smoke perf lane (8-dev CPU mesh, 2 steps/section) =="
+echo "== premerge gate 3/4: bench.py --smoke perf lane (8-dev CPU mesh, 2 steps/section) =="
 blog="$(mktemp "${TMPDIR:-/tmp}/_bench.XXXXXX.log")"
-trap 'rm -f "$t1log" "$blog"' EXIT
+msnap="$(mktemp "${TMPDIR:-/tmp}/_metrics.XXXXXX.json")"
+trap 'rm -f "$t1log" "$blog" "$msnap"' EXIT
 # The 8-device virtual mesh (the test harness's stand-in slice): on one
 # device the collectives compile to identities and the sharded mode has
 # no optimizer compute to shard away, so single-device ratios cannot
-# judge the sync modes against each other.
+# judge the sync modes against each other. The bench also dumps its
+# metrics snapshot (HOROVOD_METRICS_SNAPSHOT) for the gate-4 scrape.
 if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    HOROVOD_METRICS_SNAPSHOT="$msnap" \
     python bench.py --smoke | tee "$blog"; then
     echo "premerge: bench smoke failed" >&2
     exit 1
@@ -100,6 +106,69 @@ print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded})")
 EOF
 then
     echo "premerge: perf lane failed" >&2
+    exit 1
+fi
+
+echo "== premerge gate 4/4: /metrics scrape lane =="
+# End-to-end over the REAL plumbing: the bench run's instrument snapshot
+# is published to a live RendezvousServer via the same heartbeat PUT
+# workers use, then scraped back over plain HTTP from GET /metrics.
+# Fails if the endpoint is unreachable, any line flunks the strict
+# Prometheus-text validator, or the core instrument set (collective
+# dispatch histograms, heartbeat gauge, goodput counters) is absent.
+if ! JAX_PLATFORMS=cpu python - "$msnap" <<'EOF'
+import json
+import socket
+import sys
+import urllib.request
+
+from horovod_tpu import metrics
+from horovod_tpu.runner.http.kv_server import KVClient, RendezvousServer
+
+with open(sys.argv[1]) as f:
+    snap = json.load(f)
+if not isinstance(snap, list) or not snap:
+    sys.exit("premerge metrics lane: bench wrote an empty snapshot")
+server = RendezvousServer(host="127.0.0.1")
+server.start()
+server.set_cluster_info(world_np=1)
+try:
+    client = KVClient("127.0.0.1", server.port)
+    client.put("heartbeat", socket.gethostname(), json.dumps(
+        {"rank": 0, "steps": 1, "commits": 0, "metrics": snap}).encode())
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    with urllib.request.urlopen(url, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge metrics lane: {url} answered {r.status}")
+        text = r.read().decode()
+    parsed = metrics.validate_prometheus_text(text)
+    required = (
+        "hvd_collective_latency_seconds",
+        "hvd_collective_payload_bytes",
+        "hvd_heartbeat_age_seconds",
+        "hvd_goodput_productive_seconds_total",
+        "hvd_goodput_lost_seconds_total",
+        "hvd_world_generation",
+    )
+    missing = [m for m in required
+               if not parsed.get(m, {}).get("samples")]
+    if missing:
+        sys.exit(
+            f"premerge metrics lane: core instruments missing samples "
+            f"from the scrape: {missing}")
+    dispatches = sum(
+        v for labels, v in parsed["hvd_collective_latency_seconds"]["samples"]
+        if labels.get("le") == "+Inf")
+    if dispatches < 1:
+        sys.exit("premerge metrics lane: dispatch histogram is empty "
+                 "(bench recorded no eager collectives)")
+    print(f"premerge metrics lane: ok ({len(parsed)} metric families, "
+          f"{dispatches:.0f} dispatches in the latency histogram)")
+finally:
+    server.stop()
+EOF
+then
+    echo "premerge: metrics scrape lane failed" >&2
     exit 1
 fi
 echo "premerge: all gates passed"
