@@ -25,6 +25,11 @@ numbers:
   parameter allgather), plus per-rank optimizer-state bytes for both
   modes — the memory half of the trade.
 
+Step-time breakdown: ``phase_span_medians_ms`` carries derived
+forward/backward/collective/optimizer_update medians (phase-probe
+programs differenced against the headline step — see section 4d), so
+BENCH_r*.json records where the step time goes, not just throughput.
+
 Robustness contract (VERDICT r3 #1): every section is wrapped in
 ``_with_retry`` — one retry on transient remote-compile/transport errors
 (the exact class of flake that killed BENCH_r03) — and a failed section
@@ -649,6 +654,106 @@ def main() -> int:
                 opt_state_bytes_per_rank_sharded=sharded_bytes,
             )
 
+    # --- section 4d: per-phase step-time breakdown — forward / backward /
+    # collective / optimizer_update medians, derived by differencing
+    # phase-probe programs against the headline dist step (one jitted SPMD
+    # program cannot be phase-timed from the host, so the probes isolate
+    # prefixes of the step):
+    #   forward          = t(loss only)
+    #   backward         = t(value_and_grad) - forward
+    #   optimizer_update = t(grad + bare update, no sync) - t(value_and_grad)
+    #   collective       = t(dist step) - t(no-sync step)
+    # Recorded as spans on the tracing plane (so the trace snapshot and
+    # the premerge /timeline lane carry the breakdown) and as
+    # phase_span_medians_ms in this record.
+    def run_phases():
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import tracing
+
+        def fwd_fn(p, stats, b):
+            x, y = b
+            logits, _ = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"])
+            return jax.lax.pmean(loss_fn(logits, y), axis)
+
+        fwd_prog = jax.jit(jax.shard_map(
+            fwd_fn, mesh=mesh, in_specs=(P(), P(), P(axis)),
+            out_specs=P(), check_vma=False))
+
+        def grad_fn(p, stats, b):
+            x, y = b
+
+            def loss_of(q):
+                logits, updated = model.apply(
+                    {"params": q, "batch_stats": stats}, x, train=True,
+                    mutable=["batch_stats"])
+                return loss_fn(logits, y), updated["batch_stats"]
+
+            (loss, _), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(p)
+            # Gradients ride the outputs so nothing is dead-code
+            # eliminated; the caller fetches only the loss.
+            return jax.lax.pmean(loss, axis), grads
+
+        grad_prog = jax.jit(jax.shard_map(
+            grad_fn, mesh=mesh, in_specs=(P(), P(), P(axis)),
+            out_specs=(P(), P()), check_vma=False))
+
+        p0 = hvd.data_parallel.replicate(params)
+        s0 = hvd.data_parallel.replicate(batch_stats)
+
+        def time_fn(fn):
+            loss = fn()
+            for _ in range(max(timing["warmup"] - 1, 0)):
+                loss = fn()
+            fetch_s = _measure_fetch_overhead(loss)
+            times = []
+            for _ in range(timing["repeats"]):
+                t0 = time.perf_counter()
+                for _ in range(timing["iters"]):
+                    loss = fn()
+                float(np.asarray(loss))
+                times.append(max(time.perf_counter() - t0 - fetch_s, 1e-9)
+                             / timing["iters"])
+            return statistics.median(times)
+
+        t_fwd = time_fn(lambda: fwd_prog(p0, s0, batch))
+        t_grad = time_fn(lambda: grad_prog(p0, s0, batch)[0])
+
+        raw_opt = optax.sgd(0.1, momentum=0.9)
+        nosync_step = _build_step(model, raw_opt, mesh, axis, loss_fn)
+        t_nosync, _ = _time_steps(
+            nosync_step, fresh_state(raw_opt), batch, **timing)
+        t_full = dist[0]
+        phases = {
+            "forward": t_fwd,
+            "backward": max(t_grad - t_fwd, 0.0),
+            "optimizer_update": max(t_nosync - t_grad, 0.0),
+            "collective": max(t_full - t_nosync, 0.0),
+        }
+        # One representative step on the tracer: the derived phase spans
+        # laid back to back, so the shipped/archived timeline carries the
+        # breakdown visually.
+        t_base = tracing.clock_sync().now()
+        tracer = tracing.get_tracer()
+        with tracer.step_scope("bench_phases"):
+            cursor = t_base
+            for name, dur in phases.items():
+                cat = ("collective" if name == "collective" else "phase")
+                tracer.record(name, cat, cursor, dur,
+                              args={"derived": True})
+                cursor += dur
+        return {f"{k}_ms": round(v * 1e3, 3) for k, v in phases.items()}
+
+    if dist is not None and not out_of_time():
+        phase_medians = _with_retry("resnet_phases", run_phases, errors,
+                                    allow_retry=single_controller)
+        if phase_medians is not None:
+            emit.update(phase_span_medians_ms=phase_medians)
+
     # --- section 5: int8 (EQuARX-style) wire, machinery-forced — the
     # quantize -> exchange -> dequant round trip demonstrably executes
     # even on one chip; the ratio shows what the int8 wire costs relative
@@ -701,6 +806,24 @@ def main() -> int:
                   file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — observability only
             print(f"# bench: metrics snapshot failed: {exc}",
+                  file=sys.stderr)
+    # HOROVOD_TRACE_SNAPSHOT=/path: dump this run's trace payload (the
+    # same wire format a worker ships to PUT /trace/<host>) so the
+    # premerge timeline lane can publish it to a real KV server and fetch
+    # the merged GET /timeline back over HTTP.
+    trace_path = os.environ.get("HOROVOD_TRACE_SNAPSHOT", "")
+    if trace_path:
+        try:
+            import json as _json
+
+            from horovod_tpu import tracing as _tracing
+
+            with open(trace_path, "w") as f:
+                _json.dump(_tracing.get_tracer().payload(), f)
+            print(f"# bench: trace snapshot written to {trace_path}",
+                  file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — observability only
+            print(f"# bench: trace snapshot failed: {exc}",
                   file=sys.stderr)
     emit.update(bench_wall_time_s=round(time.perf_counter() - t_start, 1))
     return 0 if dist is not None else 1

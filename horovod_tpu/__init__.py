@@ -104,6 +104,7 @@ from . import autotune  # noqa: F401
 from . import faults  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
+from . import tracing  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import elastic  # noqa: F401
 from . import parallel  # noqa: F401

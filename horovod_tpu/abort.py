@@ -165,10 +165,17 @@ def consume() -> None:
     reason, gen = _state.snapshot()
     _state.consume()
     if armed:
-        from . import metrics
+        from . import metrics, tracing
 
         metrics.ABORT_CONSUMES.inc()
         metrics.event("abort_consumed", generation=gen, reason=reason)
+        # Every consumed abort leaves a postmortem: the flight record of
+        # this rank's last K steps (open spans included) lands in the
+        # journal next to the abort_consumed event, so each recovery in
+        # the ladder documents what every surviving rank was doing when
+        # the world wedged.
+        tracing.dump_flight_record("abort_consumed", generation=gen,
+                                   detail=reason)
 
 
 def trigger_local(reason: str, generation: int | None = None) -> None:

@@ -59,6 +59,12 @@ def _install_drain_handler() -> None:
         if not _drain.is_set():
             _drain.set()
             _metrics.event("drain_requested")
+            # Preemption postmortem: what this rank was doing when the
+            # notice landed (its last K steps' spans) rides the journal
+            # alongside drain_requested.
+            from .. import tracing
+
+            tracing.dump_flight_record("drain_requested")
             log.info(
                 "elastic: SIGTERM (preemption notice) — draining: final "
                 "commit, then clean EXIT_REMOVED"

@@ -651,6 +651,20 @@ RETRIES = counter(
 RECOVERIES = counter(
     "hvd_recoveries_total",
     "Elastic recovery attempts, by escalation-ladder rung.", ("rung",))
+TRACE_SHIPS = counter(
+    "hvd_trace_ships_total",
+    "Sampled step-trace payloads shipped to the rendezvous KV.")
+FLIGHT_DUMPS = counter(
+    "hvd_flight_dumps_total",
+    "Flight-recorder postmortems dumped to the lifecycle journal, by "
+    "trigger.", ("reason",))
+CLOCK_OFFSET = gauge(
+    "hvd_clock_offset_seconds",
+    "Estimated offset of this rank's wall clock vs the rendezvous "
+    "server (server minus local), from heartbeat round trips.")
+CLOCK_ERROR = gauge(
+    "hvd_clock_offset_error_seconds",
+    "Error bound (half best RTT) on the clock-offset estimate.")
 
 
 # ---------------------------------------------------------------------------

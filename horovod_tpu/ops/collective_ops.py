@@ -326,8 +326,9 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     import time as _time
 
     from .. import metrics as _metrics
+    from .. import tracing as _tracing
     from ..stall import get_inspector
-    from ..timeline import activity, mark_cycle
+    from ..timeline import mark_cycle
 
     mark_cycle()
     _dispatch_counts[kind] += 1
@@ -362,7 +363,11 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     ticket = get_inspector().begin(f"{kind}[{x.shape}]")
     t_exec = _time.perf_counter()
     try:
-        with activity(
+        # tracing.span triple-emits: the host Chrome-trace activity (plus
+        # its xprof annotation) AND a cross-rank step-tracer span — the
+        # per-collective record the merged /timeline and the skew gauges
+        # are built from.
+        with _tracing.span(
             kind,
             "collective",
             args={

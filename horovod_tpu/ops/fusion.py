@@ -174,16 +174,21 @@ def fused_allreduce(
     for bi, bucket in (
             reversed(list(enumerate(buckets))) if issue_reversed
             else enumerate(buckets)):
+        # Annotation names carry the bucket's static wire bytes so a
+        # profile of the step attributes transfer time to sized buckets
+        # (the tracing plane's per-collective vocabulary, trace-time leg).
+        nbytes = sum(int(tensors[i].size)
+                     * jnp.dtype(tensors[i].dtype).itemsize for i in bucket)
         if len(bucket) == 1:
             i = bucket[0]
-            with annotate_collective(f"allreduce.bucket{bi}"):
+            with annotate_collective(f"allreduce.bucket{bi}.{nbytes}B"):
                 out[i] = _reduce_bucket(
                     tensors[i], op, axis_name, prescale_factor,
                     postscale_factor
                 )
             continue
         flats = [tensors[i].ravel() for i in bucket]
-        with annotate_collective(f"allreduce.bucket{bi}"):
+        with annotate_collective(f"allreduce.bucket{bi}.{nbytes}B"):
             packed = jnp.concatenate(flats)
             reduced = _reduce_bucket(
                 packed, op, axis_name, prescale_factor, postscale_factor
@@ -303,7 +308,9 @@ def fused_reducescatter(
             reversed(list(enumerate(buckets))) if issue_reversed
             else enumerate(buckets)):
         bucket_sizes = [sizes[i] for i in bucket]
-        with annotate_collective(f"reducescatter.bucket{bi}"):
+        nbytes = sum(int(tensors[i].size)
+                     * jnp.dtype(tensors[i].dtype).itemsize for i in bucket)
+        with annotate_collective(f"reducescatter.bucket{bi}.{nbytes}B"):
             flat = _pack_shard_rows(
                 [tensors[i] for i in bucket], bucket_sizes, n).ravel()
             if prescale_factor != 1.0:
@@ -349,7 +356,9 @@ def fused_allgather_shards(
         bucket_sizes = [sizes[i] for i in bucket]
         row = (shards[bucket[0]] if len(bucket) == 1
                else jnp.concatenate([shards[i] for i in bucket]))
-        with annotate_collective(f"allgather.bucket{bi}"):
+        nbytes = sum(n * s * jnp.dtype(shards[i].dtype).itemsize
+                     for i, s in zip(bucket, bucket_sizes))
+        with annotate_collective(f"allgather.bucket{bi}.{nbytes}B"):
             full = lax.all_gather(row, axis_name, axis=0, tiled=True)
         grid = full.reshape(n, -1)
         offset = 0

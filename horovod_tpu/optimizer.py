@@ -246,6 +246,7 @@ def _reducescatter_grads(
         raise ValueError(
             f"sync_mode='sharded' supports op=Average/Sum, got {op!r}")
     from .ops.fusion import fused_reducescatter
+    from .profiler import annotate_collective
 
     n = int(world_size)
     leaves, treedef = jax.tree.flatten(grads)
@@ -256,12 +257,13 @@ def _reducescatter_grads(
             leaves, threshold_bytes, num_groups)
         _record_flush("sharded", leaves, sharded_threshold,
                       itemsize_override=1)
-        shards = int8_fused_reducescatter(
-            leaves, axis_name, n, op=op,
-            threshold_bytes=sharded_threshold,
-            prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor,
-            salt=quant_salt, issue_reversed=issue_reversed)
+        with annotate_collective("grad_reducescatter"):
+            shards = int8_fused_reducescatter(
+                leaves, axis_name, n, op=op,
+                threshold_bytes=sharded_threshold,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                salt=quant_salt, issue_reversed=issue_reversed)
         shards = [
             s.astype(l.dtype)
             if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating) else s
@@ -273,12 +275,13 @@ def _reducescatter_grads(
     ctxs = [c[1] for c in compressed]
     sharded_threshold = _sharded_threshold(wire, threshold_bytes, num_groups)
     _record_flush("sharded", wire, sharded_threshold)
-    shards = fused_reducescatter(
-        wire, op, axis_name, n,
-        threshold_bytes=sharded_threshold,
-        prescale_factor=prescale_factor,
-        postscale_factor=postscale_factor,
-        issue_reversed=issue_reversed)
+    with annotate_collective("grad_reducescatter"):
+        shards = fused_reducescatter(
+            wire, op, axis_name, n,
+            threshold_bytes=sharded_threshold,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            issue_reversed=issue_reversed)
     restored = [compression.decompress(s, ctx)
                 for s, ctx in zip(shards, ctxs)]
     return jax.tree.unflatten(treedef, restored)
@@ -344,6 +347,8 @@ def _gather_param_shards(
     rides the quantized gather — the second half of the EQuARX
     exchange). ``templates`` is a pytree of full-shape leaves (arrays or
     ShapeDtypeStructs); the result matches its structure/shapes/dtypes."""
+    from .profiler import annotate_collective
+
     n = int(world_size)
     t_leaves, treedef = jax.tree.flatten(
         templates, is_leaf=lambda x: hasattr(x, "shape"))
@@ -351,11 +356,12 @@ def _gather_param_shards(
     if getattr(compression, "marker", None) == "int8":
         from .ops.quantization import int8_fused_allgather_shards
 
-        full = int8_fused_allgather_shards(
-            s_leaves, t_leaves, axis_name, n,
-            threshold_bytes=_sharded_threshold(
-                t_leaves, threshold_bytes, num_groups),
-            salt=quant_salt)
+        with annotate_collective("param_allgather"):
+            full = int8_fused_allgather_shards(
+                s_leaves, t_leaves, axis_name, n,
+                threshold_bytes=_sharded_threshold(
+                    t_leaves, threshold_bytes, num_groups),
+                salt=quant_salt)
         full = [f.astype(t.dtype) for f, t in zip(full, t_leaves)]
         return jax.tree.unflatten(treedef, full)
     from .ops.fusion import fused_allgather_shards
@@ -363,10 +369,11 @@ def _gather_param_shards(
     compressed = [compression.compress(s) for s in s_leaves]
     wire = [c[0] for c in compressed]
     ctxs = [c[1] for c in compressed]
-    full = fused_allgather_shards(
-        wire, t_leaves, axis_name, n,
-        threshold_bytes=_sharded_threshold(
-            t_leaves, threshold_bytes, num_groups))
+    with annotate_collective("param_allgather"):
+        full = fused_allgather_shards(
+            wire, t_leaves, axis_name, n,
+            threshold_bytes=_sharded_threshold(
+                t_leaves, threshold_bytes, num_groups))
     restored = [
         compression.decompress(f, ctx).astype(t.dtype)
         for f, ctx, t in zip(full, ctxs, t_leaves)

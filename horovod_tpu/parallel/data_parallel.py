@@ -46,6 +46,7 @@ class _StallWatchedStep:
         self._prefix = name_prefix
         self._every = get_int("HOROVOD_STALL_CHECK_STEPS", 50)
         self._calls = 0
+        self._trace_calls = 0
 
     @staticmethod
     def _cross_rank_available() -> bool:
@@ -103,10 +104,34 @@ class _StallWatchedStep:
             # of them would trace collective sequences that may diverge
             # from peers that pinned the broadcast winner.
             raise _poison_error()
-        if self._every > 0 and not self._tuning_live():
+        from .. import tracing
+
+        tuning = self._tuning_live()
+        watch_due = False
+        cross = False
+        n = 0
+        if self._every > 0 and not tuning:
             cross = self._cross_rank_available()
             n = self._step_number(cross)
-            if n % self._every == 0:
+            watch_due = n % self._every == 0
+        tracer = tracing.get_tracer()
+        # Every call opens a step record in the flight-recorder ring
+        # (cheap: one dict append; un-synced steps time only the async
+        # dispatch). Every HOROVOD_TRACE_SAMPLE-th call OF THIS WRAPPER
+        # additionally blocks on the results — real step wall time — and
+        # ships its spans to the rendezvous KV for the cross-rank merge.
+        # The sampling counter is per-wrapper, not the shared tracer
+        # counter: two interleaved factory steps (train + eval) sharing
+        # one process counter could alias one of them out of sampling
+        # forever. Sampling defers while an autotune warmup is live,
+        # exactly like the stall watch: the pipeline drain would bias
+        # the tuner's samples.
+        self._trace_calls += 1
+        with tracer.step_scope(self._prefix) as rec:
+            sample = tracing.sample_every()
+            sample_due = (not tuning and sample > 0
+                          and self._trace_calls % sample == 0)
+            if watch_due:
                 import jax
 
                 from ..stall import watch
@@ -118,8 +143,16 @@ class _StallWatchedStep:
                 with watch(name=f"{self._prefix}.{n}", cross_rank=cross):
                     out = self._fn(*args, **kwargs)
                     out = jax.block_until_ready(out)
-                return out
-        return self._fn(*args, **kwargs)
+                rec.synced = True
+            else:
+                out = self._fn(*args, **kwargs)
+                if sample_due:
+                    import jax
+
+                    out = jax.block_until_ready(out)
+                    rec.synced = True
+            rec.ship = sample_due and rec.synced
+        return out
 
     @property
     def _hvd_unwatched(self):
@@ -407,7 +440,16 @@ def _make_sharded_train_step(loss_fn, spec, mesh, axis_name, donate,
                 donate_argnums=(0,) if donate else (),
             )
         args = (shards, new_state.counter) if int8 else (shards,)
-        return DeferredParams(gj(*args)), new_state, loss
+        from .. import tracing
+
+        # Host-visible half of the sharded wire: the updated-parameter
+        # allgather dispatch (the program itself runs async while the
+        # host does between-step work; the span times the dispatch and
+        # marks WHERE the gather sat relative to the step).
+        with tracing.span("param_allgather", "collective",
+                          args={"deferred": True}):
+            deferred = gj(*args)
+        return DeferredParams(deferred), new_state, loss
 
     # No transparent autotune here: the wrapper owns two programs and the
     # tuner's clear_cache contract assumes one jitted callable.
@@ -812,7 +854,13 @@ def make_elastic_train_step(
     def step(params, opt_state, batch):
         import os
 
-        loss, grads = grad_step(params, batch)
+        from .. import tracing
+
+        # The elastic step's phases ARE host-separable (compiled local
+        # leg, host collective leg, compiled apply), so each gets a real
+        # span — the per-phase breakdown the cross-rank timeline merges.
+        with tracing.span("forward_backward", "phase"):
+            loss, grads = grad_step(params, batch)
         nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
         if nprocs > 1 and jax.process_count() == 1:
             # Cross-process leg: fused host allreduce through the native
@@ -831,35 +879,38 @@ def make_elastic_train_step(
             # f64; f32/bf16/f16 accumulate in f32 and cast back.
             from ..ops.collective_ops import Sum, grouped_allreduce
 
-            n_local = float(mesh.size)
-            leaves, treedef = jax.tree.flatten(grads)
-            acc = [np.float64 if np.asarray(l).dtype == np.float64
-                   else np.float32 for l in leaves]
-            f32_idx = [i for i, a in enumerate(acc) if a == np.float32]
-            f64_idx = [i for i, a in enumerate(acc) if a == np.float64]
-            # count + loss join the f32 group.
-            f32_payload = [np.asarray(leaves[i], np.float32) * n_local
-                           for i in f32_idx]
-            f32_payload.append(np.asarray([float(loss)], np.float32)
-                               * n_local)
-            f32_payload.append(np.asarray([n_local], np.float32))
-            red32 = grouped_allreduce(f32_payload, op=Sum)
-            total_n = float(np.asarray(red32[-1])[0])
-            global_loss = float(np.asarray(red32[-2])[0]) / total_n
-            out = list(leaves)
-            for i, r in zip(f32_idx, red32[:-2]):
-                out[i] = jnp.asarray(
-                    np.asarray(r) / total_n).astype(leaves[i].dtype)
-            if f64_idx:
-                red64 = grouped_allreduce(
-                    [np.asarray(leaves[i], np.float64) * n_local
-                     for i in f64_idx], op=Sum)
-                for i, r in zip(f64_idx, red64):
+            with tracing.span("collective", "collective",
+                              args={"plane": "host"}):
+                n_local = float(mesh.size)
+                leaves, treedef = jax.tree.flatten(grads)
+                acc = [np.float64 if np.asarray(l).dtype == np.float64
+                       else np.float32 for l in leaves]
+                f32_idx = [i for i, a in enumerate(acc) if a == np.float32]
+                f64_idx = [i for i, a in enumerate(acc) if a == np.float64]
+                # count + loss join the f32 group.
+                f32_payload = [np.asarray(leaves[i], np.float32) * n_local
+                               for i in f32_idx]
+                f32_payload.append(np.asarray([float(loss)], np.float32)
+                                   * n_local)
+                f32_payload.append(np.asarray([n_local], np.float32))
+                red32 = grouped_allreduce(f32_payload, op=Sum)
+                total_n = float(np.asarray(red32[-1])[0])
+                global_loss = float(np.asarray(red32[-2])[0]) / total_n
+                out = list(leaves)
+                for i, r in zip(f32_idx, red32[:-2]):
                     out[i] = jnp.asarray(
                         np.asarray(r) / total_n).astype(leaves[i].dtype)
-            grads = jax.tree.unflatten(treedef, out)
-            loss = jnp.asarray(global_loss, jnp.float32)
-        params, opt_state = apply_step(params, opt_state, grads)
+                if f64_idx:
+                    red64 = grouped_allreduce(
+                        [np.asarray(leaves[i], np.float64) * n_local
+                         for i in f64_idx], op=Sum)
+                    for i, r in zip(f64_idx, red64):
+                        out[i] = jnp.asarray(
+                            np.asarray(r) / total_n).astype(leaves[i].dtype)
+                grads = jax.tree.unflatten(treedef, out)
+                loss = jnp.asarray(global_loss, jnp.float32)
+        with tracing.span("optimizer_update", "phase"):
+            params, opt_state = apply_step(params, opt_state, grads)
         return params, opt_state, loss
 
     return _StallWatchedStep(step, "elastic_train_step")

@@ -69,18 +69,22 @@ def summary() -> dict:
     """One-call observability snapshot: trace state plus the runtime
     counters callers keep asking the timeline for — executable-cache
     hits/misses/size, per-kind eager-dispatch counts
-    (``hvd.cache_stats()``), and the elastic goodput ledger (productive
-    vs. lost wall time, see ``horovod_tpu.metrics.GoodputTracker``).
-    ``bench.py`` emits this once per run so every benchmark record
-    carries the cache/goodput behavior that produced it.
+    (``hvd.cache_stats()``), the elastic goodput ledger (productive
+    vs. lost wall time, see ``horovod_tpu.metrics.GoodputTracker``), and
+    the straggler view from the cross-rank tracing plane (this rank's
+    measured clock offset ± error, plus — when a rendezvous KV is
+    configured — the server-computed per-collective arrival-skew
+    attribution). ``bench.py`` emits this once per run so every
+    benchmark record carries the cache/goodput behavior that produced it.
     """
-    from . import metrics
+    from . import metrics, tracing
     from .ops.collective_ops import cache_stats
 
     return {
         "trace_active": active(),
         "trace_logdir": _active_logdir,
         "goodput": metrics.goodput().summary(),
+        "stragglers": tracing.straggler_summary(),
         **cache_stats(),
     }
 

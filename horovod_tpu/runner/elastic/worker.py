@@ -144,6 +144,17 @@ class ElasticWorkerContext:
         except Exception:  # noqa: BLE001 — best-effort staleness marking
             stale = None
         abort.joined_generation(v, stale_record=stale)
+        # Tracing plane: re-joining a world rebases the step counter so
+        # every member of this generation counts steps from the same
+        # point — cross-rank skew matching keys on (generation, step,
+        # name), and a survivor's process-local count would otherwise
+        # never line up with a replacement's.
+        try:
+            from ... import tracing
+
+            tracing.get_tracer().rebase()
+        except Exception:  # noqa: BLE001 — tracing is best-effort
+            pass
         return json.loads(raw)
 
     def apply_to_env(self, assignment: dict) -> None:
@@ -268,14 +279,23 @@ class ElasticWorkerContext:
         full instrument snapshot rides the PUT (``"metrics"`` key) so the
         driver's ``GET /metrics`` serves a cluster-wide aggregate with
         per-rank labels — no extra connection, no extra poll loop.
-        ``HOROVOD_METRICS_PIGGYBACK=0`` strips it (liveness-only beats)."""
+        ``HOROVOD_METRICS_PIGGYBACK=0`` strips it (liveness-only beats).
+
+        It also doubles as the clock-alignment exchange: the server's 200
+        reply carries its wall clock (``t_server``), and the send/receive
+        stamps this side already takes bound the offset NTP-style
+        (``tracing.ClockSync``) — the cross-rank timeline merge rides
+        timestamps the liveness plane was already paying for."""
         if faults.fire(faults.HEARTBEAT_SEND):
             return False  # injected drop: silence, exactly like a hang
+        from ... import tracing as _tracing
+
+        clock = _tracing.clock_sync()
         body = {
             "steps": _counters.steps,
             "commits": _counters.commits,
             "rank": os.environ.get("HOROVOD_RANK", "0"),
-            "time": time.time(),
+            "time": clock.now(),
         }
         if os.environ.get("HOROVOD_METRICS_PIGGYBACK", "1") != "0":
             try:
@@ -286,11 +306,20 @@ class ElasticWorkerContext:
                 pass
         payload = json.dumps(body).encode()
         try:
-            self._hb_client.put(HEARTBEAT_SCOPE, self.hostname, payload)
-            return True
+            t_send = clock.now()
+            reply = self._hb_client.put(HEARTBEAT_SCOPE, self.hostname,
+                                        payload)
+            t_recv = clock.now()
         except Exception as e:
             get_logger().debug("elastic: heartbeat send failed: %s", e)
             return False
+        try:
+            t_server = json.loads(reply or b"{}").get("t_server")
+            if t_server is not None:
+                clock.observe(t_send, t_recv, float(t_server))
+        except Exception:  # noqa: BLE001 — alignment is best-effort
+            pass
+        return True
 
     def start_heartbeat(self, interval: float | None = None) -> None:
         if self._heartbeater is not None:

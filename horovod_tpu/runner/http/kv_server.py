@@ -48,6 +48,19 @@ snapshot, which workers piggyback on the heartbeat PUTs they already send
 exempt from HMAC auth by design: a standard Prometheus scraper cannot sign
 requests, and the data is read-only operational telemetry (it carries no
 rendezvous state a scraper could poison). See ``docs/observability.md``.
+
+Tracing plane (``horovod_tpu.tracing``): heartbeat PUT replies carry the
+server's wall clock (``{"t_server": ...}``) so workers can estimate their
+clock offset NTP-style from timestamps they already have; workers post
+sampled step spans to ``PUT /trace/<host>`` (bounded payloads, replaced
+per host); ``GET /timeline`` serves the merged, offset-corrected
+Chrome/Perfetto trace JSON with one track per rank; ``GET /stragglers``
+serves the per-collective arrival-skew attribution as JSON, and the
+``/metrics`` scrape gains ``hvd_collective_skew_seconds{rank}`` /
+``hvd_straggler_score{host}`` gauges from the same computation. The
+read-only ``/timeline`` and ``/stragglers`` routes share ``/metrics``'s
+auth exemption (trace viewers can't HMAC either). See
+``docs/timeline.md``.
 """
 
 from __future__ import annotations
@@ -62,6 +75,7 @@ from urllib.request import Request, urlopen
 
 from ... import faults
 from ... import metrics as _metrics
+from ... import tracing as _tracing
 from ...utils.env import get_float, get_int
 from ...utils.retry import call_with_retries
 from .. import secret as _secret
@@ -77,6 +91,14 @@ HEARTBEAT_SCOPE = "heartbeat"
 # Coordinated-abort scope: one record per world generation, posted by the
 # driver (host kill/blacklist/unclean exit) or a worker's stall inspector.
 ABORT_SCOPE = "abort"
+
+# Tracing scope: workers PUT /trace/<host> with sampled step spans + their
+# measured clock offset; one payload per host (replaced on each ship).
+TRACE_SCOPE = _tracing.TRACE_SCOPE
+
+# Payload bound for /trace PUTs: the worker caps spans/steps at the
+# source; this is the server-side backstop against a misbehaving client.
+_TRACE_MAX_BYTES = 1 << 20
 
 
 def env_generation() -> int | None:
@@ -127,6 +149,13 @@ class _KVHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             # Unauthenticated by design: Prometheus scrapers can't HMAC.
             return self._serve_metrics()
+        if self.path == "/timeline":
+            # Same exemption: Perfetto/curl can't sign; read-only.
+            return self._serve_json(_render_timeline, "application/json")
+        if self.path == "/stragglers":
+            return self._serve_json(
+                lambda httpd: _compute_cluster_skew(httpd)[0],
+                "application/json")
         if not self._authenticate():
             return
         store = self.server.store  # type: ignore[attr-defined]
@@ -169,6 +198,19 @@ class _KVHandler(BaseHTTPRequestHandler):
         if key is None:
             return self._reply(400, b"missing key")
         length = int(self.headers.get("Content-Length", 0))
+        if scope == TRACE_SCOPE and length > _TRACE_MAX_BYTES:
+            # Reject WITHOUT buffering: the backstop must bound server
+            # memory, not just storage — the whole control plane rides
+            # this one process. The body is drained in small chunks and
+            # discarded (so the client reads a clean 413 instead of a
+            # connection reset mid-upload), never held whole.
+            remaining = length
+            while remaining > 0:
+                chunk = self.rfile.read(min(remaining, 1 << 16))
+                if not chunk:
+                    break
+                remaining -= len(chunk)
+            return self._reply(413, b"trace payload too large")
         body = self.rfile.read(length)
         if not self._authenticate(body):
             return
@@ -183,6 +225,13 @@ class _KVHandler(BaseHTTPRequestHandler):
                     self.server.hb_times[key] = time.monotonic()  # type: ignore[attr-defined]
         if rejected is not None:
             return self._reply(409, rejected)
+        if scope == HEARTBEAT_SCOPE:
+            # Clock-alignment plane: the reply carries the SERVER's wall
+            # clock so the worker can estimate its offset NTP-style from
+            # its own send/receive stamps (horovod_tpu.tracing.ClockSync)
+            # — no extra round trip, no extra route.
+            return self._reply(
+                200, json.dumps({"t_server": time.time()}).encode())
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
@@ -209,11 +258,124 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _serve_json(self, render, content_type: str):
+        try:
+            body = json.dumps(render(self.server)).encode()
+        except Exception as e:  # noqa: BLE001 — must not kill the KV
+            return self._reply(500, f"render failed: {e}".encode())
+        self.send_response(200)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _reply(self, code: int, body: bytes):
         self.send_response(code)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+def _trace_payloads(httpd) -> dict[str, dict]:
+    """Parsed ``PUT /trace`` payloads by host (malformed ones dropped —
+    a broken worker must not break the merge for everyone else)."""
+    with httpd.lock:
+        raw = dict(httpd.store.get(TRACE_SCOPE, {}))
+    out: dict[str, dict] = {}
+    for host, body in raw.items():
+        try:
+            payload = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if isinstance(payload, dict):
+            out[host] = payload
+    return out
+
+
+def _render_timeline(httpd) -> dict:
+    """The merged cross-rank trace: every shipped payload's spans on one
+    server timebase (each rank's measured clock offset applied), one
+    Chrome-trace process track per rank. Loadable directly in Perfetto /
+    chrome://tracing."""
+    payloads = _trace_payloads(httpd)
+    events: list[dict] = []
+    for host, payload in sorted(payloads.items()):
+        try:
+            pid = int(payload.get("rank", 0))
+        except (TypeError, ValueError):
+            pid = 0
+        try:
+            offset = float(payload.get("clock_offset_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            offset = 0.0
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"rank {pid} ({host})"}})
+        events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
+                       "args": {"sort_index": pid}})
+        for steprec in payload.get("steps", ()) or ():
+            if not isinstance(steprec, dict):
+                continue
+            for sp in steprec.get("spans", ()) or ():
+                if not isinstance(sp, dict):
+                    continue
+                try:
+                    ts_us = (float(sp["t"]) + offset) * 1e6
+                    dur_us = max(float(sp.get("dur", 0.0)), 0.0) * 1e6
+                except (KeyError, TypeError, ValueError):
+                    continue
+                events.append({
+                    "name": str(sp.get("name", "?")),
+                    "cat": str(sp.get("cat", "phase")),
+                    "ph": "X",
+                    "ts": ts_us,
+                    "dur": dur_us,
+                    "pid": pid,
+                    "tid": 0,
+                    "args": {
+                        "step": steprec.get("step"),
+                        "host": host,
+                        **(sp.get("args") or {}),
+                    },
+                })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "timebase": "rendezvous-server wall clock (offsets applied)",
+            "ranks": sorted(
+                str(p.get("rank", "?")) for p in payloads.values()),
+        },
+    }
+
+
+def _compute_cluster_skew(httpd) -> tuple[dict, dict[str, dict]]:
+    """Arrival-skew attribution over the shipped payloads, plus the
+    payloads themselves (so /metrics renders offsets without re-parsing).
+    Journals a throttled ``straggler_detected`` event when the worst
+    matched instance crosses ``HOROVOD_STRAGGLER_WARN_SKEW``."""
+    payloads = _trace_payloads(httpd)
+    skew = _tracing.compute_skew(payloads)
+    worst = skew.get("worst")
+    # Threshold on skew MINUS the combined clock-error bound: congested
+    # heartbeats widen each rank's offset uncertainty (up to ~RTT/2), and
+    # that uncertainty must never journal a healthy host as a straggler.
+    if worst and (worst["skew_s"] - worst.get("err_s", 0.0)
+                  >= _tracing.straggler_warn_skew()):
+        with httpd.lock:
+            version = httpd.version
+            logged = getattr(httpd, "straggler_logged", None)
+            if logged is None:
+                logged = httpd.straggler_logged = set()
+            key = (version, worst["last_rank"])
+            fresh = key not in logged
+            logged.add(key)
+        if fresh:
+            _metrics.event(
+                "straggler_detected", generation=version,
+                rank=worst["last_rank"], host=worst["last_host"],
+                skew_s=worst["skew_s"], collective=worst["name"],
+                step=worst["step"])
+    return skew, payloads
 
 
 def _render_cluster_metrics(httpd) -> str:
@@ -282,6 +444,43 @@ def _render_cluster_metrics(httpd) -> str:
         "hvd_worker_commits_total", "counter",
         "State commits reported on each worker's last heartbeat.",
         commit_samples))
+    # Straggler attribution from the tracing plane: per-rank arrival skew
+    # against the earliest rank on matched collectives/steps (shipped
+    # trace payloads, offset-corrected), and a per-host score the
+    # autoscaler (ROADMAP item 3) can threshold on. Empty when no traces
+    # have shipped (HOROVOD_TRACE_SAMPLE=0) — absent series, not zeros,
+    # so dashboards can tell "no stragglers" from "not measuring".
+    skew, payloads = _compute_cluster_skew(httpd)
+    skew_samples = []
+    host_lateness: dict[str, list[float]] = {}
+    for rank, info in sorted(skew.get("ranks", {}).items()):
+        labels = {"rank": rank, "host": info.get("host", "")}
+        skew_samples.append((labels, info["max_lateness_s"]))
+        host_lateness.setdefault(info.get("host", ""), []).append(
+            info["mean_lateness_s"])
+    if skew_samples:
+        driver_families.append(_metrics.make_family(
+            "hvd_collective_skew_seconds", "gauge",
+            "Max arrival lateness of each rank behind the earliest rank "
+            "on matched collectives (offset-corrected trace spans).",
+            skew_samples))
+        driver_families.append(_metrics.make_family(
+            "hvd_straggler_score", "gauge",
+            "Mean arrival lateness per host across its ranks' matched "
+            "collectives — the straggler-replacement signal.",
+            [({"host": h}, sum(ls) / len(ls))
+             for h, ls in sorted(host_lateness.items())]))
+    offset_samples = [
+        ({"rank": str(p.get("rank", "?")), "host": h},
+         float(p.get("clock_offset_s", 0.0) or 0.0))
+        for h, p in sorted(payloads.items())
+    ]
+    if offset_samples:
+        driver_families.append(_metrics.make_family(
+            "hvd_trace_clock_offset_seconds", "gauge",
+            "Each rank's measured wall-clock offset vs the rendezvous "
+            "server (server - local), as shipped with its trace.",
+            offset_samples))
     return _metrics.render_families(groups)
 
 
@@ -297,6 +496,7 @@ class RendezvousServer:
         self._httpd.hb_times = {}  # type: ignore[attr-defined]
         self._httpd.world_np = 0  # type: ignore[attr-defined]
         self._httpd.blacklisted = 0  # type: ignore[attr-defined]
+        self._httpd.straggler_logged = set()  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
         self._httpd.secret = _secret.current_key()  # type: ignore[attr-defined]
@@ -337,6 +537,20 @@ class RendezvousServer:
         """The scrape body, rendered in-process (what ``GET /metrics``
         serves over HTTP)."""
         return _render_cluster_metrics(self._httpd)
+
+    def timeline_json(self) -> dict:
+        """The merged cross-rank Chrome trace (what ``GET /timeline``
+        serves over HTTP), rendered in-process."""
+        return _render_timeline(self._httpd)
+
+    def straggler_summary(self) -> dict:
+        """The arrival-skew attribution (what ``GET /stragglers``
+        serves), rendered in-process."""
+        return _compute_cluster_skew(self._httpd)[0]
+
+    def trace_payload(self, host: str) -> dict | None:
+        """The last trace payload a host shipped, parsed, or None."""
+        return _trace_payloads(self._httpd).get(host)
 
     def start(self) -> int:
         self._thread = threading.Thread(
@@ -412,11 +626,15 @@ class RendezvousServer:
     def clear_heartbeat(self, host: str) -> None:
         """Forget a host's liveness record (worker relaunch/removal): a
         stale timestamp must neither mask a hung relaunch nor instantly
-        condemn a fresh one."""
+        condemn a fresh one. The host's trace payload goes with it — a
+        departed rank's spans must not keep skewing the merged timeline
+        and straggler gauges against the re-formed world."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             self._httpd.hb_times.pop(host, None)  # type: ignore[attr-defined]
             self._httpd.store.get(  # type: ignore[attr-defined]
                 HEARTBEAT_SCOPE, {}).pop(host, None)
+            self._httpd.store.get(  # type: ignore[attr-defined]
+                TRACE_SCOPE, {}).pop(host, None)
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -484,9 +702,11 @@ class KVClient:
             give_up_on=(HTTPError,),
         )
 
-    def put(self, scope: str, key: str, value: bytes) -> None:
-        with self._request("PUT", f"/{scope}/{key}", value):
-            pass
+    def put(self, scope: str, key: str, value: bytes) -> bytes:
+        """Write one key; returns the reply body (heartbeat PUTs carry
+        the server's wall clock there — see ``tracing.ClockSync``)."""
+        with self._request("PUT", f"/{scope}/{key}", value) as r:
+            return r.read()
 
     def get(self, scope: str, key: str) -> bytes | None:
         try:

@@ -143,6 +143,14 @@ class StallInspector:
                     self.failure_reason = reason
                     self.failed = True
                     self._failed_at = time.monotonic()
+                    # Postmortem FIRST, while the wedge is still live:
+                    # the flight record shows this rank's last K steps
+                    # with the wedged span still OPEN (name + age) — the
+                    # "what was it doing" half of the stall report.
+                    from . import tracing
+
+                    tracing.dump_flight_record("stall_shutdown",
+                                               detail=reason)
                     # Cluster-wide: publish abort/<generation> so every
                     # peer's monitor unblocks too — detection on ONE host
                     # must recover the WHOLE job, not log-and-hang.
@@ -187,6 +195,7 @@ class StallInspector:
             return
         import os
 
+        from . import tracing
         from .runner.elastic.constants import EXIT_STALL_ABANDONED
 
         get_logger().error(
@@ -195,6 +204,19 @@ class StallInspector:
             "so the driver re-forms the world without this host",
             grace, EXIT_STALL_ABANDONED,
         )
+        # Last words before os._exit (which runs no atexit/finally): the
+        # journal gets this rank's flight record — the only evidence of
+        # what the wedged main thread was doing that survives the exit.
+        # On a SIDE thread with a bounded join: the dump does file I/O
+        # (and takes the journal lock), and a hung disk / lock holder
+        # blocked in a stalled write is exactly the wedge class that got
+        # us here — the deadman's exit must be unconditional.
+        dumper = threading.Thread(
+            target=lambda: tracing.dump_flight_record(
+                "deadman_exit", detail=self.failure_reason),
+            name="hvd-deadman-dump", daemon=True)
+        dumper.start()
+        dumper.join(timeout=5.0)
         os._exit(EXIT_STALL_ABANDONED)
 
     def stop(self) -> None:

@@ -12,10 +12,19 @@ on-device phases, point xprof at the same run and merge in the viewer.
 
 Events are written on a dedicated writer thread (as in the reference, so
 the hot path never blocks on file IO) in Chrome trace-event JSON.
+
+Crash safety: the file is **loadable at every flush point**. The writer
+keeps the closing ``]`` present after every event (write event → write
+trailer → flush → seek back over the trailer for the next event), so a
+process that dies without ``stop_timeline()`` — SIGKILL included — leaves
+a valid, viewer-loadable JSON array instead of a truncated one. An
+``atexit`` hook additionally drains and closes the writer on normal
+interpreter exit.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import queue
@@ -28,6 +37,17 @@ _lock = threading.Lock()
 
 
 class Timeline:
+    #: Trailer kept at the tail after every flush, so the file is a valid
+    #: JSON array at ALL times (the crash-safety contract).
+    _TRAILER = "\n]\n"
+
+    #: Serialized-event cap. The crash-safety protocol relies on the
+    #: whole comma+event+trailer chunk staying in the IO buffer until
+    #: the one explicit flush; an event bigger than the buffer (~8KB)
+    #: would auto-flush a partial, trailer-less write. Caller-controlled
+    #: ``args`` are dropped (with a marker) past this bound.
+    _MAX_EVENT_CHARS = 4096
+
     def __init__(self, path: str):
         self.path = path
         self._queue: "queue.Queue[dict[str, Any] | None]" = queue.Queue()
@@ -37,9 +57,16 @@ class Timeline:
         )
         self._file = open(path, "w")
         self._file.write("[\n")
+        self._tail = self._file.tell()
+        self._file.write(self._TRAILER)
+        self._file.flush()  # even a zero-event file loads as []
         self._first = True
         self._dead = False
         self._thread.start()
+        # A process that exits without stop_timeline() still drains and
+        # closes the writer (the seek/truncate protocol above covers the
+        # no-atexit deaths — SIGKILL, os._exit — too).
+        atexit.register(self.shutdown)
 
     def _now_us(self) -> float:
         return (time.perf_counter_ns() - self._start) / 1e3
@@ -49,12 +76,30 @@ class Timeline:
             event = self._queue.get()
             if event is None:
                 break
+            # Seek over the trailer, buffer event + fresh trailer, flush
+            # once: the on-disk file keeps the OLD trailer until the
+            # single flush lands the whole replacement region, so it is a
+            # valid JSON array at every instant — a crash loses at most
+            # the single event in flight (the journal's per-record
+            # durability contract). No truncate(): every write is >= the
+            # trailer's length, so the file only ever grows and there are
+            # no stale bytes to trim — and truncate() would flush the
+            # shrunk, trailer-less file to disk mid-update, re-opening
+            # exactly the unloadable window this protocol closes.
+            text = json.dumps(event)
+            if len(text) > self._MAX_EVENT_CHARS:
+                event = {**event, "args": {"dropped": "args exceeded "
+                                           "timeline event size cap"}}
+                text = json.dumps(event)
+            self._file.seek(self._tail)
             if not self._first:
                 self._file.write(",\n")
             self._first = False
-            self._file.write(json.dumps(event))
+            self._file.write(text)
+            self._tail = self._file.tell()
+            self._file.write(self._TRAILER)
             self._file.flush()
-        self._file.write("\n]\n")
+        # The trailer is already on disk after the last flush; just close.
         self._file.close()
 
     def _emit(self, name: str, phase: str, category: str, ts_us: float, dur_us: float = None, args=None):
@@ -93,6 +138,10 @@ class Timeline:
         self._dead = True
         self._queue.put(None)
         self._thread.join(timeout=5)
+        try:
+            atexit.unregister(self.shutdown)
+        except Exception:  # noqa: BLE001 — double-run is harmless anyway
+            pass
         with _lock:
             if _timeline is self:
                 _timeline = None

@@ -57,14 +57,20 @@ fi
 echo "== premerge gate 3/4: bench.py --smoke perf lane (8-dev CPU mesh, 2 steps/section) =="
 blog="$(mktemp "${TMPDIR:-/tmp}/_bench.XXXXXX.log")"
 msnap="$(mktemp "${TMPDIR:-/tmp}/_metrics.XXXXXX.json")"
-trap 'rm -f "$t1log" "$blog" "$msnap"' EXIT
+tsnap="$(mktemp "${TMPDIR:-/tmp}/_trace.XXXXXX.json")"
+trap 'rm -f "$t1log" "$blog" "$msnap" "$tsnap"' EXIT
+# Scrape/timeline artifacts survive the run for build archiving.
+ARTIFACTS="${PREMERGE_ARTIFACTS:-${TMPDIR:-/tmp}/premerge-artifacts}"
+mkdir -p "$ARTIFACTS"
 # The 8-device virtual mesh (the test harness's stand-in slice): on one
 # device the collectives compile to identities and the sharded mode has
 # no optimizer compute to shard away, so single-device ratios cannot
 # judge the sync modes against each other. The bench also dumps its
-# metrics snapshot (HOROVOD_METRICS_SNAPSHOT) for the gate-4 scrape.
+# metrics snapshot (HOROVOD_METRICS_SNAPSHOT) and trace payload
+# (HOROVOD_TRACE_SNAPSHOT) for the gate-4 scrape + timeline lanes.
 if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     HOROVOD_METRICS_SNAPSHOT="$msnap" \
+    HOROVOD_TRACE_SNAPSHOT="$tsnap" \
     python bench.py --smoke | tee "$blog"; then
     echo "premerge: bench smoke failed" >&2
     exit 1
@@ -109,15 +115,24 @@ then
     exit 1
 fi
 
-echo "== premerge gate 4/4: /metrics scrape lane =="
+echo "== premerge gate 4/4: /metrics scrape + /timeline merge lane =="
 # End-to-end over the REAL plumbing: the bench run's instrument snapshot
 # is published to a live RendezvousServer via the same heartbeat PUT
-# workers use, then scraped back over plain HTTP from GET /metrics.
-# Fails if the endpoint is unreachable, any line flunks the strict
-# Prometheus-text validator, or the core instrument set (collective
-# dispatch histograms, heartbeat gauge, goodput counters) is absent.
-if ! JAX_PLATFORMS=cpu python - "$msnap" <<'EOF'
+# workers use, then scraped back over plain HTTP from GET /metrics; the
+# bench's trace payload is published to PUT /trace as two ranks (the
+# second a relabeled copy with a deliberate clock shift + matching
+# offset, so offset correction is exercised), GET /timeline is fetched
+# and must parse as Chrome-trace JSON with >=2 rank tracks, and the
+# skew gauges must appear on the scrape. Both bodies are archived as
+# build artifacts ($PREMERGE_ARTIFACTS, default /tmp/premerge-artifacts)
+# alongside the metrics snapshot. Fails if any endpoint is unreachable,
+# any line flunks the strict Prometheus-text validator, or the core
+# instrument set (collective dispatch histograms, heartbeat gauge,
+# goodput counters) is absent.
+if ! JAX_PLATFORMS=cpu python - "$msnap" "$tsnap" "$ARTIFACTS" <<'EOF'
+import copy
 import json
+import os
 import socket
 import sys
 import urllib.request
@@ -129,6 +144,11 @@ with open(sys.argv[1]) as f:
     snap = json.load(f)
 if not isinstance(snap, list) or not snap:
     sys.exit("premerge metrics lane: bench wrote an empty snapshot")
+with open(sys.argv[2]) as f:
+    trace = json.load(f)
+if not isinstance(trace, dict) or not trace.get("steps"):
+    sys.exit("premerge timeline lane: bench wrote an empty trace payload")
+artifacts = sys.argv[3]
 server = RendezvousServer(host="127.0.0.1")
 server.start()
 server.set_cluster_info(world_np=1)
@@ -136,6 +156,20 @@ try:
     client = KVClient("127.0.0.1", server.port)
     client.put("heartbeat", socket.gethostname(), json.dumps(
         {"rank": 0, "steps": 1, "commits": 0, "metrics": snap}).encode())
+    # Publish the bench trace as rank 0, plus a relabeled copy as rank 1
+    # whose wall clocks are shifted +5s with the matching measured
+    # offset (-5s): after correction both ranks must land on one
+    # timebase, which the skew gauges then read as ~zero lateness.
+    SHIFT = 5.0
+    trace0 = dict(trace, rank="0", host="bench-r0", clock_offset_s=0.0)
+    trace1 = copy.deepcopy(trace)
+    trace1.update(rank="1", host="bench-r1", clock_offset_s=-SHIFT)
+    for steprec in trace1.get("steps", []):
+        steprec["t"] = steprec.get("t", 0) + SHIFT
+        for sp in steprec.get("spans", []):
+            sp["t"] = sp.get("t", 0) + SHIFT
+    client.put("trace", "bench-r0", json.dumps(trace0).encode())
+    client.put("trace", "bench-r1", json.dumps(trace1).encode())
     url = f"http://127.0.0.1:{server.port}/metrics"
     with urllib.request.urlopen(url, timeout=10) as r:
         if r.status != 200:
@@ -149,6 +183,8 @@ try:
         "hvd_goodput_productive_seconds_total",
         "hvd_goodput_lost_seconds_total",
         "hvd_world_generation",
+        "hvd_collective_skew_seconds",
+        "hvd_straggler_score",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -162,13 +198,47 @@ try:
     if dispatches < 1:
         sys.exit("premerge metrics lane: dispatch histogram is empty "
                  "(bench recorded no eager collectives)")
+    skews = [v for _, v in parsed["hvd_collective_skew_seconds"]["samples"]]
+    if any(s > 1.0 for s in skews):
+        sys.exit(
+            f"premerge timeline lane: offset correction failed — shifted "
+            f"replica shows residual skew {skews} (expected ~0)")
+    # Merged timeline over HTTP: valid Chrome trace JSON, >=2 rank tracks.
+    turl = f"http://127.0.0.1:{server.port}/timeline"
+    with urllib.request.urlopen(turl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge timeline lane: {turl} answered {r.status}")
+        tbody = r.read()
+    merged = json.loads(tbody)
+    events = merged.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        sys.exit("premerge timeline lane: /timeline has no traceEvents")
+    spans = [e for e in events if e.get("ph") == "X"]
+    bad = [e for e in spans
+           if not isinstance(e.get("ts"), (int, float))
+           or not isinstance(e.get("dur"), (int, float))]
+    if bad:
+        sys.exit(f"premerge timeline lane: malformed span events: {bad[:3]}")
+    pids = {e.get("pid") for e in spans}
+    if len(pids) < 2:
+        sys.exit(
+            f"premerge timeline lane: expected >=2 rank tracks, got "
+            f"pids={sorted(pids)}")
+    with open(os.path.join(artifacts, "timeline.json"), "wb") as f:
+        f.write(tbody)
+    with open(os.path.join(artifacts, "metrics_snapshot.json"), "w") as f:
+        json.dump(snap, f)
+    with open(os.path.join(artifacts, "metrics_scrape.prom"), "w") as f:
+        f.write(text)
     print(f"premerge metrics lane: ok ({len(parsed)} metric families, "
           f"{dispatches:.0f} dispatches in the latency histogram)")
+    print(f"premerge timeline lane: ok ({len(spans)} spans across "
+          f"{len(pids)} rank tracks; archived to {artifacts})")
 finally:
     server.stop()
 EOF
 then
-    echo "premerge: metrics scrape lane failed" >&2
+    echo "premerge: metrics scrape/timeline lane failed" >&2
     exit 1
 fi
 echo "premerge: all gates passed"
