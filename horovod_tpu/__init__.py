@@ -103,6 +103,7 @@ from . import abort  # noqa: F401
 from . import autotune  # noqa: F401
 from . import faults  # noqa: F401
 from . import metrics  # noqa: F401
+from . import peercheck  # noqa: F401
 from . import profiler  # noqa: F401
 from . import tracing  # noqa: F401
 from . import callbacks  # noqa: F401

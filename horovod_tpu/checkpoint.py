@@ -17,15 +17,20 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import Any
-
-import jax
+import time
+from typing import Any, MutableMapping
 
 from . import faults
+from . import metrics as _metrics
 from .exceptions import CheckpointCorruptError
 from .utils.env import get_float, get_int
 from .utils.logging import get_logger
 from .utils.retry import call_with_retries
+
+# NOTE: jax is imported lazily (only the save path touches device arrays)
+# so this module stays importable on the driver/KV-server side before any
+# framework init — the peer-replication plane (peercheck.py, kv_server.py)
+# shares the checksum/rotation helpers below from that context.
 
 # Integrity footer for rank-0 pickle checkpoints: payload ‖ sha256(payload)
 # ‖ magic. pickle.load ignores trailing bytes, so footered files stay
@@ -36,6 +41,75 @@ _FOOTER_LEN = 32 + len(_CKPT_MAGIC)
 
 def _with_footer(payload: bytes) -> bytes:
     return payload + hashlib.sha256(payload).digest() + _CKPT_MAGIC
+
+
+def payload_digest(payload: bytes) -> str:
+    """The shared integrity checksum (hex sha256) for checkpoint-shaped
+    payloads — the rank-0 pickle footer, the peer-replication wire format
+    (:mod:`horovod_tpu.peercheck`), and the KV server's install-time
+    verification all use this one digest so a payload written by any layer
+    verifies identically in every other."""
+    return hashlib.sha256(payload).hexdigest()
+
+
+def atomic_install(path: str, data: bytes) -> None:
+    """Install ``data`` at ``path``, retaining the previous good file at
+    ``<path>.prev``, with **no window in which neither exists**.
+
+    The naive rotation (``rename(path, prev); rename(tmp, path)``) has a
+    crash window between the two renames that leaves nothing at ``path``
+    (the load side papers over it by falling back to ``.prev``, but every
+    consumer of the path sees a missing checkpoint until then). Here the
+    current file is retained via a hard link *before* the new data
+    replaces it, so ``path`` always names a complete, verified payload:
+
+    1. write ``data`` to ``<path>.tmp``
+    2. ``link(path, <path>.prev)`` — prev and path both name the old file
+    3. ``replace(tmp, path)`` — atomic install of the new file
+
+    Both the durable rank-0 checkpoint (:func:`save_on_rank_0`) and any
+    file-backed peer-replica spill route through this one helper; the
+    in-memory flavor of the same rotation contract is :func:`rotate_slots`.
+    """
+    tmp = f"{path}.tmp"
+    prev = f"{path}.prev"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+        if os.path.exists(path):
+            try:
+                os.unlink(prev)
+            except FileNotFoundError:
+                pass
+            try:
+                os.link(path, prev)
+            except OSError:
+                # Filesystem without hard links: fall back to copy-rotate
+                # (still no window — path is untouched until the replace).
+                with open(path, "rb") as src, open(prev, "wb") as dst:
+                    dst.write(src.read())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)  # no orphaned partial files on failure
+        except OSError:
+            pass
+        raise
+
+
+def rotate_slots(store: MutableMapping, key: str, value,
+                 prev_suffix: str = ".prev") -> None:
+    """The mapping flavor of :func:`atomic_install`: install ``value`` at
+    ``key``, retaining the previous value at ``<key><prev_suffix>``.
+
+    Callers hold whatever lock guards ``store``; the rotation itself is
+    two plain assignments, so there is never a state with the current slot
+    empty. Used by the peer-replica pool (:mod:`horovod_tpu.peercheck`)
+    and the KV server's ``peerstate`` scope so both sides of the
+    replication plane rotate identically."""
+    if key in store:
+        store[f"{key}{prev_suffix}"] = store[key]
+    store[key] = value
 
 
 def _read_verified(path: str) -> Any:
@@ -120,12 +194,15 @@ class Checkpointer:
         inside the retried dispatch."""
         import orbax.checkpoint as ocp
 
+        t0 = time.perf_counter()
         _save_with_retries(
             lambda: self._mgr.save(step, args=ocp.args.StandardSave(state)),
             what=f"step {step}",
         )
         if wait:
             self._mgr.wait_until_finished()
+        _metrics.CHECKPOINT_SECONDS.observe(
+            time.perf_counter() - t0, kind="save", rung="durable")
 
     def restore(self, step: int | None = None, template: Any = None) -> Any:
         """Restore the latest (or given) step, re-sharded like `template`.
@@ -156,12 +233,16 @@ class Checkpointer:
                 raise FileNotFoundError(f"no checkpoints in {self._dir}")
         log = get_logger()
         last_err: Exception | None = None
+        t0 = time.perf_counter()
         for i, s in enumerate(candidates):
             try:
                 if faults.fire(faults.CHECKPOINT_RESTORE):
                     raise faults.InjectedFault(
                         f"checkpoint restore dropped: step {s}")
-                return self._mgr.restore(s, args=args)
+                out = self._mgr.restore(s, args=args)
+                _metrics.CHECKPOINT_SECONDS.observe(
+                    time.perf_counter() - t0, kind="restore", rung="durable")
+                return out
             except Exception as e:  # noqa: BLE001 — try the older steps
                 last_err = e
                 if i + 1 < len(candidates):
@@ -193,11 +274,15 @@ def save_on_rank_0(path: str, tree: Any) -> None:
     failure mid-write can never leave a truncated checkpoint behind.
 
     Integrity + retention: the payload carries a sha256 checksum footer
-    (verified on load), and the previous good checkpoint is rotated to
-    ``<path>.prev`` — so a checkpoint that corrupts AFTER the write (bit
-    rot, torn storage) costs one step of progress on resume, not the job.
+    (verified on load), and the previous good checkpoint is retained at
+    ``<path>.prev`` via :func:`atomic_install` (hard-link rotation — no
+    crash window ever leaves the path empty) — so a checkpoint that
+    corrupts AFTER the write (bit rot, torn storage) costs one step of
+    progress on resume, not the job.
     """
     import pickle
+
+    import jax
 
     from . import basics
 
@@ -207,24 +292,10 @@ def save_on_rank_0(path: str, tree: Any) -> None:
     data = _with_footer(
         pickle.dumps(jax.tree.map(lambda x: jax.device_get(x), tree)))
 
-    def write():
-        tmp = f"{path}.tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(data)
-            # Rotate AFTER the new data is safely on disk: the previous
-            # good checkpoint is never the casualty of a failed write.
-            if os.path.exists(path):
-                os.replace(path, f"{path}.prev")
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)  # no orphaned partial files on failure
-            except OSError:
-                pass
-            raise
-
-    _save_with_retries(write, what=path)
+    t0 = time.perf_counter()
+    _save_with_retries(lambda: atomic_install(path, data), what=path)
+    _metrics.CHECKPOINT_SECONDS.observe(
+        time.perf_counter() - t0, kind="save", rung="durable")
 
 
 def save_state_on_rank_0(path: str, optimizer, params: Any,
@@ -292,6 +363,7 @@ def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
     obj = None
     if basics.rank() == root_rank:
         log = get_logger()
+        t0 = time.perf_counter()
         prev = f"{path}.prev"
         need_prev = False
         if os.path.exists(path):
@@ -323,4 +395,7 @@ def load_and_broadcast(path: str, root_rank: int = 0) -> Any:
                     "previous retained checkpoint %s is also unreadable "
                     "(%s); resuming without a checkpoint", prev, pe,
                 )
+        if obj is not None:
+            _metrics.CHECKPOINT_SECONDS.observe(
+                time.perf_counter() - t0, kind="restore", rung="durable")
     return broadcast_object(obj, root_rank=root_rank)

@@ -1,2 +1,7 @@
-from .state import ObjectState, State, TpuState  # noqa: F401
+from .state import (  # noqa: F401
+    ObjectState,
+    PeerShardedState,
+    State,
+    TpuState,
+)
 from .runner import run  # noqa: F401

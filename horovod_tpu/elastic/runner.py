@@ -35,6 +35,11 @@ from ..exceptions import (
 from ..utils.env import get_float, get_int
 from ..utils.logging import get_logger
 
+# The escalation ladder's rung names, keyed by consecutive no-progress
+# failures (these are the `rung` label values of hvd_recoveries_total and
+# the journal's `recovery` events — see docs/observability.md).
+_RUNGS = {1: "restore", 2: "rendezvous", 3: "peer", 4: "durable"}
+
 # Preemption drain: SIGTERM (the cloud's preemption notice, and the elastic
 # driver's first termination signal) flips this event; the NEXT
 # ``state.commit()`` — i.e. right after a consistent snapshot — raises
@@ -87,13 +92,22 @@ def run(func):
     ``HorovodInternalError`` failures with no progress (no commit landed
     in between):
 
-    1. in-memory ``state.restore()`` to the last commit (the cheap,
-       common case — a peer died mid-step);
-    2. full re-rendezvous + ``state.sync()`` from rank 0, *skipping* the
-       local restore (the local snapshot itself may be part of the
-       problem);
-    3. durable restore via :meth:`State.register_durable_restore` (the
-       orbax/pickle checkpoint layer) when registered, else rung 1 again.
+    1. ``restore`` — in-memory ``state.restore()`` to the last commit
+       (the cheap, common case — a peer died mid-step);
+    2. ``rendezvous`` — full re-rendezvous + ``state.sync()`` from rank
+       0, *skipping* the local restore (the local snapshot itself may be
+       part of the problem);
+    3. ``peer`` — re-materialize from the in-memory peer replica pool
+       via :meth:`State.restore_peer` (:mod:`horovod_tpu.peercheck`) when
+       armed: the departed ranks' shards are rebuilt from the replicas K
+       ring neighbors hold, with zero durable-storage reads. A state
+       whose local snapshot provably cannot re-form the world
+       (``peer_restore_pending`` — shard-local commits) jumps here
+       straight from rung 1, skipping the rank-0 sync that cannot help.
+       Any replica gap or checksum mismatch falls through to
+    4. ``durable`` — restore via :meth:`State.register_durable_restore`
+       (the orbax/pickle checkpoint layer) when registered, else rung 1
+       again.
 
     A **storm breaker** caps the ladder: after
     ``HOROVOD_RECOVERY_MAX_ATTEMPTS`` consecutive no-progress failures
@@ -244,44 +258,83 @@ def run(func):
                         f"{consecutive_failures} consecutive recovery "
                         f"attempts failed with no progress (last: {e})"
                     ) from e
-                rung = min(consecutive_failures, 3)
-                _metrics.RECOVERIES.inc(rung=str(rung))
+                rung_n = min(consecutive_failures, 4)
+                if rung_n == 2 and getattr(
+                        state, "peer_restore_pending", lambda: False)():
+                    # The state reports its local snapshot cannot re-form
+                    # the world (shard-local commit after a peer death):
+                    # rung 2's rank-0 sync cannot help either — escalate
+                    # straight to the peer rung.
+                    rung_n = 3
+                if rung_n == 3 and not getattr(
+                        state, "peer_restore_armed", lambda: False)():
+                    rung_n = 4  # no replica plane: the durable rung is next
+                rung = _RUNGS[rung_n]
+                _metrics.RECOVERIES.inc(rung=rung)
                 _metrics.event(
                     "recovery", generation=_generation(), rung=rung,
                     failures=consecutive_failures, error=str(e)[:300])
                 t_restore = time.perf_counter()
-                if rung == 1:
+                if rung == "restore":
                     log.warning(
                         "elastic: internal failure (%s); restoring last "
-                        "commit (recovery rung 1)", e)
+                        "commit (recovery rung 'restore')", e)
                     if basics.is_initialized():
                         state.restore()
-                elif rung == 2:
+                elif rung == "rendezvous":
                     log.warning(
                         "elastic: internal failure (%s); escalating to full "
                         "re-rendezvous + sync from rank 0, skipping local "
-                        "restore (recovery rung 2)", e)
+                        "restore (recovery rung 'rendezvous')", e)
                 else:
-                    log.warning(
-                        "elastic: internal failure (%s); escalating to "
-                        "durable checkpoint restore (recovery rung 3)", e)
                     restored = False
-                    try:
-                        restored = state.restore_durable()
-                    except Exception as ce:  # noqa: BLE001 — fall through
-                        log.error(
-                            "elastic: durable restore failed (%s); falling "
-                            "back to the in-memory commit", ce)
-                    if not restored:
-                        _metrics.event(
-                            "checkpoint_fallback", generation=_generation(),
-                            durable_restored=False)
-                        if basics.is_initialized():
-                            state.restore()
-                    else:
-                        _metrics.event(
-                            "checkpoint_fallback", generation=_generation(),
-                            durable_restored=True)
+                    if rung == "peer":
+                        log.warning(
+                            "elastic: internal failure (%s); escalating to "
+                            "peer-replica restore (recovery rung 'peer')", e)
+                        try:
+                            restored = state.restore_peer()
+                        except Exception as pe:  # noqa: BLE001
+                            log.error(
+                                "elastic: peer-replica restore failed (%s); "
+                                "falling through to the durable rung", pe)
+                        if restored:
+                            # Every storage-free recovery leaves the same
+                            # postmortem the durable path would: the
+                            # flight record of this rank's last K steps,
+                            # replica-pool state included.
+                            from .. import tracing
+
+                            tracing.dump_flight_record(
+                                "peer_restore", generation=_generation())
+                        else:
+                            _metrics.event(
+                                "peer_fallback", generation=_generation())
+                            _metrics.RECOVERIES.inc(rung="durable")
+                            rung = "durable"
+                    if rung == "durable" and not restored:
+                        log.warning(
+                            "elastic: internal failure (%s); escalating to "
+                            "durable checkpoint restore (recovery rung "
+                            "'durable')", e)
+                        try:
+                            restored = state.restore_durable()
+                        except Exception as ce:  # noqa: BLE001
+                            log.error(
+                                "elastic: durable restore failed (%s); "
+                                "falling back to the in-memory commit", ce)
+                        if not restored:
+                            _metrics.event(
+                                "checkpoint_fallback",
+                                generation=_generation(),
+                                durable_restored=False)
+                            if basics.is_initialized():
+                                state.restore()
+                        else:
+                            _metrics.event(
+                                "checkpoint_fallback",
+                                generation=_generation(),
+                                durable_restored=True)
                 goodput.add_lost(
                     "restore", time.perf_counter() - t_restore)
                 skip_sync = False

@@ -41,6 +41,7 @@ class State:
     def __init__(self, **kwargs):
         self._reset_callbacks: list[Callable[[], None]] = []
         self._durable_restore_fn: Callable[[], None] | None = None
+        self._peer_restore_fn: Callable[[], None] | None = None
         self._kwargs = kwargs
 
     def register_reset_callbacks(self, callbacks) -> None:
@@ -68,6 +69,34 @@ class State:
             return False
         self._durable_restore_fn()
         return True
+
+    def register_peer_restore(self, fn: Callable[[], None]) -> None:
+        """Arm the recovery ladder's ``peer`` rung (between the sync-only
+        re-rendezvous and the durable restore): ``fn`` re-materializes
+        this state's fields from the peer replica pool
+        (:mod:`horovod_tpu.peercheck`) — storage never enters the path.
+        ``fn`` raising (replica gap, checksum mismatch) makes the ladder
+        fall through to the durable rung. :class:`PeerShardedState` arms
+        this automatically."""
+        self._peer_restore_fn = fn
+
+    def restore_peer(self) -> bool:
+        """Run the registered peer restore; False when none is armed (the
+        ladder then proceeds straight to the durable rung)."""
+        if self._peer_restore_fn is None:
+            return False
+        self._peer_restore_fn()
+        return True
+
+    def peer_restore_armed(self) -> bool:
+        return self._peer_restore_fn is not None
+
+    def peer_restore_pending(self) -> bool:
+        """True when this state KNOWS its local snapshot cannot re-form
+        the world (a shard-local commit after a peer death) — the elastic
+        ladder then escalates straight to the peer rung instead of
+        burning an attempt on a rank-0 sync that cannot help."""
+        return False
 
     def on_reset(self) -> None:
         for cb in self._reset_callbacks:
@@ -167,13 +196,15 @@ class TpuState(State):
         self.opt_state = opt_state
         self._sharded_spec = None
         if sharded_optimizer is not None:
-            from ..optimizer import reduce_spec_of
+            from ..optimizer import ReduceSpec, reduce_spec_of
 
-            spec = reduce_spec_of(sharded_optimizer)
+            spec = (sharded_optimizer
+                    if isinstance(sharded_optimizer, ReduceSpec)
+                    else reduce_spec_of(sharded_optimizer))
             if spec is None or getattr(spec, "sync_mode", None) != "sharded":
                 raise ValueError(
                     "sharded_optimizer must be a DistributedOptimizer "
-                    "built with sync_mode='sharded'")
+                    "built with sync_mode='sharded' (or its ReduceSpec)")
             self._sharded_spec = spec
         for k, v in extras.items():
             setattr(self, k, v)
@@ -218,6 +249,15 @@ class TpuState(State):
         for k in self._extras:
             setattr(self, k, self._saved[k])
 
+    def _sync_world_size(self) -> int:
+        """The world size ``sync()`` re-shards for: the device world of
+        the single-controller regime. The peer-replicated flavor
+        overrides this with the process world (one shard row per
+        process)."""
+        from .. import basics
+
+        return basics.size()
+
     def sync(self) -> None:
         self.params = broadcast_parameters(self.params, root_rank=0)
         if self._sharded_spec is not None and self.opt_state is not None:
@@ -225,10 +265,9 @@ class TpuState(State):
             # to the monolithic layout (pure host math — the rows hold
             # every rank's shard), broadcast rank-0's copy like any other
             # state, then re-derive ownership from the new world size.
-            # Also heals a rung-3 durable restore that installed a
+            # Also heals a durable-rung restore that installed a
             # monolithic-layout opt_state: unshard of an already-full
             # state is skipped by layout detection below.
-            from .. import basics
             from ..optimizer import reshard_opt_state, unshard_opt_state
 
             full = self.opt_state
@@ -237,7 +276,8 @@ class TpuState(State):
                     self._sharded_spec, self.opt_state, self.params)
             full = broadcast_parameters(full, root_rank=0)
             self.opt_state = reshard_opt_state(
-                self._sharded_spec, full, self.params, basics.size())
+                self._sharded_spec, full, self.params,
+                self._sync_world_size())
         else:
             self.opt_state = broadcast_parameters(
                 self.opt_state, root_rank=0)
@@ -272,6 +312,249 @@ class TpuState(State):
         t_shapes = [np.shape(l) for l in jax.tree.leaves(template)]
         s_shapes = [np.shape(l) for l in jax.tree.leaves(state)]
         return t_shapes != s_shapes
+
+
+def _world_rank_size() -> tuple[int, int]:
+    """(rank, world size) for shard ownership: the PROCESS world in
+    multi-process elastic launches (each process owns one shard row; the
+    local jax device view is 1 there), else the device world of the
+    single-controller regime."""
+    import os
+
+    n = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)
+    if n > 1:
+        from .. import process_world
+
+        return process_world.rank(), process_world.size()
+    from .. import basics
+
+    if basics.is_initialized():
+        return int(basics.rank()), int(basics.size())
+    return 0, 1
+
+
+class PeerShardedState(TpuState):
+    """ZeRO-1 elastic state with **shard-local commits** and peer
+    replication — the state flavor under the recovery ladder's ``peer``
+    rung (:mod:`horovod_tpu.peercheck`).
+
+    Where :class:`TpuState` snapshots the full stacked optimizer state on
+    every ``commit()``, this flavor snapshots only the **owned shard
+    row** (≈1/n of the state — the commit-cost twin of the ZeRO-1 memory
+    win) and replicates it to the generation-fenced ``peerstate`` KV
+    scope, where K ring neighbors also hold it in memory. The trade is
+    explicit: after a failure, ``restore()`` can re-materialize only this
+    rank's row, so re-forming the world needs the *other* ranks' rows —
+    which is exactly what the peer rung supplies
+    (:meth:`restore_peer` → ``PeerReplicator.assemble`` →
+    ``unshard_opt_state`` → next ``sync()`` re-shards for the current
+    world via ``reshard_opt_state``, pure host math, zero storage reads).
+    A replica gap or checksum mismatch falls through to the durable rung.
+
+    ``rank`` / ``world_size`` are injectable for single-controller tests;
+    elastic workers derive both from the launcher env contract.
+    """
+
+    def __init__(self, params=None, opt_state=None, sharded_optimizer=None,
+                 replicator=None, rank: int | None = None,
+                 world_size: int | None = None, **extras):
+        if sharded_optimizer is None:
+            raise ValueError(
+                "PeerShardedState requires sharded_optimizer (a "
+                "sync_mode='sharded' DistributedOptimizer or its "
+                "ReduceSpec): shard ownership is what gets replicated")
+        from .. import peercheck
+
+        self._rank_override = rank
+        self._world_override = world_size
+        if replicator is None:
+            replicator = peercheck.PeerReplicator(
+                rank=rank,
+                world_size_fn=((lambda: world_size)
+                               if world_size is not None else None))
+        self._replicator = replicator
+        self._peer_dirty = False
+        self._commit_seq = 0
+        super().__init__(params=params, opt_state=opt_state,
+                         sharded_optimizer=sharded_optimizer, **extras)
+        self.register_peer_restore(self._restore_from_peers)
+
+    # -- world facts ---------------------------------------------------------
+
+    def _rank_world(self) -> tuple[int, int]:
+        if self._rank_override is not None and self._world_override:
+            return self._rank_override, self._world_override
+        return _world_rank_size()
+
+    def peer_restore_pending(self) -> bool:
+        return self._peer_dirty and self.peer_restore_armed()
+
+    def needs_world_sync(self) -> bool:
+        if self._peer_dirty:
+            return True
+        return super().needs_world_sync()
+
+    # -- shard-local commit + replication ------------------------------------
+
+    def _own_row(self, r: int):
+        """(host copy of this rank's shard row, layout tag). Falls back
+        to the full tree when the live state is not in the stacked layout
+        (e.g. right after a monolithic peer/durable install)."""
+        state = self.opt_state
+        if state is None:
+            return None, "none"
+        if self._looks_sharded():
+            leaves = jax.tree.leaves(state)
+            if leaves:
+                n_state = int(np.shape(leaves[0])[0])
+                if r < n_state:
+                    return _to_host(
+                        jax.tree.map(lambda l: np.asarray(l)[r], state)
+                    ), "row"
+        return _to_host(state), "full"
+
+    def commit(self) -> None:
+        import pickle
+
+        self._commit_seq += 1
+        r, n = self._rank_world()
+        row, layout = self._own_row(r)
+        self._saved = {
+            "params": _to_host(self.params),
+            "row": row,
+            "layout": layout,
+            "rank": r,
+            "world": n,
+            **{k: getattr(self, k) for k in self._extras},
+        }
+        payload = pickle.dumps({
+            "row": row,
+            "layout": layout,
+            "extras": {k: self._saved[k] for k in self._extras},
+            # Parameters are replicated across ranks, so ONE record per
+            # set carries them (rank 0's) — the replica set stays
+            # self-sufficient without multiplying the wire cost by n.
+            "params": self._saved["params"] if r == 0 else None,
+        })
+        self._replicator.replicate(payload, step=self._commit_seq,
+                                   has_params=(r == 0))
+        self.check_host_updates()
+
+    def restore(self) -> None:
+        assert self._saved is not None
+        self.params = self._saved["params"]
+        for k in self._extras:
+            setattr(self, k, self._saved[k])
+        layout = self._saved["layout"]
+        if layout == "none":
+            self.opt_state = None
+            self._peer_dirty = False
+        elif layout == "full":
+            self.opt_state = self._saved["row"]
+            self._peer_dirty = False
+        else:
+            # Re-materialize the stacked layout with only the owned row:
+            # the other rows are gone (that is the shard-local trade) and
+            # must come from the peer rung before the next sync().
+            r, n = self._saved["rank"], self._saved["world"]
+
+            def expand(x):
+                x = np.asarray(x)
+                z = np.zeros((n,) + x.shape, x.dtype)
+                z[r] = x
+                return z
+
+            self.opt_state = jax.tree.map(expand, self._saved["row"])
+            self._peer_dirty = True
+
+    def _sync_world_size(self) -> int:
+        return self._rank_world()[1]
+
+    def sync(self) -> None:
+        if self._peer_dirty:
+            from ..exceptions import HorovodInternalError
+
+            raise HorovodInternalError(
+                "shard-local commit holds only this rank's optimizer "
+                "shard; the departed ranks' shards must be "
+                "re-materialized from the peer replica pool (recovery "
+                "rung 'peer') or the durable checkpoint")
+        # Re-align the commit counter to the replica plane's world-synced
+        # baseline: replica sets are matched across ranks by
+        # (generation, step), and a replacement rank's fresh counter
+        # would otherwise diverge from the survivors' forever — silently
+        # disabling the peer rung after the first membership change. The
+        # baseline reads PRIOR generations only (frozen by the server's
+        # fence), so every rank of the new generation computes the same
+        # value regardless of how formation interleaves with commits.
+        self._commit_seq = max(
+            self._commit_seq,
+            self._replicator.latest_step(
+                before_generation=self._replicator.generation()))
+        super().sync()
+
+    def install_full(self, params, opt_state, **extras) -> None:
+        """Install an externally restored FULL state — the durable rung's
+        entry point for this flavor (a monolithic ``opt_state`` is fine:
+        the next ``sync()`` re-shards it for the current world). Clears
+        the shard-local dirty flag that makes ``sync()`` refuse."""
+        self.params = params
+        self.opt_state = opt_state
+        for k, v in extras.items():
+            if k in self._extras:
+                setattr(self, k, v)
+        self._peer_dirty = False
+
+    # -- the peer rung -------------------------------------------------------
+
+    def _restore_from_peers(self) -> None:
+        """Assemble the last commit's complete replica set and install
+        the re-materialized FULL state (monolithic layout — the next
+        ``sync()`` re-shards it for the current world, exactly like a
+        rung-``durable`` gather-on-save restore). Raises
+        ``ReplicaUnavailableError`` on any gap/corruption, which the
+        ladder converts into a durable-rung fall-through."""
+        import pickle
+        import time as _time
+
+        from .. import metrics as _metrics
+        from .. import peercheck
+        from ..optimizer import unshard_opt_state
+
+        t0 = _time.perf_counter()
+        records = self._replicator.assemble()
+        payloads = [pickle.loads(rec.payload) for rec in records]
+        params = next(
+            (p["params"] for p in payloads if p.get("params") is not None),
+            None)
+        if params is None:
+            raise peercheck.ReplicaUnavailableError(
+                "no record in the replica set carries the parameters")
+        if len(records) == 1 and payloads[0]["layout"] != "row":
+            full = payloads[0]["row"]  # degenerate: the full tree as-is
+        else:
+            bad = [r.rank for r, p in zip(records, payloads)
+                   if p["layout"] != "row"]
+            if bad:
+                raise peercheck.ReplicaUnavailableError(
+                    f"records of ranks {bad} are not shard rows")
+            rows = [p["row"] for p in payloads]
+            stacked = jax.tree.map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]), *rows)
+            full = unshard_opt_state(self._sharded_spec, stacked, params)
+        self.params = params
+        self.opt_state = full
+        for k, v in payloads[0].get("extras", {}).items():
+            if k in self._extras:
+                setattr(self, k, v)
+        self._peer_dirty = False
+        rec = records[0]
+        _metrics.CHECKPOINT_SECONDS.observe(
+            _time.perf_counter() - t0, kind="restore", rung="peer")
+        _metrics.event(
+            "peer_restore", generation=rec.generation, step=rec.step,
+            world_size=rec.world_size,
+            bytes=sum(len(r.payload) for r in records))
 
 
 class ExtrasState(State):

@@ -61,6 +61,8 @@ HEARTBEAT_SEND = "heartbeat.send"
 ABORT_POLL = "abort.poll"
 CHECKPOINT_SAVE = "checkpoint.save"
 CHECKPOINT_RESTORE = "checkpoint.restore"
+PEER_REPLICATE = "peer.replicate"
+PEER_VERIFY = "peer.verify"
 
 _MODES = ("drop", "delay", "raise", "hang")
 _DEFAULT_HANG_S = 3600.0

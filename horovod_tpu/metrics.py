@@ -305,6 +305,7 @@ def reset_for_testing() -> None:
     cells are dropped (and goodput's zero-cells re-created)."""
     _registry.reset()
     goodput().reset()
+    _materialize_checkpoint_cells()
 
 
 # ---------------------------------------------------------------------------
@@ -665,6 +666,59 @@ CLOCK_OFFSET = gauge(
 CLOCK_ERROR = gauge(
     "hvd_clock_offset_error_seconds",
     "Error bound (half best RTT) on the clock-offset estimate.")
+CHECKPOINT_SECONDS = histogram(
+    "hvd_checkpoint_seconds",
+    "Checkpoint save/restore wall time, by kind (save|restore) and "
+    "recovery rung (durable|peer).", ("kind", "rung"), COMPILE_BUCKETS_S)
+PEER_REPLICATION_BYTES = histogram(
+    "hvd_peer_replication_bytes",
+    "Wire bytes per peer-replica publication (the rank's owned shard "
+    "snapshot shipped on each elastic commit).", (), BYTE_BUCKETS)
+PEER_REPLICATION_SECONDS = histogram(
+    "hvd_peer_replication_seconds",
+    "Wall time per peer-replica publication (encode + fenced KV PUT + "
+    "neighbor pulls).", (), LATENCY_BUCKETS_S)
+PEER_POOL_REPLICAS = gauge(
+    "hvd_peer_pool_replicas",
+    "Replica records currently held in this rank's in-memory peer pool.")
+
+# Materialize the zero cells (the goodput pattern): a job that never
+# checkpointed or replicated still reports the series at 0, so the scrape
+# gate can assert the instruments exist and dashboards can tell "never
+# needed" from "not measuring".
+def _materialize_checkpoint_cells() -> None:
+    for kind in ("save", "restore"):
+        for rung in ("durable", "peer"):
+            CHECKPOINT_SECONDS.labels(kind=kind, rung=rung)
+    PEER_REPLICATION_BYTES.labels()
+    PEER_REPLICATION_SECONDS.labels()
+    PEER_POOL_REPLICAS.labels()
+
+
+_materialize_checkpoint_cells()
+
+
+def checkpoint_summary() -> dict:
+    """Process-local checkpoint/replication ledger for
+    ``profiler.summary()``: save/restore counts + total seconds per rung,
+    plus the peer-replication byte/latency totals."""
+    out: dict = {"rungs": {}, "replication": {}}
+    for sample in CHECKPOINT_SECONDS.dump()["samples"]:
+        labels = sample["labels"]
+        rung = out["rungs"].setdefault(labels["rung"], {})
+        rung[labels["kind"]] = {
+            "count": sample["count"],
+            "total_s": round(sample["sum"], 4),
+        }
+    by = PEER_REPLICATION_BYTES.dump()["samples"]
+    sec = PEER_REPLICATION_SECONDS.dump()["samples"]
+    out["replication"] = {
+        "count": by[0]["count"] if by else 0,
+        "bytes_total": round(by[0]["sum"]) if by else 0,
+        "seconds_total": round(sec[0]["sum"], 4) if sec else 0.0,
+        "pool_replicas": PEER_POOL_REPLICAS.labels().get(),
+    }
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -765,7 +819,7 @@ class EventJournal:
     One record per line::
 
         {"event": "recovery", "generation": 3, "t_wall": ...,
-         "t_mono": ..., "rung": 2, ...}
+         "t_mono": ..., "rung": "rendezvous", ...}
 
     ``t_wall`` is ``time.time()`` (cross-host correlation, survives
     restarts); ``t_mono`` is ``time.monotonic()`` (in-process ordering

@@ -1,0 +1,498 @@
+"""Peer-redundant in-memory checkpoints: the replication plane under the
+recovery ladder's ``peer`` rung.
+
+The ladder's bottom rung — durable-storage restore — costs minutes of
+goodput at pod scale, yet the *common* failure is one preempted host. The
+ZeRO-1 layout (PR 4) makes the fix cheap: each rank owns only ~1/n of the
+optimizer state, and shard ownership is a pure function of the world size
+(``unshard_opt_state`` / ``reshard_opt_state`` are host math), so K peers
+holding a rank's shard replica let the survivors re-materialize a departed
+rank's state **without ever touching storage**. This module is that plane:
+
+1. **Wire format** (:func:`encode_record` / :func:`decode_record`): one
+   self-verifying record per rank per commit — a JSON header (rank, step,
+   generation, world size, payload sha256 — the shared digest from
+   ``checkpoint.payload_digest``) followed by the opaque payload bytes. A
+   torn write, a bit flip, or a half-received body fails verification and
+   is rejected at install time, so no pool slot is ever half-written.
+2. **Replica pool** (:class:`ReplicaPool`): the bounded in-memory store a
+   peer holds replicas in — last good commit per rank plus a ``.prev``
+   slot, rotated through ``checkpoint.rotate_slots`` (the same rotation
+   contract as the durable ``.prev`` file). Records are verified before
+   install; a bad record leaves the previous good one in place.
+3. **Replicator** (:class:`PeerReplicator`): on each elastic commit,
+   publishes the rank's owned-shard snapshot to the generation-fenced
+   ``PUT /peerstate/<rank>`` KV route (a zombie's stale shard bounces off
+   the fence and can never poison the pool) and pulls its K ring
+   neighbors' records (``HOROVOD_PEERCHECK_REPLICAS``) into the local
+   pool. Memory cost of the plane ≈ K/n of the optimizer state per rank.
+4. **Assembly** (:meth:`PeerReplicator.assemble`): the recovery side —
+   collect the newest *complete, checksum-valid, same-generation-lineage*
+   replica set (every rank of the recorded world present at one
+   ``(generation, step)``, each record verifying, the generation an
+   ancestor of the current one). Any gap or mismatch raises
+   :class:`ReplicaUnavailableError`, which the elastic ladder converts
+   into a fall-through to the durable rung.
+
+The elastic integration (shard extraction, ``restore_peer``, the
+``PeerShardedState`` flavor with 1/n shard-local commits) lives in
+``horovod_tpu/elastic/state.py``; the ladder rung itself in
+``elastic/runner.py``. This module is **stdlib-only** (no jax) so the KV
+server — which verifies records at install time on the driver, before any
+framework init — can import it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from . import faults
+from . import metrics as _metrics
+from .utils.env import get_float, get_int
+from .utils.logging import get_logger
+
+#: KV scope replica records publish to (``PUT /peerstate/<rank>``).
+PEERSTATE_SCOPE = "peerstate"
+
+#: Suffix of the retained-previous slot (both pool- and server-side).
+PREV_SUFFIX = ".prev"
+
+_MAGIC = "HVDPEER1"
+
+
+def replica_count() -> int:
+    """K: how many ring-neighbor ranks hold each rank's shard replica."""
+    return max(1, get_int("HOROVOD_PEERCHECK_REPLICAS", 1))
+
+
+def max_record_bytes() -> int:
+    """Server-side backstop on one replica record's wire size."""
+    return get_int("HOROVOD_PEERCHECK_MAX_BYTES", 256 << 20)
+
+
+class ReplicaCorruptError(ValueError):
+    """A replica record failed decoding or checksum verification."""
+
+
+class ReplicaUnavailableError(RuntimeError):
+    """No complete, checksum-valid, same-generation-lineage replica set —
+    the peer rung must fall through to the durable rung."""
+
+
+class ReplicaRecord:
+    """One rank's shard snapshot at one commit, plus its provenance."""
+
+    __slots__ = ("rank", "step", "generation", "world_size", "has_params",
+                 "payload")
+
+    def __init__(self, rank: int, step: int, generation: int,
+                 world_size: int, payload: bytes, has_params: bool = False):
+        self.rank = int(rank)
+        self.step = int(step)
+        self.generation = int(generation)
+        self.world_size = int(world_size)
+        self.has_params = bool(has_params)
+        self.payload = payload
+
+    def group(self) -> tuple[int, int]:
+        """The commit identity records are matched across ranks by."""
+        return (self.generation, self.step)
+
+    def summary(self) -> dict:
+        return {"rank": self.rank, "step": self.step,
+                "generation": self.generation,
+                "world_size": self.world_size,
+                "bytes": len(self.payload)}
+
+
+def encode_record(record: ReplicaRecord) -> bytes:
+    """Wire form: one JSON header line, then the raw payload bytes. The
+    header carries the payload's sha256 (the shared checksum from
+    ``checkpoint.payload_digest``) so any holder — peer pool, KV server,
+    assembling survivor — verifies the same digest."""
+    from .checkpoint import payload_digest
+
+    header = json.dumps({
+        "magic": _MAGIC,
+        "rank": record.rank,
+        "step": record.step,
+        "generation": record.generation,
+        "world_size": record.world_size,
+        "has_params": record.has_params,
+        "sha256": payload_digest(record.payload),
+        "bytes": len(record.payload),
+    }, sort_keys=True).encode()
+    return header + b"\n" + record.payload
+
+
+def decode_record(blob: bytes, verify: bool = True) -> ReplicaRecord:
+    """Parse and (by default) checksum-verify a wire record. Raises
+    :class:`ReplicaCorruptError` on any malformation — a torn header, a
+    short payload, a digest mismatch."""
+    from .checkpoint import payload_digest
+
+    nl = blob.find(b"\n")
+    if nl < 0:
+        raise ReplicaCorruptError("replica record has no header line")
+    try:
+        header = json.loads(blob[:nl])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise ReplicaCorruptError(f"replica header unparseable: {e}") from e
+    if not isinstance(header, dict) or header.get("magic") != _MAGIC:
+        raise ReplicaCorruptError("replica header has no magic")
+    payload = blob[nl + 1:]
+    try:
+        declared = int(header["bytes"])
+        record = ReplicaRecord(
+            rank=header["rank"], step=header["step"],
+            generation=header["generation"],
+            world_size=header["world_size"], payload=payload,
+            has_params=header.get("has_params", False),
+        )
+    except (KeyError, TypeError, ValueError) as e:
+        raise ReplicaCorruptError(f"replica header incomplete: {e}") from e
+    if len(payload) != declared:
+        raise ReplicaCorruptError(
+            f"replica payload truncated: {len(payload)} of {declared} bytes")
+    if verify:
+        if faults.fire(faults.PEER_VERIFY):
+            raise ReplicaCorruptError(
+                "replica checksum mismatch (injected corruption)")
+        if payload_digest(payload) != header["sha256"]:
+            raise ReplicaCorruptError(
+                f"replica payload for rank {record.rank} failed its "
+                "checksum (torn/corrupted write)")
+    return record
+
+
+def verify_wire(blob: bytes) -> str | None:
+    """Install-time gate used by the KV server: None when ``blob`` is a
+    complete, checksum-valid record, else the rejection reason. Never
+    raises — the server must answer, not die."""
+    try:
+        decode_record(blob, verify=True)
+        return None
+    except ReplicaCorruptError as e:
+        return str(e)
+    except Exception as e:  # noqa: BLE001 — any failure is a rejection
+        return f"replica record unreadable: {e}"
+
+
+class ReplicaPool:
+    """Bounded in-memory replica store: last good record per rank plus a
+    ``.prev`` slot, rotated through the shared
+    ``checkpoint.rotate_slots`` helper (the durable file rotation's
+    mapping flavor). Records are verified BEFORE rotation, so a corrupt
+    install attempt leaves both slots untouched."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots: dict[str, ReplicaRecord] = {}
+
+    def install(self, blob_or_record) -> ReplicaRecord:
+        """Verify + rotate one record in. Raises
+        :class:`ReplicaCorruptError` (pool untouched) on a bad record."""
+        from .checkpoint import rotate_slots
+
+        if isinstance(blob_or_record, ReplicaRecord):
+            record = blob_or_record
+        else:
+            record = decode_record(blob_or_record, verify=True)
+        with self._lock:
+            existing = self._slots.get(str(record.rank))
+            if existing is not None and existing.group() == record.group():
+                # Same commit re-offered (neighbor pull after our own
+                # install): keep the slot, don't rotate prev away.
+                return existing
+            rotate_slots(self._slots, str(record.rank), record,
+                         prev_suffix=PREV_SUFFIX)
+            count = len(self._slots)
+        try:
+            _metrics.PEER_POOL_REPLICAS.set(count)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+        return record
+
+    def records(self) -> list[ReplicaRecord]:
+        with self._lock:
+            return list(self._slots.values())
+
+    def get(self, rank: int, prev: bool = False) -> ReplicaRecord | None:
+        key = f"{rank}{PREV_SUFFIX}" if prev else str(rank)
+        with self._lock:
+            return self._slots.get(key)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._slots.clear()
+
+    def summary(self) -> dict:
+        """Flight-recorder view: what this rank's pool holds right now
+        (rides every flight dump, including abort-consume)."""
+        with self._lock:
+            slots = dict(self._slots)
+        return {
+            "replicas": {k: r.summary() for k, r in sorted(slots.items())},
+            "count": len(slots),
+        }
+
+
+def _env_generation() -> int:
+    """The generation replica records are stamped with: the elastic
+    worker context's JOINED generation when one exists (the same source
+    the heartbeat/abort clients fence with), else the launcher env."""
+    from .runner.elastic import worker as elastic_worker
+
+    ctx = elastic_worker._context
+    if ctx is not None:
+        return ctx.joined_version
+    try:
+        return int(os.environ.get("HOROVOD_WORLD_VERSION", "0") or 0)
+    except ValueError:
+        return 0
+
+
+class PeerReplicator:
+    """The per-rank replication agent: publish-own-shard on commit, hold
+    K neighbors' replicas in memory, assemble complete sets on recovery.
+
+    ``client`` is anything with the ``KVClient`` surface (``put`` /
+    ``get`` / ``keys``); by default a dedicated short-timeout
+    generation-fenced client is built from the launcher env (the
+    replication PUT rides the commit path and must never inherit the fat
+    KV retry budget). ``rank`` / ``world_size_fn`` are injectable for
+    single-controller tests; elastic workers derive both from the env
+    contract.
+    """
+
+    def __init__(self, client=None, k: int | None = None,
+                 rank: int | None = None,
+                 world_size_fn: Callable[[], int] | None = None,
+                 generation_fn: Callable[[], int] | None = None):
+        self._client = client
+        self._k = k
+        self._rank = rank
+        self._world_size_fn = world_size_fn
+        self._generation_fn = generation_fn or _env_generation
+        self.pool = ReplicaPool()
+        self._log = get_logger()
+        global _active
+        _active = self
+
+    # -- world facts ---------------------------------------------------------
+
+    @property
+    def rank(self) -> int:
+        if self._rank is not None:
+            return self._rank
+        return int(os.environ.get("HOROVOD_RANK", "0") or 0)
+
+    def world_size(self) -> int:
+        if self._world_size_fn is not None:
+            return int(self._world_size_fn())
+        return int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
+
+    @property
+    def k(self) -> int:
+        return self._k if self._k is not None else replica_count()
+
+    def generation(self) -> int:
+        return int(self._generation_fn())
+
+    def client(self):
+        if self._client is None:
+            addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+            port = os.environ.get("HOROVOD_RENDEZVOUS_PORT", "")
+            if not addr or not port:
+                return None
+            from .runner.http.kv_server import KVClient
+
+            self._client = KVClient(
+                addr, int(port),
+                timeout=get_float("HOROVOD_PEERCHECK_TIMEOUT", 5.0),
+                retries=1, generation_fn=self._generation_fn)
+        return self._client
+
+    # -- publish (the commit hook) -------------------------------------------
+
+    def replicate(self, payload: bytes, step: int,
+                  has_params: bool = False) -> bool:
+        """Publish this rank's shard snapshot for one commit and refresh
+        the local pool with the K ring neighbors' records. Best-effort by
+        contract: a replication failure degrades the peer rung (recovery
+        falls through to durable), it never takes down training. Returns
+        True when the record landed on the KV."""
+        t0 = time.perf_counter()
+        record = ReplicaRecord(
+            rank=self.rank, step=step, generation=self.generation(),
+            world_size=self.world_size(), payload=payload,
+            has_params=has_params)
+        blob = encode_record(record)
+        shipped = False
+        try:
+            if faults.fire(faults.PEER_REPLICATE):
+                raise faults.InjectedFault(
+                    f"peer replication dropped: rank {record.rank} "
+                    f"step {step}")
+            client = self.client()
+            if client is not None:
+                client.put(PEERSTATE_SCOPE, str(record.rank), blob)
+                shipped = True
+            self.pool.install(record)
+            self._pull_neighbors(client)
+        except Exception as e:  # noqa: BLE001 — replication is best-effort
+            self._log.warning(
+                "peercheck: replication of step %d failed (%s); the peer "
+                "recovery rung degrades to durable until the next commit",
+                step, e)
+        dt = time.perf_counter() - t0
+        try:
+            _metrics.PEER_REPLICATION_BYTES.observe(len(blob))
+            _metrics.PEER_REPLICATION_SECONDS.observe(dt)
+            _metrics.CHECKPOINT_SECONDS.observe(dt, kind="save", rung="peer")
+            _metrics.event(
+                "peer_replicate", generation=record.generation,
+                rank=record.rank, step=step, bytes=len(blob),
+                world_size=record.world_size, shipped=shipped)
+        except Exception:  # noqa: BLE001
+            pass
+        return shipped
+
+    def _pull_neighbors(self, client) -> None:
+        """Hold the K ring predecessors' records in this rank's in-memory
+        pool (replica placement: rank r's shard lives on ranks r+1..r+K
+        mod n — every single-host failure leaves K live holders)."""
+        if client is None:
+            return
+        n = self.world_size()
+        if n <= 1:
+            return
+        me = self.rank
+        for i in range(1, min(self.k, n - 1) + 1):
+            neighbor = (me - i) % n  # we HOLD our predecessors' shards
+            try:
+                blob = client.get(PEERSTATE_SCOPE, str(neighbor))
+                if blob is not None:
+                    self.pool.install(blob)
+            except Exception as e:  # noqa: BLE001 — best-effort
+                self._log.debug(
+                    "peercheck: neighbor %d pull failed: %s", neighbor, e)
+
+    # -- assemble (the recovery side) ----------------------------------------
+
+    def fetch_all(self) -> list[ReplicaRecord]:
+        """Every decodable record visible to this rank: the local pool
+        plus the KV's ``peerstate`` scope (current + ``.prev`` slots).
+        Corrupt records are dropped here; completeness is judged in
+        :meth:`assemble`."""
+        records: list[ReplicaRecord] = list(self.pool.records())
+        client = self.client()
+        if client is not None:
+            try:
+                keys = client.keys(PEERSTATE_SCOPE)
+            except Exception as e:  # noqa: BLE001
+                self._log.warning(
+                    "peercheck: cannot list the peerstate scope (%s)", e)
+                keys = []
+            for key in keys:
+                try:
+                    blob = client.get(PEERSTATE_SCOPE, key)
+                    if blob is not None:
+                        records.append(decode_record(blob, verify=True))
+                except ReplicaCorruptError as e:
+                    self._log.error(
+                        "peercheck: replica %r failed verification: %s",
+                        key, e)
+                except Exception as e:  # noqa: BLE001
+                    self._log.debug(
+                        "peercheck: replica %r fetch failed: %s", key, e)
+        return records
+
+    def latest_step(self, before_generation: int) -> int:
+        """The highest commit step recorded by any PRIOR generation
+        (``record.generation < before_generation``) — the world-synced
+        baseline ranks re-align their commit counters to at every world
+        formation. Restricting to prior generations makes the read
+        race-free: the server's fence rejects further writes from them
+        the moment the generation bumps, so every rank of the new
+        generation computes the same maximum no matter how the formation
+        interleaves with peers' first commits. Returns 0 when nothing
+        qualifies (fresh job, or a stall-only re-join of the SAME
+        generation — where every survivor's counter is already
+        aligned)."""
+        steps = [r.step for r in self.fetch_all()
+                 if r.generation < before_generation]
+        return max(steps, default=0)
+
+    def assemble(self,
+                 current_generation: int | None = None
+                 ) -> list[ReplicaRecord]:
+        """The newest complete, checksum-valid, same-generation-lineage
+        replica set: for some ``(generation, step)`` with ``generation``
+        an ancestor of (≤) the current generation, one verified record
+        per rank of that commit's world, all agreeing on the world size.
+        Returns the records sorted by rank; raises
+        :class:`ReplicaUnavailableError` with the gap/mismatch detail
+        otherwise (the ladder's cue to fall through to durable)."""
+        if current_generation is None:
+            current_generation = self.generation()
+        groups: dict[tuple[int, int], dict[int, ReplicaRecord]] = {}
+        for record in self.fetch_all():
+            if record.generation > current_generation:
+                continue  # not our lineage: a fenced-off future/foreign gen
+            slot = groups.setdefault(record.group(), {})
+            held = slot.get(record.rank)
+            if held is None or len(record.payload) >= len(held.payload):
+                slot[record.rank] = record
+        if not groups:
+            raise ReplicaUnavailableError(
+                "no replica records visible (pool empty, peerstate scope "
+                "empty or unreachable)")
+        reasons: list[str] = []
+        for group_key in sorted(groups, reverse=True):
+            generation, step = group_key
+            members = groups[group_key]
+            sizes = {r.world_size for r in members.values()}
+            if len(sizes) != 1:
+                reasons.append(
+                    f"(gen {generation}, step {step}): inconsistent world "
+                    f"sizes {sorted(sizes)}")
+                continue
+            world = sizes.pop()
+            missing = sorted(set(range(world)) - set(members))
+            if missing:
+                reasons.append(
+                    f"(gen {generation}, step {step}): missing ranks "
+                    f"{missing} of {world}")
+                continue
+            return [members[r] for r in range(world)]
+        raise ReplicaUnavailableError(
+            "no complete replica set: " + "; ".join(reasons))
+
+
+_active: PeerReplicator | None = None
+
+
+def active_replicator() -> PeerReplicator | None:
+    """The process's most recently constructed replicator (the flight
+    recorder reads the pool state through this)."""
+    return _active
+
+
+def pool_summary() -> Mapping[str, Any] | None:
+    """Replica-pool state for flight-record dumps, or None when no
+    replicator exists in this process. Never raises."""
+    try:
+        rep = active_replicator()
+        return None if rep is None else rep.pool.summary()
+    except Exception:  # noqa: BLE001 — postmortems are best-effort
+        return None
+
+
+def reset_for_testing() -> None:
+    global _active
+    _active = None

@@ -84,6 +84,7 @@ def summary() -> dict:
         "trace_active": active(),
         "trace_logdir": _active_logdir,
         "goodput": metrics.goodput().summary(),
+        "checkpoint": metrics.checkpoint_summary(),
         "stragglers": tracing.straggler_summary(),
         **cache_stats(),
     }

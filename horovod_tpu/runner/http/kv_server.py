@@ -75,7 +75,9 @@ from urllib.request import Request, urlopen
 
 from ... import faults
 from ... import metrics as _metrics
+from ... import peercheck as _peercheck
 from ... import tracing as _tracing
+from ...checkpoint import rotate_slots
 from ...utils.env import get_float, get_int
 from ...utils.retry import call_with_retries
 from .. import secret as _secret
@@ -95,6 +97,17 @@ ABORT_SCOPE = "abort"
 # Tracing scope: workers PUT /trace/<host> with sampled step spans + their
 # measured clock offset; one payload per host (replaced on each ship).
 TRACE_SCOPE = _tracing.TRACE_SCOPE
+
+# Peer-replication scope: each elastic rank PUTs its owned-shard replica
+# record to /peerstate/<rank> on every commit (generation-fenced like all
+# worker writes). Records are checksum-verified at install time — a torn
+# body from a SIGKILL mid-PUT is rejected with 422 and the previous good
+# record survives — and rotated (<rank> + <rank>.prev) through the same
+# helper as the durable checkpoint's .prev file, so the replica pool is
+# never left half-written. The scope deliberately SURVIVES epoch
+# publication: the replica set of generation g is exactly what the peer
+# recovery rung of generation g+1 assembles (horovod_tpu/peercheck.py).
+PEERSTATE_SCOPE = _peercheck.PEERSTATE_SCOPE
 
 # Payload bound for /trace PUTs: the worker caps spans/steps at the
 # source; this is the server-side backstop against a misbehaving client.
@@ -193,31 +206,54 @@ class _KVHandler(BaseHTTPRequestHandler):
                     f"(world at generation {current})").encode()
         return None
 
+    def _drain_and_413(self, length: int, reason: bytes):
+        """Reject an oversize body WITHOUT buffering it: the backstop
+        must bound server memory, not just storage — the whole control
+        plane rides this one process. The body is drained in small
+        chunks and discarded (so the client reads a clean 413 instead of
+        a connection reset mid-upload), never held whole."""
+        remaining = length
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 16))
+            if not chunk:
+                break
+            remaining -= len(chunk)
+        return self._reply(413, reason)
+
     def do_PUT(self):  # noqa: N802
         scope, key = self._split()
         if key is None:
             return self._reply(400, b"missing key")
         length = int(self.headers.get("Content-Length", 0))
+        if (scope == PEERSTATE_SCOPE
+                and length > _peercheck.max_record_bytes()):
+            return self._drain_and_413(length, b"replica record too large")
         if scope == TRACE_SCOPE and length > _TRACE_MAX_BYTES:
-            # Reject WITHOUT buffering: the backstop must bound server
-            # memory, not just storage — the whole control plane rides
-            # this one process. The body is drained in small chunks and
-            # discarded (so the client reads a clean 413 instead of a
-            # connection reset mid-upload), never held whole.
-            remaining = length
-            while remaining > 0:
-                chunk = self.rfile.read(min(remaining, 1 << 16))
-                if not chunk:
-                    break
-                remaining -= len(chunk)
-            return self._reply(413, b"trace payload too large")
+            return self._drain_and_413(length, b"trace payload too large")
         body = self.rfile.read(length)
         if not self._authenticate(body):
             return
+        if scope == PEERSTATE_SCOPE:
+            # Install-time integrity gate: a half-received body (SIGKILL
+            # mid-PUT, cut connection) or a corrupt record is rejected
+            # BEFORE it can touch the pool — the previous good replica
+            # (and its .prev) stay authoritative.
+            why = _peercheck.verify_wire(body)
+            if why is not None:
+                return self._reply(422, why.encode())
         with self.server.lock:  # type: ignore[attr-defined]
             rejected = self._fence_check_locked()
             if rejected is None:
-                self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
+                if scope == PEERSTATE_SCOPE:
+                    # Rotate, don't overwrite: <rank> + <rank>.prev, via
+                    # the same helper as the durable .prev file — the
+                    # previous good commit survives until this one is
+                    # verified and installed.
+                    rotate_slots(
+                        self.server.store.setdefault(scope, {}),  # type: ignore[attr-defined]
+                        key, body, prev_suffix=_peercheck.PREV_SUFFIX)
+                else:
+                    self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
                 if scope == HEARTBEAT_SCOPE:
                     # Liveness plane: stamp the receive time on the SERVER
                     # clock (driver-side monotonic; worker clocks

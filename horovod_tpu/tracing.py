@@ -558,6 +558,17 @@ def dump_flight_record(reason: str, generation: int | None = None,
         from . import metrics
 
         snap = get_tracer().flight_snapshot()
+        # Replica-pool state rides every dump (abort-consume included):
+        # which ranks' shards this process holds, at which step and
+        # generation — the first question after a peer-rung recovery.
+        try:
+            from . import peercheck
+
+            pool = peercheck.pool_summary()
+            if pool is not None:
+                snap["peer_pool"] = pool
+        except Exception:  # noqa: BLE001 — the dump must still land
+            pass
         metrics.FLIGHT_DUMPS.inc(reason=reason)
         metrics.event(
             "flight_record", generation=generation, reason=reason,

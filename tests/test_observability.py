@@ -778,11 +778,11 @@ class TestLifecycleJournal:
         gens = [r["generation"] for r in records]
         assert gens == sorted(gens)
         rec = [r for r in records if r["event"] == "recovery"][0]
-        assert rec["rung"] == 1 and rec["failures"] == 1
+        assert rec["rung"] == "restore" and rec["failures"] == 1
         # The abort flowed through the counters too.
         snap = {f["name"]: f for f in hvd.metrics.snapshot()}
         assert snap["hvd_abort_consumed_total"]["samples"][0]["value"] >= 1
-        assert any(s["labels"] == {"rung": "1"}
+        assert any(s["labels"] == {"rung": "restore"}
                    for s in snap["hvd_recoveries_total"]["samples"])
 
     def test_journal_disabled_without_env(self, monkeypatch):

@@ -1,0 +1,870 @@
+"""Peer-redundant in-memory checkpoints: the replication plane + the
+recovery ladder's ``peer`` rung.
+
+Proven here, bottom up:
+
+- the self-verifying wire format and the bounded replica pool (rotation
+  through the shared ``checkpoint.rotate_slots`` helper; a corrupt record
+  can never displace a good one);
+- the generation-fenced ``PUT /peerstate/<rank>`` KV route with
+  install-time verification (a torn body — SIGKILL mid-PUT — answers 422
+  and the previous good record survives);
+- replica-set assembly: completeness, checksum validity, generation
+  lineage, ``.prev``-slot completion of a commit wave;
+- ``PeerShardedState``: 1/n shard-local commits, dirty-after-restore,
+  byte-exact peer re-materialization through
+  ``unshard_opt_state``/``reshard_opt_state``;
+- the ladder: rung order restore → rendezvous → peer → durable, the
+  pending-state jump, and the gap/corruption fall-through to durable;
+- end to end with the real ``ElasticDriver``: SIGKILL one worker
+  mid-training → the world re-forms at g+1 and the survivor restores
+  from the peer rung with ZERO durable-storage reads, loss continuity
+  asserted against the exact expected trajectory; corrupting the
+  replicas makes the same scenario fall through to the durable rung
+  instead of crashing.
+"""
+
+import json
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from horovod_tpu import abort, faults, peercheck
+from horovod_tpu.exceptions import HorovodInternalError
+from horovod_tpu.optimizer import ReduceSpec, init_sharded_state
+from horovod_tpu.runner.http.kv_server import KVClient, RendezvousServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HARD_TIMEOUT_S = float(os.environ.get("HOROVOD_TEST_HARD_TIMEOUT", "300"))
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    import faulthandler
+
+    faulthandler.dump_traceback_later(HARD_TIMEOUT_S, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    faults.reset()
+    abort.reset()
+    peercheck.reset_for_testing()
+    yield
+    faults.reset()
+    abort.reset()
+    peercheck.reset_for_testing()
+
+
+@pytest.fixture()
+def kv_server():
+    server = RendezvousServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+def _record(rank, step=1, generation=0, world=2, payload=b"shard-bytes",
+            has_params=False):
+    return peercheck.encode_record(peercheck.ReplicaRecord(
+        rank=rank, step=step, generation=generation, world_size=world,
+        payload=payload, has_params=has_params))
+
+
+def _sgd_spec():
+    return ReduceSpec(
+        inner=optax.sgd(0.1, momentum=0.9), op="average", compression=None,
+        prescale_factor=1.0, postscale_factor=1.0, process_set=None,
+        num_groups=0, fusion_threshold_bytes=None,
+        backward_passes_per_step=1, sync_mode="sharded")
+
+
+# -- wire format --------------------------------------------------------------
+
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        blob = _record(3, step=7, generation=2, world=4, payload=b"\x00\xff",
+                       has_params=True)
+        rec = peercheck.decode_record(blob)
+        assert (rec.rank, rec.step, rec.generation, rec.world_size,
+                rec.payload, rec.has_params) == (3, 7, 2, 4, b"\x00\xff",
+                                                 True)
+        assert peercheck.verify_wire(blob) is None
+
+    def test_corrupt_payload_rejected(self):
+        blob = bytearray(_record(0, payload=b"aaaaaaaa"))
+        blob[-3] ^= 0xFF  # bit-rot inside the payload
+        with pytest.raises(peercheck.ReplicaCorruptError, match="checksum"):
+            peercheck.decode_record(bytes(blob))
+        assert "checksum" in peercheck.verify_wire(bytes(blob))
+
+    def test_truncated_payload_rejected(self):
+        blob = _record(0, payload=b"a" * 100)
+        assert "truncated" in peercheck.verify_wire(blob[:-40])
+
+    def test_torn_header_rejected(self):
+        assert peercheck.verify_wire(b"garbage with no newline") is not None
+        assert peercheck.verify_wire(b"{not json}\npayload") is not None
+        assert peercheck.verify_wire(b'{"magic": "nope"}\nx') is not None
+
+    def test_verify_injection_point(self):
+        blob = _record(0)
+        faults.inject(faults.PEER_VERIFY, "drop", at=1, count=1)
+        with pytest.raises(peercheck.ReplicaCorruptError, match="injected"):
+            peercheck.decode_record(blob)
+        assert peercheck.decode_record(blob).rank == 0  # window passed
+
+
+# -- the replica pool ---------------------------------------------------------
+
+
+class TestReplicaPool:
+    def test_install_rotates_prev(self):
+        pool = peercheck.ReplicaPool()
+        pool.install(_record(1, step=1))
+        pool.install(_record(1, step=2))
+        assert pool.get(1).step == 2
+        assert pool.get(1, prev=True).step == 1
+
+    def test_corrupt_install_leaves_pool_untouched(self):
+        pool = peercheck.ReplicaPool()
+        pool.install(_record(1, step=1))
+        bad = bytearray(_record(1, step=2))
+        bad[-1] ^= 0xFF
+        with pytest.raises(peercheck.ReplicaCorruptError):
+            pool.install(bytes(bad))
+        assert pool.get(1).step == 1          # still the good record
+        assert pool.get(1, prev=True) is None  # and prev never rotated
+
+    def test_same_commit_reoffered_does_not_rotate(self):
+        pool = peercheck.ReplicaPool()
+        pool.install(_record(2, step=5))
+        pool.install(_record(2, step=5))  # neighbor pull after own install
+        assert pool.get(2).step == 5
+        assert pool.get(2, prev=True) is None
+
+    def test_summary_shape(self):
+        pool = peercheck.ReplicaPool()
+        pool.install(_record(0, step=3, generation=1))
+        s = pool.summary()
+        assert s["count"] == 1
+        assert s["replicas"]["0"]["step"] == 3
+        assert s["replicas"]["0"]["generation"] == 1
+
+
+# -- the KV route -------------------------------------------------------------
+
+
+class TestPeerstateRoute:
+    def test_put_get_and_server_side_rotation(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=1))
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=2))
+        cur = peercheck.decode_record(
+            client.get(peercheck.PEERSTATE_SCOPE, "0"))
+        prev = peercheck.decode_record(
+            client.get(peercheck.PEERSTATE_SCOPE, "0.prev"))
+        assert (cur.step, prev.step) == (2, 1)
+
+    def test_corrupt_record_rejected_422_good_one_survives(self, kv_server):
+        from urllib.error import HTTPError
+
+        client = KVClient("127.0.0.1", kv_server.port)
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=1))
+        bad = bytearray(_record(0, step=2))
+        bad[-1] ^= 0xFF
+        with pytest.raises(HTTPError) as err:
+            client.put(peercheck.PEERSTATE_SCOPE, "0", bytes(bad))
+        assert err.value.code == 422
+        assert peercheck.decode_record(
+            client.get(peercheck.PEERSTATE_SCOPE, "0")).step == 1
+        assert client.get(peercheck.PEERSTATE_SCOPE, "0.prev") is None
+
+    def test_stale_generation_replica_fenced(self, kv_server):
+        """A resumed zombie's stale shard can never poison the pool: its
+        pre-abort-generation PUT bounces off the 409 fence."""
+        from urllib.error import HTTPError
+
+        kv_server.reset()  # the world moved to generation 1
+        zombie = KVClient("127.0.0.1", kv_server.port,
+                          generation_fn=lambda: 0)
+        with pytest.raises(HTTPError) as err:
+            zombie.put(peercheck.PEERSTATE_SCOPE, "0",
+                       _record(0, step=99, generation=0))
+        assert err.value.code == 409
+        assert kv_server.fenced_writes == 1
+
+    def test_oversize_record_rejected_413(self, kv_server, monkeypatch):
+        from urllib.error import HTTPError
+
+        monkeypatch.setenv("HOROVOD_PEERCHECK_MAX_BYTES", "1024")
+        client = KVClient("127.0.0.1", kv_server.port)
+        with pytest.raises(HTTPError) as err:
+            client.put(peercheck.PEERSTATE_SCOPE, "0",
+                       _record(0, payload=b"x" * 4096))
+        assert err.value.code == 413
+
+
+# -- assembly -----------------------------------------------------------------
+
+
+class TestAssembly:
+    def _replicator(self, kv_server, rank=0, world=2, generation=0):
+        return peercheck.PeerReplicator(
+            client=KVClient("127.0.0.1", kv_server.port), rank=rank,
+            world_size_fn=lambda: world, generation_fn=lambda: generation)
+
+    def test_complete_set_assembles_sorted(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        for r in (1, 0):
+            client.put(peercheck.PEERSTATE_SCOPE, str(r),
+                       _record(r, step=4, world=2))
+        records = self._replicator(kv_server).assemble()
+        assert [r.rank for r in records] == [0, 1]
+        assert all(r.step == 4 for r in records)
+
+    def test_missing_rank_is_unavailable(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=4,
+                                                           world=3))
+        client.put(peercheck.PEERSTATE_SCOPE, "2", _record(2, step=4,
+                                                           world=3))
+        with pytest.raises(peercheck.ReplicaUnavailableError,
+                           match=r"missing ranks \[1\]"):
+            self._replicator(kv_server, world=3).assemble()
+
+    def test_commit_wave_completes_from_prev_slot(self, kv_server):
+        """Ranks commit in a wave: rank 0 already at step 5, rank 1 still
+        at step 4 — the newest COMPLETE set is step 4, completed by rank
+        0's rotated .prev record."""
+        client = KVClient("127.0.0.1", kv_server.port)
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=4))
+        client.put(peercheck.PEERSTATE_SCOPE, "1", _record(1, step=4))
+        client.put(peercheck.PEERSTATE_SCOPE, "0", _record(0, step=5))
+        records = self._replicator(kv_server).assemble()
+        assert all(r.step == 4 for r in records)
+
+    def test_future_generation_excluded_from_lineage(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        for r in (0, 1):
+            client.put(peercheck.PEERSTATE_SCOPE, str(r),
+                       _record(r, step=9, generation=5))
+        with pytest.raises(peercheck.ReplicaUnavailableError):
+            self._replicator(kv_server, generation=3).assemble()
+        # The same records ARE the lineage once the observer reaches g>=5.
+        records = self._replicator(kv_server, generation=6).assemble()
+        assert all(r.generation == 5 for r in records)
+
+    def test_corrupt_member_drops_group(self, kv_server):
+        """One corrupt replica (bit rot AFTER install) breaks its set:
+        with no older complete set, assembly is unavailable — the ladder's
+        durable fall-through."""
+        client = KVClient("127.0.0.1", kv_server.port)
+        for r in (0, 1):
+            client.put(peercheck.PEERSTATE_SCOPE, str(r), _record(r, step=4))
+        with kv_server._httpd.lock:
+            store = kv_server._httpd.store[peercheck.PEERSTATE_SCOPE]
+            store["1"] = store["1"][:-1] + bytes(
+                [store["1"][-1] ^ 0xFF])
+        with pytest.raises(peercheck.ReplicaUnavailableError):
+            self._replicator(kv_server).assemble()
+
+    def test_replicate_populates_pool_and_kv(self, kv_server):
+        rep = self._replicator(kv_server, rank=1, world=2)
+        other = self._replicator(kv_server, rank=0, world=2)
+        assert other.replicate(b"rank0-shard", step=1, has_params=True)
+        assert rep.replicate(b"rank1-shard", step=1)
+        # K=1 ring: rank 1 now holds its predecessor's (rank 0's) replica.
+        assert rep.pool.get(0) is not None
+        assert rep.pool.get(0).has_params
+        records = rep.assemble()
+        assert [r.payload for r in records] == [b"rank0-shard",
+                                                b"rank1-shard"]
+
+    def test_replicate_injection_degrades_gracefully(self, kv_server):
+        rep = self._replicator(kv_server, rank=0, world=1)
+        faults.inject(faults.PEER_REPLICATE, "drop", at=1, count=1)
+        assert rep.replicate(b"dropped", step=1) is False  # never raises
+        assert rep.replicate(b"landed", step=2) is True
+
+
+# -- PeerShardedState ---------------------------------------------------------
+
+
+def _build_states(kv_server, n=4, epoch=7, genbox=None):
+    """n single-controller PeerShardedStates sharing one KV — the
+    in-process stand-in for n elastic ranks. ``genbox`` (a one-element
+    list) lets a test advance the generation every replicator stamps."""
+    from horovod_tpu.elastic import PeerShardedState
+
+    if genbox is None:
+        genbox = [0]
+    spec = _sgd_spec()
+    params = {"w": np.arange(10, dtype=np.float32), "b": np.float32(3.0)}
+    stacked = init_sharded_state(spec, params, world_size=n)
+    # Distinct momentum bits per element: zeros would hide row mixups.
+    stacked = jax.tree.map(
+        lambda l: np.asarray(l) + np.arange(
+            np.asarray(l).size, dtype=np.asarray(l).dtype
+        ).reshape(np.shape(l)), stacked)
+    states = []
+    for r in range(n):
+        rep = peercheck.PeerReplicator(
+            client=KVClient("127.0.0.1", kv_server.port), rank=r,
+            world_size_fn=lambda: n, generation_fn=lambda: genbox[0])
+        states.append(PeerShardedState(
+            params=params, opt_state=stacked, sharded_optimizer=spec,
+            replicator=rep, rank=r, world_size=n, epoch=epoch))
+    return spec, params, stacked, states
+
+
+class TestPeerShardedState:
+    def test_commit_is_shard_local(self, hvd, kv_server):
+        _, _, stacked, states = _build_states(kv_server, n=4)
+        st = states[2]
+        saved = st._saved
+        assert saved["layout"] == "row"
+        row = jax.tree.leaves(saved["row"])[0]
+        want = np.asarray(jax.tree.leaves(stacked)[0])[2]
+        np.testing.assert_array_equal(np.asarray(row), want)
+        # The snapshot holds ~1/n of the state, not the full stack.
+        assert np.asarray(row).size * 4 == np.asarray(
+            jax.tree.leaves(stacked)[0]).size
+
+    def test_restore_marks_peer_pending_and_sync_refuses(self, hvd,
+                                                         kv_server):
+        _, _, _, states = _build_states(kv_server, n=2)
+        st = states[1]
+        assert not st.peer_restore_pending()
+        st.restore()
+        assert st.peer_restore_pending() and st.needs_world_sync()
+        with pytest.raises(HorovodInternalError, match="peer"):
+            st.sync()
+
+    def test_peer_restore_is_byte_exact(self, hvd, kv_server):
+        from horovod_tpu.optimizer import unshard_opt_state
+
+        spec, params, stacked, states = _build_states(kv_server, n=4)
+        st = states[1]
+        st.epoch = 99  # diverged live value; replicas hold the commit
+        st.restore()
+        assert st.restore_peer() is True
+        want = jax.tree.map(np.asarray,
+                            unshard_opt_state(spec, stacked, params))
+        got = jax.tree.map(np.asarray, st.opt_state)
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(a, b)
+        assert st.epoch == 7          # extras came from the replica set
+        assert not st.peer_restore_pending()
+        st.sync()                     # re-shards for the (override) world
+        assert np.shape(jax.tree.leaves(st.opt_state)[0])[0] == 4
+
+    def test_gap_falls_through_as_unavailable(self, hvd, kv_server):
+        _, _, _, states = _build_states(kv_server, n=3)
+        with kv_server._httpd.lock:
+            kv_server._httpd.store[peercheck.PEERSTATE_SCOPE].pop("0")
+        st = states[2]
+        st._replicator.pool.clear()
+        st.restore()
+        with pytest.raises(peercheck.ReplicaUnavailableError):
+            st.restore_peer()
+
+    def test_replacement_rank_realigns_commit_counter(self, hvd,
+                                                      kv_server):
+        """Replica sets are matched by (generation, step): a replacement
+        rank joining after a membership change starts with a fresh
+        counter and must re-align to the survivors' world-synced
+        baseline at sync(), or no complete set would ever form again —
+        the peer rung silently dying after its first real use."""
+        from horovod_tpu.elastic import PeerShardedState
+
+        genbox = [0]
+        spec, params, _, states = _build_states(kv_server, n=2,
+                                                genbox=genbox)
+        for st in states:
+            st.epoch += 1
+            st.commit()  # both ranks now at commit step 2, generation 0
+        # A host is replaced: the driver bumps the epoch (store kept —
+        # publish, not reset) and the new world joins at generation 1.
+        kv_server.publish_epoch("world", {})
+        genbox[0] = 1
+        replacement = PeerShardedState(
+            params=params,
+            opt_state=init_sharded_state(spec, params, world_size=2),
+            sharded_optimizer=spec,
+            replicator=peercheck.PeerReplicator(
+                client=KVClient("127.0.0.1", kv_server.port), rank=0,
+                world_size_fn=lambda: 2,
+                generation_fn=lambda: genbox[0]),
+            rank=0, world_size=2, epoch=0)
+        survivor = states[1]
+        # Formation order must not matter: prior-generation records are
+        # frozen by the fence, so both compute the same baseline.
+        replacement.sync()
+        survivor.sync()
+        records = survivor._replicator.assemble()
+        assert [r.rank for r in records] == [0, 1]
+        assert all(r.generation == 1 for r in records)
+        # Baseline = survivors' last prior-gen step (2) + this commit.
+        assert {r.step for r in records} == {3}, records
+
+    def test_commit_journal_and_instruments(self, hvd, kv_server,
+                                            monkeypatch, tmp_path):
+        jpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(jpath))
+        _, _, _, states = _build_states(kv_server, n=2)
+        states[0].epoch = 8
+        states[0].commit()
+        events = [json.loads(l) for l in jpath.read_text().splitlines()]
+        reps = [e for e in events if e["event"] == "peer_replicate"]
+        assert reps and reps[-1]["rank"] == 0 and reps[-1]["shipped"]
+        from horovod_tpu import metrics
+
+        summ = metrics.checkpoint_summary()
+        assert summ["replication"]["count"] >= 1
+        assert summ["replication"]["bytes_total"] > 0
+        assert summ["rungs"]["peer"]["save"]["count"] >= 1
+
+
+# -- the recovery ladder ------------------------------------------------------
+
+
+class TestLadderPeerRung:
+    def test_peer_rung_sits_between_sync_and_durable(self, hvd,
+                                                     monkeypatch):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        calls = []
+        state = ObjectState(step=0)
+        state.register_peer_restore(lambda: calls.append("peer"))
+        state.register_durable_restore(lambda: calls.append("durable"))
+        failures = []
+
+        @elastic_run
+        def train(st):
+            if len(failures) < 3:
+                failures.append(1)
+                raise HorovodInternalError("boom")
+            return "recovered"
+
+        assert train(state) == "recovered"
+        # restore (f1), rendezvous (f2), PEER (f3) — durable never ran.
+        assert calls == ["peer"]
+
+    def test_peer_failure_falls_through_to_durable_same_attempt(
+            self, hvd, monkeypatch, tmp_path):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        jpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(jpath))
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        calls = []
+        state = ObjectState(step=0)
+
+        def broken_peer():
+            calls.append("peer")
+            raise peercheck.ReplicaUnavailableError("replica gap")
+
+        state.register_peer_restore(broken_peer)
+        state.register_durable_restore(lambda: calls.append("durable"))
+        failures = []
+
+        @elastic_run
+        def train(st):
+            if len(failures) < 3:
+                failures.append(1)
+                raise HorovodInternalError("boom")
+            return "recovered"
+
+        assert train(state) == "recovered"
+        # The gap fell through to durable INSIDE the same attempt.
+        assert calls == ["peer", "durable"]
+        events = [json.loads(l) for l in jpath.read_text().splitlines()]
+        rungs = [e["rung"] for e in events if e["event"] == "recovery"]
+        assert rungs == ["restore", "rendezvous", "peer"]
+        assert any(e["event"] == "peer_fallback" for e in events)
+
+    def test_unarmed_peer_skips_to_durable(self, hvd, monkeypatch,
+                                           tmp_path):
+        from horovod_tpu.elastic import ObjectState
+        from horovod_tpu.elastic import run as elastic_run
+
+        jpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(jpath))
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        calls = []
+        state = ObjectState(step=0)
+        state.register_durable_restore(lambda: calls.append("durable"))
+        failures = []
+
+        @elastic_run
+        def train(st):
+            if len(failures) < 3:
+                failures.append(1)
+                raise HorovodInternalError("boom")
+            return "recovered"
+
+        assert train(state) == "recovered"
+        assert calls == ["durable"]  # rung order preserved, no extra lap
+        events = [json.loads(l) for l in jpath.read_text().splitlines()]
+        rungs = [e["rung"] for e in events if e["event"] == "recovery"]
+        assert rungs == ["restore", "rendezvous", "durable"]
+
+    def test_pending_state_jumps_to_peer_at_second_failure(
+            self, hvd, kv_server, monkeypatch, tmp_path):
+        """A shard-local state that KNOWS its snapshot cannot re-form the
+        world escalates straight from restore to the peer rung — the
+        single-host-preemption recovery is one failed attempt, not
+        three."""
+        from horovod_tpu.elastic import run as elastic_run
+
+        jpath = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(jpath))
+        monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.1")
+        _, _, _, states = _build_states(kv_server, n=2)
+        state = states[1]
+        failures = []
+
+        @elastic_run
+        def train(st):
+            if not failures:
+                failures.append(1)
+                raise HorovodInternalError("peer host died")
+            return st.epoch
+
+        assert train(state) == 7
+        events = [json.loads(l) for l in jpath.read_text().splitlines()]
+        rungs = [e["rung"] for e in events if e["event"] == "recovery"]
+        # f1: restore (marks dirty); f2: sync refuses -> JUMP to peer.
+        assert rungs == ["restore", "peer"]
+        assert any(e["event"] == "peer_restore" for e in events)
+        assert any(e["event"] == "flight_record"
+                   and e.get("reason") == "peer_restore"
+                   and "peer_pool" in e for e in events)
+
+
+# -- SIGKILL during commit ----------------------------------------------------
+
+
+class TestSigkillDuringCommit:
+    def test_torn_put_never_half_writes_the_pool(self, kv_server,
+                                                 tmp_path):
+        """The chaos-lane guarantee: a worker SIGKILLed mid-PUT (its
+        replica body half-sent) cannot leave the pool half-written — the
+        server's install-time verification rejects the torn body and the
+        previous good record (current AND .prev) survives intact."""
+        script = tmp_path / "torn_commit.py"
+        script.write_text(f"""
+import os, signal, socket, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu import peercheck
+from horovod_tpu.runner.http.kv_server import KVClient
+
+port = int(os.environ["KV_PORT"])
+client = KVClient("127.0.0.1", port)
+good = peercheck.encode_record(peercheck.ReplicaRecord(
+    rank=0, step=1, generation=0, world_size=1, payload=b"g" * 1024))
+client.put(peercheck.PEERSTATE_SCOPE, "0", good)
+print("GOOD COMMITTED", flush=True)
+
+# Next commit: stream half the record, then die mid-body (SIGKILL).
+torn = peercheck.encode_record(peercheck.ReplicaRecord(
+    rank=0, step=2, generation=0, world_size=1, payload=b"t" * (1 << 20)))
+sock = socket.create_connection(("127.0.0.1", port))
+head = (
+    "PUT /peerstate/0 HTTP/1.1\\r\\nHost: x\\r\\n"
+    "Content-Length: %d\\r\\n\\r\\n" % len(torn)).encode()
+sock.sendall(head + torn[: len(torn) // 2])
+print("HALF SENT", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+""")
+        env = dict(os.environ)
+        env["KV_PORT"] = str(kv_server.port)
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == -signal.SIGKILL, (proc.returncode, out)
+        assert "HALF SENT" in out, out
+        # Give the server its rejection beat (connection closed -> short
+        # read -> verification failure -> record dropped).
+        deadline = time.monotonic() + 10
+        client = KVClient("127.0.0.1", kv_server.port)
+        while time.monotonic() < deadline:
+            blob = client.get(peercheck.PEERSTATE_SCOPE, "0")
+            if blob is not None:
+                break
+            time.sleep(0.05)
+        rec = peercheck.decode_record(blob)  # verifies the checksum too
+        assert rec.step == 1 and rec.payload == b"g" * 1024
+        assert client.get(peercheck.PEERSTATE_SCOPE, "0.prev") is None
+        # And the set still assembles to the last GOOD commit.
+        rep = peercheck.PeerReplicator(
+            client=client, rank=0, world_size_fn=lambda: 1,
+            generation_fn=lambda: 0)
+        records = rep.assemble()
+        assert [r.step for r in records] == [1]
+
+
+# -- end-to-end: the peer rung with the real ElasticDriver --------------------
+
+_E2E_WORKER = '''
+import os, signal, sys
+sys.path.insert(0, {repo_root!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+host = os.environ["HOROVOD_HOSTNAME"]
+tmp = os.environ["TEST_TMP"]
+os.environ["HOROVOD_EVENT_LOG"] = os.path.join(
+    tmp, "events-%s.jsonl" % host)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import pickle
+import numpy as np
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, faults, process_world
+from horovod_tpu.elastic import PeerShardedState, run as elastic_run
+from horovod_tpu.optimizer import ReduceSpec, init_sharded_state, \\
+    unshard_opt_state
+
+CORRUPT = os.environ.get("TEST_CORRUPT", "") == "1"
+if CORRUPT and host != "localhost":
+    # The survivor sees every replica checksum as corrupt at assembly:
+    # the models-bit-rot chaos that must fall through to the durable rung.
+    faults.inject(faults.PEER_VERIFY, "drop", at=1, count=1000000)
+
+LR, MU, EPOCHS = 0.05, 0.9, 6
+W0 = np.linspace(0.5, -0.5, 8).astype(np.float32)
+
+
+def local_grad(w, e, r):
+    rng = np.random.RandomState(1000 + 10 * e + r)
+    A = rng.randn(16, 8).astype(np.float32)
+    return ((A.T @ (A @ w)) / 16.0).astype(np.float32)
+
+
+spec = ReduceSpec(
+    inner=optax.sgd(LR, momentum=MU), op="average", compression=None,
+    prescale_factor=1.0, postscale_factor=1.0, process_set=None,
+    num_groups=0, fusion_threshold_bytes=None, backward_passes_per_step=1,
+    sync_mode="sharded")
+n0 = process_world.size()
+params = {{"w": W0.copy()}}
+state = PeerShardedState(
+    params=params, opt_state=init_sharded_state(spec, params, world_size=n0),
+    sharded_optimizer=spec, epoch=0)
+
+durable_path = os.path.join(tmp, "durable-%s.pkl" % host)
+
+
+def save_durable():
+    full = unshard_opt_state(spec, state.opt_state, state.params)
+    blob = pickle.dumps({{"params": jax.device_get(state.params),
+                          "full": jax.device_get(full),
+                          "epoch": state.epoch}})
+    checkpoint.atomic_install(durable_path, blob)
+
+
+def durable_restore():
+    print("DURABLE_RESTORE_USED", flush=True)
+    with open(durable_path, "rb") as f:
+        t = pickle.loads(f.read())
+    state.install_full(t["params"], t["full"], epoch=t["epoch"])
+
+
+state.register_durable_restore(durable_restore)
+
+
+@elastic_run
+def train(state):
+    from horovod_tpu.parallel.hierarchical import _default_native_world
+
+    while state.epoch < EPOCHS:
+        e = state.epoch
+        r, n = process_world.rank(), process_world.size()
+        if host == "localhost" and e == 2 and n > 1:
+            print("host=%s SIGKILL at epoch 2" % host, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        w = np.asarray(state.params["w"])
+        g = local_grad(w, e, r)
+        if n > 1:
+            world = _default_native_world()
+            g = np.asarray(world.allreduce(g, name="grad.%d" % e,
+                                           op="average"),
+                           dtype=np.float32)
+        # The ZeRO-1 step in host math (single-controller SPMD emulation:
+        # the reduced gradient is rank-identical, so every row of the
+        # stacked momentum updates deterministically).
+        tdef = jax.tree.structure(state.opt_state)
+        trace = np.asarray(jax.tree.leaves(state.opt_state)[0])
+        n_axis, s = trace.shape
+        g_rows = np.pad(g, (0, n_axis * s - g.size)).reshape(n_axis, s)
+        trace = (MU * trace + g_rows).astype(np.float32)
+        w = (w - LR * trace.reshape(-1)[: w.size]).astype(np.float32)
+        state.opt_state = jax.tree.unflatten(tdef, [trace])
+        state.params = {{"w": w}}
+        print("rank=%d epoch=%d np=%d gen=%s w0=%.6f wsum=%.6f" % (
+            r, e, n, os.environ.get("HOROVOD_WORLD_VERSION", "?"),
+            float(w[0]), float(np.sum(w))), flush=True)
+        state.epoch = e + 1
+        save_durable()
+        state.commit()
+    return state.epoch
+
+
+done = train(state)
+print("host=%s finished at epoch %d" % (host, done), flush=True)
+'''
+
+
+def _expected_trajectory():
+    """The one continuous SGD-momentum trajectory the job must follow:
+    epochs 0-1 on the 2-rank averaged gradient, 2+ solo on rank 0. Any
+    loss of the momentum state across the recovery (zeros after a
+    restart-from-scratch) diverges from this immediately."""
+    lr, mu = 0.05, 0.9
+
+    def local_grad(w, e, r):
+        rng = np.random.RandomState(1000 + 10 * e + r)
+        A = rng.randn(16, 8).astype(np.float32)
+        return ((A.T @ (A @ w)) / 16.0).astype(np.float32)
+
+    w = np.linspace(0.5, -0.5, 8).astype(np.float32)
+    m = np.zeros(8, np.float32)
+    out = {}
+    for e in range(6):
+        if e < 2:
+            g = ((local_grad(w, e, 0) + local_grad(w, e, 1)) / 2.0
+                 ).astype(np.float32)
+        else:
+            g = local_grad(w, e, 0)
+        m = (mu * m + g).astype(np.float32)
+        w = (w - lr * m).astype(np.float32)
+        out[e] = w.copy()
+    return out
+
+
+def _run_peer_e2e(tmp_path, corrupt):
+    import re
+    import stat
+
+    from horovod_tpu.runner.elastic.driver import run_elastic
+    from horovod_tpu.runner.launch import Settings
+
+    worker = tmp_path / "peer_worker.py"
+    worker.write_text(_E2E_WORKER.format(repo_root=REPO_ROOT))
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost\n127.0.0.1\n")
+    discover = tmp_path / "discover.sh"
+    discover.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    discover.chmod(discover.stat().st_mode | stat.S_IEXEC)
+    env = {
+        "TEST_TMP": str(tmp_path),
+        "HOROVOD_RECOVERY_BACKOFF_MAX": "0.2",
+        "HOROVOD_ABORT_POLL_INTERVAL": "0.2",
+    }
+    if corrupt:
+        env["TEST_CORRUPT"] = "1"
+    settings = Settings(
+        num_proc=2,
+        hosts=[],
+        command=[sys.executable, str(worker)],
+        cpu_mode=True,
+        elastic=True,
+        min_np=1,
+        max_np=2,
+        discovery_script=str(discover),
+        elastic_timeout=60.0,
+        env=env,
+    )
+    lines = []
+    rc = run_elastic(settings, sink=lines.append)
+    text = "\n".join(lines)
+    assert rc == 0, text
+    assert "SIGKILL at epoch 2" in text, text
+    assert any("finished at epoch 6" in l for l in lines), text
+
+    # Loss continuity against the exact expected trajectory: the
+    # momentum state crossed the recovery intact (a restart from zeros
+    # diverges by epoch 3 at the 4th decimal).
+    expected = _expected_trajectory()
+    seen = {}
+    for line in text.splitlines():
+        match = re.search(
+            r"rank=(\d+) epoch=(\d+) np=(\d+) gen=(\d+) w0=(-?[0-9.]+)",
+            line)
+        if match:
+            r, e, np_, gen, w0 = (int(match.group(1)), int(match.group(2)),
+                                  int(match.group(3)), int(match.group(4)),
+                                  float(match.group(5)))
+            seen.setdefault(e, []).append((r, np_, gen, w0))
+    for e in range(6):
+        assert e in seen, (e, sorted(seen))
+        for r, np_, gen, w0 in seen[e]:
+            assert np_ == (2 if e < 2 else 1), (e, r, np_)
+            assert abs(w0 - float(expected[e][0])) < 2e-4, (
+                e, r, w0, float(expected[e][0]))
+    # Generation fencing: post-recovery epochs run at a bumped generation.
+    pre = {gen for _, _, gen, _ in seen[0]}
+    post = {gen for _, _, gen, _ in seen[5]}
+    assert max(post) > max(pre), (pre, post)
+
+    # The survivor's lifecycle journal tells the recovery story.
+    jpath = tmp_path / "events-127.0.0.1.jsonl"
+    events = [json.loads(l) for l in jpath.read_text().splitlines()]
+    rungs = [e["rung"] for e in events if e["event"] == "recovery"]
+    return text, events, rungs
+
+
+class TestPeerRungE2E:
+    @pytest.mark.slow
+    def test_sigkill_recovers_on_peer_rung_with_zero_storage_reads(
+            self, tmp_path, monkeypatch):
+        text, events, rungs = _run_peer_e2e(tmp_path, corrupt=False)
+        # The ladder: restore (marks the shard-local snapshot dirty),
+        # then the pending jump straight onto the PEER rung.
+        assert "peer" in rungs, rungs
+        assert "durable" not in rungs, rungs
+        assert any(e["event"] == "peer_restore" for e in events), events
+        assert not any(e["event"] == "checkpoint_fallback"
+                       for e in events), events
+        assert not any(e["event"] == "peer_fallback" for e in events)
+        # ZERO durable-storage reads: the registered durable restore
+        # (which loudly marks its use) never ran.
+        assert "DURABLE_RESTORE_USED" not in text, text
+        # The storage-free recovery left its postmortem: a flight record
+        # with the replica-pool state attached.
+        assert any(e["event"] == "flight_record"
+                   and e.get("reason") == "peer_restore"
+                   for e in events), events
+
+    @pytest.mark.slow
+    def test_corrupt_replicas_fall_through_to_durable_rung(
+            self, tmp_path, monkeypatch):
+        text, events, rungs = _run_peer_e2e(tmp_path, corrupt=True)
+        # Same scenario, replicas unusable: the peer rung is attempted,
+        # falls through to durable — and the job still completes with
+        # the same loss continuity (asserted in _run_peer_e2e).
+        assert "peer" in rungs, rungs
+        assert any(e["event"] == "peer_fallback" for e in events), events
+        assert "DURABLE_RESTORE_USED" in text, text

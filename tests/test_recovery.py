@@ -520,8 +520,11 @@ class TestRecoveryLadder:
             return "recovered"
 
         assert train(state) == "recovered"
-        # Rung 1: in-memory restore. Rung 2: NO local restore (sync-only
-        # re-rendezvous). Rung 3: durable checkpoint restore.
+        # Rung 'restore': in-memory restore. Rung 'rendezvous': NO local
+        # restore (sync-only re-rendezvous). Failure #3 reaches the
+        # 'peer' rung, which is unarmed here and proceeds straight to
+        # 'durable' without burning an extra attempt (the armed-peer
+        # ordering is tests/test_peercheck.py::TestLadderPeerRung).
         assert calls.count("restore") == 1
         assert calls.count("durable") == 1
         assert calls.count("sync") == 4  # before every attempt

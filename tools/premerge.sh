@@ -45,9 +45,14 @@ echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # dump+exit after HOROVOD_TEST_HARD_TIMEOUT, default 300s): a regression
 # that re-introduces an unbounded hang fails THAT test fast with every
 # thread's stack dumped, instead of silently eating the lane's budget.
+# test_peercheck.py is the peer-replication plane's acceptance proof:
+# SIGKILL-during-commit never half-writes the replica pool, and the
+# SIGKILL-one-worker e2e recovers on the peer rung (rc=0, zero
+# durable-storage reads) with corrupt replicas falling through to the
+# durable rung instead of crashing.
 if ! timeout -k 10 900 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
-    tests/test_faults.py tests/test_recovery.py -q \
+    tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -185,6 +190,8 @@ try:
         "hvd_world_generation",
         "hvd_collective_skew_seconds",
         "hvd_straggler_score",
+        "hvd_checkpoint_seconds",
+        "hvd_peer_replication_bytes",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
