@@ -24,6 +24,13 @@ numbers:
   sync_mode="sharded" (ZeRO-1 wire: reduce-scatter + shard-local update +
   parameter allgather), plus per-rank optimizer-state bytes for both
   modes — the memory half of the trade.
+- ``vs_baseline_machinery_fsdp``: same protocol with sync_mode="fsdp"
+  (ZeRO-3 wire: params resident-sharded, per-segment just-in-time
+  gathers, reduce-scatter inside backprop, no trailing allgather), plus
+  ``resident_bytes_per_rank`` for all three modes, the standalone
+  gather-probe price (``param_gather_probe_ms`` →
+  ``hvd_param_gather_seconds``) and the derived
+  ``fsdp_prefetch_overlap_ratio``.
 
 Step-time breakdown: ``phase_span_medians_ms`` carries derived
 forward/backward/collective/optimizer_update medians (phase-probe
@@ -123,7 +130,8 @@ class _Emitter:
 
 
 def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
-                overlap_spec=None, sharded_spec=None):
+                overlap_spec=None, sharded_spec=None, fsdp_spec=None,
+                world_size=None):
     """sync_grads: None when `optimizer` already syncs (DistributedOptimizer);
     for the raw baseline it is the hand-written pmean a correct hand-rolled
     DP step must do, so both sides do equivalent communication work.
@@ -136,13 +144,47 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
     sharded_spec: a sync_mode='sharded' ReduceSpec switches the step to
     the ZeRO-1 wire — per-bucket reduce-scatter, shard-local inner
     update (opt_state arrives in the STACKED sharded layout, sharded
-    over the axis), allgather of updated parameter shards."""
+    over the axis), allgather of updated parameter shards.
+
+    fsdp_spec: a sync_mode='fsdp' ReduceSpec switches the step to the
+    ZeRO-3 wire — the params argument is the resident ShardedParams
+    rows (sharded over the axis, ~1/n per rank at rest), each segment's
+    full tensors are allgathered just in time in the forward, gradients
+    reduce-scatter inside backprop at the gather boundaries, and the
+    shard-local update writes back to the resident rows with no
+    trailing allgather."""
     import jax
     import optax
     from jax.sharding import PartitionSpec as P
 
     def spmd_step(params, batch_stats, opt_state, batch):
         x, y = batch
+
+        if fsdp_spec is not None:
+            from horovod_tpu.parallel.param_sharding import gather_params
+
+            meta = params.meta
+            shards = jax.tree.unflatten(
+                meta.treedef, [a[0] for a in params.rows])
+            local_state = jax.tree.map(lambda a: a[0], opt_state)
+
+            def loss_of_shards(sh):
+                full = gather_params(sh, meta, fsdp_spec, axis_name,
+                                     int(world_size))
+                logits, updated = model.apply(
+                    {"params": full, "batch_stats": batch_stats},
+                    x, train=True, mutable=["batch_stats"])
+                return loss_fn(logits, y), updated["batch_stats"]
+
+            (loss, new_stats), grad_shards = jax.value_and_grad(
+                loss_of_shards, has_aux=True)(shards)
+            updates, new_local = fsdp_spec.inner.update(
+                grad_shards, local_state, shards)
+            new_shards = optax.apply_updates(shards, updates)
+            new_rows = type(params)(
+                [a[None] for a in jax.tree.leaves(new_shards)], meta)
+            new_opt = jax.tree.map(lambda a: a[None], new_local)
+            return new_rows, new_stats, new_opt, loss
 
         def loss_of(p):
             if overlap_spec is not None:
@@ -178,13 +220,15 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
         new_params = optax.apply_updates(params, updates)
         return new_params, new_stats, new_opt, loss
 
-    opt_spec = P(axis_name) if sharded_spec is not None else P()
+    sharded_state = sharded_spec is not None or fsdp_spec is not None
+    opt_spec = P(axis_name) if sharded_state else P()
+    param_spec = P(axis_name) if fsdp_spec is not None else P()
     return jax.jit(
         jax.shard_map(
             spmd_step,
             mesh=mesh,
-            in_specs=(P(), P(), opt_spec, P(axis_name)),
-            out_specs=(P(), P(), opt_spec, P()),
+            in_specs=(param_spec, P(), opt_spec, P(axis_name)),
+            out_specs=(param_spec, P(), opt_spec, P()),
             check_vma=False,
         ),
         donate_argnums=(0, 1, 2),
@@ -192,11 +236,15 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
 
 
 def _tree_bytes(tree) -> int:
+    """Static byte count of a pytree — reads shape/dtype only, so it
+    never materializes device arrays and accepts eval_shape trees
+    (ShapeDtypeStructs) for sizing a state without allocating it."""
     import jax
     import numpy as np
 
     return int(sum(
-        np.asarray(l).size * np.asarray(l).dtype.itemsize
+        int(np.prod(np.shape(l)) if np.shape(l) else 1)
+        * np.dtype(l.dtype).itemsize
         for l in jax.tree.leaves(tree)))
 
 
@@ -642,17 +690,145 @@ def main() -> int:
             per_rank_bytes = _tree_bytes(stacked) // max(1, n)
             return _time_steps(step, state, batch, **timing), per_rank_bytes
 
+    sharded = None
     if raw is not None and not out_of_time():
         sharded = _with_retry("resnet_sharded", run_sharded, errors,
                               allow_retry=single_controller)
         if sharded is not None:
             (t_sharded, _), sharded_bytes = sharded
-            mono_state_bytes = _tree_bytes(dist_opt.init(params))
+            # eval_shape: size the monolithic state without allocating
+            # it (2x model bytes for momentum/Adam states).
+            mono_state_bytes = _tree_bytes(
+                jax.eval_shape(dist_opt.init, params))
             emit.update(
                 vs_baseline_machinery_sharded=round(raw[0] / t_sharded, 4),
                 opt_state_bytes_per_rank=mono_state_bytes,
                 opt_state_bytes_per_rank_sharded=sharded_bytes,
             )
+
+    # --- section 4c2: full parameter sharding (ZeRO-3 / FSDP wire),
+    # machinery-forced — params live sharded at rest (~1/n per rank) and
+    # full tensors exist only transiently per segment: forward allgathers
+    # each segment just in time, the backward emits the gradient
+    # reduce-scatter inside backprop at the gather boundaries, and the
+    # shard-local update writes back to the resident shard with NO
+    # trailing allgather. Reported alongside: per-rank resident
+    # param+optimizer bytes for all three modes (the memory story that
+    # motivates the mode), a standalone gather-program probe (the price
+    # the step must hide under compute -> hvd_param_gather_seconds), and
+    # the derived prefetch-overlap ratio.
+    def run_fsdp():
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import tracing
+        from horovod_tpu.parallel import param_sharding
+
+        with _forced_wire():
+            fsdp_opt = hvd.DistributedOptimizer(
+                optax.sgd(0.1, momentum=0.9),
+                compression=(hvd.Compression.bf16 if on_tpu
+                             else hvd.Compression.none),
+                sync_mode="fsdp",
+            )
+            spec = hvd.reduce_spec_of(fsdp_opt)
+            step = _build_step(model, fsdp_opt, mesh, axis, loss_fn,
+                               fsdp_spec=spec, world_size=n)
+            sp = hvd.shard_params(params, n)
+            stacked = fsdp_opt.init(params)
+            resident = {
+                "params": param_sharding.resident_param_bytes(sp),
+                "opt_state": _tree_bytes(stacked) // max(1, n),
+            }
+            state = (
+                hvd.data_parallel.shard_state(sp),
+                hvd.data_parallel.replicate(batch_stats),
+                hvd.data_parallel.shard_state(stacked),
+            )
+            timed = _time_steps(step, state, batch, **timing)
+
+            # Standalone gather probe: the full per-segment parameter
+            # gather as its own program — total gather time with NOTHING
+            # to hide it under. The sum over every gathered leaf defeats
+            # DCE without meaningfully adding to the collective cost.
+            meta = sp.meta
+
+            def gather_only(rows):
+                shards = jax.tree.unflatten(
+                    meta.treedef, [a[0] for a in rows.rows])
+                full = param_sharding.gather_params(
+                    shards, meta, spec, axis, n)
+                return sum(jnp.sum(l) for l in jax.tree.leaves(full))
+
+            gather_prog = jax.jit(jax.shard_map(
+                gather_only, mesh=mesh, in_specs=(P(axis),),
+                out_specs=P(), check_vma=False))
+            probe_sp = hvd.data_parallel.shard_state(hvd.shard_params(
+                params, n))
+            out = gather_prog(probe_sp)
+            fetch_s = _measure_fetch_overhead(out)
+            samples = []
+            for _ in range(max(2, timing["repeats"])):
+                t0 = time.perf_counter()
+                for _ in range(timing["iters"]):
+                    out = gather_prog(probe_sp)
+                float(np.asarray(out))
+                dt = max(time.perf_counter() - t0 - fetch_s, 1e-9) \
+                    / timing["iters"]
+                samples.append(dt)
+                try:
+                    hvd.metrics.PARAM_GATHER_SECONDS.observe(dt)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+            samples.sort()
+            t_gather = statistics.median(samples)
+            t_base = tracing.clock_sync().now()
+            tracing.record_span("fsdp_param_gather", "collective",
+                                t_base, t_gather,
+                                args={"probe": "standalone"})
+            return timed, resident, t_gather
+
+    if raw is not None and not out_of_time():
+        fsdp = _with_retry("resnet_fsdp", run_fsdp, errors,
+                           allow_retry=single_controller)
+        if fsdp is not None:
+            from horovod_tpu import tracing as _tracing
+
+            (t_fsdp, _), fsdp_resident, t_gather = fsdp
+            mono_params_bytes = _tree_bytes(params)
+            mono_state_bytes = _tree_bytes(
+                jax.eval_shape(dist_opt.init, params))
+            resident_by_mode = {
+                "monolithic": mono_params_bytes + mono_state_bytes,
+                "fsdp": fsdp_resident["params"] + fsdp_resident["opt_state"],
+            }
+            if sharded is not None:
+                resident_by_mode["sharded"] = (
+                    mono_params_bytes + sharded[1])
+            record = {
+                "vs_baseline_machinery_fsdp": round(raw[0] / t_fsdp, 4),
+                "resident_bytes_per_rank": resident_by_mode,
+            }
+            if sharded is not None and t_gather > 0:
+                # Prefetch-overlap ratio: the standalone probe prices the
+                # total gather time; the fsdp-vs-sharded step delta is
+                # the EXPOSED part (both wires move the same bytes per
+                # step — RS+AG — so the comparison cancels everything but
+                # where the gather sits relative to compute). The hidden
+                # fraction is what the just-in-time prefetch bought.
+                exposed = max(t_fsdp - sharded[0][0], 0.0)
+                ratio = max(0.0, min(1.0, (t_gather - exposed) / t_gather))
+                try:
+                    hvd.metrics.FSDP_PREFETCH_OVERLAP.set(ratio)
+                except Exception:  # noqa: BLE001 — observability only
+                    pass
+                _tracing.record_span(
+                    "fsdp_gather_exposed", "collective",
+                    _tracing.clock_sync().now(), exposed,
+                    args={"derived": True})
+                record["fsdp_prefetch_overlap_ratio"] = round(ratio, 4)
+            record["param_gather_probe_ms"] = round(t_gather * 1e3, 3)
+            emit.update(**record)
 
     # --- section 4d: per-phase step-time breakdown — forward / backward /
     # collective / optimizer_update medians, derived by differencing

@@ -52,6 +52,7 @@ from .exceptions import (  # noqa: F401
     HostsUpdatedInterrupt,
     NotInitializedError,
     RecoveryExhaustedError,
+    SyncModeIneligibleError,
 )
 from .ops import (  # noqa: F401
     Adasum,
@@ -115,6 +116,12 @@ from .parallel.data_parallel import (  # noqa: F401
     make_overlapped_train_step,
     overlap_gradient_sync,
     shard_state,
+)
+from .parallel.param_sharding import (  # noqa: F401
+    ShardedParams,
+    reshard_params,
+    shard_params,
+    unshard_params,
 )
 from .stall import fetch  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401

@@ -475,17 +475,17 @@ def maybe_autotune_step(jitted, segment_candidates=None,
 
 def tune_step_sync_mode(
     build_step: Callable[[str], Callable[[], Any]],
-    sync_modes: Sequence[str] = ("allreduce", "sharded"),
+    sync_modes: Sequence[str] = ("allreduce", "sharded", "fsdp"),
     iters: int = 3,
 ) -> str:
     """Explicit warmup tuning of the gradient sync mode.
 
     The sync_mode axis cannot ride the transparent per-step tuner for a
     stock factory step: the mode fixes the optimizer-state LAYOUT
-    (monolithic pytree vs sharded stacked rows), so one jitted step
-    cannot re-trace between modes against the same state arguments.
-    This harness sidesteps that by letting the caller rebuild the whole
-    (optimizer, state, step) world per mode::
+    (monolithic pytree vs sharded stacked rows vs resident fsdp param
+    rows), so one jitted step cannot re-trace between modes against the
+    same state arguments. This harness sidesteps that by letting the
+    caller rebuild the whole (optimizer, state, step) world per mode::
 
         def build(mode):
             opt = hvd.DistributedOptimizer(optax.adam(1e-3),
@@ -494,22 +494,43 @@ def tune_step_sync_mode(
             state = make_state_for(opt)          # replicate / shard_state
             return lambda: step(*state.feed())   # one timed step
 
-    The fastest mode is pinned via :func:`set_tuned_sync_mode` (so
-    optimizers built afterwards with ``sync_mode=None`` inherit it) and
-    returned. Abort semantics match the step tuner: an exception
-    mid-sweep pins the rank-identical FIRST mode before re-raising, so a
-    partially-sampled decision can never diverge across ranks.
+    An INELIGIBLE mode — ``build_step(mode)`` (or its compile/settle
+    call) raising :class:`~horovod_tpu.exceptions.SyncModeIneligibleError`,
+    the guard tables' dedicated class (e.g. fsdp with num_groups>1,
+    sharded on a hierarchical mesh, replicated params fed to the fsdp
+    factory) — is SKIPPED with a warning, not treated as an abort:
+    guard rejections are deterministic functions of the job's static
+    configuration, so every rank skips identically and the sweep stays
+    rank-aligned. Any OTHER exception (including a bare ``ValueError``
+    from user code, which could be rank-local) keeps the abort
+    semantics: the rank-identical first ELIGIBLE mode is pinned before
+    re-raising, so a partially-sampled decision can never diverge
+    across ranks. All modes ineligible raises ``ValueError``.
+
+    The fastest eligible mode is pinned via :func:`set_tuned_sync_mode`
+    (so optimizers built afterwards with ``sync_mode=None`` inherit it)
+    and returned.
     """
     import time as _time
 
     import jax
 
+    from .exceptions import SyncModeIneligibleError
+
     log = get_logger()
     results: list[tuple[str, float]] = []
+    skipped: set[str] = set()
     try:
         for mode in sync_modes:
-            run = build_step(mode)
-            out = run()  # compile + settle
+            try:
+                run = build_step(mode)
+                out = run()  # compile + settle
+            except SyncModeIneligibleError as e:
+                log.warning(
+                    "autotune sync_mode: %r ineligible for this job "
+                    "(%s); skipped", mode, e)
+                skipped.add(mode)
+                continue
             jax.block_until_ready(out)
             t0 = _time.perf_counter()
             for _ in range(max(1, iters)):
@@ -520,11 +541,22 @@ def tune_step_sync_mode(
             _record_trial("sync_mode", seconds)
             log.info("autotune sync_mode: %s -> %.6fs/step", mode, seconds)
     except Exception:
-        set_tuned_sync_mode(sync_modes[0])
+        # Pin the first candidate NOT already proven ineligible — a
+        # skipped mode would crash every later sync_mode=None
+        # construction on its own guard. Skipping is a deterministic
+        # function of the job's static config, so this choice stays
+        # rank-identical.
+        fallback = next((m for m in sync_modes if m not in skipped),
+                        sync_modes[0])
+        set_tuned_sync_mode(fallback)
         log.warning(
             "autotune sync_mode: aborted mid-sweep; pinned the "
-            "rank-identical first candidate %r", sync_modes[0])
+            "rank-identical first eligible candidate %r", fallback)
         raise
+    if not results:
+        raise ValueError(
+            f"autotune sync_mode: every candidate in {tuple(sync_modes)} "
+            "was ineligible for this job (see the skip warnings above)")
     best = min(results, key=lambda p: p[1])[0]
     set_tuned_sync_mode(best)
     log.info("autotune sync_mode: pinned %r", best)

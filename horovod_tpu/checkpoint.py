@@ -310,15 +310,27 @@ def save_state_on_rank_0(path: str, optimizer, params: Any,
     shard): no collective, no extra wire.
     """
     from .optimizer import reduce_spec_of, unshard_opt_state
+    from .parallel.param_sharding import ShardedParams, unshard_params
 
     spec = reduce_spec_of(optimizer)
-    if spec is not None and getattr(spec, "sync_mode", None) == "sharded":
+    if spec is not None and getattr(spec, "sync_mode", None) in (
+            "sharded", "fsdp"):
         # Deliberately NOT gated on rank 0: in a multi-controller world
         # the state's stacked rows span non-addressable devices and the
         # unshard is a COLLECTIVE allgather — every process must reach
         # it. Single-controller worlds have no other ranks to spare the
-        # transient full-state materialization anyway.
-        opt_state = unshard_opt_state(spec, opt_state, params)
+        # transient full-state materialization anyway. Under fsdp the
+        # resident PARAMETER rows gather the same way, so the on-disk
+        # layout stays mode-independent for params too.
+        if isinstance(params, ShardedParams):
+            # Opt-state first, while params is still a ShardedParams:
+            # that branch of unshard_opt_state reads the template via
+            # jax.eval_shape — no transient full monolithic state
+            # allocation on top of the unavoidable full-params gather.
+            opt_state = unshard_opt_state(spec, opt_state, params)
+            params = unshard_params(params)
+        else:
+            opt_state = unshard_opt_state(spec, opt_state, params)
     save_on_rank_0(path, {"params": params, "opt_state": opt_state,
                           **extras})
 
@@ -334,18 +346,25 @@ def load_state_and_broadcast(path: str, optimizer, root_rank: int = 0,
     (``params`` / ``opt_state`` / extras) or None when no checkpoint is
     readable."""
     from .optimizer import reduce_spec_of, reshard_opt_state
+    from .parallel.param_sharding import shard_params
 
     obj = load_and_broadcast(path, root_rank)
     if obj is None:
         return None
     spec = reduce_spec_of(optimizer)
-    if spec is not None and getattr(spec, "sync_mode", None) == "sharded":
+    mode = getattr(spec, "sync_mode", None) if spec is not None else None
+    if mode in ("sharded", "fsdp"):
         n = world_size
         if n is None:
             n = spec.process_set.size()
         obj = dict(obj)
         obj["opt_state"] = reshard_opt_state(
             spec, obj["opt_state"], obj["params"], n)
+        if mode == "fsdp":
+            # The checkpoint holds the monolithic full-parameter layout
+            # (gather-on-save); re-shard into the resident rows for the
+            # CURRENT world — cross-mode and cross-size resume both ways.
+            obj["params"] = shard_params(obj["params"], n)
     return obj
 
 

@@ -178,15 +178,19 @@ class TpuState(State):
         state = hvd.elastic.TpuState(params=params, opt_state=opt_state,
                                      epoch=0, batch=0)
 
-    ``sharded_optimizer``: pass the ``sync_mode='sharded'``
-    DistributedOptimizer whose stacked state ``opt_state`` holds. Across
-    an elastic world resize, shard ownership is a pure function of the
-    NEW world size and the parameter shapes, so ``sync()`` (which always
-    runs during re-rendezvous) gathers the old world's shards to the
-    monolithic layout, broadcasts rank-0's copy, and re-shards for the
-    current world — recovery and the escalation ladder keep working with
-    no extra coordination. :meth:`needs_world_sync` flags a stale
-    leading world axis so even a skip-sync host update re-shards.
+    ``sharded_optimizer``: pass the ``sync_mode='sharded'`` (or
+    ``'fsdp'``) DistributedOptimizer whose stacked state ``opt_state``
+    holds. Across an elastic world resize, shard ownership is a pure
+    function of the NEW world size and the parameter shapes, so
+    ``sync()`` (which always runs during re-rendezvous) gathers the old
+    world's shards to the monolithic layout, broadcasts rank-0's copy,
+    and re-shards for the current world — recovery and the escalation
+    ladder keep working with no extra coordination. Under ``fsdp`` the
+    PARAMETERS live in the same stacked-row layout
+    (:class:`~horovod_tpu.parallel.param_sharding.ShardedParams`) and
+    take the identical unshard → broadcast → reshard hop.
+    :meth:`needs_world_sync` flags a stale leading world axis (state or
+    resident params) so even a skip-sync host update re-shards.
     """
 
     def __init__(self, params=None, opt_state=None, sharded_optimizer=None,
@@ -201,10 +205,12 @@ class TpuState(State):
             spec = (sharded_optimizer
                     if isinstance(sharded_optimizer, ReduceSpec)
                     else reduce_spec_of(sharded_optimizer))
-            if spec is None or getattr(spec, "sync_mode", None) != "sharded":
+            if spec is None or getattr(spec, "sync_mode", None) not in (
+                    "sharded", "fsdp"):
                 raise ValueError(
                     "sharded_optimizer must be a DistributedOptimizer "
-                    "built with sync_mode='sharded' (or its ReduceSpec)")
+                    "built with sync_mode='sharded' or 'fsdp' (or its "
+                    "ReduceSpec)")
             self._sharded_spec = spec
         for k, v in extras.items():
             setattr(self, k, v)
@@ -220,6 +226,10 @@ class TpuState(State):
         leaves = jax.tree.leaves(self.opt_state)
         return int(np.shape(leaves[0])[0]) if leaves else None
 
+    def _is_fsdp(self) -> bool:
+        return (self._sharded_spec is not None
+                and getattr(self._sharded_spec, "sync_mode", None) == "fsdp")
+
     def needs_world_sync(self) -> bool:
         if self._sharded_spec is None or self.opt_state is None:
             return False
@@ -227,6 +237,16 @@ class TpuState(State):
 
         if not basics.is_initialized():
             return False
+        if self._is_fsdp() and self.params is not None:
+            from ..parallel.param_sharding import ShardedParams
+
+            if not isinstance(self.params, ShardedParams):
+                # A monolithic full-parameter install mid-run (durable
+                # restore from a gather-on-save checkpoint): sync()
+                # re-shards it into the resident layout.
+                return True
+            if self.params.world_size != basics.size():
+                return True
         if not self._looks_sharded():
             # A monolithic layout mid-run (rung-3 durable restore from a
             # gather-on-save checkpoint): sync() re-shards it.
@@ -259,7 +279,25 @@ class TpuState(State):
         return basics.size()
 
     def sync(self) -> None:
-        self.params = broadcast_parameters(self.params, root_rank=0)
+        if self._is_fsdp() and self.params is not None:
+            # Resident fsdp parameters take the same hop as the sharded
+            # optimizer state: gather the stacked rows to the full
+            # layout (pure host math), broadcast rank-0's copy, re-shard
+            # for the CURRENT world. A monolithic install (durable rung)
+            # skips the unshard and just re-shards.
+            from ..parallel.param_sharding import (
+                ShardedParams,
+                shard_params,
+                unshard_params,
+            )
+
+            full_p = (unshard_params(self.params)
+                      if isinstance(self.params, ShardedParams)
+                      else self.params)
+            full_p = broadcast_parameters(full_p, root_rank=0)
+            self.params = shard_params(full_p, self._sync_world_size())
+        else:
+            self.params = broadcast_parameters(self.params, root_rank=0)
         if self._sharded_spec is not None and self.opt_state is not None:
             # Re-shard for the CURRENT world: gather the stacked shards
             # to the monolithic layout (pure host math — the rows hold
@@ -307,8 +345,13 @@ class TpuState(State):
             state = state.inner_state
         # eval_shape: the template's SHAPES without allocating the full
         # monolithic state (2x params for Adam) on the recovery path.
-        template = jax.eval_shape(self._sharded_spec.inner.init,
-                                  self.params)
+        # Resident fsdp params carry the full shapes as static metadata.
+        from ..parallel.param_sharding import ShardedParams
+
+        p = self.params
+        if isinstance(p, ShardedParams):
+            p = p.template_tree()
+        template = jax.eval_shape(self._sharded_spec.inner.init, p)
         t_shapes = [np.shape(l) for l in jax.tree.leaves(template)]
         s_shapes = [np.shape(l) for l in jax.tree.leaves(state)]
         return t_shapes != s_shapes
@@ -361,8 +404,9 @@ class PeerShardedState(TpuState):
         if sharded_optimizer is None:
             raise ValueError(
                 "PeerShardedState requires sharded_optimizer (a "
-                "sync_mode='sharded' DistributedOptimizer or its "
-                "ReduceSpec): shard ownership is what gets replicated")
+                "sync_mode='sharded' or 'fsdp' DistributedOptimizer or "
+                "its ReduceSpec): shard ownership is what gets "
+                "replicated")
         from .. import peercheck
 
         self._rank_override = rank
@@ -413,14 +457,33 @@ class PeerShardedState(TpuState):
                     ), "row"
         return _to_host(state), "full"
 
+    def _own_param_row(self, r: int):
+        """(host copy of this rank's PARAM shard row, layout tag, meta).
+
+        Under fsdp the resident :class:`ShardedParams` rows make the
+        parameter commit shard-local too (~1/n, like the opt state);
+        any other layout — plain replicated params (sharded mode), or a
+        transient monolithic install — snapshots in full, rank 0 only on
+        the wire."""
+        from ..parallel.param_sharding import ShardedParams
+
+        p = self.params
+        if isinstance(p, ShardedParams) and r < p.world_size:
+            return p.row(r), "row", p.meta
+        return _to_host(p), "full", None
+
     def commit(self) -> None:
         import pickle
 
         self._commit_seq += 1
         r, n = self._rank_world()
         row, layout = self._own_row(r)
+        param_row, param_layout, param_meta = self._own_param_row(r)
         self._saved = {
-            "params": _to_host(self.params),
+            "params": param_row if param_layout == "full" else None,
+            "param_row": param_row if param_layout == "row" else None,
+            "param_layout": param_layout,
+            "param_meta": param_meta,
             "row": row,
             "layout": layout,
             "rank": r,
@@ -431,10 +494,16 @@ class PeerShardedState(TpuState):
             "row": row,
             "layout": layout,
             "extras": {k: self._saved[k] for k in self._extras},
-            # Parameters are replicated across ranks, so ONE record per
-            # set carries them (rank 0's) — the replica set stays
-            # self-sufficient without multiplying the wire cost by n.
-            "params": self._saved["params"] if r == 0 else None,
+            # Replicated parameters ride ONE record per set (rank 0's) —
+            # the replica set stays self-sufficient without multiplying
+            # the wire cost by n. Under fsdp every record instead
+            # carries its OWN param shard row (plus the tiny static
+            # metadata), keeping the whole commit ~1/n.
+            "params": (self._saved["params"]
+                       if r == 0 and param_layout == "full" else None),
+            "param_row": self._saved["param_row"],
+            "param_layout": param_layout,
+            "param_meta": param_meta,
         })
         self._replicator.replicate(payload, step=self._commit_seq,
                                    has_params=(r == 0))
@@ -442,29 +511,43 @@ class PeerShardedState(TpuState):
 
     def restore(self) -> None:
         assert self._saved is not None
-        self.params = self._saved["params"]
+        r = self._saved["rank"]
+
+        def expand_at(x, n):
+            x = np.asarray(x)
+            z = np.zeros((n,) + x.shape, x.dtype)
+            z[r] = x
+            return z
+
+        if self._saved.get("param_layout") == "row":
+            # fsdp: the snapshot holds only this rank's param shard row;
+            # re-materialize the resident layout with zeros elsewhere —
+            # the other rows must come from the peer rung (dirty below).
+            from ..parallel.param_sharding import ShardedParams
+
+            meta = self._saved["param_meta"]
+            rows = jax.tree.map(
+                lambda x: expand_at(x, meta.world_size),
+                self._saved["param_row"])
+            self.params = ShardedParams(jax.tree.leaves(rows), meta)
+        else:
+            self.params = self._saved["params"]
         for k in self._extras:
             setattr(self, k, self._saved[k])
         layout = self._saved["layout"]
         if layout == "none":
             self.opt_state = None
-            self._peer_dirty = False
+            self._peer_dirty = self._saved.get("param_layout") == "row"
         elif layout == "full":
             self.opt_state = self._saved["row"]
-            self._peer_dirty = False
+            self._peer_dirty = self._saved.get("param_layout") == "row"
         else:
             # Re-materialize the stacked layout with only the owned row:
             # the other rows are gone (that is the shard-local trade) and
             # must come from the peer rung before the next sync().
-            r, n = self._saved["rank"], self._saved["world"]
-
-            def expand(x):
-                x = np.asarray(x)
-                z = np.zeros((n,) + x.shape, x.dtype)
-                z[r] = x
-                return z
-
-            self.opt_state = jax.tree.map(expand, self._saved["row"])
+            n = self._saved["world"]
+            self.opt_state = jax.tree.map(
+                lambda x: expand_at(x, n), self._saved["row"])
             self._peer_dirty = True
 
     def _sync_world_size(self) -> int:
@@ -524,9 +607,41 @@ class PeerShardedState(TpuState):
         t0 = _time.perf_counter()
         records = self._replicator.assemble()
         payloads = [pickle.loads(rec.payload) for rec in records]
-        params = next(
-            (p["params"] for p in payloads if p.get("params") is not None),
-            None)
+        if any(p.get("param_layout") == "row" for p in payloads):
+            # fsdp replica set: every record carries its rank's param
+            # shard row — stack them back into the resident layout and
+            # re-materialize the full parameters (pure host math, the
+            # same unshard the optimizer rows take below).
+            from ..parallel.param_sharding import (
+                stack_param_rows,
+                unshard_params,
+            )
+
+            bad = [r.rank for r, p in zip(records, payloads)
+                   if p.get("param_layout") != "row"
+                   or p.get("param_row") is None]
+            if bad:
+                raise peercheck.ReplicaUnavailableError(
+                    f"records of ranks {bad} carry no param shard row")
+            meta = next(p["param_meta"] for p in payloads
+                        if p.get("param_meta") is not None)
+            try:
+                sp = stack_param_rows(
+                    [p["param_row"] for p in payloads], meta)
+            except ValueError as e:
+                raise peercheck.ReplicaUnavailableError(str(e)) from e
+            params = unshard_params(sp)
+            # Template for the opt-state unshard below: the
+            # ShardedParams carries the full shapes as static metadata,
+            # so unshard_opt_state's eval_shape branch avoids allocating
+            # the full monolithic inner state on the recovery path.
+            template_params = sp
+        else:
+            params = next(
+                (p["params"] for p in payloads
+                 if p.get("params") is not None),
+                None)
+            template_params = params
         if params is None:
             raise peercheck.ReplicaUnavailableError(
                 "no record in the replica set carries the parameters")
@@ -541,7 +656,8 @@ class PeerShardedState(TpuState):
             rows = [p["row"] for p in payloads]
             stacked = jax.tree.map(
                 lambda *xs: np.stack([np.asarray(x) for x in xs]), *rows)
-            full = unshard_opt_state(self._sharded_spec, stacked, params)
+            full = unshard_opt_state(self._sharded_spec, stacked,
+                                     template_params)
         self.params = params
         self.opt_state = full
         for k, v in payloads[0].get("extras", {}).items():

@@ -47,6 +47,22 @@ class CheckpointCorruptError(HorovodTpuError):
     """
 
 
+class SyncModeIneligibleError(ValueError):
+    """A sync mode's guard table rejected this job's static configuration.
+
+    Raised (instead of a bare ``ValueError``) by every sharded/fsdp
+    eligibility guard — the DistributedOptimizer construction table
+    (op/accumulation/num_groups), the step factories' flat-axis /
+    deferred-gather / elastic-factory / resident-layout checks — so the
+    sync-mode sweep (``autotune.tune_step_sync_mode``) can distinguish
+    "this mode is statically ineligible on every rank, skip it" from an
+    arbitrary rank-local ``ValueError`` mid-build, which must ABORT the
+    sweep (a silent skip there could pin divergent modes across ranks).
+    Subclasses ``ValueError`` so existing callers' error handling keeps
+    working.
+    """
+
+
 class HostsUpdatedInterrupt(HorovodTpuError):
     """Raised when the elastic driver reports a host-set change.
 

@@ -681,6 +681,25 @@ PEER_REPLICATION_SECONDS = histogram(
 PEER_POOL_REPLICAS = gauge(
     "hvd_peer_pool_replicas",
     "Replica records currently held in this rank's in-memory peer pool.")
+PARAM_GATHER_BYTES = histogram(
+    "hvd_param_gather_bytes",
+    "Wire bytes per traced fsdp parameter-gather program segment "
+    "(post-compression view; one observation per TRACE, not per step).",
+    (), BYTE_BUCKETS)
+PARAM_GATHER_SECONDS = histogram(
+    "hvd_param_gather_seconds",
+    "Wall time of a standalone fsdp parameter-gather program (the bench "
+    "probe that prices the gather the step must hide under compute).",
+    (), LATENCY_BUCKETS_S)
+RESIDENT_BYTES = gauge(
+    "hvd_resident_state_bytes",
+    "Per-rank resident bytes of sharded training state at rest, by kind "
+    "(params|opt_state) and sync_mode.", ("kind", "sync_mode"))
+FSDP_PREFETCH_OVERLAP = gauge(
+    "hvd_fsdp_prefetch_overlap_ratio",
+    "Fraction of the fsdp parameter-gather time hidden under compute "
+    "(gather time hidden / total gather time), derived from the bench "
+    "phase probes and tracing spans.")
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -693,6 +712,12 @@ def _materialize_checkpoint_cells() -> None:
     PEER_REPLICATION_BYTES.labels()
     PEER_REPLICATION_SECONDS.labels()
     PEER_POOL_REPLICAS.labels()
+    PARAM_GATHER_BYTES.labels()
+    PARAM_GATHER_SECONDS.labels()
+    FSDP_PREFETCH_OVERLAP.labels()
+    for mode in ("sharded", "fsdp"):
+        RESIDENT_BYTES.labels(kind="opt_state", sync_mode=mode)
+    RESIDENT_BYTES.labels(kind="params", sync_mode="fsdp")
 
 
 _materialize_checkpoint_cells()
@@ -719,6 +744,30 @@ def checkpoint_summary() -> dict:
         "pool_replicas": PEER_POOL_REPLICAS.labels().get(),
     }
     return out
+
+
+def fsdp_summary() -> dict:
+    """Process-local parameter-sharding ledger for
+    ``profiler.summary()``: per-rank resident bytes by kind/mode, the
+    traced param-gather byte/latency totals, and the bench-derived
+    prefetch-overlap ratio (gather time hidden under compute / total
+    gather time; 0 until a bench probe has priced the gather)."""
+    resident: dict = {}
+    for sample in RESIDENT_BYTES.dump()["samples"]:
+        labels = sample["labels"]
+        resident.setdefault(labels["sync_mode"], {})[labels["kind"]] = (
+            sample["value"])
+    gb = PARAM_GATHER_BYTES.dump()["samples"]
+    gs = PARAM_GATHER_SECONDS.dump()["samples"]
+    return {
+        "resident_bytes": resident,
+        "param_gather": {
+            "traces": gb[0]["count"] if gb else 0,
+            "bytes_total": round(gb[0]["sum"]) if gb else 0,
+            "probe_seconds_total": round(gs[0]["sum"], 4) if gs else 0.0,
+        },
+        "prefetch_overlap_ratio": FSDP_PREFETCH_OVERLAP.labels().get(),
+    }
 
 
 # ---------------------------------------------------------------------------

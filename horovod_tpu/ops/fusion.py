@@ -57,6 +57,23 @@ def overlap_segments() -> int:
     return max(1, get_int("HOROVOD_OVERLAP_SEGMENTS", 4))
 
 
+def fsdp_segments() -> int:
+    """Resolve the fsdp parameter-streaming segment count.
+
+    Precedence: ``HOROVOD_FSDP_SEGMENTS`` > the overlap scheduler's
+    resolution (:func:`overlap_segments` — a pinned autotune decision or
+    ``HOROVOD_OVERLAP_SEGMENTS``). The two knobs share a default because
+    they segment the same leaf list for the same reason (per-segment
+    collectives that overlap neighboring compute); the dedicated env
+    exists so the gather granularity can diverge from the gradient
+    overlap granularity when profiling says so.
+    """
+    explicit = get_int("HOROVOD_FSDP_SEGMENTS", 0)
+    if explicit > 0:
+        return explicit
+    return overlap_segments()
+
+
 def segment_leaves(
     leaves: Sequence[Any], num_segments: int
 ) -> list[list[int]]:

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from .compression import Compression
+from .exceptions import SyncModeIneligibleError
 from .ops import collective_ops
 from .ops.fusion import fused_allreduce
 
@@ -168,7 +169,7 @@ def _reduce_grads(
     return jax.tree.unflatten(treedef, restored)
 
 
-_VALID_SYNC_MODES = ("allreduce", "sharded")
+_VALID_SYNC_MODES = ("allreduce", "sharded", "fsdp")
 
 
 def resolve_sync_mode(sync_mode: str | None = None) -> str:
@@ -222,6 +223,7 @@ def _reducescatter_grads(
     world_size,
     quant_salt=None,
     issue_reversed=False,
+    flush_label: str = "sharded",
 ):
     """Compress -> fused reduce-scatter -> decompress over a gradient
     pytree: the gradient half of ``sync_mode="sharded"``. An allreduce is
@@ -255,7 +257,7 @@ def _reducescatter_grads(
 
         sharded_threshold = _sharded_threshold(
             leaves, threshold_bytes, num_groups)
-        _record_flush("sharded", leaves, sharded_threshold,
+        _record_flush(flush_label, leaves, sharded_threshold,
                       itemsize_override=1)
         with annotate_collective("grad_reducescatter"):
             shards = int8_fused_reducescatter(
@@ -274,7 +276,7 @@ def _reducescatter_grads(
     wire = [c[0] for c in compressed]
     ctxs = [c[1] for c in compressed]
     sharded_threshold = _sharded_threshold(wire, threshold_bytes, num_groups)
-    _record_flush("sharded", wire, sharded_threshold)
+    _record_flush(flush_label, wire, sharded_threshold)
     with annotate_collective("grad_reducescatter"):
         shards = fused_reducescatter(
             wire, op, axis_name, n,
@@ -450,37 +452,57 @@ def _spec_of(optimizer) -> ReduceSpec:
 
 
 def init_sharded_state(optimizer, params, world_size: int | None = None):
-    """Materialize the sharded optimizer state for ``sync_mode="sharded"``:
-    rank r's shard-local inner state, stacked on a leading world axis.
+    """Materialize the sharded optimizer state for ``sync_mode="sharded"``
+    and ``"fsdp"``: rank r's shard-local inner state, stacked on a
+    leading world axis.
 
     Every array leaf of the monolithic state with ``size m`` becomes
     ``(n, ceil(m/n))`` (rows = per-rank shards of the zero-padded flat
     view, per ``ops.fusion.shard_ownership``); scalar leaves become
     ``(n,)``. The factories shard the leading axis over the mesh
     (``in_specs=P(axis)``), so each rank materializes only its ``1/n``
-    of the optimizer state — the ZeRO-1 memory win.
+    of the optimizer state — the ZeRO-1 memory win. ``params`` may be
+    the full pytree or an already-resident :class:`ShardedParams` (the
+    fsdp flow: the rows ARE the per-rank shard slices the inner init
+    runs on, so both spellings produce the identical state).
     """
     from .ops.fusion import shard_ownership
+    from .parallel.param_sharding import ShardedParams
 
     spec = _spec_of(optimizer)
-    n = int(world_size) if world_size else _known_size(spec.process_set)
-    if not n:
-        raise ValueError(
-            "init_sharded_state needs a known process-set size "
-            "(init() first, or pass world_size=)")
-    leaves, treedef = jax.tree.flatten(params)
-    sizes = shard_ownership(leaves, n)
-    padded = [
-        jnp.pad(jnp.asarray(l).ravel(), (0, n * s - int(l.size)))
-        .reshape(n, s)
-        for l, s in zip(leaves, sizes)
-    ]
+    if isinstance(params, ShardedParams):
+        if world_size and int(world_size) != params.world_size:
+            raise ValueError(
+                f"init_sharded_state got world_size={world_size} but the "
+                f"ShardedParams rows are sharded for "
+                f"{params.world_size} ranks — reshard_params(params, "
+                f"{world_size}) first, or drop the world_size argument")
+        n = params.world_size
+        treedef = params.meta.treedef
+        padded = [jnp.asarray(r) for r in params.rows]
+    else:
+        n = int(world_size) if world_size else _known_size(spec.process_set)
+        if not n:
+            raise ValueError(
+                "init_sharded_state needs a known process-set size "
+                "(init() first, or pass world_size=)")
+        leaves, treedef = jax.tree.flatten(params)
+        sizes = shard_ownership(leaves, n)
+        padded = [
+            jnp.pad(jnp.asarray(l).ravel(), (0, n * s - int(l.size)))
+            .reshape(n, s)
+            for l, s in zip(leaves, sizes)
+        ]
     per_rank = [
         spec.inner.init(jax.tree.unflatten(treedef, [p[r] for p in padded]))
         for r in range(n)
     ]
     stacked = jax.tree.map(
         lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *per_rank)
+    from .parallel.param_sharding import _record_resident, _resident_bytes
+
+    _record_resident("opt_state", spec.sync_mode,
+                     _resident_bytes(jax.tree.leaves(stacked), n))
     if getattr(spec.compression, "marker", None) == "int8":
         return _SaltState(stacked, jnp.zeros((n,), jnp.uint32))
     return stacked
@@ -521,6 +543,10 @@ def unshard_opt_state(optimizer, opt_state, params):
     (single-controller worlds, host snapshots); in a multi-controller
     world the P(axis)-sharded rows are first replicated via one compiled
     allgather per leaf (collective — call on every process)."""
+    import numpy as np
+
+    from .parallel.param_sharding import ShardedParams
+
     spec = _spec_of(optimizer)
     state = _gather_if_nonaddressable(opt_state)
     salted = isinstance(state, _SaltState)
@@ -528,15 +554,22 @@ def unshard_opt_state(optimizer, opt_state, params):
     if salted:
         counter = state.counter
         state = state.inner_state
-    template = spec.inner.init(params)
+    if isinstance(params, ShardedParams):
+        # fsdp flow: the resident rows carry the full shapes as static
+        # metadata, so the template comes from eval_shape — no transient
+        # full-parameter materialization on the recovery path.
+        template = jax.eval_shape(spec.inner.init, params.template_tree())
+    else:
+        template = spec.inner.init(params)
 
     def un(st, tmpl):
         st = jnp.asarray(st)
-        tmpl = jnp.asarray(tmpl)
-        if tmpl.ndim == 0:
-            return st[0].astype(tmpl.dtype)
-        return (st.reshape(-1)[: int(tmpl.size)]
-                .reshape(tmpl.shape).astype(tmpl.dtype))
+        shape = tuple(tmpl.shape)
+        dtype = jnp.dtype(tmpl.dtype)
+        if not shape:
+            return st[0].astype(dtype)
+        size = int(np.prod(shape))
+        return st.reshape(-1)[:size].reshape(shape).astype(dtype)
 
     full = jax.tree.map(un, state, template)
     if salted:
@@ -691,15 +724,41 @@ def DistributedOptimizer(
     sync_mode = resolve_sync_mode(sync_mode)
     if sync_mode == "sharded":
         if op not in (collective_ops.Average, collective_ops.Sum):
-            raise ValueError(
+            raise SyncModeIneligibleError(
                 f"sync_mode='sharded' supports op=Average/Sum, got {op!r}")
         if k != 1:
-            raise ValueError(
+            raise SyncModeIneligibleError(
                 "sync_mode='sharded' does not compose with "
                 "backward_passes_per_step > 1: accumulation defers the "
                 "reduction, and the shard-local state would go stale "
                 "between boundaries — accumulate outside the optimizer "
                 "or use sync_mode='allreduce'")
+    if sync_mode == "fsdp":
+        # The fsdp guard table mirrors the sharded one (docs/perf.md),
+        # with one addition: num_groups. Every rejection names the fix.
+        if op not in (collective_ops.Average, collective_ops.Sum):
+            raise SyncModeIneligibleError(
+                f"sync_mode='fsdp' supports op=Average/Sum, got {op!r} "
+                "(Adasum's whole-vector dot products need the full "
+                "tensors resident on every rank — use "
+                "sync_mode='allreduce' for Adasum)")
+        if k != 1:
+            raise SyncModeIneligibleError(
+                "sync_mode='fsdp' does not compose with "
+                "backward_passes_per_step > 1: accumulation defers the "
+                "reduction past the per-segment gather/reduce-scatter "
+                "boundaries, and the shard-local state would go stale "
+                "between microsteps — accumulate outside the optimizer "
+                "or use sync_mode='allreduce'")
+        if num_groups and num_groups > 1:
+            raise SyncModeIneligibleError(
+                f"sync_mode='fsdp' does not compose with num_groups="
+                f"{num_groups}: num_groups caps bucket bytes at "
+                "total/num_groups of the WHOLE gradient tree, but the "
+                "fsdp wire is per-segment gather/reduce-scatter programs "
+                "whose totals differ per segment — cap bucket sizes with "
+                "fusion_threshold_bytes instead (it applies uniformly to "
+                "every segment's buckets)")
 
     int8 = getattr(compression, "marker", None) == "int8"
 
@@ -735,6 +794,46 @@ def DistributedOptimizer(
         backward_passes_per_step=k,
         sync_mode=sync_mode,
     )
+
+    if sync_mode == "fsdp":
+
+        def init_fsdp(params):
+            """Shard-local inner state, stacked on the leading world
+            axis (identical layout to sync_mode='sharded' — the fsdp
+            difference is the PARAMETER residency, not the state).
+            Accepts the full parameter pytree or a resident
+            ``ShardedParams``."""
+            return init_sharded_state(spec, params)
+
+        def update_fsdp(grads, state, params=None):
+            """Shard-domain update: under fsdp, parameters, gradients,
+            and optimizer state all live in the shard domain — ``grads``
+            are this rank's reduce-scattered shards (the
+            ``param_sharding.gather_params`` boundary's output),
+            ``state`` is this rank's ROW of the stacked state, and
+            ``params`` this rank's parameter shards
+            (``ShardedParams.shards_tree`` with the world axis
+            stripped). Returns shard-shaped updates — there is no
+            trailing full-parameter allgather in this mode; the next
+            forward's segment gathers are the only re-materialization.
+            The step factories (``make_train_step``) wire all of this;
+            hand-rolled steps should mirror ``_make_fsdp_train_step``.
+            """
+            if params is None:
+                raise ValueError(
+                    "sync_mode='fsdp' update needs params= (this rank's "
+                    "parameter shards — the shard-local update reads "
+                    "them)")
+            if int8:
+                inner_local, salt = state.inner_state, state.counter
+                upd, new_inner = optimizer.update(grads, inner_local,
+                                                  params)
+                return upd, _SaltState(new_inner, salt + 1)
+            return optimizer.update(grads, state, params)
+
+        init_fsdp._hvd_reduce_spec = spec
+        update_fsdp._hvd_reduce_spec = spec
+        return optax.GradientTransformation(init_fsdp, update_fsdp)
 
     if sync_mode == "sharded":
 

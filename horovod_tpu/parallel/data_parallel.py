@@ -239,12 +239,29 @@ def _sharded_spec_of(optimizer):
     return None
 
 
-def _check_flat_axis(axis_name, what: str):
+def _fsdp_spec_of(optimizer):
+    """The optimizer's ReduceSpec when it was built with
+    ``sync_mode='fsdp'``, else None."""
+    from ..optimizer import reduce_spec_of
+
+    spec = reduce_spec_of(optimizer)
+    if spec is not None and getattr(spec, "sync_mode", None) == "fsdp":
+        return spec
+    return None
+
+
+def _check_flat_axis(axis_name, what: str, sync_mode: str = "sharded"):
+    from ..exceptions import SyncModeIneligibleError
+
     if not isinstance(axis_name, str):
-        raise ValueError(
-            f"sync_mode='sharded' does not compose with the hierarchical "
-            f"(cross, local) mesh in {what}; use the flat axis (the "
-            f"two-level reduction already reduce-scatters its local leg)")
+        raise SyncModeIneligibleError(
+            f"sync_mode='{sync_mode}' does not compose with the "
+            f"hierarchical (cross, local) mesh in {what}; use the flat "
+            f"axis (the two-level reduction already reduce-scatters its "
+            f"local leg"
+            + (" — and the fsdp shard ownership map is defined over ONE "
+               "world axis" if sync_mode == "fsdp" else "")
+            + ")")
 
 
 def shard_state(tree, mesh=None, axis_name: str | None = None):
@@ -311,12 +328,26 @@ def make_train_step(
     import optax
 
     spec = _sharded_spec_of(optimizer)
+    fsdp_spec = _fsdp_spec_of(optimizer)
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
+    from ..exceptions import SyncModeIneligibleError
+
+    if deferred_param_gather and fsdp_spec is not None:
+        raise SyncModeIneligibleError(
+            "deferred_param_gather does not apply to sync_mode='fsdp': "
+            "fsdp has NO trailing parameter allgather to defer — the "
+            "shard-local update writes back to the resident shard, and "
+            "the next forward's per-segment gathers are the only "
+            "re-materialization")
     if deferred_param_gather and spec is None:
         raise ValueError(
             "deferred_param_gather requires a DistributedOptimizer built "
             "with sync_mode='sharded' (there is no parameter allgather to "
             "defer in allreduce mode)")
+    if fsdp_spec is not None:
+        _check_flat_axis(axis_name, "make_train_step", "fsdp")
+        return _make_fsdp_train_step(
+            loss_fn, fsdp_spec, mesh, axis_name, donate, loss_is_averaged)
     if spec is not None:
         _check_flat_axis(axis_name, "make_train_step")
         return _make_sharded_train_step(
@@ -454,6 +485,94 @@ def _make_sharded_train_step(loss_fn, spec, mesh, axis_name, donate,
     # No transparent autotune here: the wrapper owns two programs and the
     # tuner's clear_cache contract assumes one jitted callable.
     return _StallWatchedStep(step, "train_step")
+
+
+def _make_fsdp_train_step(loss_fn, spec, mesh, axis_name, donate,
+                          loss_is_averaged, num_segments=None,
+                          name_prefix: str = "train_step"):
+    """The sync_mode='fsdp' program (ZeRO-3): parameters arrive as a
+    :class:`param_sharding.ShardedParams` of stacked ``(world, shard)``
+    rows sharded over the axis — each rank resident-holds ~1/n of the
+    model. Per segment, the forward allgathers the segment's parameters
+    just in time (independent HLOs: XLA overlaps segment k+1's gather
+    with segment k's compute), the backward emits the segment's gradient
+    reduce-scatter inside backprop (the gather boundary's custom-vjp),
+    and the shard-local inner update writes back to the resident shard
+    with no trailing allgather.
+
+    ``step(sharded_params, opt_state, batch) -> (sharded_params,
+    opt_state, loss)`` — build the resident layout with
+    ``hvd.shard_params(params)`` + ``shard_state``, and the stacked
+    optimizer state with the fsdp optimizer's ``init``.
+    """
+    import optax
+
+    from ..autotune import maybe_autotune_step
+    from ..optimizer import _SaltState, _known_size
+    from .param_sharding import ShardedParams, gather_params
+
+    int8 = getattr(spec.compression, "marker", None) == "int8"
+    n = _known_size(spec.process_set)
+    if n is None:
+        raise ValueError(
+            "sync_mode='fsdp' needs a known process-set size at step-build "
+            "time (init() first)")
+
+    def spmd_step(sharded_params, opt_state, batch):
+        if not isinstance(sharded_params, ShardedParams):
+            # SyncModeIneligibleError: this is a static-config
+            # eligibility fact, and the sync-mode sweep's skip net
+            # (autotune.tune_step_sync_mode) skips exactly this class —
+            # a builder that feeds replicated params must skip the fsdp
+            # candidate, not abort the sweep.
+            from ..exceptions import SyncModeIneligibleError
+
+            raise SyncModeIneligibleError(
+                "the fsdp train step takes resident ShardedParams (build "
+                "with hvd.shard_params(params) and place with "
+                f"shard_state), got {type(sharded_params).__name__}")
+        meta = sharded_params.meta
+        # Strip the leading world axis: inside the shard_map each rank
+        # sees its own (1, s) row of every leaf.
+        shards = jax.tree.unflatten(
+            meta.treedef, [a[0] for a in sharded_params.rows])
+        local_state = jax.tree.map(lambda a: a[0], opt_state)
+        if int8:
+            inner_local, salt = local_state.inner_state, local_state.counter
+        else:
+            inner_local, salt = local_state, None
+
+        def loss_of(sh):
+            full = gather_params(sh, meta, spec, axis_name, n, salt=salt,
+                                 num_segments=num_segments)
+            return loss_fn(full, batch)
+
+        # Gradients arrive ALREADY reduce-scattered to the shard domain:
+        # each segment boundary's backward emitted its reducescatter
+        # inside backprop and its cotangent IS the owned (s,) slice.
+        loss, grad_shards = jax.value_and_grad(loss_of)(shards)
+        updates, new_inner = spec.inner.update(grad_shards, inner_local,
+                                               shards)
+        new_shards = optax.apply_updates(shards, updates)
+        new_local = _SaltState(new_inner, salt + 1) if int8 else new_inner
+        new_rows = ShardedParams(
+            [a[None] for a in jax.tree.leaves(new_shards)], meta)
+        new_state = jax.tree.map(lambda a: a[None], new_local)
+        if loss_is_averaged:
+            loss = jax.lax.pmean(loss, axis_name)
+        return new_rows, new_state, loss
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh,
+        in_specs=(P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P(axis_name), P()),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return _StallWatchedStep(
+        maybe_autotune_step(jax.jit(sharded, donate_argnums=donate_argnums)),
+        name_prefix)
 
 
 def _segment_sync(leaves, seg_index, spec, axis_name, salt):
@@ -671,6 +790,16 @@ def make_overlapped_train_step(
     int8 = getattr(spec.compression, "marker", None) == "int8"
     sharded_mode = getattr(spec, "sync_mode", "allreduce") == "sharded"
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
+    if getattr(spec, "sync_mode", "allreduce") == "fsdp":
+        # fsdp's gather boundaries ARE the overlap machinery: each
+        # segment's reduce-scatter already rides a custom-vjp backward
+        # inside backprop, and the per-segment forward gathers prefetch
+        # against neighboring compute — the overlapped factory is the
+        # same program, with the requested segment count honored.
+        _check_flat_axis(axis_name, "make_overlapped_train_step", "fsdp")
+        return _make_fsdp_train_step(
+            loss_fn, spec, mesh, axis_name, donate, loss_is_averaged,
+            num_segments=num_segments, name_prefix="overlapped_train_step")
     if sharded_mode:
         _check_flat_axis(axis_name, "make_overlapped_train_step")
 
@@ -817,13 +946,23 @@ def make_elastic_train_step(
 
     from .. import basics
 
+    from ..exceptions import SyncModeIneligibleError
+
     if _sharded_spec_of(optimizer) is not None:
-        raise ValueError(
+        raise SyncModeIneligibleError(
             "make_elastic_train_step does not support sync_mode='sharded' "
             "(its cross-process leg reduces on the host plane, outside the "
             "compiled shard domain); build the compiled step with "
             "make_train_step and let hvd.elastic.TpuState(...,"
             "sharded_optimizer=...) re-shard state across world changes")
+    if _fsdp_spec_of(optimizer) is not None:
+        raise SyncModeIneligibleError(
+            "make_elastic_train_step does not support sync_mode='fsdp' "
+            "(its cross-process leg reduces on the host plane, outside "
+            "the compiled shard domain where the per-segment parameter "
+            "gathers live); build the compiled step with make_train_step "
+            "and let hvd.elastic.PeerShardedState re-shard the resident "
+            "parameter and optimizer shards across world changes")
     mesh = mesh or basics.global_mesh()
     axis = axis_name or basics.global_axis_name()
 

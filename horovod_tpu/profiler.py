@@ -86,6 +86,7 @@ def summary() -> dict:
         "goodput": metrics.goodput().summary(),
         "checkpoint": metrics.checkpoint_summary(),
         "stragglers": tracing.straggler_summary(),
+        "fsdp": metrics.fsdp_summary(),
         **cache_stats(),
     }
 

@@ -333,6 +333,108 @@ def _build_states(kv_server, n=4, epoch=7, genbox=None):
     return spec, params, stacked, states
 
 
+def _build_fsdp_states(kv_server, n=4, epoch=7):
+    """n single-controller PeerShardedStates under sync_mode='fsdp':
+    params live as resident ShardedParams rows, so the commit is
+    shard-local for params AND optimizer state."""
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic import PeerShardedState
+
+    spec = _sgd_spec()._replace(sync_mode="fsdp")
+    params_full = {"w": np.arange(10, dtype=np.float32),
+                   "b": np.float32(3.0)}
+    sp = hvd.shard_params(params_full, n)
+    stacked = init_sharded_state(spec, params_full, world_size=n)
+    states = []
+    for r in range(n):
+        rep = peercheck.PeerReplicator(
+            client=KVClient("127.0.0.1", kv_server.port), rank=r,
+            world_size_fn=lambda: n, generation_fn=lambda: 0)
+        states.append(PeerShardedState(
+            params=sp, opt_state=stacked, sharded_optimizer=spec,
+            replicator=rep, rank=r, world_size=n, epoch=epoch))
+    return spec, params_full, sp, stacked, states
+
+
+class TestFsdpPeerShardedState:
+    def test_commit_carries_own_param_row(self, hvd, kv_server):
+        _, _, sp, _, states = _build_fsdp_states(kv_server, n=4)
+        st = states[2]
+        saved = st._saved
+        assert saved["param_layout"] == "row"
+        assert saved["params"] is None  # no full copy anywhere in the commit
+        row_w = np.asarray(jax.tree.leaves(saved["param_row"])[-1])
+        want = np.asarray(sp.rows[-1])[2]
+        np.testing.assert_array_equal(row_w, want)
+        # ~1/n: the param snapshot holds one row of every leaf.
+        assert row_w.size * 4 == np.asarray(sp.rows[-1]).size
+
+    def test_restore_marks_params_dirty_too(self, hvd, kv_server):
+        _, _, _, _, states = _build_fsdp_states(kv_server, n=2)
+        st = states[1]
+        st.restore()
+        assert st.peer_restore_pending()
+        from horovod_tpu.parallel.param_sharding import ShardedParams
+
+        assert isinstance(st.params, ShardedParams)
+        # Only the own row survived the local snapshot; row 0 is zeros.
+        assert not np.any(np.asarray(st.params.rows[-1])[0])
+        with pytest.raises(HorovodInternalError, match="peer"):
+            st.sync()
+
+    def test_peer_restore_rebuilds_params_byte_exact(self, hvd, kv_server):
+        from horovod_tpu.optimizer import unshard_opt_state
+        from horovod_tpu.parallel.param_sharding import ShardedParams
+
+        spec, params_full, _, stacked, states = _build_fsdp_states(
+            kv_server, n=4)
+        st = states[1]
+        st.epoch = 99
+        st.restore()
+        assert st.restore_peer() is True
+        # Full monolithic install (params + opt), byte for byte.
+        assert not isinstance(st.params, ShardedParams)
+        for a, b in zip(jax.tree.leaves(params_full),
+                        jax.tree.leaves(st.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        want = jax.tree.map(
+            np.asarray, unshard_opt_state(spec, stacked, params_full))
+        for a, b in zip(jax.tree.leaves(want),
+                        jax.tree.leaves(st.opt_state)):
+            np.testing.assert_array_equal(a, np.asarray(b))
+        assert st.epoch == 7
+        st.sync()  # re-shards both for the (override) world
+        assert isinstance(st.params, ShardedParams)
+        assert st.params.world_size == 4
+        assert np.shape(jax.tree.leaves(st.opt_state)[0])[0] == 4
+
+    def test_missing_param_row_is_unavailable(self, hvd, kv_server):
+        import pickle as _pickle
+
+        _, _, _, _, states = _build_fsdp_states(kv_server, n=3)
+        st = states[2]
+        # Rewrite rank 0's record into one WITHOUT a param row (a mixed
+        # set — e.g. a pre-fsdp writer) — assembly must refuse, not
+        # silently drop the params.
+        with kv_server._httpd.lock:
+            blob = kv_server._httpd.store[peercheck.PEERSTATE_SCOPE]["0"]
+        rec = peercheck.decode_record(blob)
+        payload = _pickle.loads(rec.payload)
+        payload["param_row"] = None
+        payload["param_layout"] = "full"
+        new_blob = peercheck.encode_record(peercheck.ReplicaRecord(
+            rank=rec.rank, step=rec.step, generation=rec.generation,
+            world_size=rec.world_size, payload=_pickle.dumps(payload),
+            has_params=rec.has_params))
+        with kv_server._httpd.lock:
+            kv_server._httpd.store[peercheck.PEERSTATE_SCOPE]["0"] = new_blob
+        st._replicator.pool.clear()
+        st.restore()
+        with pytest.raises(peercheck.ReplicaUnavailableError,
+                           match="param shard row"):
+            st.restore_peer()
+
+
 class TestPeerShardedState:
     def test_commit_is_shard_local(self, hvd, kv_server):
         _, _, stacked, states = _build_states(kv_server, n=4)
@@ -737,6 +839,120 @@ print("host=%s finished at epoch %d" % (host, done), flush=True)
 '''
 
 
+_E2E_FSDP_WORKER = '''
+import os, signal, sys
+sys.path.insert(0, {repo_root!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+host = os.environ["HOROVOD_HOSTNAME"]
+tmp = os.environ["TEST_TMP"]
+os.environ["HOROVOD_EVENT_LOG"] = os.path.join(
+    tmp, "events-%s.jsonl" % host)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import pickle
+import numpy as np
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint, process_world
+from horovod_tpu.elastic import PeerShardedState, run as elastic_run
+from horovod_tpu.optimizer import ReduceSpec, init_sharded_state, \\
+    unshard_opt_state
+from horovod_tpu.parallel.param_sharding import ShardedParams, \\
+    shard_params, unshard_params
+
+LR, MU, EPOCHS = 0.05, 0.9, 6
+W0 = np.linspace(0.5, -0.5, 8).astype(np.float32)
+
+
+def local_grad(w, e, r):
+    rng = np.random.RandomState(1000 + 10 * e + r)
+    A = rng.randn(16, 8).astype(np.float32)
+    return ((A.T @ (A @ w)) / 16.0).astype(np.float32)
+
+
+spec = ReduceSpec(
+    inner=optax.sgd(LR, momentum=MU), op="average", compression=None,
+    prescale_factor=1.0, postscale_factor=1.0, process_set=None,
+    num_groups=0, fusion_threshold_bytes=None, backward_passes_per_step=1,
+    sync_mode="fsdp")
+n0 = process_world.size()
+params_full = {{"w": W0.copy()}}
+# Params live SHARDED at rest: the resident rows are what gets
+# committed (each rank's replica record carries its own param row).
+state = PeerShardedState(
+    params=shard_params(params_full, n0),
+    opt_state=init_sharded_state(spec, params_full, world_size=n0),
+    sharded_optimizer=spec, epoch=0)
+
+durable_path = os.path.join(tmp, "durable-%s.pkl" % host)
+
+
+def save_durable():
+    p_full = (unshard_params(state.params)
+              if isinstance(state.params, ShardedParams) else state.params)
+    full = unshard_opt_state(spec, state.opt_state, state.params)
+    blob = pickle.dumps({{"params": jax.device_get(p_full),
+                          "full": jax.device_get(full),
+                          "epoch": state.epoch}})
+    checkpoint.atomic_install(durable_path, blob)
+
+
+def durable_restore():
+    print("DURABLE_RESTORE_USED", flush=True)
+    with open(durable_path, "rb") as f:
+        t = pickle.loads(f.read())
+    state.install_full(t["params"], t["full"], epoch=t["epoch"])
+
+
+state.register_durable_restore(durable_restore)
+
+
+@elastic_run
+def train(state):
+    from horovod_tpu.parallel.hierarchical import _default_native_world
+
+    while state.epoch < EPOCHS:
+        e = state.epoch
+        r, n = process_world.rank(), process_world.size()
+        if host == "localhost" and e == 2 and n > 1:
+            print("host=%s SIGKILL at epoch 2" % host, flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        # Re-materialize the full params from the resident rows (the
+        # host-math twin of the per-segment forward gather).
+        w = np.asarray(unshard_params(state.params)["w"])
+        g = local_grad(w, e, r)
+        if n > 1:
+            world = _default_native_world()
+            g = np.asarray(world.allreduce(g, name="grad.%d" % e,
+                                           op="average"),
+                           dtype=np.float32)
+        # The shard-local update on the stacked momentum rows; the new
+        # params re-shard straight back to the resident layout — no
+        # trailing full-param state anywhere between steps.
+        tdef = jax.tree.structure(state.opt_state)
+        trace = np.asarray(jax.tree.leaves(state.opt_state)[0])
+        n_axis, s = trace.shape
+        g_rows = np.pad(g, (0, n_axis * s - g.size)).reshape(n_axis, s)
+        trace = (MU * trace + g_rows).astype(np.float32)
+        w = (w - LR * trace.reshape(-1)[: w.size]).astype(np.float32)
+        state.opt_state = jax.tree.unflatten(tdef, [trace])
+        state.params = shard_params({{"w": w}}, n_axis)
+        print("rank=%d epoch=%d np=%d gen=%s w0=%.6f wsum=%.6f" % (
+            r, e, n, os.environ.get("HOROVOD_WORLD_VERSION", "?"),
+            float(w[0]), float(np.sum(w))), flush=True)
+        state.epoch = e + 1
+        save_durable()
+        state.commit()
+    return state.epoch
+
+
+done = train(state)
+print("host=%s finished at epoch %d" % (host, done), flush=True)
+'''
+
+
 def _expected_trajectory():
     """The one continuous SGD-momentum trajectory the job must follow:
     epochs 0-1 on the 2-rank averaged gradient, 2+ solo on rank 0. Any
@@ -764,7 +980,7 @@ def _expected_trajectory():
     return out
 
 
-def _run_peer_e2e(tmp_path, corrupt):
+def _run_peer_e2e(tmp_path, corrupt, worker_src=_E2E_WORKER):
     import re
     import stat
 
@@ -772,7 +988,7 @@ def _run_peer_e2e(tmp_path, corrupt):
     from horovod_tpu.runner.launch import Settings
 
     worker = tmp_path / "peer_worker.py"
-    worker.write_text(_E2E_WORKER.format(repo_root=REPO_ROOT))
+    worker.write_text(worker_src.format(repo_root=REPO_ROOT))
     hosts_file = tmp_path / "hosts.txt"
     hosts_file.write_text("localhost\n127.0.0.1\n")
     discover = tmp_path / "discover.sh"
@@ -857,6 +1073,23 @@ class TestPeerRungE2E:
         assert any(e["event"] == "flight_record"
                    and e.get("reason") == "peer_restore"
                    for e in events), events
+
+    @pytest.mark.slow
+    def test_fsdp_sigkill_recovers_on_peer_rung(self, tmp_path,
+                                                monkeypatch):
+        """PR 8 acceptance: the same SIGKILL-one-worker chaos under
+        sync_mode='fsdp' — params resident-sharded, every replica record
+        carrying its own param shard row — recovers on the peer rung
+        with ZERO durable-storage reads and the exact loss continuity
+        (the momentum AND the re-assembled params crossed the recovery
+        intact)."""
+        text, events, rungs = _run_peer_e2e(
+            tmp_path, corrupt=False, worker_src=_E2E_FSDP_WORKER)
+        assert "peer" in rungs, rungs
+        assert "durable" not in rungs, rungs
+        assert any(e["event"] == "peer_restore" for e in events), events
+        assert not any(e["event"] == "peer_fallback" for e in events)
+        assert "DURABLE_RESTORE_USED" not in text, text
 
     @pytest.mark.slow
     def test_corrupt_replicas_fall_through_to_durable_rung(

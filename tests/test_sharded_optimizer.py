@@ -589,6 +589,148 @@ class TestCheckpointRoundTrip:
         _assert_tree_close(pm, sp)
 
 
+class TestCrossModeResumeChain:
+    """PR 8 satellite: the checkpoint layout is mode-INDEPENDENT across
+    all three sync modes, proven as a resume CHAIN — fsdp → sharded →
+    monolithic → fsdp, one file per hop — whose loss trajectory matches
+    an uninterrupted monolithic run step for step."""
+
+    def test_fsdp_sharded_monolithic_chain(self, hvd, tmp_path):
+        from horovod_tpu.checkpoint import (
+            load_state_and_broadcast,
+            save_state_on_rank_0,
+        )
+        from horovod_tpu.parallel.param_sharding import ShardedParams
+
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        b = dp.shard_batch(batch)
+
+        # The uninterrupted monolithic reference: 5 steps.
+        mono_ref = hvd.DistributedOptimizer(optax.adam(0.05))
+        step_ref = dp.make_train_step(loss_fn, mono_ref, donate=False)
+        pr, sr = dp.replicate(params), dp.replicate(mono_ref.init(params))
+        ref_losses = []
+        for _ in range(5):
+            pr, sr, loss = step_ref(pr, sr, b)
+            ref_losses.append(float(loss))
+
+        chain_losses = []
+
+        # Hop 1: 2 steps under fsdp, save.
+        fsdp = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        step_f = dp.make_train_step(loss_fn, fsdp, donate=False)
+        p = dp.shard_state(hvd.shard_params(params))
+        s = dp.shard_state(fsdp.init(params))
+        for _ in range(2):
+            p, s, loss = step_f(p, s, b)
+            chain_losses.append(float(loss))
+        path1 = str(tmp_path / "hop1.pkl")
+        save_state_on_rank_0(path1, fsdp, jax.device_get(p),
+                             jax.device_get(s))
+
+        # Hop 2: resume as sharded, 1 step, save.
+        shrd = hvd.DistributedOptimizer(optax.adam(0.05),
+                                        sync_mode="sharded")
+        obj = load_state_and_broadcast(path1, shrd)
+        assert not isinstance(obj["params"], ShardedParams)
+        step_s = dp.make_train_step(loss_fn, shrd, donate=False)
+        p = dp.replicate(obj["params"])
+        s = dp.shard_state(obj["opt_state"])
+        p, s, loss = step_s(p, s, b)
+        chain_losses.append(float(loss))
+        path2 = str(tmp_path / "hop2.pkl")
+        save_state_on_rank_0(path2, shrd, jax.device_get(p),
+                             jax.device_get(s))
+
+        # Hop 3: resume as monolithic, 1 step, save.
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        obj = load_state_and_broadcast(path2, mono)
+        step_m = dp.make_train_step(loss_fn, mono, donate=False)
+        p = dp.replicate(obj["params"])
+        s = dp.replicate(obj["opt_state"])
+        p, s, loss = step_m(p, s, b)
+        chain_losses.append(float(loss))
+        path3 = str(tmp_path / "hop3.pkl")
+        save_state_on_rank_0(path3, mono, jax.device_get(p),
+                             jax.device_get(s))
+
+        # Hop 4: back to fsdp (load re-shards params into resident rows).
+        fsdp2 = hvd.DistributedOptimizer(optax.adam(0.05),
+                                         sync_mode="fsdp")
+        obj = load_state_and_broadcast(path3, fsdp2)
+        assert isinstance(obj["params"], ShardedParams)
+        step_f2 = dp.make_train_step(loss_fn, fsdp2, donate=False)
+        p = dp.shard_state(obj["params"])
+        s = dp.shard_state(obj["opt_state"])
+        p, s, loss = step_f2(p, s, b)
+        chain_losses.append(float(loss))
+
+        assert chain_losses == pytest.approx(ref_losses, rel=1e-5)
+
+
+class TestFsdpElasticResizeChain:
+    def test_resize_8_4_6_keeps_trajectory(self, hvd):
+        """Elastic resize chain 8 -> 4 -> 6 under fsdp (the PR 7 resize
+        pattern, extended to resident params): each hop unshard-reshards
+        params AND optimizer rows for the new world, and every segment
+        of the chain matches a monolithic run from the same synced state
+        on the same process set, step for step."""
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem(batch=24)
+        x, y = batch
+
+        def world(ranks):
+            if len(ranks) == 8:
+                return None, hvd.global_mesh(), "hvd"
+            ps = _get_or_add_ps(hvd, ranks)
+            return ps, ps.mesh, ps.axis_name
+
+        cur_params, cur_full_state = params, None
+        for ranks, nbatch in (([*range(8)], 24), ([*range(4)], 16),
+                              ([*range(6)], 24)):
+            n = len(ranks)
+            ps, mesh, axis = world(ranks)
+            kw = dict(process_set=ps) if ps is not None else {}
+            fsdp = hvd.DistributedOptimizer(optax.adam(0.05),
+                                            sync_mode="fsdp", **kw)
+            mono = hvd.DistributedOptimizer(optax.adam(0.05), **kw)
+            step_f = dp.make_train_step(loss_fn, fsdp, mesh=mesh,
+                                        axis_name=axis, donate=False)
+            step_m = dp.make_train_step(loss_fn, mono, mesh=mesh,
+                                        axis_name=axis, donate=False)
+            bb = dp.shard_batch((x[:nbatch], y[:nbatch]), mesh=mesh,
+                                axis_name=axis)
+            # Re-shard the synced full state for THIS world (ownership
+            # is a pure function of the new size — no coordination).
+            sp = dp.shard_state(hvd.shard_params(cur_params, n), mesh=mesh,
+                                axis_name=axis)
+            if cur_full_state is None:
+                sf = dp.shard_state(
+                    hvd.init_sharded_state(fsdp, cur_params, world_size=n),
+                    mesh=mesh, axis_name=axis)
+                mono_state = mono.init(cur_params)
+            else:
+                sf = dp.shard_state(
+                    hvd.reshard_opt_state(fsdp, cur_full_state,
+                                          cur_params, n),
+                    mesh=mesh, axis_name=axis)
+                mono_state = cur_full_state
+            pm = dp.replicate(cur_params, mesh=mesh)
+            sm = dp.replicate(mono_state, mesh=mesh)
+            for _ in range(2):
+                sp, sf, l_f = step_f(sp, sf, bb)
+                pm, sm, l_m = step_m(pm, sm, bb)
+                assert float(l_f) == pytest.approx(float(l_m), rel=1e-6)
+            # "Sync": gather to the mode-independent layout for the next
+            # world (what TpuState.sync does across a real resize).
+            cur_params = hvd.unshard_params(jax.device_get(sp))
+            cur_full_state = hvd.unshard_opt_state(
+                fsdp, jax.device_get(sf), cur_params)
+            _assert_tree_close(jax.device_get(pm), cur_params)
+            _assert_tree_close(jax.device_get(sm), cur_full_state)
+
+
 class TestAutotuneSyncModeAxis:
     """The sync_mode axis in the joint warmup grid: candidates expand the
     product, _pin pins the mode process-wide, and an abort pins the
@@ -676,7 +818,7 @@ class TestAutotuneSyncModeAxis:
             built.append(mode)
 
             def run():
-                if mode == "allreduce":
+                if mode != "sharded":
                     time.sleep(0.03)
                 return jnp.zeros(())
 
@@ -684,7 +826,8 @@ class TestAutotuneSyncModeAxis:
 
         try:
             best = at.tune_step_sync_mode(build, iters=1)
-            assert built == ["allreduce", "sharded"]
+            # fsdp joined the default sweep axis (PR 8).
+            assert built == ["allreduce", "sharded", "fsdp"]
             assert best == "sharded"
             assert at.tuned_sync_mode() == "sharded"
         finally:

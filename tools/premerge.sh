@@ -81,10 +81,14 @@ if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     exit 1
 fi
 # Perf lane: the machinery metrics must be PRESENT in the record (a bench
-# refactor silently dropping them reads as "no regression" forever), and
-# the sharded sync mode must not regress more than 2% below the
-# monolithic machinery ratio (both are vs the same raw baseline, so the
-# comparison cancels the baseline out).
+# refactor silently dropping them reads as "no regression" forever); the
+# sharded sync mode must not regress more than 2% below the monolithic
+# machinery ratio (both are vs the same raw baseline, so the comparison
+# cancels the baseline out); the fsdp mode must not regress more than 2%
+# below sharded (same wire bytes per step — RS+AG — so the comparison
+# isolates where the gather sits) and its per-rank resident param+opt
+# bytes must be < 40% of monolithic (the memory win that motivates the
+# mode; on the 8-dev mesh the honest number is ~1/8).
 if ! python - "$blog" <<'EOF'
 import json
 import sys
@@ -102,18 +106,39 @@ if last is None:
     sys.exit("premerge perf lane: no JSON record in bench output")
 mono = last.get("vs_baseline_machinery")
 sharded = last.get("vs_baseline_machinery_sharded")
-if mono is None or sharded is None:
+fsdp = last.get("vs_baseline_machinery_fsdp")
+resident = last.get("resident_bytes_per_rank") or {}
+if mono is None or sharded is None or fsdp is None:
     sys.exit(
         "premerge perf lane: machinery metrics missing from bench record "
         f"(vs_baseline_machinery={mono!r}, "
-        f"vs_baseline_machinery_sharded={sharded!r})")
+        f"vs_baseline_machinery_sharded={sharded!r}, "
+        f"vs_baseline_machinery_fsdp={fsdp!r})")
 if sharded < mono * 0.98:
     sys.exit(
         f"premerge perf lane: sharded sync mode regressed "
         f"{(1 - sharded / mono) * 100:.1f}% below the monolithic "
         f"machinery ratio (sharded={sharded}, monolithic={mono}, "
         f"allowed slack 2%)")
-print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded})")
+if fsdp < sharded * 0.98:
+    sys.exit(
+        f"premerge perf lane: fsdp sync mode regressed "
+        f"{(1 - fsdp / sharded) * 100:.1f}% below the sharded machinery "
+        f"ratio (fsdp={fsdp}, sharded={sharded}, allowed slack 2%)")
+r_mono = resident.get("monolithic")
+r_fsdp = resident.get("fsdp")
+if not r_mono or r_fsdp is None:
+    sys.exit(
+        "premerge perf lane: resident_bytes_per_rank missing from bench "
+        f"record (got {resident!r})")
+if r_fsdp >= 0.40 * r_mono:
+    sys.exit(
+        f"premerge perf lane: fsdp resident param+opt bytes are "
+        f"{r_fsdp / r_mono:.1%} of monolithic (must be < 40%: the "
+        f"params-sharded-at-rest contract; fsdp={r_fsdp}, "
+        f"monolithic={r_mono})")
+print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded}, "
+      f"fsdp={fsdp}, resident fsdp/mono={r_fsdp / r_mono:.1%})")
 EOF
 then
     echo "premerge: perf lane failed" >&2
@@ -192,6 +217,10 @@ try:
         "hvd_straggler_score",
         "hvd_checkpoint_seconds",
         "hvd_peer_replication_bytes",
+        "hvd_param_gather_bytes",
+        "hvd_param_gather_seconds",
+        "hvd_resident_state_bytes",
+        "hvd_fsdp_prefetch_overlap_ratio",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
