@@ -80,12 +80,24 @@ def _maybe_init_distributed() -> None:
         from .runner.elastic import worker as elastic_worker
 
         ctx = elastic_worker.get_worker_context()
-        ctx.apply_to_env(ctx.fetch_assignment())
-        ctx.start_polling()
-        # Liveness plane: publish heartbeats so the driver can tell a hung
-        # host (SIGSTOP'd, wedged VM) from a slow one — popen.poll() alone
-        # cannot. No-op when HOROVOD_ELASTIC_HEARTBEAT_INTERVAL <= 0.
-        ctx.start_heartbeat()
+        if elastic_worker.spare_mode():
+            # Warm spare: no assignment exists yet by design. Start the
+            # poll loop (advances the generation view so KV writes stay
+            # fenced) and the heartbeat sender (the driver's liveness
+            # plane watches spares too) FIRST, then park until the driver
+            # publishes a world that includes this host — promotion costs
+            # one re-rendezvous, not a cold launch.
+            ctx.start_polling()
+            ctx.start_heartbeat()
+            ctx.apply_to_env(ctx.wait_for_assignment())
+        else:
+            ctx.apply_to_env(ctx.fetch_assignment())
+            ctx.start_polling()
+            # Liveness plane: publish heartbeats so the driver can tell a
+            # hung host (SIGSTOP'd, wedged VM) from a slow one —
+            # popen.poll() alone cannot. No-op when
+            # HOROVOD_ELASTIC_HEARTBEAT_INTERVAL <= 0.
+            ctx.start_heartbeat()
 
     coord = os.environ.get("HOROVOD_COORDINATOR_ADDR", "")
     nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "0") or 0)

@@ -121,7 +121,10 @@ def run(func):
     phase into the goodput tracker — world formation + ``sync()`` as
     ``rendezvous`` loss, ``restore()``/durable restore as ``restore``
     loss, the inter-attempt sleep as ``backoff`` loss, time inside
-    ``func`` as productive — surfaced in ``hvd.profiler.summary()`` and
+    ``func`` as productive — except a FAILED attempt
+    (``HorovodInternalError``: its work rolls back and replays), whose
+    doomed tail after the last landed commit books as
+    ``failed_attempt`` loss — surfaced in ``hvd.profiler.summary()`` and
     the ``hvd_goodput_*`` scrape counters; and journals every lifecycle
     transition (world_synced, recovery rung, checkpoint fallback,
     hosts_updated, removed_from_world, recovery_exhausted) to
@@ -205,19 +208,40 @@ def run(func):
                 # only commits the training function itself lands count as
                 # progress for the storm breaker below.
                 commits_before_attempt = _counters.commits
-                # Formation + sync time is rendezvous loss; everything
-                # from here until func returns/raises is productive.
+                # Formation + sync time is rendezvous loss; from here on
+                # the attempt's time is attributed by how it ends: up to
+                # the last landed commit is productive; a failed
+                # attempt's doomed tail (after its last commit, or the
+                # whole attempt when nothing committed) books as
+                # lost{cause="failed_attempt"} so the SLO controller
+                # optimizes an honest signal.
                 goodput.add_lost(
                     "rendezvous", time.perf_counter() - t_attempt)
                 run_started = time.perf_counter()
                 try:
-                    return func(state, *args, **kwargs)
-                finally:
-                    # Covers return AND raise: time inside func counts as
-                    # productive either way (the un-committed tail of a
-                    # failed attempt is unknowable; documented caveat).
+                    result = func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    now = time.perf_counter()
+                    last_commit = _counters.last_commit_pc
+                    if (_counters.commits > commits_before_attempt
+                            and last_commit is not None
+                            and run_started <= last_commit <= now):
+                        goodput.add_productive(last_commit - run_started)
+                        goodput.add_lost(
+                            "failed_attempt", now - last_commit)
+                    else:
+                        goodput.add_lost(
+                            "failed_attempt", now - run_started)
+                    raise
+                except BaseException:
+                    # Host updates, drain exits, and user exceptions all
+                    # end at (or propagate out of) a consistent point:
+                    # their in-func time stays productive.
                     goodput.add_productive(
                         time.perf_counter() - run_started)
+                    raise
+                goodput.add_productive(time.perf_counter() - run_started)
+                return result
             except HorovodInternalError as e:
                 from .. import abort, stall
                 from ..runner.elastic.worker import _counters

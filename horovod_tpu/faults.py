@@ -16,6 +16,20 @@ kill workers by behavior flag). This module generalizes that into named
   simulate delayed abort propagation
 - ``checkpoint.save``    — every durable checkpoint write attempt
 - ``checkpoint.restore`` — every durable checkpoint read/restore attempt
+- ``policy.decide``      — every self-healing policy evaluation on the
+  elastic driver (``raise`` proves a broken policy cannot take the driver
+  down; ``delay`` defers decisions)
+- ``spare.promote``      — every warm-spare promotion into the world
+  (``raise`` forces the cold-launch fallback path)
+
+The canonical **straggler injector** is a ``delay`` on ``worker.step``::
+
+    HOROVOD_FAULTS="worker.step=delay:1.5@1x999999"
+
+Every stall-watched step on the armed worker then enters its collectives
+``1.5`` seconds late — a persistently slow-but-alive host, exactly the
+signal the tracing plane's skew gauges and the self-healing policy
+(``horovod_tpu/elastic/policy.py``) detect and drain.
 
 Each point can be armed (via API or env) to **drop**, **delay**, **raise**,
 or **hang** on the Nth hit, for a window of consecutive hits — deterministic
@@ -63,6 +77,8 @@ CHECKPOINT_SAVE = "checkpoint.save"
 CHECKPOINT_RESTORE = "checkpoint.restore"
 PEER_REPLICATE = "peer.replicate"
 PEER_VERIFY = "peer.verify"
+POLICY_DECIDE = "policy.decide"
+SPARE_PROMOTE = "spare.promote"
 
 _MODES = ("drop", "delay", "raise", "hang")
 _DEFAULT_HANG_S = 3600.0

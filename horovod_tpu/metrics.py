@@ -700,6 +700,22 @@ FSDP_PREFETCH_OVERLAP = gauge(
     "Fraction of the fsdp parameter-gather time hidden under compute "
     "(gather time hidden / total gather time), derived from the bench "
     "phase probes and tracing spans.")
+# Self-healing policy plane (driver-side; the rendezvous server mirrors
+# these into the /metrics scrape so they exist even before a decision —
+# see runner/http/kv_server.py).
+POLICY_DECISIONS = counter(
+    "hvd_policy_decisions_total",
+    "Self-healing policy actions taken by the elastic driver "
+    "(drain|promote|preempt).", ("action",))
+POLICY_SPARES = gauge(
+    "hvd_policy_spare_hosts",
+    "Warm spare hosts currently launched, heartbeating, and held out of "
+    "the world by the elastic driver.")
+POLICY_STRAGGLER_EWMA = gauge(
+    "hvd_policy_straggler_ewma_seconds",
+    "EWMA (over HOROVOD_STRAGGLER_WINDOW) of each host's straggler "
+    "score — the sustained-evidence signal the drain decision "
+    "thresholds on.", ("host",))
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -782,16 +798,20 @@ class GoodputTracker:
     the user's training function is **productive**; world formation +
     ``state.sync()`` is lost to ``rendezvous``; ``restore()`` /
     ``restore_durable()`` to ``restore``; the inter-attempt exponential
-    backoff sleep to ``backoff``. Caveat (documented, not hidden):
-    training time that ends in a failure still counts as productive —
-    the un-committed tail is unknowable without step-level accounting.
+    backoff sleep to ``backoff``; and the doomed tail of a FAILED
+    attempt (one ending in ``HorovodInternalError`` — its work rolls
+    back and replays) — everything after its last landed commit, or the
+    whole attempt when no commit landed — to ``failed_attempt``, so the
+    SLO controller optimizes an honest signal. Attempts that return (or
+    end in a host-update/drain interrupt at a consistent point) book
+    fully productive: their tail is retained work, not a replay.
 
     Mirrored live into the ``hvd_goodput_*`` registry counters so the
     cluster scrape carries every rank's goodput; :meth:`summary` is the
     process-local view ``profiler.summary()`` and ``bench.py`` emit.
     """
 
-    CAUSES = ("rendezvous", "restore", "backoff")
+    CAUSES = ("rendezvous", "restore", "backoff", "failed_attempt")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -168,6 +168,7 @@ def hierarchical_allreduce(
 # ---------------------------------------------------------------------------
 
 _host_world = None
+_host_world_gen = None  # HOROVOD_WORLD_VERSION the cached world was built in
 
 
 def _default_native_world():
@@ -178,8 +179,17 @@ def _default_native_world():
     teardown, another NativeWorld instance) can kill it — in which case the
     next call re-establishes a live world instead of handing back a dead
     one forever.
+
+    In an elastic world, a cached world found dead within the SAME
+    generation it was built for is a peer-departure signal (a drained or
+    crashed rank's negotiated shutdown), not a rebuild opportunity:
+    re-forming from the still-stale env would re-join the dying epoch's
+    endpoints (connect-timeout against a drained peer's dead coordinator).
+    That case raises ``HorovodInternalError`` so the elastic recovery
+    ladder re-rendezvouses with fresh env; once re-init has advanced
+    ``HOROVOD_WORLD_VERSION``, rebuilding is legitimate again.
     """
-    global _host_world
+    global _host_world, _host_world_gen
     if _host_world is not None and not _host_world.alive:
         # Initialized-but-dead (fatal control-plane error) or shut down:
         # tear down so re-init can form a fresh world (elastic recovery).
@@ -188,24 +198,58 @@ def _default_native_world():
         except Exception:
             pass
         _host_world = None
+        import os
+
+        from ..runner.elastic.worker import elastic_enabled
+
+        env_gen = os.environ.get("HOROVOD_WORLD_VERSION")
+        if (elastic_enabled() and env_gen is not None
+                and env_gen == _host_world_gen):
+            from ..exceptions import HorovodInternalError
+
+            raise HorovodInternalError(
+                f"native host world died within generation {env_gen} "
+                "(peer drained or crashed); entering elastic recovery"
+            )
     if _host_world is None:
         import os
 
-        from ..runtime import NativeWorld
+        from ..runner.elastic.worker import elastic_enabled
+        from ..runtime import NativeRuntimeError, NativeWorld
+        from ..utils.env import get_float
 
         nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
         proc_id = int(os.environ.get("HOROVOD_PROCESS_ID", "0") or 0)
         addr = os.environ.get("HOROVOD_COORDINATOR_ADDR", "127.0.0.1")
         addr = addr.rsplit(":", 1)[0]
         port = int(os.environ.get("HOROVOD_NATIVE_PORT", "0") or 0)
-        if nprocs > 1:
-            addr, port = _exchange_native_endpoint(proc_id, port)
-        if nprocs > 1 and not port:
-            raise RuntimeError(
-                "host_hierarchical_allreduce needs HOROVOD_NATIVE_PORT (the "
-                "native runtime's coordinator port) in a multi-process world"
-            )
-        _host_world = NativeWorld(proc_id, nprocs, addr, port or 29500)
+        try:
+            if nprocs > 1:
+                addr, port = _exchange_native_endpoint(proc_id, port)
+            if nprocs > 1 and not port:
+                raise RuntimeError(
+                    "host_hierarchical_allreduce needs HOROVOD_NATIVE_PORT "
+                    "(the native runtime's coordinator port) in a "
+                    "multi-process world"
+                )
+            _host_world = NativeWorld(
+                proc_id, nprocs, addr, port or 29500,
+                timeout_s=get_float("HOROVOD_NATIVE_INIT_TIMEOUT", 30.0))
+        except (NativeRuntimeError, TimeoutError) as e:
+            if not elastic_enabled():
+                raise
+            # An elastic epoch can die between this worker's assignment
+            # fetch and its native join (a drained peer's coordinator is
+            # gone, the endpoint never gets published, ...). That is
+            # world churn, not a fatal runtime fault: surface it as the
+            # recovery exception so the elastic ladder re-rendezvouses
+            # with fresh state instead of the process dying rc=1.
+            from ..exceptions import HorovodInternalError
+
+            raise HorovodInternalError(
+                f"native host world join failed ({e}); entering elastic "
+                "recovery") from e
+        _host_world_gen = os.environ.get("HOROVOD_WORLD_VERSION")
         _register_atexit_shutdown()
     return _host_world
 

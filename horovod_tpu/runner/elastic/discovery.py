@@ -120,10 +120,27 @@ class HostManager:
         valid_sizes: Callable[[int], bool] | None = None,
         cooldown_s: float | None = None,
         max_discovery_failures: int | None = None,
+        warm_spares: int | None = None,
     ):
         from ...utils.env import get_float, get_int
 
         self._discovery = discovery
+        # Warm-spare tier (HOROVOD_WARM_SPARES): up to this many usable
+        # hosts are held OUT of the world — discovered, launchable,
+        # heartbeating — so a replacement costs one re-rendezvous at the
+        # next generation fence instead of a cold launch. 0 (default)
+        # disables the tier entirely (HEAD behavior, bit for bit).
+        self._warm_spares = (
+            get_int("HOROVOD_WARM_SPARES", 0)
+            if warm_spares is None else warm_spares)
+        self._spares: set[str] = set()
+        # Hosts whose blacklist cooldown expired while still discovered:
+        # with the spare tier enabled they must RE-ENTER AS SPARES, not
+        # swap straight back into a healthy world — a host that was just
+        # condemned proves itself warm first. The flag clears when the
+        # world actually NEEDS the host (a shrink below target), which is
+        # exactly the promotion path.
+        self._cooldown_returned: set[str] = set()
         # A single discovery blip is routine (script timeout, cloud API
         # hiccup) and the driver retries it; a STREAK of
         # HOROVOD_ELASTIC_DISCOVERY_FAILURES consecutive failures means
@@ -221,6 +238,8 @@ class HostManager:
             # that re-admits nothing.
             if h in self._current:
                 self._expired_pending = True
+                if self._warm_spares > 0:
+                    self._cooldown_returned.add(h)
 
     def _usable_locked(self) -> dict[str, int]:
         self._prune_blacklist_locked()
@@ -239,9 +258,23 @@ class HostManager:
         first for rank stability, append new hosts, cap at max_np, snap to
         a topology-valid shape (host granularity + homogeneous local size,
         :func:`snap_to_topology`), then snap down to the largest valid
-        host count."""
+        host count.
+
+        With the warm-spare tier enabled (``warm_spares > 0``) the pick
+        additionally: (a) holds up to ``warm_spares`` surplus usable hosts
+        OUT of the world (``spare_hosts()`` reports them — the driver
+        keeps warm worker processes on them); (b) keeps cooldown-returned
+        hosts in the spare tier until the world actually needs them to
+        reach its target size — a just-condemned host proves itself warm
+        before it re-enters; a blacklisted host is never usable at all,
+        so it can appear in neither the world nor the spare tier.
+        """
         with self._lock:
             usable = self._usable_locked()
+            # A returned host that left discovery (or was re-blacklisted)
+            # sheds the flag — stale entries must not leak.
+            self._cooldown_returned &= set(usable)
+            returned = set(self._cooldown_returned)
         ordered: list[HostInfo] = []
         for h in preferred:
             if h in usable:
@@ -249,7 +282,51 @@ class HostManager:
         for h, s in sorted(usable.items()):
             if all(o.hostname != h for o in ordered):
                 ordered.append(HostInfo(h, s))
-        ordered = snap_to_topology(ordered, max_hosts=max_np)
-        while ordered and not self._valid(len(ordered)):
-            ordered.pop()
-        return ordered
+        if self._warm_spares <= 0:
+            ordered = snap_to_topology(ordered, max_hosts=max_np)
+            while ordered and not self._valid(len(ordered)):
+                ordered.pop()
+            with self._lock:
+                self._spares = set()
+            return ordered
+        # Spare-aware pick: fill the world from hosts NOT gated behind the
+        # cooldown-return rule first; promote returned hosts only when the
+        # world would otherwise fall short of its budget.
+        budget = max_np if max_np is not None else max(
+            len(ordered) - self._warm_spares, 1)
+        world = [h for h in ordered if h.hostname not in returned][:budget]
+        promoted: set[str] = set()
+        if len(world) < budget:
+            for h in ordered:
+                if len(world) >= budget:
+                    break
+                if h.hostname in returned and all(
+                        o.hostname != h.hostname for o in world):
+                    world.append(h)
+                    promoted.add(h.hostname)
+        # Re-impose preferred-first order (rank stability) after the fill.
+        order_index = {h.hostname: i for i, h in enumerate(ordered)}
+        world.sort(key=lambda h: order_index[h.hostname])
+        world = snap_to_topology(world, max_hosts=budget)
+        while world and not self._valid(len(world)):
+            world.pop()
+        world_names = {h.hostname for h in world}
+        spares = [h for h in ordered
+                  if h.hostname not in world_names][: self._warm_spares]
+        with self._lock:
+            self._cooldown_returned -= promoted & world_names
+            self._spares = {h.hostname for h in spares}
+        return world
+
+    def spare_hosts(self) -> list[HostInfo]:
+        """The current spare tier: usable hosts the last ``pick_world``
+        held out of the world for warm standby (empty when the tier is
+        disabled)."""
+        with self._lock:
+            usable = self._usable_locked()
+            return [HostInfo(h, usable[h])
+                    for h in sorted(self._spares) if h in usable]
+
+    @property
+    def warm_spares_target(self) -> int:
+        return self._warm_spares

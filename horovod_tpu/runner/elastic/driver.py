@@ -24,20 +24,25 @@ from __future__ import annotations
 
 import json
 import os
+import signal
+import threading
 import time
 
+from ... import faults
 from ... import metrics as _metrics
+from ...elastic.policy import PolicyController
 from ...exceptions import HostDiscoveryFailedError
 from ...utils.env import get_float
 from ...utils.logging import get_logger
 from ..exec_utils import (
     WorkerProc,
     build_worker_env,
+    drain_worker,
     launch_worker,
     terminate_worker,
     terminate_workers,
 )
-from ..hosts import HostInfo, get_host_assignments
+from ..hosts import HostInfo, ProcessAssignment, get_host_assignments
 from ..http.kv_server import RendezvousServer
 from ..network import coordinator_addr, driver_addr, free_port
 from .discovery import FixedHostDiscovery, HostDiscoveryScript, HostManager
@@ -94,6 +99,15 @@ class ElasticDriver:
             "HOROVOD_ELASTIC_HEARTBEAT_GRACE",
             max(10.0 * self._hb_timeout, 60.0),
         )
+        # Self-healing policy plane (ROADMAP item 3): the controller that
+        # turns the straggler/goodput sensors into proactive drains. Inert
+        # unless HOROVOD_TARGET_GOODPUT is set; the warm-spare tier is
+        # governed independently by HOROVOD_WARM_SPARES (via HostManager).
+        self._policy = PolicyController(min_np=self._min_np)
+        self._spare_procs: dict[str, WorkerProc] = {}
+        self._rate_state: dict[str, tuple[float, float]] = {}
+        self._last_policy_tick = 0.0
+        self._draining = False
 
     # -- world formation -----------------------------------------------------
 
@@ -176,6 +190,39 @@ class ElasticDriver:
                 # re-crash gets reaped (and blacklisted) by the monitor
                 # normally.
                 del self._workers[a.hostname]
+            sp = self._spare_procs.pop(a.hostname, None)
+            if sp is not None and sp.popen.poll() is None:
+                # Warm-spare promotion: the host already runs a launched,
+                # heartbeating, framework-imported worker parked on the
+                # assignment wait — move it into the world instead of
+                # cold-launching. Its poll loop sees this version bump and
+                # fetches the assignment; the join costs one
+                # re-rendezvous. Heartbeat record deliberately kept (it
+                # is live — clearing it would reset liveness to the
+                # never-heartbeated grace).
+                try:
+                    if faults.fire(faults.SPARE_PROMOTE):
+                        raise faults.InjectedFault(
+                            "spare promotion dropped")
+                    self._workers[a.hostname] = sp
+                    self._server.clear_spare(a.hostname)
+                    self._server.record_policy_action("promote")
+                    _metrics.POLICY_DECISIONS.inc(action="promote")
+                    _metrics.event("spare_promoted", generation=version,
+                                   host=a.hostname, rank=a.rank)
+                    self._log.info(
+                        "elastic: promoting warm spare on %s into the "
+                        "world (rank %d/%d, v%d)",
+                        a.hostname, a.rank, a.size, version,
+                    )
+                    continue
+                except Exception as e:  # noqa: BLE001 — chaos/injection
+                    self._log.warning(
+                        "elastic: spare promotion on %s failed (%s); "
+                        "falling back to a cold launch", a.hostname, e,
+                    )
+                    terminate_worker(sp)
+                    self._server.clear_spare(a.hostname)
             env = build_worker_env(
                 a,
                 base_env=dict(os.environ),
@@ -208,6 +255,7 @@ class ElasticDriver:
             )
 
     def _reconfigure(self) -> None:
+        t0 = time.monotonic()
         hosts = self._manager.pick_world(
             [h.hostname for h in self._world_hosts], self._max_np
         )
@@ -215,6 +263,21 @@ class ElasticDriver:
             hosts = self._wait_for_available_slots(
                 self._min_np, self._settings.elastic_timeout
             )
+        if (self._manager.warm_spares_target > 0
+                and [(h.hostname, h.slots) for h in hosts]
+                == [(h.hostname, h.slots) for h in self._world_hosts]
+                and all(h.hostname in self._workers
+                        and self._workers[h.hostname].popen.poll() is None
+                        for h in hosts)):
+            # Spare-tier-only change (a cooldown-returned host routed to
+            # standby, a surplus host discovered): the WORLD is unchanged
+            # AND every world host still runs a live worker — a host
+            # reaped without blacklisting (EXIT_DRIVER_LOST) keeps its
+            # world slot and MUST fall through to the relaunch below.
+            # Publishing a new epoch here would only churn every worker
+            # through a re-sync; refresh the spare fleet instead.
+            self._ensure_spares(self._server.version)
+            return
         keep = {h.hostname for h in hosts}
         # Kill workers on hosts that left the world.
         leaving = [n for n in self._workers if n not in keep]
@@ -225,6 +288,10 @@ class ElasticDriver:
         terminate_workers([self._workers.pop(n) for n in leaving])
         version = self._publish_world(hosts)
         self._launch_missing_workers(version)
+        self._ensure_spares(version)
+        # The SLO gate weighs a voluntary drain against the MEASURED
+        # price of a re-rendezvous, not an assumed one.
+        self._policy.note_resize_cost(time.monotonic() - t0)
 
     # -- main loop -----------------------------------------------------------
 
@@ -237,26 +304,46 @@ class ElasticDriver:
         self._server.start()
         version = self._publish_world(hosts)
         self._launch_missing_workers(version)
+        self._ensure_spares(version)
+        prev_sigterm = self._install_sigterm_forwarder()
         try:
             return self._monitor()
         finally:
-            terminate_workers(list(self._workers.values()))
+            terminate_workers(list(self._workers.values())
+                              + list(self._spare_procs.values()))
+            try:
+                # A decision whose realization window the job outlived
+                # still gets its policy_decision record (partial window).
+                self._policy.flush()
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                pass
+            if prev_sigterm is not None:
+                try:
+                    signal.signal(signal.SIGTERM, prev_sigterm)
+                except (ValueError, OSError):
+                    pass
             self._server.stop()
 
-    def _dead_by_heartbeat(self) -> list[tuple[str, str]]:
+    def _dead_by_heartbeat(
+            self, procs: dict[str, WorkerProc] | None = None,
+    ) -> list[tuple[str, str]]:
         """Hosts the liveness plane has declared dead: (host, why) pairs.
 
         A host is dead when its last heartbeat is older than hb_timeout,
         or — if it has NEVER heartbeated — when hb_grace has elapsed since
         its launch (interpreter startup, framework import). popen.poll()
         cannot see either case: a SIGSTOP'd process, a wedged TPU VM, or a
-        livelocked trainer is still "running" to the OS.
+        livelocked trainer is still "running" to the OS. ``procs``
+        defaults to the world workers; the spare fleet is checked with the
+        same rule (a hung spare is a replacement that would not replace).
         """
         if self._hb_timeout <= 0:
             return []
+        if procs is None:
+            procs = self._workers
         dead: list[tuple[str, str]] = []
         now = time.monotonic()
-        for name, w in self._workers.items():
+        for name, w in procs.items():
             if w.popen.poll() is not None:
                 continue  # exited: the reap path owns it
             age = self._server.heartbeat_age(name)
@@ -293,6 +380,292 @@ class ElasticDriver:
             blacklisted=self._manager.blacklist_count())
         _metrics.event("blacklist", generation=self._server.generation,
                        host=name, reason=why)
+
+    # -- warm spares ---------------------------------------------------------
+
+    def _launch_spare(self, host: HostInfo, version: int) -> None:
+        """Launch a WARM SPARE worker on ``host``: same command, same env
+        contract, plus ``HOROVOD_SPARE=1`` — the worker imports its
+        frameworks, heartbeats, registers at ``PUT /spare/<host>``, and
+        parks on the assignment wait until a world includes it."""
+        assignment = ProcessAssignment(
+            hostname=host.hostname, rank=0, size=1, local_rank=0,
+            local_size=1, cross_rank=0, cross_size=1, slots=host.slots,
+            first_device_rank=0)
+        world_names = [h.hostname for h in self._world_hosts]
+        env = build_worker_env(
+            assignment,
+            base_env=dict(os.environ),
+            rendezvous_addr=driver_addr(world_names + [host.hostname]),
+            rendezvous_port=self._server.port,
+            coordinator_addr=coordinator_addr(world_names or
+                                              [host.hostname]),
+            coordinator_port=self._coord_port,
+            native_port=self._native_port,
+            cpu_mode=self._settings.cpu_mode,
+            extra_env={
+                **self._settings.env,
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_SPARE": "1",
+                "HOROVOD_WORLD_VERSION": str(version),
+                "HOROVOD_HOSTNAME": host.hostname,
+            },
+        )
+        self._log.info("elastic: launching warm spare on %s (v%d)",
+                       host.hostname, version)
+        self._server.clear_heartbeat(host.hostname)
+        self._launched_at[host.hostname] = time.monotonic()
+        self._spare_procs[host.hostname] = launch_worker(
+            assignment, self._settings.command, env,
+            ssh_port=self._settings.ssh_port, sink=self._sink,
+        )
+        _metrics.event("spare_launched", generation=version,
+                       host=host.hostname)
+
+    def _retire_spare(self, name: str, why: str, version: int) -> None:
+        w = self._spare_procs.pop(name, None)
+        if w is None:
+            return
+        self._log.info("elastic: retiring spare on %s (%s)", name, why)
+        terminate_worker(w)
+        self._launched_at.pop(name, None)
+        self._server.clear_heartbeat(name)
+        self._server.clear_spare(name)
+        _metrics.event("spare_retired", generation=version, host=name,
+                       reason=why)
+
+    def _ensure_spares(self, version: int) -> None:
+        """Reconcile the spare fleet with the HostManager's spare tier:
+        reap exits, kill hung spares (same liveness rule as the world —
+        but no abort, no reconfigure: spares are not in anyone's
+        collectives), retire tier-leavers, launch tier-joiners."""
+        if self._manager.warm_spares_target <= 0 and not self._spare_procs:
+            return
+        for name in [n for n, w in self._spare_procs.items()
+                     if w.popen.poll() is not None]:
+            w = self._spare_procs.pop(name)
+            self._launched_at.pop(name, None)
+            self._server.clear_heartbeat(name)
+            self._server.clear_spare(name)
+            _metrics.event("spare_exit", generation=version, host=name,
+                           rc=w.popen.returncode)
+            self._log.warning(
+                "elastic: spare on %s exited rc=%d; the tier will "
+                "relaunch it while the host stays discovered",
+                name, w.popen.returncode)
+        for name, why in self._dead_by_heartbeat(self._spare_procs):
+            self._log.warning(
+                "elastic: spare on %s is hung (%s); killing", name, why)
+            _metrics.event("spare_hung", generation=version, host=name,
+                           reason=why)
+            self._retire_spare(name, f"hung: {why}", version)
+        tier = {h.hostname: h for h in self._manager.spare_hosts()}
+        for name in [n for n in self._spare_procs if n not in tier]:
+            self._retire_spare(name, "left the spare tier", version)
+        for name, h in tier.items():
+            if name not in self._spare_procs and name not in self._workers:
+                self._launch_spare(h, version)
+        self._server.set_cluster_info(spares=len(self._spare_procs))
+        _metrics.POLICY_SPARES.set(len(self._spare_procs))
+
+    def _warm_spare_count(self) -> int:
+        """Spares that are launched, registered (framework-imported), and
+        fresh on the liveness plane — the replacements a drain may count
+        on joining at the next generation fence."""
+        registered = self._server.spare_records()
+        warm = 0
+        for name, w in self._spare_procs.items():
+            if w.popen.poll() is not None or name not in registered:
+                continue
+            age = self._server.heartbeat_age(name)
+            if age is None:
+                continue
+            if self._hb_timeout > 0 and age >= self._hb_timeout:
+                continue
+            warm += 1
+        return warm
+
+    # -- proactive drain (policy + preemption notices) ------------------------
+
+    def _drain_host(self, name: str, why: str, decision=None,
+                    action: str = "drain") -> None:
+        """Proactively drain one world host through the existing
+        SIGTERM→final-commit path, then re-form the world without it.
+
+        SIGTERM first: the worker's drain handler finishes its current
+        step, lands a final commit at the STILL-CURRENT generation (the
+        fence would 409 it after the bump), and exits ``EXIT_REMOVED``.
+        Only after the exit (or the drain grace) does the driver post the
+        coordinated abort — unwedging survivors blocked with the departed
+        peer — blacklist the host, and reconfigure; a warm spare then
+        joins at the new generation fence."""
+        w = self._workers.get(name)
+        if w is None:
+            return
+        gen = self._server.generation
+        # Post-hoc "why did you replace that host": the driver-side
+        # flight record carries the host's last shipped trace window and
+        # the evidence that condemned it.
+        payload = self._server.trace_payload(name) or {}
+        _metrics.FLIGHT_DUMPS.inc(reason="policy_drain")
+        _metrics.event(
+            "flight_record", generation=gen, reason="policy_drain",
+            host=name,
+            steps=(payload.get("steps") or [])[-2:],
+            clock_offset_s=payload.get("clock_offset_s"),
+            evidence=(decision.evidence if decision is not None else None))
+        self._log.warning(
+            "elastic: proactively draining worker on %s (%s)", name, why)
+        # Remote-aware TERM delivery: a raw local killpg cannot reach an
+        # ssh-launched worker's remote tree (pty teardown is SIGHUP, not
+        # SIGTERM — the drain handler would never run).
+        drain_worker(w)
+        grace = get_float("HOROVOD_POLICY_DRAIN_GRACE", 20.0)
+        deadline = time.monotonic() + grace
+        while time.monotonic() < deadline and w.popen.poll() is None:
+            time.sleep(0.05)
+        rc = w.popen.poll()
+        if rc is None:
+            self._log.warning(
+                "elastic: drained worker on %s still alive after %.0fs "
+                "grace; escalating to SIGKILL", name, grace)
+        _metrics.event("policy_drain", generation=gen, host=name,
+                       action=action, reason=why, rc=rc)
+        self._post_abort(f"proactive drain of {name} ({why})")
+        terminate_worker(self._workers.pop(name))
+        self._launched_at.pop(name, None)
+        self._server.clear_heartbeat(name)
+        self._blacklist(name, f"{action}: {why}")
+        self._server.record_policy_action(action)
+        if decision is not None:
+            # record_drain counts the action into POLICY_DECISIONS.
+            self._policy.record_drain(decision, generation=gen)
+        else:
+            _metrics.POLICY_DECISIONS.inc(action=action)
+        self._reconfigure()
+
+    def _handle_preempt_notices(self, version: int) -> None:
+        """External preemption notices (``PUT /preempt/<host>``) become
+        drain signals end to end: the DRIVER forwards the SIGTERM to that
+        host's worker — the notice works even when the cloud cannot
+        signal the worker process directly. Consumed once handled."""
+        for name in self._server.preempt_notices():
+            self._server.consume_preempt(name)
+            _metrics.event("preempt_notice", generation=version, host=name)
+            if name in self._workers:
+                self._log.warning(
+                    "elastic: preemption notice for %s — draining via "
+                    "SIGTERM forward", name)
+                self._drain_host(name, "external preemption notice",
+                                 action="preempt")
+            elif name in self._spare_procs:
+                self._retire_spare(name, "external preemption notice",
+                                   version)
+                self._blacklist(name, "external preemption notice")
+            else:
+                # Not running anything of ours, but about to vanish:
+                # keep pick_world from choosing it (cooldown re-admits).
+                self._blacklist(name, "external preemption notice")
+
+    def _install_sigterm_forwarder(self):
+        """Driver-level preemption: SIGTERM on the DRIVER forwards the
+        drain to every worker and spare per host, so a launcher-level
+        notice drains the whole job through final commits instead of
+        dying with uncommitted epochs. Returns the previous handler (to
+        restore on exit) or None when not installable (non-main thread,
+        exotic hosts)."""
+        if threading.current_thread() is not threading.main_thread():
+            return None
+
+        def _on_sigterm(signum, frame):
+            if self._draining:
+                return
+            self._draining = True
+            _metrics.event("driver_drain",
+                           generation=self._server.generation)
+            self._log.warning(
+                "elastic: driver received SIGTERM (preemption notice) — "
+                "forwarding the drain to %d worker(s) and %d spare(s)",
+                len(self._workers), len(self._spare_procs))
+            for w in (list(self._workers.values())
+                      + list(self._spare_procs.values())):
+                drain_worker(w)
+
+        try:
+            return signal.signal(signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):
+            return None
+
+    # -- policy tick ---------------------------------------------------------
+
+    def _update_world_rate(self) -> None:
+        """Feed the policy's throughput signal: per-host commit rates
+        from successive heartbeat payload counters, averaged over the
+        world (counter resets across relaunches reseed, never go
+        negative)."""
+        now = time.monotonic()
+        world = {h.hostname for h in self._world_hosts}
+        for name in [n for n in self._rate_state if n not in world]:
+            del self._rate_state[name]
+        rates = []
+        for name in world:
+            raw = self._server.heartbeat_payload(name)
+            if raw is None:
+                continue
+            try:
+                commits = json.loads(raw).get("commits")
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if not isinstance(commits, (int, float)):
+                continue
+            prev = self._rate_state.get(name)
+            self._rate_state[name] = (float(commits), now)
+            if prev is None:
+                continue
+            prev_commits, prev_t = prev
+            dt = now - prev_t
+            delta = float(commits) - prev_commits
+            if dt <= 0 or delta < 0:
+                continue
+            rates.append(delta / dt)
+        if rates:
+            self._policy.note_rate(sum(rates) / len(rates))
+
+    def _policy_tick(self) -> None:
+        """One self-healing evaluation (throttled to the policy
+        interval): reconcile spares, consume preemption notices, and —
+        when the SLO knob arms the controller — fold the skew/heartbeat
+        evidence, decide, and drain. A policy failure must never take the
+        driver down; the monitor wraps this call."""
+        now = time.monotonic()
+        if now - self._last_policy_tick < max(
+                min(self._policy.interval_s, 30.0), 0.25):
+            return
+        self._last_policy_tick = now
+        version = self._server.generation
+        self._ensure_spares(version)
+        self._handle_preempt_notices(version)
+        if not self._policy.enabled:
+            return  # inert: no evidence gathering, no decisions
+        self._update_world_rate()
+        try:
+            skew = self._server.straggler_summary()
+        except Exception as e:  # noqa: BLE001 — evidence is best-effort
+            self._log.debug("elastic: straggler summary failed: %s", e)
+            skew = {}
+        world_names = [h.hostname for h in self._world_hosts]
+        self._policy.observe(skew, self._server.heartbeat_ages(),
+                             world_names)
+        decision = self._policy.decide(world_names,
+                                       self._warm_spare_count())
+        if decision is not None and decision.host in self._workers:
+            self._drain_host(decision.host, decision.reason,
+                             decision=decision, action=decision.action)
+        realized = self._policy.realize_tick()
+        if realized is not None:
+            self._log.info(
+                "elastic: policy decision on %s realized: %s",
+                realized.host, realized.predicted.get("realized"))
 
     def _monitor(self) -> int:
         last_poll = 0.0
@@ -391,9 +764,28 @@ class ElasticDriver:
                 self._server.clear_heartbeat(name)
                 self._blacklist(name, f"hung: {why}")
                 need_reconfigure = True
+            # Driver-level drain: once every worker has exited (final
+            # commits landed, EXIT_REMOVED reaped above), the job is
+            # drained — don't re-form a world we were told to vacate.
+            if self._draining:
+                if not self._workers:
+                    self._log.info("elastic: drain complete; exiting")
+                    _metrics.event("driver_drained",
+                                   generation=self._server.generation)
+                    return 0
+                time.sleep(0.05)
+                continue
             if need_reconfigure:
                 self._reconfigure()
                 continue
+            # 1c. Self-healing policy plane: warm-spare reconciliation,
+            # preemption notices, and (when HOROVOD_TARGET_GOODPUT arms
+            # it) straggler-drain decisions. Policy failures are logged,
+            # never fatal — a broken brain must not kill the body.
+            try:
+                self._policy_tick()
+            except Exception as e:  # noqa: BLE001
+                self._log.warning("elastic: policy tick failed: %s", e)
             # 2. Poll discovery.
             if time.time() - last_poll >= self._poll_interval:
                 last_poll = time.time()

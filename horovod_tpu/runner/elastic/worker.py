@@ -33,6 +33,7 @@ import threading
 import time
 
 from ... import abort, faults
+from ... import metrics as _metrics
 from ...elastic.runner import notification_manager
 from ...utils.env import get_float
 from ...utils.logging import get_logger
@@ -44,15 +45,26 @@ def elastic_enabled() -> bool:
     return os.environ.get("HOROVOD_ELASTIC", "") == "1"
 
 
+def spare_mode() -> bool:
+    """True when this worker was launched as a WARM SPARE: discovered,
+    heartbeating, framework-imported, but deliberately excluded from the
+    world until the driver publishes an epoch that includes its host."""
+    return os.environ.get("HOROVOD_SPARE", "") == "1"
+
+
 class _HeartbeatCounters:
     """Process-wide progress counters piggybacked on every heartbeat, so
     the driver's liveness record doubles as a progress trace."""
 
-    __slots__ = ("steps", "commits")
+    __slots__ = ("steps", "commits", "last_commit_pc")
 
     def __init__(self):
         self.steps = 0
         self.commits = 0
+        # perf_counter stamp of the last landed commit: the goodput
+        # ledger splits a failed attempt at this point — productive up to
+        # the last commit, lost{failed_attempt} after it.
+        self.last_commit_pc: float | None = None
 
 
 _counters = _HeartbeatCounters()
@@ -64,6 +76,7 @@ def record_step() -> None:
 
 def record_commit() -> None:
     _counters.commits += 1
+    _counters.last_commit_pc = time.perf_counter()
 
 
 class ElasticWorkerContext:
@@ -97,6 +110,11 @@ class ElasticWorkerContext:
         # the JOINED generation: a survivor wedged in world g's collectives
         # is still in world g even after its poller has seen g+1 announced.
         self.joined_version = self.version
+        # True while a warm spare is parked on the assignment wait: it
+        # has no world rank yet, so its tracer must not ship (its dummy
+        # launch-env rank label would collide with a real rank's in the
+        # skew attribution).
+        self.parked = False
         self.consecutive_poll_failures = 0
         self._on_driver_lost = on_driver_lost or self._exit_driver_lost
         self._poller: threading.Thread | None = None
@@ -156,6 +174,85 @@ class ElasticWorkerContext:
         except Exception:  # noqa: BLE001 — tracing is best-effort
             pass
         return json.loads(raw)
+
+    def wait_for_assignment(self, poll_s: float | None = None) -> dict:
+        """Spare-mode parking orbit: register as a warm spare, then poll
+        until the driver publishes a world that includes this host.
+
+        The caller must have started the poll loop (which advances
+        ``self.version`` so KV writes stay inside the generation fence)
+        and the heartbeat sender (the driver's liveness plane watches
+        spares too) BEFORE parking here. A SIGTERM drain while waiting
+        raises ``RemovedFromWorldError`` so the spare exits cleanly with
+        ``EXIT_REMOVED``; transient KV failures propagate as
+        ``HorovodInternalError`` and the elastic retry loop re-enters the
+        wait (registration is idempotent).
+        """
+        if poll_s is None:
+            poll_s = get_float("HOROVOD_SPARE_POLL_INTERVAL", 0.5)
+        self.parked = True
+        try:
+            return self._wait_for_assignment_parked(poll_s)
+        finally:
+            self.parked = False
+
+    def _wait_for_assignment_parked(self, poll_s: float) -> dict:
+        from ...elastic.runner import drain_requested
+        from ...exceptions import RemovedFromWorldError
+        from ..http.kv_server import SPARE_SCOPE
+
+        announced = False
+        registered = False
+        while True:
+            try:
+                assignment = self.fetch_assignment()
+            except RemovedFromWorldError:
+                if drain_requested():
+                    raise RemovedFromWorldError(
+                        "spare drained (SIGTERM) while waiting for an "
+                        "assignment") from None
+                if not announced:
+                    # Park only after the first miss: a PROMOTED spare
+                    # re-entering init() after a recovery fetches its
+                    # assignment immediately and must not re-appear in
+                    # the driver's spare roster.
+                    announced = True
+                    get_logger().info(
+                        "elastic: warm spare on %s — framework ready, "
+                        "waiting for a world assignment", self.hostname)
+                    _metrics.event("spare_wait", generation=self.version,
+                                   host=self.hostname)
+                if not registered:
+                    # Retried on every poll until it lands: a transient
+                    # KV blip or a generation-fence 409 (the world
+                    # reconfigured during this worker's long framework
+                    # import) must not leave a warm, heartbeating spare
+                    # permanently invisible to the policy's
+                    # replacement-availability gate. Idempotent by
+                    # construction.
+                    try:
+                        self.client.put(
+                            SPARE_SCOPE, self.hostname, json.dumps({
+                                "host": self.hostname,
+                                "pid": os.getpid(),
+                                "t": time.time(),
+                            }).encode())
+                        registered = True
+                    except Exception as e:  # noqa: BLE001 — advisory
+                        get_logger().debug(
+                            "elastic: spare registration failed "
+                            "(will retry): %s", e)
+                time.sleep(poll_s)
+                continue
+            if announced:
+                _metrics.event("spare_joined", generation=self.version,
+                               host=self.hostname,
+                               rank=assignment.get("process_id"))
+                get_logger().info(
+                    "elastic: spare on %s promoted into world v%d "
+                    "(rank %s)", self.hostname, self.version,
+                    assignment.get("process_id"))
+            return assignment
 
     def apply_to_env(self, assignment: dict) -> None:
         """Refresh the env contract so re-init picks up the new world."""
@@ -318,6 +415,19 @@ class ElasticWorkerContext:
             if t_server is not None:
                 clock.observe(t_send, t_recv, float(t_server))
         except Exception:  # noqa: BLE001 — alignment is best-effort
+            pass
+        try:
+            # Eager host-plane workloads have no sampled step scope, so
+            # their dispatch spans would never reach the merged timeline
+            # or the straggler gauges: ship the tracer window on the
+            # heartbeat cadence instead (throttled; no-op unless
+            # HOROVOD_TRACE_SAMPLE enables shipping). A PARKED spare
+            # never ships: it has no world rank, and its dummy launch-env
+            # rank label would collide with a real rank's in the skew
+            # attribution (heartbeats still flow — liveness needs them).
+            if not self.parked:
+                _tracing.maybe_ship_heartbeat()
+        except Exception:  # noqa: BLE001 — shipping is best-effort
             pass
         return True
 

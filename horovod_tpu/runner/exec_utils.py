@@ -263,6 +263,41 @@ def _remote_kill(w: WorkerProc, timeout_s: float = 15.0) -> None:
         pass  # host unreachable: nothing more we can do
 
 
+def drain_worker(w: WorkerProc, timeout_s: float = 15.0) -> None:
+    """Deliver SIGTERM — the graceful-drain signal — to a worker,
+    REMOTE process tree included, with no KILL escalation (the caller
+    owns the grace wait and any escalation).
+
+    A raw local ``killpg`` cannot drain an ssh-launched worker: it
+    signals only the local ssh client, whose death closes the pty and
+    delivers SIGHUP — not SIGTERM — to the remote tree (the
+    :func:`_remote_kill` caveat), so the worker's drain handler never
+    runs and the final commit never lands. Remote workers get an
+    explicit ``kill -TERM`` of the pidfile-recorded group instead; the
+    pidfile is left in place for the eventual :func:`terminate_worker`.
+    """
+    if w.remote_host and w.kill_marker:
+        pidfile = _remote_pidfile(w.kill_marker)
+        script = (
+            f"p=$(cat {pidfile} 2>/dev/null) && "
+            "{ kill -TERM -- -$p 2>/dev/null || kill -TERM $p 2>/dev/null; }"
+        )
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", "-o",
+               "BatchMode=yes"]
+        if w.ssh_port:
+            cmd += ["-p", str(w.ssh_port)]
+        cmd += [w.remote_host, script]
+        try:
+            subprocess.run(cmd, timeout=timeout_s, capture_output=True)
+        except (subprocess.TimeoutExpired, OSError):
+            pass  # host unreachable: the caller's grace/escalation owns it
+        return
+    try:
+        os.killpg(os.getpgid(w.popen.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
 def terminate_worker(w: WorkerProc, grace_s: float = 5.0) -> None:
     """SIGTERM the worker's process group, escalate to SIGKILL.
 

@@ -98,6 +98,24 @@ ABORT_SCOPE = "abort"
 # measured clock offset; one payload per host (replaced on each ship).
 TRACE_SCOPE = _tracing.TRACE_SCOPE
 
+# Warm-spare registration scope: a spare worker (launched with
+# HOROVOD_SPARE=1, waiting for an assignment) PUTs /spare/<host> once its
+# framework imports are done — the driver's policy plane treats presence
+# here (plus a fresh heartbeat) as "warm and promotable".
+SPARE_SCOPE = "spare"
+
+# Preemption-notice scope: an external agent (cloud metadata watcher,
+# maintenance tooling) PUTs /preempt/<host> to announce the host is about
+# to be reclaimed. The elastic driver polls the scope and drains the host
+# through the SIGTERM -> final-commit path — driver-side forwarding, so
+# the notice works even when the cloud cannot signal the worker process
+# directly. Notices are consumed once handled.
+PREEMPT_SCOPE = "preempt"
+
+#: The self-healing policy's action vocabulary (the `action` label values
+#: of hvd_policy_decisions_total; zero-materialized on every scrape).
+POLICY_ACTIONS = ("drain", "promote", "preempt")
+
 # Peer-replication scope: each elastic rank PUTs its owned-shard replica
 # record to /peerstate/<rank> on every commit (generation-fenced like all
 # worker writes). Records are checksum-verified at install time — a torn
@@ -423,6 +441,8 @@ def _render_cluster_metrics(httpd) -> str:
         fenced = httpd.fenced
         world_np = getattr(httpd, "world_np", 0)
         blacklisted = getattr(httpd, "blacklisted", 0)
+        spares = getattr(httpd, "spare_count", 0)
+        policy_actions = dict(getattr(httpd, "policy_actions", {}))
         now = time.monotonic()
         ages = {h: now - t for h, t in httpd.hb_times.items()}
         payloads = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
@@ -447,6 +467,20 @@ def _render_cluster_metrics(httpd) -> str:
             "hvd_heartbeat_age_seconds", "gauge",
             "Seconds since each host's last heartbeat (server clock).",
             [({"host": h}, age) for h, age in sorted(ages.items())]),
+        # Self-healing policy plane: zero-materialized so the scrape gate
+        # can assert the instruments exist before any decision fires, and
+        # dashboards can tell "no drains yet" from "not measuring".
+        _metrics.make_family(
+            "hvd_policy_spare_hosts", "gauge",
+            "Warm spare hosts currently launched, heartbeating, and held "
+            "out of the world by the elastic driver.",
+            [({}, spares)]),
+        _metrics.make_family(
+            "hvd_policy_decisions_total", "counter",
+            "Self-healing policy actions taken by the elastic driver "
+            "(drain|promote|preempt).",
+            [({"action": a}, policy_actions.get(a, 0))
+             for a in POLICY_ACTIONS]),
     ]
     groups: list = [({}, driver_families)]
     steps_samples: list = []
@@ -532,6 +566,8 @@ class RendezvousServer:
         self._httpd.hb_times = {}  # type: ignore[attr-defined]
         self._httpd.world_np = 0  # type: ignore[attr-defined]
         self._httpd.blacklisted = 0  # type: ignore[attr-defined]
+        self._httpd.spare_count = 0  # type: ignore[attr-defined]
+        self._httpd.policy_actions = {}  # type: ignore[attr-defined]
         self._httpd.straggler_logged = set()  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
@@ -559,15 +595,63 @@ class RendezvousServer:
             return self._httpd.fenced  # type: ignore[attr-defined]
 
     def set_cluster_info(self, world_np: int | None = None,
-                         blacklisted: int | None = None) -> None:
+                         blacklisted: int | None = None,
+                         spares: int | None = None) -> None:
         """Driver-side gauges for the ``/metrics`` scrape: the elastic
-        driver refreshes these on every world publish / blacklist, since
-        only it knows them (the server sees heartbeats, not topology)."""
+        driver refreshes these on every world publish / blacklist / spare
+        change, since only it knows them (the server sees heartbeats, not
+        topology)."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             if world_np is not None:
                 self._httpd.world_np = int(world_np)  # type: ignore[attr-defined]
             if blacklisted is not None:
                 self._httpd.blacklisted = int(blacklisted)  # type: ignore[attr-defined]
+            if spares is not None:
+                self._httpd.spare_count = int(spares)  # type: ignore[attr-defined]
+
+    def record_policy_action(self, action: str) -> None:
+        """Count one self-healing policy action into the scrape's
+        ``hvd_policy_decisions_total{action=...}`` counter."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            counts = self._httpd.policy_actions  # type: ignore[attr-defined]
+            counts[action] = counts.get(action, 0) + 1
+
+    # -- warm-spare registration + preemption notices -------------------------
+
+    def _scope_records(self, scope: str) -> dict[str, dict]:
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            raw = dict(self._httpd.store.get(scope, {}))  # type: ignore[attr-defined]
+        out: dict[str, dict] = {}
+        for key, body in raw.items():
+            try:
+                rec = json.loads(body)
+            except (ValueError, UnicodeDecodeError):
+                rec = {}
+            out[key] = rec if isinstance(rec, dict) else {}
+        return out
+
+    def spare_records(self) -> dict[str, dict]:
+        """Hosts whose spare workers have registered as warm (parsed
+        ``PUT /spare/<host>`` records)."""
+        return self._scope_records(SPARE_SCOPE)
+
+    def clear_spare(self, host: str) -> None:
+        """Drop a host's spare registration (promotion into the world, or
+        spare teardown)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.get(  # type: ignore[attr-defined]
+                SPARE_SCOPE, {}).pop(host, None)
+
+    def preempt_notices(self) -> dict[str, dict]:
+        """Outstanding external preemption notices by host (parsed
+        ``PUT /preempt/<host>`` records)."""
+        return self._scope_records(PREEMPT_SCOPE)
+
+    def consume_preempt(self, host: str) -> None:
+        """Drop a handled preemption notice so the drain fires once."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.store.get(  # type: ignore[attr-defined]
+                PREEMPT_SCOPE, {}).pop(host, None)
 
     def metrics_text(self) -> str:
         """The scrape body, rendered in-process (what ``GET /metrics``

@@ -316,7 +316,22 @@ class NativeWorld:
         if x.dtype not in _DTYPE_MAP:
             raise TypeError(f"unsupported dtype {x.dtype} for native runtime")
         x = np.ascontiguousarray(x)
+        auto_named = not name
         name = name or self._auto_name("op", process_set_id)
+        # Tracing plane: every host-plane enqueue records a dispatch span
+        # (zero-dur, sequence-suffixed). Ranks enqueue in lockstep program
+        # order, so the k-th instance of a name pairs across ranks and the
+        # merged-timeline skew attribution sees eager torch/TF collectives
+        # too — the straggler evidence the self-healing policy acts on.
+        # Auto-names are already one-per-call (and lockstep-identical
+        # across ranks): recorded unsuffixed so the tracer's seq map stays
+        # bounded by the named vocabulary.
+        try:
+            from .. import tracing as _tracing
+
+            _tracing.get_tracer().record_dispatch(name, unique=auto_named)
+        except Exception:  # noqa: BLE001 — tracing must not break dispatch
+            pass
         if process_set_id:
             # Names are per-set in the reference (each set has its own
             # controller); this runtime's single controller keys state by

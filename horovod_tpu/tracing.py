@@ -211,6 +211,7 @@ class StepTracer:
         self._open: dict[int, tuple[str, str, float]] = {}
         self._next_open = 0
         self._step_count = 0
+        self._dispatch_seq: dict[str, int] = {}
 
     # -- span recording -----------------------------------------------------
 
@@ -241,6 +242,38 @@ class StepTracer:
         medians use this)."""
         with self._lock:
             self._record_locked(name, cat, t_start, dur, args)
+
+    def record_dispatch(self, name: str, cat: str = "collective",
+                        unique: bool = False) -> None:
+        """Record a host-plane collective DISPATCH as a zero-duration
+        span, suffixed with a per-name sequence number.
+
+        The native runtime (``horovod_tpu/runtime``) calls this at every
+        enqueue — the funnel all torch/TF-surface and hierarchical-leg
+        collectives pass through — so eager host-plane workloads feed the
+        cross-rank skew attribution, not just compiled factory steps.
+        The sequence suffix makes each *instance* of a repeated name
+        (``allreduce.weight`` every step) its own matched group: ranks
+        run the host plane in lockstep program order, so ``name#k`` pairs
+        the k-th dispatch across ranks and the skew gauges track the
+        CURRENT lateness instead of the first instance ever seen. The
+        counter resets with :meth:`rebase` at world join, keeping
+        survivors and replacements aligned within a generation.
+
+        ``unique=True`` marks a name that is already one-per-call
+        (auto-generated ``op.N`` counters — lockstep-identical across
+        ranks, so they self-match): it is recorded as-is, keeping the
+        seq map bounded by the *named* collective vocabulary instead of
+        growing one permanent entry per auto-named enqueue.
+        """
+        t0 = self.clock.now()
+        with self._lock:
+            if unique:
+                self._record_locked(name, cat, t0, 0.0, None)
+                return
+            seq = self._dispatch_seq.get(name, 0) + 1
+            self._dispatch_seq[name] = seq
+            self._record_locked(f"{name}#{seq}", cat, t0, 0.0, None)
 
     def _record_locked(self, name, cat, t_start, dur, args) -> None:
         target = self._current
@@ -314,6 +347,7 @@ class StepTracer:
         replacement at step 1 would otherwise never match."""
         with self._lock:
             self._step_count = 0
+            self._dispatch_seq.clear()
 
     # -- snapshots ------------------------------------------------------------
 
@@ -407,10 +441,12 @@ def get_tracer() -> StepTracer:
 
 def reset_for_testing() -> None:
     """Fresh tracer + clock sync (re-reads the ring/sampling env)."""
-    global _tracer, _clock_sync
+    global _tracer, _clock_sync, _last_hb_ship
     with _lock:
         _tracer = None
         _clock_sync = None
+    with _ship_lock:
+        _last_hb_ship = 0.0
 
 
 def record_span(name: str, cat: str, t_start: float, dur: float,
@@ -544,6 +580,38 @@ def ship_async(payload: dict) -> None:
         _ship_event.set()
 
 
+def ship_interval_s() -> float:
+    """Floor between heartbeat-coupled trace ships (seconds)."""
+    return get_float("HOROVOD_TRACE_SHIP_SECONDS", 5.0)
+
+
+_last_hb_ship = 0.0
+
+
+def maybe_ship_heartbeat() -> bool:
+    """Ship the current tracer window on the heartbeat cadence.
+
+    Step-scoped workloads ship on every sampled step; eager host-plane
+    workloads (the torch/TF surfaces) have no step scope, so their spans
+    would collect locally and never reach the merged timeline or the
+    straggler gauges. The elastic heartbeat sender calls this after each
+    successful beat: when shipping is enabled (``HOROVOD_TRACE_SAMPLE >
+    0``), the ring + ambient window ships at most once per
+    ``HOROVOD_TRACE_SHIP_SECONDS`` — the freshness the self-healing
+    policy's skew evidence rides on. Returns True when a ship was queued.
+    """
+    global _last_hb_ship
+    if sample_every() <= 0:
+        return False
+    now = time.monotonic()
+    with _ship_lock:
+        if now - _last_hb_ship < ship_interval_s():
+            return False
+        _last_hb_ship = now
+    ship_async(get_tracer().payload())
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder dump
 # ---------------------------------------------------------------------------
@@ -623,16 +691,12 @@ def compute_skew(payloads: Mapping[str, Mapping]) -> dict:
         if not isinstance(payload, Mapping):
             continue
         rank = str(payload.get("rank", "?"))
-        rank_host[rank] = host
         try:
             offset = float(payload.get("clock_offset_s", 0.0) or 0.0)
         except (TypeError, ValueError):
             offset = 0.0
-        try:
-            rank_err[rank] = float(payload.get("clock_error_s") or 0.0)
-        except (TypeError, ValueError):
-            rank_err[rank] = 0.0
         generation = payload.get("generation")
+        contributed = False
         for steprec in payload.get("steps", ()) or ():
             if not isinstance(steprec, Mapping):
                 continue
@@ -648,6 +712,20 @@ def compute_skew(payloads: Mapping[str, Mapping]) -> dict:
                     continue
                 key = (generation, step, sp.get("name"))
                 groups.setdefault(key, []).append((rank, host, t))
+                contributed = True
+        # Only a payload that contributed spans may claim a rank's
+        # identity: a spanless payload with a stale/default rank label
+        # (a worker mid-bootstrap shipping its empty ring) must not
+        # steal a real rank's host attribution — the gauges and the
+        # policy would then pin the measured lateness on the wrong
+        # host (or drop it entirely, hiding a straggler).
+        if not contributed:
+            continue
+        rank_host[rank] = host
+        try:
+            rank_err[rank] = float(payload.get("clock_error_s") or 0.0)
+        except (TypeError, ValueError):
+            rank_err[rank] = 0.0
     matched = 0
     lateness: dict[str, list[float]] = {}
     worst: dict | None = None

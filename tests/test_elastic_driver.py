@@ -129,6 +129,111 @@ class TestHostManager:
         assert d.find_available_hosts_and_slots() == {"h1": 2}
 
 
+class TestHostManagerSpareTier:
+    """HOROVOD_WARM_SPARES: surplus hosts held OUT of the world as warm
+    standby, and its interaction with the blacklist cooldown — a
+    just-condemned host proves itself warm before re-entering the world;
+    a blacklisted host appears in neither tier."""
+
+    def _manager(self, hosts=("a", "b", "c"), **kw):
+        m = HostManager(
+            FixedHostDiscovery([HostInfo(h, 1) for h in hosts]), **kw)
+        m.update_available_hosts()
+        return m
+
+    def test_spares_held_out_of_world(self):
+        m = self._manager(warm_spares=1)
+        world = m.pick_world([], max_np=2)
+        assert [h.hostname for h in world] == ["a", "b"]
+        assert [h.hostname for h in m.spare_hosts()] == ["c"]
+        assert m.warm_spares_target == 1
+
+    def test_tier_disabled_is_head_behavior(self):
+        m = self._manager(warm_spares=0)
+        world = m.pick_world([], max_np=2)
+        assert [h.hostname for h in world] == ["a", "b"]
+        assert m.spare_hosts() == []
+        assert m.warm_spares_target == 0
+
+    def test_spare_backfills_world_immediately(self):
+        """A world-member failure promotes the standby host into the
+        world at the next pick — the one-re-rendezvous replacement."""
+        m = self._manager(warm_spares=1, cooldown_s=60.0)
+        m.pick_world([], max_np=2)                    # world [a,b], spare c
+        m.blacklist("a")
+        world = m.pick_world(["a", "b"], max_np=2)
+        assert [h.hostname for h in world] == ["b", "c"]
+        assert m.spare_hosts() == []                  # a is blacklisted
+
+    def test_cooldown_returned_host_reenters_as_spare(self):
+        """The satellite contract: a cooled-down host must re-enter as a
+        SPARE, not swap straight back into a healthy full-size world."""
+        m = self._manager(warm_spares=1, cooldown_s=0.2)
+        m.pick_world([], max_np=2)
+        m.blacklist("a")
+        assert [h.hostname for h in m.pick_world(["a", "b"], max_np=2)] \
+            == ["b", "c"]
+        time.sleep(0.25)
+        assert m.update_available_hosts() is True     # a came back
+        world = m.pick_world(["b", "c"], max_np=2)
+        assert [h.hostname for h in world] == ["b", "c"]   # world unchanged
+        assert [h.hostname for h in m.spare_hosts()] == ["a"]
+
+    def test_returned_spare_promoted_when_world_needs_it(self):
+        """The probation flag clears exactly when the world would fall
+        short without the host — which is the promotion path."""
+        m = self._manager(warm_spares=1, cooldown_s=0.2)
+        m.pick_world([], max_np=2)
+        m.blacklist("a")
+        m.pick_world(["a", "b"], max_np=2)            # world [b,c]
+        time.sleep(0.25)
+        m.update_available_hosts()
+        m.pick_world(["b", "c"], max_np=2)            # a parked as spare
+        m.blacklist("c")                              # now the world is short
+        world = m.pick_world(["b", "c"], max_np=2)
+        assert [h.hostname for h in world] == ["b", "a"]
+        assert m.spare_hosts() == []
+
+    def test_blacklisted_spare_never_promoted(self):
+        """A blacklisted spare is not usable AT ALL: it must appear in
+        neither the world nor the spare tier, even when the world is
+        short."""
+        m = self._manager(warm_spares=1, cooldown_s=60.0)
+        m.pick_world([], max_np=2)                    # spare c
+        m.blacklist("c")
+        world = m.pick_world(["a", "b"], max_np=2)
+        assert [h.hostname for h in world] == ["a", "b"]
+        assert m.spare_hosts() == []
+        m.blacklist("b")                              # world short of budget
+        world = m.pick_world(["a", "b"], max_np=2)
+        assert [h.hostname for h in world] == ["a"]   # c still banned
+        assert m.spare_hosts() == []
+
+    def test_departed_host_sheds_probation_flag(self):
+        """A cooldown-returned host that then leaves discovery must not
+        leak its probation flag back in when it reappears much later."""
+
+        class MutableDiscovery(FixedHostDiscovery):
+            def set_hosts(self, hosts):
+                self._hosts = {h.hostname: h.slots for h in hosts}
+
+        d = MutableDiscovery([HostInfo(h, 1) for h in ("a", "b", "c")])
+        m = HostManager(d, warm_spares=1, cooldown_s=0.2)
+        m.update_available_hosts()
+        m.pick_world([], max_np=2)
+        m.blacklist("a")
+        m.pick_world(["a", "b"], max_np=2)
+        time.sleep(0.25)
+        m.update_available_hosts()
+        m.pick_world(["b", "c"], max_np=2)
+        assert [h.hostname for h in m.spare_hosts()] == ["a"]
+        d.set_hosts([HostInfo("b", 1), HostInfo("c", 1)])   # a departs
+        m.update_available_hosts()
+        m.pick_world(["b", "c"], max_np=2)
+        assert m.spare_hosts() == []
+        assert "a" not in m._cooldown_returned
+
+
 def _elastic_worker(tmp_path) -> str:
     """Worker driven by a behavior map {hostname: behavior}:
     - "fail_once": exit 1 on first launch, 0 on relaunch

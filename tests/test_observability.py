@@ -850,8 +850,11 @@ def test_goodput_tracker_accounting():
 
 
 def test_elastic_run_accrues_goodput(hvd, monkeypatch):
-    """One failure+recovery cycle books rendezvous, restore, backoff AND
-    productive seconds — the accounting profiler.summary() surfaces."""
+    """One failure+recovery cycle books rendezvous, restore, backoff,
+    productive AND failed_attempt seconds — the accounting
+    profiler.summary() surfaces. The failed attempt landed no commit, so
+    its whole tail is lost{failed_attempt}, NOT productive (the PR 5
+    caveat, fixed): only the successful attempt's time is productive."""
     import time as _time
 
     from horovod_tpu import metrics
@@ -874,12 +877,48 @@ def test_elastic_run_accrues_goodput(hvd, monkeypatch):
 
     assert train(state) == "ok"
     after = gp.summary()
-    assert after["productive_s"] >= before["productive_s"] + 0.03
+    assert after["productive_s"] >= before["productive_s"] + 0.015
+    assert (after["lost_s"]["failed_attempt"]
+            >= before["lost_s"].get("failed_attempt", 0.0) + 0.015)
     assert after["lost_s"]["backoff"] > before["lost_s"]["backoff"]
     assert after["lost_s"]["rendezvous"] >= before["lost_s"]["rendezvous"]
     import horovod_tpu.profiler as prof
 
     assert prof.summary()["goodput"] == gp.summary()
+
+
+def test_failed_attempt_tail_splits_at_last_commit(hvd, monkeypatch):
+    """An attempt that commits then fails books productive time only up
+    to its last commit; the doomed tail after it is lost{failed_attempt}."""
+    import time as _time
+
+    from horovod_tpu import metrics
+    from horovod_tpu.elastic import ObjectState
+    from horovod_tpu.exceptions import HorovodInternalError
+
+    monkeypatch.setenv("HOROVOD_RECOVERY_BACKOFF_MAX", "0.05")
+    gp = metrics.goodput()
+    before = gp.summary()
+    calls = []
+    state = ObjectState(step=0)
+
+    @hvd.elastic.run
+    def train(st):
+        calls.append(1)
+        if len(calls) == 1:
+            _time.sleep(0.03)   # productive: committed below
+            st.commit()
+            _time.sleep(0.05)   # the doomed tail
+            raise HorovodInternalError("boom")
+        return "ok"
+
+    assert train(state) == "ok"
+    after = gp.summary()
+    tail = (after["lost_s"]["failed_attempt"]
+            - before["lost_s"].get("failed_attempt", 0.0))
+    productive = after["productive_s"] - before["productive_s"]
+    assert tail >= 0.04, after  # the post-commit sleep, not the whole run
+    assert productive >= 0.02, after  # the pre-commit sleep survived
 
 
 def test_log_records_carry_rank_generation_prefix(monkeypatch):

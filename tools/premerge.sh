@@ -49,10 +49,17 @@ echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # SIGKILL-during-commit never half-writes the replica pool, and the
 # SIGKILL-one-worker e2e recovers on the peer rung (rc=0, zero
 # durable-storage reads) with corrupt replicas falling through to the
-# durable rung instead of crashing.
-if ! timeout -k 10 900 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
+# durable rung instead of crashing. test_policy.py is the self-healing
+# plane's: a faults-plane straggler (worker.step delay) is detected from
+# shipped skew evidence, proactively SIGTERM-drained (final commit
+# lands, rc=0), and a warm spare joins at the next generation — with
+# loss continuity, exactly one policy_decision record whose realized
+# goodput beats the no-action counterfactual, and an A/B arm proving
+# the plane is inert with HOROVOD_TARGET_GOODPUT unset.
+if ! timeout -k 10 1200 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
-    tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py -q \
+    tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py \
+    tests/test_policy.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -221,6 +228,8 @@ try:
         "hvd_param_gather_seconds",
         "hvd_resident_state_bytes",
         "hvd_fsdp_prefetch_overlap_ratio",
+        "hvd_policy_decisions_total",
+        "hvd_policy_spare_hosts",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
