@@ -255,8 +255,20 @@ def post(reason: str, generation: int | None = None) -> None:
         try:
             from .runner.http.kv_server import KVClient
 
-            KVClient(addr, int(port), timeout=2.0, retries=1).put(
-                ABORT_SCOPE, str(gen), record)
+            # Epoch-fenced (NOT generation-fenced: a survivor of world g
+            # must be able to post abort/<g> even after the server moved
+            # to g+1 — the record is generation-keyed, so it can only
+            # reach peers still in g). The driver-epoch stamp keeps a
+            # worker still loyal to a SUPERSEDED driver from planting
+            # records into the successor's store.
+            try:
+                env_epoch = int(
+                    os.environ.get("HOROVOD_DRIVER_EPOCH", "0") or 0)
+            except ValueError:
+                env_epoch = 0
+            KVClient(addr, int(port), timeout=2.0, retries=1,
+                     epoch_fn=(lambda: env_epoch) if env_epoch > 0
+                     else None).put(ABORT_SCOPE, str(gen), record)
         except Exception as e:  # noqa: BLE001 — local unblock still happens
             get_logger().warning(
                 "could not post coordinated abort to the rendezvous KV "

@@ -97,6 +97,22 @@ def atomic_install(path: str, data: bytes) -> None:
         raise
 
 
+def atomic_read(path: str):
+    """Read side of :func:`atomic_install`: yield the raw bytes of the
+    current slot, then — whether or not the caller accepted the first —
+    of the retained ``<path>.prev`` slot, each tagged ``("current"`` /
+    ``"prev")``. The caller verifies each candidate and stops at the
+    first good one; a torn/corrupted current file (SIGKILL mid-write,
+    bit rot) therefore costs one rotation of progress, never the state.
+    Missing slots are skipped silently."""
+    for p, which in ((path, "current"), (f"{path}.prev", "prev")):
+        try:
+            with open(p, "rb") as f:
+                yield f.read(), which
+        except OSError:
+            continue
+
+
 def rotate_slots(store: MutableMapping, key: str, value,
                  prev_suffix: str = ".prev") -> None:
     """The mapping flavor of :func:`atomic_install`: install ``value`` at

@@ -220,6 +220,58 @@ class PolicyController:
                 except Exception:  # noqa: BLE001 — gauges are advisory
                     pass
 
+    # -- durable control-plane state (driver crash-restart takeover) ---------
+
+    def export_state(self) -> dict:
+        """The controller's resumable evidence, for the driver's durable
+        snapshot (``runner/elastic/driver_state.py``): per-host skew and
+        heartbeat-age EWMAs, each host's SUSTAINED-condemnation age
+        (relative seconds — monotonic stamps do not survive a process
+        restart), and the measured resize-cost EWMA. Rate samples and a
+        pending realization window are deliberately NOT exported: the
+        counterfactual was measured against a world the crash just
+        perturbed."""
+        now = self._clock()
+        with self._lock:
+            return {
+                "ewma": {h: float(v) for h, v in self._ewma.items()},
+                "hb_ewma": {h: float(v)
+                            for h, v in self._hb_ewma.items()},
+                "above_ages": {h: max(now - t, 0.0)
+                               for h, t in self._above_since.items()},
+                "resize_cost": self._resize_cost_ewma,
+            }
+
+    def restore_state(self, state: Mapping[str, Any] | None) -> None:
+        """Resume exported evidence after a driver restart: EWMAs and
+        sustained-condemnation clocks pick up where the predecessor
+        left off (a straggler already half-condemned does not get a
+        fresh window just because the control plane flapped)."""
+        if not isinstance(state, Mapping):
+            return
+        now = self._clock()
+        with self._lock:
+            for key, target in (("ewma", self._ewma),
+                                ("hb_ewma", self._hb_ewma)):
+                values = state.get(key)
+                if isinstance(values, Mapping):
+                    for h, v in values.items():
+                        try:
+                            target[str(h)] = float(v)
+                        except (TypeError, ValueError):
+                            continue
+            ages = state.get("above_ages")
+            if isinstance(ages, Mapping):
+                for h, age in ages.items():
+                    try:
+                        self._above_since[str(h)] = now - max(
+                            float(age), 0.0)
+                    except (TypeError, ValueError):
+                        continue
+            cost = state.get("resize_cost")
+            if isinstance(cost, (int, float)) and cost > 0:
+                self._resize_cost_ewma = float(cost)
+
     # -- deliberation --------------------------------------------------------
 
     def _recent_rate(self, since: float | None = None,

@@ -241,6 +241,17 @@ def run(func):
                         time.perf_counter() - run_started)
                     raise
                 goodput.add_productive(time.perf_counter() - run_started)
+                try:
+                    # Completion record: the rc=0 this process is about
+                    # to exit with is unreadable to a driver that
+                    # ADOPTED it across a crash-restart takeover — the
+                    # done record is how success survives (best-effort;
+                    # see runner/elastic/worker.announce_done).
+                    from ..runner.elastic.worker import announce_done
+
+                    announce_done()
+                except Exception:  # noqa: BLE001 — advisory only
+                    pass
                 return result
             except HorovodInternalError as e:
                 from .. import abort, stall

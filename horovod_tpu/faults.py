@@ -21,6 +21,21 @@ kill workers by behavior flag). This module generalizes that into named
   down; ``delay`` defers decisions)
 - ``spare.promote``      — every warm-spare promotion into the world
   (``raise`` forces the cold-launch fallback path)
+- ``driver.snapshot``    — every durable control-plane snapshot write
+  (``raise`` simulates a storage blip the driver must survive; pair
+  with :func:`kill_driver` for the torn-write chaos case)
+- ``driver.takeover``    — the restarted driver's snapshot-load/adopt
+  path (``raise`` fails the takeover so the supervisor retries;
+  ``delay`` widens the orphan window)
+- ``kv.serve``           — every request the rendezvous KV server
+  handles; firing (drop semantics) closes the connection without
+  answering — to the client that is a transport failure, exactly a
+  driver mid-crash
+
+The canonical **control-plane injectors** are these three plus
+:func:`kill_driver` (SIGKILL the driver process — the KV server dies
+mid-request with no cleanup, the exact crash the takeover path exists
+to survive).
 
 The canonical **straggler injector** is a ``delay`` on ``worker.step``::
 
@@ -79,6 +94,9 @@ PEER_REPLICATE = "peer.replicate"
 PEER_VERIFY = "peer.verify"
 POLICY_DECIDE = "policy.decide"
 SPARE_PROMOTE = "spare.promote"
+DRIVER_SNAPSHOT = "driver.snapshot"
+DRIVER_TAKEOVER = "driver.takeover"
+KV_SERVE = "kv.serve"
 
 _MODES = ("drop", "delay", "raise", "hang")
 _DEFAULT_HANG_S = 3600.0
@@ -251,3 +269,15 @@ def self_suspend() -> None:
     """A worker SIGSTOPs itself — the deterministic in-process way for a
     chaos-test worker to become a hung host at an exact step."""
     os.kill(os.getpid(), signal.SIGSTOP)
+
+
+def kill_driver(pid: int) -> None:
+    """SIGKILL the elastic DRIVER process: the canonical control-plane
+    crash injector. The in-process rendezvous KV server dies mid-request
+    with no cleanup, workers are orphaned (their process group survives
+    the driver — ``start_new_session``), and the only recovery is a
+    supervisor relaunch taking over from the durable snapshot
+    (``runner/elastic/driver_state.py``). Distinct from
+    :func:`kill_process` only in intent — the signal is the same — but
+    chaos tests naming the driver explicitly read as what they are."""
+    os.kill(pid, signal.SIGKILL)

@@ -716,6 +716,23 @@ POLICY_STRAGGLER_EWMA = gauge(
     "EWMA (over HOROVOD_STRAGGLER_WINDOW) of each host's straggler "
     "score — the sustained-evidence signal the drain decision "
     "thresholds on.", ("host",))
+# Control-plane fault tolerance (driver crash-restart takeover; the
+# rendezvous server mirrors the epoch and driver-lost counts into the
+# /metrics scrape so operators see control-plane flaps before the
+# 3-consecutive-203 cap blacklists a healthy host).
+DRIVER_EPOCH = gauge(
+    "hvd_driver_epoch",
+    "Monotonic driver epoch: bumped on every driver (re)start; the "
+    "split-brain fence workers and the KV server follow.")
+DRIVER_LOST = counter(
+    "hvd_driver_lost_total",
+    "Workers reaped with EXIT_DRIVER_LOST (rendezvous KV unreachable "
+    "past the deadline), by host — the control-plane flap signal.",
+    ("host",))
+DRIVER_TAKEOVERS = counter(
+    "hvd_driver_takeovers_total",
+    "Driver restarts that resumed a prior control-plane snapshot "
+    "(crash-restart takeovers).")
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -734,6 +751,8 @@ def _materialize_checkpoint_cells() -> None:
     for mode in ("sharded", "fsdp"):
         RESIDENT_BYTES.labels(kind="opt_state", sync_mode=mode)
     RESIDENT_BYTES.labels(kind="params", sync_mode="fsdp")
+    DRIVER_EPOCH.labels()
+    DRIVER_TAKEOVERS.labels()
 
 
 _materialize_checkpoint_cells()

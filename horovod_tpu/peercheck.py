@@ -303,6 +303,15 @@ class PeerReplicator:
     def generation(self) -> int:
         return int(self._generation_fn())
 
+    def repoint(self) -> None:
+        """Drop the cached KV client so the next replicate/fetch builds
+        a fresh one from the launcher env — called by the worker's
+        endpoint re-resolution after a driver crash-restart takeover, so
+        the very next commit re-publishes this rank's replica to the
+        successor's (empty) peerstate scope and the peer rung re-arms
+        with zero durable reads."""
+        self._client = None
+
     def client(self):
         if self._client is None:
             addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")

@@ -20,6 +20,14 @@ EXIT_DRIVER_LOST = 203
 # its heartbeats alone would have kept it looking alive forever.
 EXIT_STALL_ABANDONED = 204
 
+# Exit code for a DRIVER that discovered it was superseded: a newer
+# driver epoch owns the durable control-plane state (driver_state.py),
+# meaning a supervisor already relaunched the control plane — typically
+# after this driver was SIGSTOP'd/partitioned through its own liveness
+# deadline. The stale driver stands down WITHOUT terminating its former
+# workers (the successor adopted them); killing them would be sabotage.
+EXIT_DRIVER_SUPERSEDED = 205
+
 # Consecutive KV poll failures before the worker escalates its logging
 # from debug to warning (the first couple of blips are routine — a driver
 # mid-reconfiguration answers late; a streak is a signal).

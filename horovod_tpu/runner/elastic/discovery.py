@@ -213,6 +213,31 @@ class HostManager:
             # cooldown window.
             self._blacklist[hostname] = time.monotonic()
 
+    def export_blacklist(self) -> dict[str, float]:
+        """Blacklist as {host: age-in-seconds} — RELATIVE ages, because
+        monotonic stamps do not survive a driver restart. Feeds the
+        durable control-plane snapshot (driver_state.py)."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune_blacklist_locked()
+            return {h: now - t for h, t in self._blacklist.items()}
+
+    def restore_blacklist(self, ages) -> None:
+        """Takeover resume: re-enter blacklist entries with their
+        exported ages re-based onto THIS process's monotonic clock —
+        cooldown windows keep counting across the crash instead of
+        restarting (a condemned host must not be re-admitted early just
+        because the control plane flapped)."""
+        if not isinstance(ages, dict):
+            return
+        now = time.monotonic()
+        with self._lock:
+            for host, age in ages.items():
+                try:
+                    self._blacklist[str(host)] = now - max(float(age), 0.0)
+                except (TypeError, ValueError):
+                    continue
+
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
             self._prune_blacklist_locked()

@@ -45,14 +45,52 @@ from ..exec_utils import (
 from ..hosts import HostInfo, ProcessAssignment, get_host_assignments
 from ..http.kv_server import RendezvousServer
 from ..network import coordinator_addr, driver_addr, free_port
+from . import driver_state
 from .discovery import FixedHostDiscovery, HostDiscoveryScript, HostManager
 
 from .constants import (  # noqa: E402  (EXIT_REMOVED re-exported for users)
     EXIT_DRIVER_LOST,
+    EXIT_DRIVER_SUPERSEDED,
     EXIT_REMOVED,
 )
 
 WORLD_SCOPE = "world"
+
+
+class _AdoptedPopen:
+    """Liveness-only stand-in for a worker Popen the driver did not
+    spawn: a crash-restarted driver ADOPTS the predecessor's still-live
+    workers by PID (they survived the crash — ``start_new_session`` —
+    and rejoin at the next generation fence without a process restart).
+    ``poll()`` answers via signal 0; the exit CODE of a non-child is
+    unreadable, so the monitor special-cases adopted exits (completion
+    comes from the worker's ``PUT /done/<host>`` record instead)."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: int | None = None
+
+    def poll(self) -> int | None:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            os.kill(self.pid, 0)
+        except ProcessLookupError:
+            self.returncode = 0  # sentinel; the monitor checks the type
+            return self.returncode
+        except PermissionError:
+            pass  # alive, different uid (shouldn't happen; treat alive)
+        return None
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    return True
 
 
 class ElasticDriver:
@@ -73,10 +111,28 @@ class ElasticDriver:
             else:
                 discovery = FixedHostDiscovery(settings.hosts)
         self._manager = HostManager(discovery)
-        # Secret before server construction: the server snapshots its HMAC
-        # key at __init__ (a later setdefault would leave it open-mode).
+        # Durable control-plane state (crash-restart takeover) is opened
+        # FIRST: the predecessor's snapshot carries the job's HMAC
+        # secret, which must be resumed before the server snapshots its
+        # key below — a takeover driver minting a fresh secret would 403
+        # every orphaned worker's rejoin forever. Entirely inert with
+        # HOROVOD_DRIVER_STATE_DIR unset: no store, epoch 0, no snapshot
+        # writes, no endpoint record — bit-for-bit the
+        # driver-loss-is-fatal (203) behavior.
         from .. import secret as _secret
 
+        self._store: driver_state.DriverStateStore | None = None
+        self._snapshot: dict | None = None
+        sdir = driver_state.state_dir()
+        if sdir is not None:
+            self._store, self._snapshot = driver_state.DriverStateStore.open(
+                sdir)
+            if self._snapshot is not None:
+                prev_secret = self._snapshot.get("secret_key")
+                if prev_secret:
+                    os.environ[_secret.ENV_KEY] = str(prev_secret)
+        # Secret before server construction: the server snapshots its HMAC
+        # key at __init__ (a later setdefault would leave it open-mode).
         os.environ.setdefault(_secret.ENV_KEY, _secret.make_secret_key())
         self._server = RendezvousServer()
         self._workers: dict[str, WorkerProc] = {}
@@ -108,6 +164,146 @@ class ElasticDriver:
         self._rate_state: dict[str, tuple[float, float]] = {}
         self._last_policy_tick = 0.0
         self._draining = False
+        self._superseded = False
+        self._last_state_save = 0.0
+        self._state_refresh_s = get_float("HOROVOD_DRIVER_STATE_REFRESH",
+                                          10.0)
+
+    # -- durable control-plane state ------------------------------------------
+
+    @property
+    def driver_epoch(self) -> int:
+        return self._store.epoch if self._store is not None else 0
+
+    def _proc_record(self, w: WorkerProc) -> dict:
+        return {
+            "pid": int(w.popen.pid),
+            "local": w.remote_host is None,
+            "slots": int(getattr(w.assignment, "slots", 1) or 1),
+            # PID-reuse guard: adoption re-checks the kernel start time,
+            # so a recycled PID can never get an unrelated process
+            # adopted (and later SIGKILLed) as a worker.
+            "start_ticks": driver_state.proc_start_ticks(
+                int(w.popen.pid)),
+        }
+
+    def _snapshot_record(self) -> dict:
+        """The driver's authoritative state, as one JSON-able record:
+        what a successor needs to re-form the world at g+1 without
+        losing membership, fencing, blacklist cooldowns, policy
+        evidence, or the live workers themselves (adopted by PID)."""
+        return {
+            "generation": self._server.generation,
+            "min_np": self._min_np,
+            "max_np": self._max_np,
+            "world": [[h.hostname, h.slots] for h in self._world_hosts],
+            "workers": {n: self._proc_record(w)
+                        for n, w in self._workers.items()},
+            "spares": {n: self._proc_record(w)
+                       for n, w in self._spare_procs.items()},
+            "blacklist": self._manager.export_blacklist(),
+            "driver_lost_counts": dict(self._driver_lost_counts),
+            "policy": self._policy.export_state(),
+            # The job HMAC secret: the takeover driver must serve (and
+            # sign) with the SAME key the orphaned workers hold, or
+            # their rejoin probes 403 forever. The state dir is 0700.
+            "secret_key": os.environ.get("HOROVOD_SECRET_KEY", ""),
+        }
+
+    def _save_state(self) -> None:
+        """Journal the control-plane snapshot (every mutating path calls
+        this; the monitor additionally refreshes it every
+        HOROVOD_DRIVER_STATE_REFRESH seconds to capture PID/EWMA drift).
+        A fencing rejection means a SUCCESSOR owns the state — this
+        driver stands down instead of corrupting it; any other failure
+        is logged and survived (a storage blip must not kill the job the
+        snapshot exists to protect)."""
+        if self._store is None or self._superseded:
+            return
+        try:
+            self._store.save(self._snapshot_record())
+            self._last_state_save = time.monotonic()
+        except driver_state.DriverFencedError as e:
+            self._log.error("elastic: %s", e)
+            self._superseded = True
+        except Exception as e:  # noqa: BLE001 — snapshot is best-effort
+            self._log.warning(
+                "elastic: control-plane snapshot failed (%s); takeover "
+                "would resume from the previous snapshot", e)
+
+    def _publish_endpoint(self) -> None:
+        """Refresh the shared-storage discovery record orphaned workers
+        re-resolve the rendezvous endpoint from (fenced like the
+        snapshot)."""
+        if self._store is None or self._superseded:
+            return
+        addr = driver_addr([h.hostname for h in self._world_hosts]
+                           or ["localhost"])
+        try:
+            self._store.publish_endpoint(addr, self._server.port,
+                                         self._server.generation)
+        except driver_state.DriverFencedError as e:
+            self._log.error("elastic: %s", e)
+            self._superseded = True
+        except Exception as e:  # noqa: BLE001
+            self._log.warning(
+                "elastic: endpoint record publish failed (%s); orphaned "
+                "workers cannot rejoin until it lands", e)
+
+    def _state_env(self) -> dict[str, str]:
+        """Worker-env additions for the durable-control-plane contract:
+        the state dir (so orphans can re-resolve the endpoint record)
+        and the serving driver epoch (the split-brain fence identity
+        workers stamp their writes with). Empty when the plane is off —
+        the worker env stays bit-for-bit the HEAD contract."""
+        if self._store is None:
+            return {}
+        return {
+            driver_state.ENV_STATE_DIR: self._store.directory,
+            driver_state.ENV_DRIVER_EPOCH: str(self._store.epoch),
+        }
+
+    def _adopt_from_snapshot(self, snap: dict) -> list[str]:
+        """Adopt the predecessor's still-live LOCAL workers and spares
+        by PID: they keep training through the takeover and rejoin at
+        the g+1 fence without a process restart. Remote (ssh-launched)
+        workers cannot be adopted — their local ssh client died with the
+        predecessor — and are relaunched cold by the normal path."""
+        adopted: list[str] = []
+        for table, target in (("workers", self._workers),
+                              ("spares", self._spare_procs)):
+            for host, info in (snap.get(table) or {}).items():
+                if not isinstance(info, dict) or not info.get("local"):
+                    continue
+                try:
+                    pid = int(info.get("pid"))
+                except (TypeError, ValueError):
+                    continue
+                if not _pid_alive(pid):
+                    continue
+                recorded = info.get("start_ticks")
+                if recorded is not None:
+                    ticks = driver_state.proc_start_ticks(pid)
+                    if ticks is not None and ticks != recorded:
+                        self._log.warning(
+                            "elastic: pid %d on %s was recycled (start "
+                            "ticks %s != recorded %s); not adopting",
+                            pid, host, ticks, recorded)
+                        continue
+                assignment = ProcessAssignment(
+                    hostname=host, rank=0, size=1, local_rank=0,
+                    local_size=1, cross_rank=0, cross_size=1,
+                    slots=int(info.get("slots", 1) or 1),
+                    first_device_rank=0)
+                target[host] = WorkerProc(assignment, _AdoptedPopen(pid),
+                                          None)
+                self._launched_at[host] = time.monotonic()
+                adopted.append(host)
+                self._log.info(
+                    "elastic: adopted orphaned %s on %s (pid %d)",
+                    "worker" if table == "workers" else "spare", host,
+                    pid)
+        return adopted
 
     # -- world formation -----------------------------------------------------
 
@@ -164,7 +360,13 @@ class ElasticDriver:
             blacklisted=self._manager.blacklist_count())
         _metrics.event(
             "world_published", generation=version, np=len(hosts),
-            hosts=[h.hostname for h in hosts])
+            hosts=[h.hostname for h in hosts],
+            driver_epoch=self.driver_epoch)
+        # Durable control plane: every world publish refreshes the
+        # endpoint discovery record (orphan rejoin target) and the
+        # snapshot (membership + generation are the takeover's core).
+        self._publish_endpoint()
+        self._save_state()
         return version
 
     def _launch_missing_workers(self, version: int) -> None:
@@ -237,6 +439,7 @@ class ElasticDriver:
                     "HOROVOD_ELASTIC": "1",
                     "HOROVOD_WORLD_VERSION": str(version),
                     "HOROVOD_HOSTNAME": a.hostname,
+                    **self._state_env(),
                 },
             )
             self._log.info(
@@ -253,6 +456,10 @@ class ElasticDriver:
                 a, self._settings.command, env,
                 ssh_port=self._settings.ssh_port, sink=self._sink,
             )
+        # Fresh PIDs land in the durable snapshot immediately — a driver
+        # crash right after a launch wave must still let the successor
+        # adopt the new workers instead of double-launching their hosts.
+        self._save_state()
 
     def _reconfigure(self) -> None:
         t0 = time.monotonic()
@@ -295,13 +502,100 @@ class ElasticDriver:
 
     # -- main loop -----------------------------------------------------------
 
+    def _prepare_takeover(self) -> bool:
+        """Resume the predecessor's control-plane state (fires the
+        ``driver.takeover`` fault point): seed the fresh KV server with
+        the snapshot's generation (so the takeover world publishes at
+        g+1 and the generation fence stays monotonic across the crash)
+        and this driver's bumped epoch (arming the split-brain fence),
+        then restore the blacklist cooldowns, policy evidence, and
+        driver-lost counters. Returns True when a snapshot was resumed."""
+        snap = self._snapshot
+        if self._store is None or snap is None:
+            if self._store is not None:
+                self._server.seed(driver_epoch=self._store.epoch)
+                _metrics.DRIVER_EPOCH.set(self._store.epoch)
+            return False
+        if faults.fire(faults.DRIVER_TAKEOVER):
+            raise faults.InjectedFault(
+                "driver takeover dropped (injected)")
+        try:
+            generation = int(snap.get("generation", 0))
+        except (TypeError, ValueError):
+            generation = 0
+        self._server.seed(generation=generation,
+                          driver_epoch=self._store.epoch)
+        _metrics.DRIVER_EPOCH.set(self._store.epoch)
+        self._manager.restore_blacklist(snap.get("blacklist"))
+        self._policy.restore_state(snap.get("policy"))
+        counts = snap.get("driver_lost_counts")
+        if isinstance(counts, dict):
+            for host, n in counts.items():
+                try:
+                    self._driver_lost_counts[str(host)] = int(n)
+                except (TypeError, ValueError):
+                    continue
+            # The scrape counter resumes too: the cap continuing from
+            # restored counts while hvd_driver_lost_total read 0 would
+            # hide exactly the flap trail the metric exists to show.
+            self._server.seed_driver_lost(self._driver_lost_counts)
+        # Prefer the snapshot's membership for rank stability: pick_world
+        # keeps `preferred` (the previous world) first.
+        world = []
+        for entry in snap.get("world") or []:
+            try:
+                world.append(HostInfo(str(entry[0]), int(entry[1])))
+            except (TypeError, ValueError, IndexError):
+                continue
+        self._world_hosts = world
+        _metrics.DRIVER_TAKEOVERS.inc()
+        self._log.warning(
+            "elastic: taking over from driver epoch %d at generation %d "
+            "(world %s, %d blacklisted)", self._store.epoch - 1,
+            generation, [h.hostname for h in world],
+            self._manager.blacklist_count())
+        return True
+
     def run(self) -> int:
-        _metrics.event("driver_start", generation=0,
-                       min_np=self._min_np, max_np=self._max_np)
+        takeover = self._prepare_takeover()
+        _metrics.event("driver_start",
+                       generation=self._server.generation,
+                       min_np=self._min_np, max_np=self._max_np,
+                       driver_epoch=self.driver_epoch, takeover=takeover)
         hosts = self._wait_for_available_slots(
             self._min_np, self._settings.elastic_timeout
         )
         self._server.start()
+        adopted: list[str] = []
+        if takeover:
+            # Adopt BEFORE the first snapshot save: the save below
+            # persists THIS driver's worker table, and an empty one
+            # would clobber the predecessor's PID record — a crash in
+            # the takeover window would then leave the next successor
+            # nothing to adopt (double-launched hosts).
+            adopted = self._adopt_from_snapshot(self._snapshot or {})
+        # Persist the bumped epoch before anything else mutates: from
+        # this instant a resurrected predecessor's snapshot/endpoint
+        # writes raise DriverFencedError and it stands down. (The epoch
+        # itself was already claimed O_EXCL at store open.)
+        self._save_state()
+        if takeover:
+            _metrics.event(
+                "driver_takeover", generation=self._server.generation,
+                driver_epoch=self.driver_epoch, adopted=adopted,
+                world=[h.hostname for h in self._world_hosts])
+            # The old world's liveness is unknowable (a worker may be
+            # wedged in a collective with a peer that died alongside the
+            # driver): post the coordinated abort for the restored
+            # generation so every survivor — wedged or training — enters
+            # the recovery ladder and re-rendezvouses at g+1. With the
+            # peer replica plane armed this lands on the peer rung: zero
+            # durable reads, and each rank re-publishes its replica to
+            # this server on its next commit.
+            self._post_abort(
+                f"driver takeover (epoch {self.driver_epoch}): "
+                f"re-forming the world at generation "
+                f"{self._server.generation + 1}")
         version = self._publish_world(hosts)
         self._launch_missing_workers(version)
         self._ensure_spares(version)
@@ -309,8 +603,16 @@ class ElasticDriver:
         try:
             return self._monitor()
         finally:
-            terminate_workers(list(self._workers.values())
-                              + list(self._spare_procs.values()))
+            if self._superseded:
+                # A successor owns the world AND the workers (it adopted
+                # them); terminating "our" processes would kill ITS
+                # world. Stand down touching nothing.
+                self._log.warning(
+                    "elastic: superseded driver standing down without "
+                    "touching %d worker(s)", len(self._workers))
+            else:
+                terminate_workers(list(self._workers.values())
+                                  + list(self._spare_procs.values()))
             try:
                 # A decision whose realization window the job outlived
                 # still gets its policy_decision record (partial window).
@@ -380,6 +682,7 @@ class ElasticDriver:
             blacklisted=self._manager.blacklist_count())
         _metrics.event("blacklist", generation=self._server.generation,
                        host=name, reason=why)
+        self._save_state()
 
     # -- warm spares ---------------------------------------------------------
 
@@ -409,6 +712,7 @@ class ElasticDriver:
                 "HOROVOD_SPARE": "1",
                 "HOROVOD_WORLD_VERSION": str(version),
                 "HOROVOD_HOSTNAME": host.hostname,
+                **self._state_env(),
             },
         )
         self._log.info("elastic: launching warm spare on %s (v%d)",
@@ -670,6 +974,16 @@ class ElasticDriver:
     def _monitor(self) -> int:
         last_poll = 0.0
         while True:
+            if self._superseded:
+                # A snapshot/endpoint write was fenced: a higher-epoch
+                # driver owns the world (this one was SIGSTOP'd or
+                # partitioned through its own relaunch). Stand down
+                # WITHOUT touching the workers — the successor adopted
+                # them (run()'s finally skips termination on this flag).
+                _metrics.event("driver_superseded",
+                               generation=self._server.generation,
+                               driver_epoch=self.driver_epoch)
+                return EXIT_DRIVER_SUPERSEDED
             # 1. Reap exited workers.
             finished = {
                 n: w for n, w in self._workers.items()
@@ -683,7 +997,32 @@ class ElasticDriver:
                 self._server.clear_heartbeat(name)
                 _metrics.event("worker_exit",
                                generation=self._server.generation,
-                               host=name, rc=rc)
+                               host=name, rc=rc,
+                               adopted=isinstance(w.popen, _AdoptedPopen))
+                if isinstance(w.popen, _AdoptedPopen):
+                    # An adopted (non-child) worker's exit code is
+                    # unreadable. Completion is learned from the done
+                    # record the elastic loop publishes on return;
+                    # anything else is treated as an unclean exit — but
+                    # WITHOUT blacklisting (we cannot distinguish a
+                    # crash from a clean EXIT_REMOVED, and a takeover
+                    # must not poison the blacklist with guesses).
+                    if name in self._server.done_records():
+                        self._log.info(
+                            "elastic: adopted worker on %s finished ok "
+                            "(done record)", name)
+                        _metrics.event("job_complete",
+                                       generation=self._server.generation,
+                                       host=name)
+                        return 0
+                    self._log.warning(
+                        "elastic: adopted worker on %s exited with an "
+                        "unreadable code and no done record; relaunching "
+                        "without blacklisting", name)
+                    self._post_abort(
+                        f"adopted worker on {name} exited uncleanly")
+                    need_reconfigure = True
+                    continue
                 if rc == 0:
                     # Success on any worker ⇒ the job completed (reference
                     # semantics: the training function returned).
@@ -710,6 +1049,16 @@ class ElasticDriver:
                     # like any failure.
                     n = self._driver_lost_counts.get(name, 0) + 1
                     self._driver_lost_counts[name] = n
+                    # Control-plane flap observability (the cap below was
+                    # invisible before): hvd_driver_lost_total{host} on
+                    # the scrape + a driver_lost journal event per reap,
+                    # so operators see flaps building toward the
+                    # blacklist long before it fires.
+                    self._server.record_driver_lost(name)
+                    _metrics.DRIVER_LOST.inc(host=name)
+                    _metrics.event(
+                        "driver_lost", generation=self._server.generation,
+                        host=name, consecutive=n, capped=n > 3)
                     if n <= 3:
                         self._log.error(
                             "elastic: worker on %s lost the rendezvous KV "
@@ -786,6 +1135,17 @@ class ElasticDriver:
                 self._policy_tick()
             except Exception as e:  # noqa: BLE001
                 self._log.warning("elastic: policy tick failed: %s", e)
+            # 1d. Durable control plane: periodic snapshot refresh — the
+            # mutation paths save eagerly, but worker PIDs and policy
+            # EWMAs drift between mutations and a takeover should resume
+            # the freshest view (also the stale-driver tripwire: a
+            # resumed predecessor's first refresh hits the fence and it
+            # stands down).
+            if (self._store is not None
+                    and self._state_refresh_s > 0
+                    and time.monotonic() - self._last_state_save
+                    >= self._state_refresh_s):
+                self._save_state()
             # 2. Poll discovery.
             if time.time() - last_poll >= self._poll_interval:
                 last_poll = time.time()

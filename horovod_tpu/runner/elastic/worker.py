@@ -23,12 +23,26 @@ once failures have been continuous for
 ``EXIT_DRIVER_LOST`` instead of polling a dead driver forever (the main
 thread may be wedged in a collective precisely because the world died, so
 the poller owns the exit).
+
+Driver crash-restart rejoin: when the durable control-plane state plane
+is armed (``HOROVOD_DRIVER_STATE_DIR``), an unreachable KV no longer
+means the job is over — a supervisor may be relaunching the driver. The
+poller then re-resolves the rendezvous endpoint from the shared-storage
+discovery record (``driver_state.read_endpoint``) with jittered backoff
+on every failed poll, and ONLY gives up (``EXIT_DRIVER_LOST``) after the
+loss deadline plus ``HOROVOD_DRIVER_REJOIN_TIMEOUT`` of fruitless orphan
+waiting. A record carrying a HIGHER driver epoch than this worker's is a
+successor driver: the worker repoints every KV client at it (heartbeat,
+abort, replication, tracing all follow), adopts the new epoch for the
+split-brain fence, and the successor's g+1 world publish then surfaces
+through the normal recovery machinery — no process restart.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 
@@ -37,7 +51,7 @@ from ... import metrics as _metrics
 from ...elastic.runner import notification_manager
 from ...utils.env import get_float
 from ...utils.logging import get_logger
-from ..http.kv_server import HEARTBEAT_SCOPE, KVClient
+from ..http.kv_server import DONE_SCOPE, HEARTBEAT_SCOPE, KVClient
 from .constants import EXIT_DRIVER_LOST, POLL_FAILURE_WARN_AFTER
 
 
@@ -83,26 +97,16 @@ class ElasticWorkerContext:
     """This worker's view of the elastic world, refreshed per epoch."""
 
     def __init__(self, on_driver_lost=None):
-        addr = os.environ["HOROVOD_RENDEZVOUS_ADDR"]
-        port = int(os.environ["HOROVOD_RENDEZVOUS_PORT"])
         self.hostname = os.environ.get("HOROVOD_HOSTNAME", "localhost")
-        # Every client stamps writes with this worker's live generation
-        # view, so the server's fence can reject a zombie's replays (a
-        # SIGSTOP'd-through-recovery worker resumes with a stale version).
-        gen_fn = lambda: self.version  # noqa: E731
-        self.client = KVClient(addr, port, generation_fn=gen_fn)
-        # Dedicated heartbeat client: ONE attempt, short timeout. The beat
-        # loop itself is the retry — a beat that inherited the full KV
-        # retry budget (3 × 10s timeout + backoff) could block the sender
-        # past the driver's heartbeat deadline and get a healthy worker
-        # killed for the very silence the budget was absorbing.
-        self._hb_client = KVClient(addr, port, timeout=2.0, retries=1,
-                                   generation_fn=gen_fn)
-        # Dedicated abort-poll client, same 1-attempt/2s discipline: the
-        # abort poll bounds wedged survivors' unblock latency and must
-        # never stretch it by inheriting the fat retry budget.
-        self._abort_client = KVClient(addr, port, timeout=2.0, retries=1,
-                                      generation_fn=gen_fn)
+        # The serving driver's epoch (split-brain fence): writes carry it
+        # as X-Hvd-Driver-Epoch so a worker still loyal to a superseded
+        # driver bounces off the successor's 409 fence; the worker
+        # follows the HIGHEST epoch it has seen (endpoint re-resolution
+        # bumps it, never lowers it).
+        self.driver_epoch = int(
+            os.environ.get("HOROVOD_DRIVER_EPOCH", "0") or 0)
+        self._build_clients(os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+                            int(os.environ["HOROVOD_RENDEZVOUS_PORT"]))
         self.version = int(os.environ.get("HOROVOD_WORLD_VERSION", "0"))
         # The generation this worker last actually JOINED (fetch_assignment)
         # — distinct from `version`, which the poll loop advances the
@@ -121,6 +125,32 @@ class ElasticWorkerContext:
         self._heartbeater: threading.Thread | None = None
         self._abort_poller: threading.Thread | None = None
         self._stop = threading.Event()
+        self._next_rejoin_probe = 0.0
+
+    def _build_clients(self, addr: str, port: int) -> None:
+        """(Re)build the three KV clients against one endpoint. Every
+        client stamps writes with this worker's live generation view, so
+        the server's fence can reject a zombie's replays (a
+        SIGSTOP'd-through-recovery worker resumes with a stale version),
+        and with the driver epoch (split-brain fence).
+
+        The heartbeat and abort-poll clients are dedicated ONE-attempt /
+        2s-timeout clients: the beat loop itself is the retry — a beat
+        that inherited the full KV retry budget (3 × 10s timeout +
+        backoff) could block the sender past the driver's heartbeat
+        deadline and get a healthy worker killed for the very silence
+        the budget was absorbing; the abort poll bounds wedged
+        survivors' unblock latency and must never stretch it either."""
+        gen_fn = lambda: self.version  # noqa: E731
+        epoch_fn = lambda: (  # noqa: E731
+            self.driver_epoch if self.driver_epoch > 0 else None)
+        self.client = KVClient(addr, port, generation_fn=gen_fn,
+                               epoch_fn=epoch_fn)
+        self._hb_client = KVClient(addr, port, timeout=2.0, retries=1,
+                                   generation_fn=gen_fn, epoch_fn=epoch_fn)
+        self._abort_client = KVClient(addr, port, timeout=2.0, retries=1,
+                                      generation_fn=gen_fn,
+                                      epoch_fn=epoch_fn)
 
     def fetch_assignment(self, version: int | None = None) -> dict:
         """Read this host's assignment for a world version (JSON dict with
@@ -291,6 +321,74 @@ class ElasticWorkerContext:
         # the driver — a SystemExit there would never be seen.
         os._exit(EXIT_DRIVER_LOST)
 
+    def rejoin_timeout(self) -> float:
+        """The bounded orphan window: how long past the driver-loss
+        deadline a worker keeps re-resolving the rendezvous endpoint
+        before giving up with ``EXIT_DRIVER_LOST``. Zero — the default
+        whenever ``HOROVOD_DRIVER_STATE_DIR`` is unset — disables the
+        orphan loop entirely: the 203 path is bit-for-bit the
+        state-plane-free one."""
+        from . import driver_state
+
+        if driver_state.state_dir() is None:
+            return 0.0
+        return get_float("HOROVOD_DRIVER_REJOIN_TIMEOUT", 600.0)
+
+    def _try_rejoin(self) -> bool:
+        """One endpoint re-resolution attempt (jittered backoff between
+        reads): follow the shared-storage discovery record to a SUCCESSOR
+        driver — strictly higher epoch, answering probe — and repoint
+        every client at it. Returns True on a completed repoint."""
+        from . import driver_state
+
+        now = time.monotonic()
+        if now < self._next_rejoin_probe:
+            return False
+        base = get_float("HOROVOD_DRIVER_REJOIN_PROBE_INTERVAL", 1.0)
+        self._next_rejoin_probe = now + base * (1.0 + random.random())
+        record = driver_state.read_endpoint()
+        if record is None or record["driver_epoch"] <= self.driver_epoch:
+            return False  # the dead driver's own record (or none yet)
+        probe = KVClient(record["addr"], record["port"], timeout=2.0,
+                         retries=1)
+        try:
+            probe.world_version()
+        except Exception:  # noqa: BLE001 — successor not up yet
+            return False
+        self._repoint(record["addr"], record["port"],
+                      record["driver_epoch"])
+        return True
+
+    def _repoint(self, addr: str, port: int, epoch: int) -> None:
+        """Adopt a successor driver's endpoint + epoch: rebuild the
+        three owned clients, refresh the env contract (the trace
+        shipper, ``abort.post``, and the peer replicator all resolve
+        the endpoint from env), and reset the replicator's cached
+        client so the next commit re-publishes its replica to the new
+        KV — the peer rung re-arms with zero durable reads."""
+        get_logger().warning(
+            "elastic: rendezvous endpoint re-resolved to %s:%d (driver "
+            "epoch %d > %d) — rejoining the restarted driver",
+            addr, port, epoch, self.driver_epoch)
+        self.driver_epoch = epoch
+        os.environ["HOROVOD_RENDEZVOUS_ADDR"] = addr
+        os.environ["HOROVOD_RENDEZVOUS_PORT"] = str(port)
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = addr
+        os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(port)
+        os.environ["HOROVOD_DRIVER_EPOCH"] = str(epoch)
+        self._build_clients(addr, port)
+        try:
+            from ... import peercheck
+
+            rep = peercheck.active_replicator()
+            if rep is not None:
+                rep.repoint()
+        except Exception:  # noqa: BLE001 — replication is best-effort
+            pass
+        _metrics.event("driver_rejoin", generation=self.version,
+                       host=self.hostname, driver_epoch=epoch,
+                       endpoint=f"{addr}:{port}")
+
     def start_polling(self, interval: float = 1.0) -> None:
         if self._poller is not None:
             return
@@ -299,6 +397,7 @@ class ElasticWorkerContext:
         def loop():
             log = get_logger()
             first_failure: float | None = None
+            orphaned = False
             while not self._stop.wait(interval):
                 try:
                     self.check_for_update()
@@ -316,7 +415,40 @@ class ElasticWorkerContext:
                         )
                     else:
                         log.debug("elastic poll failed: %s", e)
-                    if (lost_timeout > 0
+                    rejoin_budget = self.rejoin_timeout()
+                    if rejoin_budget > 0:
+                        # Orphan loop: a supervisor may be relaunching
+                        # the driver — keep re-resolving the endpoint
+                        # (jittered) and only die at loss + rejoin.
+                        try:
+                            if self._try_rejoin():
+                                first_failure = None
+                                orphaned = False
+                                self.consecutive_poll_failures = 0
+                                continue
+                        except Exception as re:  # noqa: BLE001
+                            log.debug("elastic rejoin probe failed: %s",
+                                      re)
+                        if (lost_timeout > 0 and not orphaned
+                                and now - first_failure >= lost_timeout):
+                            orphaned = True
+                            log.warning(
+                                "elastic: driver lost for %.0fs — "
+                                "entering the orphan wait (another "
+                                "%.0fs of endpoint re-resolution "
+                                "before exit %d)",
+                                now - first_failure, rejoin_budget,
+                                EXIT_DRIVER_LOST)
+                            _metrics.event(
+                                "driver_orphaned",
+                                generation=self.version,
+                                host=self.hostname,
+                                silent_s=round(now - first_failure, 1))
+                        if (lost_timeout > 0
+                                and now - first_failure
+                                >= lost_timeout + rejoin_budget):
+                            self._on_driver_lost(now - first_failure)
+                    elif (lost_timeout > 0
                             and now - first_failure >= lost_timeout):
                         self._on_driver_lost(now - first_failure)
                 else:
@@ -328,6 +460,7 @@ class ElasticWorkerContext:
                         )
                     self.consecutive_poll_failures = 0
                     first_failure = None
+                    orphaned = False
 
         self._poller = threading.Thread(
             target=loop, name="hvd-elastic-poll", daemon=True
@@ -472,3 +605,37 @@ def get_worker_context() -> ElasticWorkerContext:
     if _context is None:
         _context = ElasticWorkerContext()
     return _context
+
+
+def announce_done() -> None:
+    """Best-effort completion record (``PUT /done/<host>``), published
+    when the elastic training function returns. The driver normally
+    learns completion from the rc=0 it reaps — but a worker ADOPTED
+    across a driver crash-restart is not the new driver's child, so this
+    record is the only way its success survives the takeover. Failures
+    are swallowed: a worker whose KV is gone still exits 0, and the
+    pre-takeover reap path never needed the record anyway."""
+    ctx = _context
+    if ctx is None or not elastic_enabled():
+        return
+    try:
+        # Deliberately NOT generation-fenced: a worker finishing while
+        # the driver is mid-reconfigure (server already at g+1) must
+        # still land its completion — a 409'd done record would read as
+        # an unclean adopted exit and re-run the finished job. The
+        # driver-epoch fence still applies (a superseded driver's
+        # worker must not plant records in the successor's store).
+        client = KVClient(
+            os.environ["HOROVOD_RENDEZVOUS_ADDR"],
+            int(os.environ["HOROVOD_RENDEZVOUS_PORT"]),
+            timeout=5.0, retries=3,
+            epoch_fn=(lambda: ctx.driver_epoch)
+            if ctx.driver_epoch > 0 else None)
+        client.put(DONE_SCOPE, ctx.hostname, json.dumps({
+            "host": ctx.hostname,
+            "rc": 0,
+            "generation": ctx.joined_version,
+            "t": time.time(),
+        }).encode())
+    except Exception as e:  # noqa: BLE001 — advisory record only
+        get_logger().debug("elastic: completion announce failed: %s", e)

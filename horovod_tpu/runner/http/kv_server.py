@@ -85,6 +85,16 @@ from .. import secret as _secret
 AUTH_HEADER = "X-Hvd-Auth"
 GENERATION_HEADER = "X-Hvd-Generation"
 
+# Split-brain fence (control-plane fault tolerance): alongside the world
+# generation, writes may carry the monotonic DRIVER EPOCH (bumped on
+# every driver (re)start, persisted in runner/elastic/driver_state.py).
+# A write stamped with an epoch LOWER than the serving driver's is a
+# resurrected stale driver's (or a worker still loyal to one) and is
+# rejected with 409 — a SIGSTOP'd-through-takeover driver can never
+# reclaim or corrupt the re-formed world. Writes without the header are
+# unfenced (plain tooling, static launches).
+DRIVER_EPOCH_HEADER = "X-Hvd-Driver-Epoch"
+
 # Liveness scope: workers PUT /heartbeat/<host>; the server records the
 # RECEIVE time (server clock — worker clocks don't enter the liveness
 # decision, so skew/NTP steps on preempted VMs can't fake death or life).
@@ -103,6 +113,14 @@ TRACE_SCOPE = _tracing.TRACE_SCOPE
 # framework imports are done — the driver's policy plane treats presence
 # here (plus a fresh heartbeat) as "warm and promotable".
 SPARE_SCOPE = "spare"
+
+# Completion scope: an elastic worker whose training function RETURNED
+# announces it here (``PUT /done/<host>``) before exiting 0. The driver
+# normally learns completion from the exit code it reaps — but a worker
+# ADOPTED across a driver restart is not the new driver's child, so its
+# exit code is unreadable; the done record is how job completion
+# survives a control-plane takeover.
+DONE_SCOPE = "done"
 
 # Preemption-notice scope: an external agent (cloud metadata watcher,
 # maintenance tooling) PUTs /preempt/<host> to announce the host is about
@@ -156,6 +174,16 @@ class _KVHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         pass
 
+    def _serve_fault(self) -> bool:
+        """The ``kv.serve`` injection point: firing (drop semantics)
+        closes the connection without answering — to the client that is
+        a transport failure, indistinguishable from a driver dying
+        mid-request; ``delay``/``hang`` stretch the request in place."""
+        if faults.fire(faults.KV_SERVE):
+            self.close_connection = True
+            return True
+        return False
+
     def _authenticate(self, body: bytes = b"") -> bool:
         tag = self.headers.get(AUTH_HEADER, "")
         key = self.server.secret  # type: ignore[attr-defined]
@@ -177,6 +205,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         return scope, key
 
     def do_GET(self):  # noqa: N802
+        if self._serve_fault():
+            return
         if self.path == "/metrics":
             # Unauthenticated by design: Prometheus scrapers can't HMAC.
             return self._serve_metrics()
@@ -193,6 +223,9 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._split()
         if scope == "_version":
             body = str(self.server.version).encode()  # type: ignore[attr-defined]
+            return self._reply(200, body)
+        if scope == "_epoch":
+            body = str(self.server.driver_epoch).encode()  # type: ignore[attr-defined]
             return self._reply(200, body)
         if scope == "_scope":
             with self.server.lock:  # type: ignore[attr-defined]
@@ -212,7 +245,9 @@ class _KVHandler(BaseHTTPRequestHandler):
         proceed. Writes without the header are unfenced (plain clients)."""
         raw = self.headers.get(GENERATION_HEADER)
         if raw is None:
-            return None
+            # No generation stamp, but the driver-epoch fence must still
+            # run: epoch-only clients (abort.post) fence on it alone.
+            return self._epoch_fence_locked()
         try:
             gen = int(raw)
         except ValueError:
@@ -222,6 +257,26 @@ class _KVHandler(BaseHTTPRequestHandler):
             self.server.fenced += 1  # type: ignore[attr-defined]
             return (f"stale generation {gen} rejected "
                     f"(world at generation {current})").encode()
+        return self._epoch_fence_locked()
+
+    def _epoch_fence_locked(self) -> bytes | None:
+        """Driver-epoch fence (under the server lock): a write stamped
+        with a driver epoch older than the serving driver's comes from a
+        resurrected stale driver's world — 409 it so a
+        SIGSTOP'd-through-takeover driver can never corrupt the state of
+        the driver that superseded it. Headerless writes are unfenced."""
+        raw = self.headers.get(DRIVER_EPOCH_HEADER)
+        if raw is None:
+            return None
+        try:
+            epoch = int(raw)
+        except ValueError:
+            return b"bad driver-epoch header"
+        current = self.server.driver_epoch  # type: ignore[attr-defined]
+        if epoch < current:
+            self.server.fenced += 1  # type: ignore[attr-defined]
+            return (f"stale driver epoch {epoch} rejected "
+                    f"(world owned by driver epoch {current})").encode()
         return None
 
     def _drain_and_413(self, length: int, reason: bytes):
@@ -239,6 +294,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         return self._reply(413, reason)
 
     def do_PUT(self):  # noqa: N802
+        if self._serve_fault():
+            return
         scope, key = self._split()
         if key is None:
             return self._reply(400, b"missing key")
@@ -289,6 +346,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self._reply(200, b"")
 
     def do_DELETE(self):  # noqa: N802
+        if self._serve_fault():
+            return
         if not self._authenticate():
             return
         scope = self.path.strip("/")
@@ -443,6 +502,8 @@ def _render_cluster_metrics(httpd) -> str:
         blacklisted = getattr(httpd, "blacklisted", 0)
         spares = getattr(httpd, "spare_count", 0)
         policy_actions = dict(getattr(httpd, "policy_actions", {}))
+        driver_epoch = getattr(httpd, "driver_epoch", 0)
+        driver_lost = dict(getattr(httpd, "driver_lost", {}))
         now = time.monotonic()
         ages = {h: now - t for h, t in httpd.hb_times.items()}
         payloads = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
@@ -481,6 +542,23 @@ def _render_cluster_metrics(httpd) -> str:
             "(drain|promote|preempt).",
             [({"action": a}, policy_actions.get(a, 0))
              for a in POLICY_ACTIONS]),
+        # Control-plane fault tolerance: the driver epoch (split-brain
+        # fence identity; 0 = no driver-state plane) and per-host
+        # EXIT_DRIVER_LOST reap counts. The unlabeled sample is the
+        # job-wide total, zero-materialized so the scrape gate can
+        # assert the instrument before any flap.
+        _metrics.make_family(
+            "hvd_driver_epoch", "gauge",
+            "Monotonic driver epoch: bumped on every driver (re)start; "
+            "stale-epoch writes are 409-fenced.",
+            [({}, driver_epoch)]),
+        _metrics.make_family(
+            "hvd_driver_lost_total", "counter",
+            "Workers reaped with EXIT_DRIVER_LOST (rendezvous KV "
+            "unreachable past the deadline) — control-plane flaps, by "
+            "host, plus the unlabeled job-wide total.",
+            [({}, sum(driver_lost.values()))]
+            + [({"host": h}, n) for h, n in sorted(driver_lost.items())]),
     ]
     groups: list = [({}, driver_families)]
     steps_samples: list = []
@@ -568,6 +646,8 @@ class RendezvousServer:
         self._httpd.blacklisted = 0  # type: ignore[attr-defined]
         self._httpd.spare_count = 0  # type: ignore[attr-defined]
         self._httpd.policy_actions = {}  # type: ignore[attr-defined]
+        self._httpd.driver_epoch = 0  # type: ignore[attr-defined]
+        self._httpd.driver_lost = {}  # type: ignore[attr-defined]
         self._httpd.straggler_logged = set()  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
@@ -590,9 +670,58 @@ class RendezvousServer:
 
     @property
     def fenced_writes(self) -> int:
-        """How many stale-generation writes the fence has rejected."""
+        """How many stale-generation/stale-epoch writes the fences have
+        rejected."""
         with self._httpd.lock:  # type: ignore[attr-defined]
             return self._httpd.fenced  # type: ignore[attr-defined]
+
+    @property
+    def driver_epoch(self) -> int:
+        return self._httpd.driver_epoch  # type: ignore[attr-defined]
+
+    def seed(self, generation: int | None = None,
+             driver_epoch: int | None = None) -> None:
+        """Takeover entry (``runner/elastic/driver_state.py``): a
+        restarted driver seeds its fresh server with the snapshot's
+        world generation — so the takeover epoch publishes at g+1 and
+        the existing generation fence stays monotonic across the crash —
+        and with its own (bumped) driver epoch, arming the split-brain
+        fence. Call before :meth:`start`."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            if generation is not None:
+                self._httpd.version = int(generation)  # type: ignore[attr-defined]
+            if driver_epoch is not None:
+                self._httpd.driver_epoch = int(driver_epoch)  # type: ignore[attr-defined]
+
+    def seed_driver_lost(self, counts: dict) -> None:
+        """Takeover resume: carry the predecessor's per-host
+        EXIT_DRIVER_LOST counts into the scrape, so
+        ``hvd_driver_lost_total`` keeps telling the truth about flaps
+        building toward the blacklist cap across the very control-plane
+        event it exists to expose."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            table = self._httpd.driver_lost  # type: ignore[attr-defined]
+            for host, n in (counts or {}).items():
+                try:
+                    table[str(host)] = max(table.get(str(host), 0),
+                                           int(n))
+                except (TypeError, ValueError):
+                    continue
+
+    def record_driver_lost(self, host: str) -> None:
+        """Count one EXIT_DRIVER_LOST reap into the scrape's
+        ``hvd_driver_lost_total{host}`` counter (the control-plane flap
+        signal operators watch before the 3-consecutive cap blacklists
+        a healthy host)."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            counts = self._httpd.driver_lost  # type: ignore[attr-defined]
+            counts[host] = counts.get(host, 0) + 1
+
+    def done_records(self) -> dict[str, dict]:
+        """Hosts whose workers announced clean completion (parsed
+        ``PUT /done/<host>`` records) — how an ADOPTED worker's rc=0
+        survives the driver restart that orphaned it."""
+        return self._scope_records(DONE_SCOPE)
 
     def set_cluster_info(self, world_np: int | None = None,
                          blacklisted: int | None = None,
@@ -779,13 +908,16 @@ class KVClient:
 
     ``generation_fn`` (elastic workers pass their live world-generation
     view) stamps every write with ``X-Hvd-Generation`` so the server's
-    fence can reject zombies from a pre-abort world; ``None`` (or a fn
-    returning ``None``) leaves writes unfenced.
+    fence can reject zombies from a pre-abort world; ``epoch_fn``
+    likewise stamps ``X-Hvd-Driver-Epoch`` (the split-brain fence: a
+    write still loyal to a superseded driver's epoch is 409'd). ``None``
+    (or a fn returning ``None``) leaves writes unfenced.
     """
 
     def __init__(self, addr: str, port: int, timeout: float = 10.0,
                  retries: int | None = None, backoff: float | None = None,
-                 generation_fn: Callable[[], int | None] | None = None):
+                 generation_fn: Callable[[], int | None] | None = None,
+                 epoch_fn: Callable[[], int | None] | None = None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
         self._retries = (get_int("HOROVOD_KV_RETRIES", 3)
@@ -793,6 +925,7 @@ class KVClient:
         self._backoff = (get_float("HOROVOD_KV_RETRY_BACKOFF", 0.1)
                          if backoff is None else backoff)
         self._generation_fn = generation_fn
+        self._epoch_fn = epoch_fn
 
     def _request(self, method: str, path: str, body: bytes | None = None):
         def attempt():
@@ -813,6 +946,10 @@ class KVClient:
                         # world — the server must 409 this write.
                         gen -= 1
                     req.add_header(GENERATION_HEADER, str(gen))
+            if self._epoch_fn is not None and method in ("PUT", "DELETE"):
+                epoch = self._epoch_fn()
+                if epoch is not None:
+                    req.add_header(DRIVER_EPOCH_HEADER, str(epoch))
             return urlopen(req, timeout=self._timeout)
 
         return call_with_retries(
@@ -848,6 +985,12 @@ class KVClient:
 
     def world_version(self) -> int:
         with self._request("GET", "/_version") as r:
+            return int(r.read())
+
+    def driver_epoch(self) -> int:
+        """The serving driver's epoch (``GET /_epoch``; 0 when the
+        driver-state plane is off)."""
+        with self._request("GET", "/_epoch") as r:
             return int(r.read())
 
     def abort_posted(self, generation: int) -> dict | None:

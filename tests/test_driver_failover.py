@@ -1,0 +1,884 @@
+"""Control-plane fault tolerance: driver crash-restart takeover with
+split-brain fencing.
+
+The chaos battery for `runner/elastic/driver_state.py` and the takeover
+machinery around it:
+
+- the durable snapshot store (atomic rotation, checksum verification,
+  SIGKILL-mid-write falling back to the previous epoch's intact state)
+- driver-epoch fencing at every layer: the state dir (a stale driver's
+  snapshot/endpoint writes raise ``DriverFencedError``), the rendezvous
+  KV (stale-epoch writes 409), and the worker (follows the highest
+  epoch it has seen)
+- the worker orphan loop: driver loss no longer exits 203 when the
+  state plane is armed — the worker re-resolves the endpoint record and
+  repoints every client at the successor
+- end to end with the real ``ElasticDriver``: SIGKILL the driver
+  mid-training with 2 workers → a supervisor relaunch resumes from the
+  snapshot, both workers rejoin at generation g+1 WITHOUT a process
+  restart, recovery lands on the peer rung (zero durable reads), and
+  the loss trajectory matches an uninterrupted run step for step; plus
+  the SIGSTOP'd-through-takeover stale driver standing down
+  (``EXIT_DRIVER_SUPERSEDED``) without touching the successor's world.
+
+Determinism contract: failures are injected (SIGKILL/SIGSTOP at exact
+observed points, fault points on exact hits), so the tests assert exact
+trajectories instead of racing a scheduler."""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults
+from horovod_tpu.runner.elastic import driver_state
+from horovod_tpu.runner.elastic.constants import (
+    EXIT_DRIVER_SUPERSEDED,
+)
+from horovod_tpu.runner.http.kv_server import (
+    KVClient,
+    RendezvousServer,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    monkeypatch.delenv(driver_state.ENV_STATE_DIR, raising=False)
+    monkeypatch.delenv(driver_state.ENV_DRIVER_EPOCH, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def kv_server():
+    server = RendezvousServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+# -- the snapshot store -------------------------------------------------------
+
+
+class TestDriverStateStore:
+    def test_save_load_roundtrip_and_epoch_monotonicity(self, tmp_path):
+        d = str(tmp_path)
+        store, snap = driver_state.DriverStateStore.open(d)
+        assert snap is None and store.epoch == 1
+        store.save({"generation": 3, "world": [["a", 1], ["b", 1]]})
+        store2, snap2 = driver_state.DriverStateStore.open(d)
+        assert store2.epoch == 2
+        assert snap2["generation"] == 3
+        assert snap2["world"] == [["a", 1], ["b", 1]]
+        assert snap2["driver_epoch"] == 1
+
+    def test_stale_driver_snapshot_and_endpoint_fenced(self, tmp_path):
+        d = str(tmp_path)
+        old, _ = driver_state.DriverStateStore.open(d)
+        old.save({"generation": 1})
+        new, _ = driver_state.DriverStateStore.open(d)
+        new.save({"generation": 2})
+        with pytest.raises(driver_state.DriverFencedError):
+            old.save({"generation": 99})
+        # The endpoint record is fenced against the SNAPSHOT's epoch
+        # too (a successor may write either file first).
+        with pytest.raises(driver_state.DriverFencedError):
+            old.publish_endpoint("127.0.0.1", 1, 1)
+        # The successor is unaffected, and its own records land.
+        new.publish_endpoint("127.0.0.1", 4242, 2)
+        rec = driver_state.read_endpoint(d)
+        assert rec["driver_epoch"] == 2 and rec["port"] == 4242
+
+    def test_open_clears_endpoint_epoch_too(self, tmp_path):
+        # Crash between the endpoint write and the snapshot write can
+        # leave the endpoint record at a HIGHER epoch than the snapshot;
+        # the next open must clear both.
+        d = str(tmp_path)
+        store = driver_state.DriverStateStore(d, epoch=7)
+        store.publish_endpoint("127.0.0.1", 1, 0)
+        nxt, snap = driver_state.DriverStateStore.open(d)
+        assert snap is None and nxt.epoch == 8
+
+    def test_corrupt_current_falls_back_to_prev(self, tmp_path):
+        d = str(tmp_path)
+        store, _ = driver_state.DriverStateStore.open(d)
+        store.save({"generation": 1, "tag": "good"})
+        store.save({"generation": 2, "tag": "newer"})
+        # Bit-rot the current slot: load must recover the retained one.
+        path = store.state_path
+        blob = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(blob[: len(blob) // 2])
+        rec = store.load()
+        assert rec is not None and rec["tag"] == "good"
+
+    def test_snapshot_fault_point(self, tmp_path):
+        store, _ = driver_state.DriverStateStore.open(str(tmp_path))
+        faults.inject(faults.DRIVER_SNAPSHOT, "raise", at=1, count=1)
+        with pytest.raises(faults.InjectedFault):
+            store.save({"generation": 1})
+        store.save({"generation": 1})  # next attempt lands
+        assert store.load()["generation"] == 1
+
+    def test_read_endpoint_rejects_malformed(self, tmp_path, monkeypatch):
+        d = str(tmp_path)
+        monkeypatch.setenv(driver_state.ENV_STATE_DIR, d)
+        assert driver_state.read_endpoint() is None  # nothing yet
+        store = driver_state.DriverStateStore(d, epoch=1)
+        store._fenced_install(store.endpoint_path, {"addr": "x"})  # no port
+        assert driver_state.read_endpoint() is None
+
+    def test_concurrent_opens_claim_distinct_epochs(self, tmp_path):
+        """A flapping supervisor can relaunch two takeover drivers in
+        the same window: the O_EXCL epoch claim must hand them DISTINCT
+        epochs (equal epochs would pass every fence — split brain)."""
+        d = str(tmp_path)
+        a, _ = driver_state.DriverStateStore.open(d)
+        b, _ = driver_state.DriverStateStore.open(d)
+        assert a.epoch != b.epoch
+        assert {a.epoch, b.epoch} == {1, 2}
+        # The loser fences the winner out on its first write.
+        b.save({"generation": 0})
+        with pytest.raises(driver_state.DriverFencedError):
+            a.save({"generation": 0})
+        # A third open clears every claimed epoch, records or not.
+        c, _ = driver_state.DriverStateStore.open(d)
+        assert c.epoch == 3
+
+    def test_proc_start_ticks_detects_pid_identity(self):
+        ticks = driver_state.proc_start_ticks(os.getpid())
+        assert ticks is not None and ticks > 0
+        assert driver_state.proc_start_ticks(os.getpid()) == ticks
+        # A vanished pid reads as None (callers fall back to pid-only).
+        assert driver_state.proc_start_ticks(2 ** 22 + 12345) is None
+
+    def test_adoption_rejects_recycled_pid(self, tmp_path, monkeypatch):
+        """A snapshot PID alive but with a DIFFERENT kernel start time
+        is a recycled PID naming a stranger — adoption must skip it
+        (the liveness plane would otherwise SIGKILL an innocent
+        process group)."""
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+        from horovod_tpu.runner.launch import Settings
+
+        monkeypatch.setenv("HOROVOD_SECRET_KEY", "")
+        monkeypatch.delenv("HOROVOD_SECRET_KEY", raising=False)
+        settings = Settings(
+            num_proc=1, hosts=[], command=["true"], elastic=True,
+            min_np=1, max_np=1, discovery_script=None)
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHostDiscovery,
+        )
+        from horovod_tpu.runner.hosts import HostInfo
+
+        drv = ElasticDriver(
+            settings,
+            discovery=FixedHostDiscovery([HostInfo("localhost", 1)]))
+        me = os.getpid()
+        good_ticks = driver_state.proc_start_ticks(me)
+        adopted = drv._adopt_from_snapshot({"workers": {"localhost": {
+            "pid": me, "local": True, "start_ticks": good_ticks - 7}}})
+        assert adopted == [] and not drv._workers
+        adopted = drv._adopt_from_snapshot({"workers": {"localhost": {
+            "pid": me, "local": True, "start_ticks": good_ticks}}})
+        assert adopted == ["localhost"] and "localhost" in drv._workers
+
+
+class TestTornSnapshotChaos:
+    def test_sigkill_mid_snapshot_restart_loads_previous_epoch(
+            self, tmp_path):
+        """The torn-write chaos case (mirrors test_peercheck's raw-socket
+        pattern): a driver SIGKILLed mid-snapshot-write leaves a partial
+        tmp file and/or a half-written current slot — the restarted
+        driver must load the previous epoch's INTACT state, never a
+        torn one, and take over at a strictly higher epoch."""
+        script = tmp_path / "torn_driver.py"
+        script.write_text(f"""
+import os, signal, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu.runner.elastic import driver_state
+
+d = os.environ["STATE_DIR"]
+store, _ = driver_state.DriverStateStore.open(d)
+store.save({{"generation": 5, "world": [["a", 1], ["b", 1]],
+             "tag": "intact"}})
+print("GOOD SAVED", flush=True)
+# Next snapshot: die mid-write. Write half of a VALID next record
+# straight into the current slot (the torn-filesystem case atomic
+# rotation + checksums exist for), then SIGKILL.
+blob = driver_state._encode({{"generation": 6, "tag": "torn",
+                              "driver_epoch": store.epoch}})
+# Rotate like atomic_install would have (prev = the good record)...
+import shutil
+shutil.copy(store.state_path, store.state_path + ".prev")
+with open(store.state_path, "wb") as f:
+    f.write(blob[: len(blob) // 2])
+    f.flush()
+    print("HALF WRITTEN", flush=True)
+    os.kill(os.getpid(), signal.SIGKILL)
+""")
+        env = dict(os.environ)
+        env["STATE_DIR"] = str(tmp_path / "state")
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == -signal.SIGKILL, (proc.returncode, out)
+        assert "HALF WRITTEN" in out, out
+        # The restarted driver: loads the intact epoch-1 snapshot from
+        # the retained slot, takes over at epoch 2.
+        store, snap = driver_state.DriverStateStore.open(
+            str(tmp_path / "state"))
+        assert snap is not None, "takeover lost the snapshot entirely"
+        assert snap["tag"] == "intact" and snap["generation"] == 5
+        assert store.epoch == 2
+
+    def test_sigkill_mid_tmp_write_leaves_current_untouched(
+            self, tmp_path):
+        """The atomic_install crash window proper: dying inside the tmp
+        write must leave the CURRENT slot byte-identical."""
+        script = tmp_path / "tmp_torn.py"
+        script.write_text(f"""
+import os, signal, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu.runner.elastic import driver_state
+
+d = os.environ["STATE_DIR"]
+store, _ = driver_state.DriverStateStore.open(d)
+store.save({{"generation": 5, "tag": "intact"}})
+print("GOOD SAVED", flush=True)
+with open(store.state_path + ".tmp", "wb") as f:
+    f.write(b"x" * 10)
+    f.flush()
+    os.kill(os.getpid(), signal.SIGKILL)
+""")
+        env = dict(os.environ)
+        env["STATE_DIR"] = str(tmp_path / "state")
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == -signal.SIGKILL, (proc.returncode, out)
+        store, snap = driver_state.DriverStateStore.open(
+            str(tmp_path / "state"))
+        assert snap["tag"] == "intact" and store.epoch == 2
+
+
+# -- KV-layer split-brain fencing --------------------------------------------
+
+
+class TestDriverEpochFence:
+    def test_stale_epoch_write_409_fresh_epoch_lands(self, kv_server):
+        from urllib.error import HTTPError
+
+        kv_server.seed(generation=5, driver_epoch=3)
+        ok = KVClient("127.0.0.1", kv_server.port,
+                      generation_fn=lambda: 5, epoch_fn=lambda: 3)
+        ok.put("s", "k", b"v")
+        stale = KVClient("127.0.0.1", kv_server.port,
+                         generation_fn=lambda: 5, epoch_fn=lambda: 2)
+        with pytest.raises(HTTPError) as ei:
+            stale.put("s", "k", b"zombie")
+        assert ei.value.code == 409
+        assert ok.get("s", "k") == b"v"  # the zombie corrupted nothing
+        assert kv_server.fenced_writes == 1
+        assert ok.driver_epoch() == 3
+
+    def test_headerless_writes_unfenced(self, kv_server):
+        kv_server.seed(driver_epoch=9)
+        plain = KVClient("127.0.0.1", kv_server.port)
+        plain.put("s", "k", b"v")  # static/plain tooling keeps working
+        assert plain.get("s", "k") == b"v"
+
+    def test_epoch_only_writes_are_fenced_too(self, kv_server):
+        """abort.post's client stamps the epoch WITHOUT a generation
+        header — the epoch fence must still evaluate (a worker still
+        loyal to a superseded driver cannot plant abort records)."""
+        from urllib.error import HTTPError
+
+        kv_server.seed(driver_epoch=5)
+        stale = KVClient("127.0.0.1", kv_server.port, epoch_fn=lambda: 4)
+        with pytest.raises(HTTPError) as ei:
+            stale.put("abort", "3", b"{}")
+        assert ei.value.code == 409
+        fresh = KVClient("127.0.0.1", kv_server.port, epoch_fn=lambda: 5)
+        fresh.put("abort", "3", b"{}")  # current epoch lands
+
+    def test_seed_driver_lost_resumes_scrape_counts(self, kv_server):
+        from horovod_tpu import metrics
+
+        kv_server.seed_driver_lost({"hostA": 2, "hostB": "bad"})
+        kv_server.record_driver_lost("hostA")
+        parsed = metrics.validate_prometheus_text(
+            kv_server.metrics_text())
+        samples = dict(
+            (tuple(sorted(l.items())), v)
+            for l, v in parsed["hvd_driver_lost_total"]["samples"])
+        assert samples[(("host", "hostA"),)] == 3.0
+        assert samples[()] == 3.0
+
+    def test_scrape_carries_epoch_and_driver_lost(self, kv_server):
+        from horovod_tpu import metrics
+
+        kv_server.seed(driver_epoch=4)
+        kv_server.record_driver_lost("hostA")
+        kv_server.record_driver_lost("hostA")
+        text = kv_server.metrics_text()
+        parsed = metrics.validate_prometheus_text(text)
+        assert ({}, 4.0) in [
+            (l, v) for l, v in parsed["hvd_driver_epoch"]["samples"]]
+        samples = dict(
+            (tuple(sorted(l.items())), v)
+            for l, v in parsed["hvd_driver_lost_total"]["samples"])
+        assert samples[()] == 2.0  # the zero-materialized total
+        assert samples[(("host", "hostA"),)] == 2.0
+
+    def test_kv_serve_fault_is_a_transport_failure(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port, retries=3,
+                          backoff=0.01)
+        faults.inject(faults.KV_SERVE, "drop", at=1, count=1)
+        client.put("s", "k", b"v")  # dropped serve, retried, landed
+        assert client.get("s", "k") == b"v"
+        assert faults.fired(faults.KV_SERVE) == 1
+
+    def test_done_records_roundtrip(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        client.put("done", "hostA", json.dumps({"rc": 0}).encode())
+        assert "hostA" in kv_server.done_records()
+
+
+# -- policy/blacklist resume --------------------------------------------------
+
+
+class TestControlPlaneResume:
+    def test_policy_evidence_roundtrip(self):
+        from horovod_tpu.elastic.policy import PolicyController
+
+        clock = [100.0]
+        a = PolicyController(clock=lambda: clock[0])
+        a._ewma["h1"] = 1.5
+        a._hb_ewma["h1"] = 0.4
+        a._above_since["h1"] = 70.0  # condemned for 30s
+        a.note_resize_cost(12.0)
+        state = a.export_state()
+        clock[0] = 500.0  # a different clock era entirely
+        b = PolicyController(clock=lambda: clock[0])
+        b.restore_state(state)
+        assert b._ewma["h1"] == pytest.approx(1.5)
+        assert b._hb_ewma["h1"] == pytest.approx(0.4)
+        # The sustained-condemnation AGE survived the clock change.
+        assert 500.0 - b._above_since["h1"] == pytest.approx(30.0)
+        assert b.resize_cost_s() == pytest.approx(12.0)
+        b.restore_state(None)  # malformed input is a no-op
+        b.restore_state({"ewma": "nope"})
+
+    def test_blacklist_cooldown_survives_restart(self):
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHostDiscovery,
+            HostManager,
+        )
+        from horovod_tpu.runner.hosts import HostInfo
+
+        m1 = HostManager(FixedHostDiscovery([HostInfo("a", 1)]),
+                         cooldown_s=60.0)
+        m1.blacklist("a")
+        ages = m1.export_blacklist()
+        assert 0.0 <= ages["a"] < 5.0
+        m2 = HostManager(FixedHostDiscovery([HostInfo("a", 1)]),
+                         cooldown_s=60.0)
+        # Simulate 50s already served before the crash: the successor
+        # must re-admit after ~10 more, not a fresh 60.
+        m2.restore_blacklist({"a": 50.0})
+        assert m2.is_blacklisted("a")
+        m3 = HostManager(FixedHostDiscovery([HostInfo("a", 1)]),
+                         cooldown_s=60.0)
+        m3.restore_blacklist({"a": 61.0})  # already expired
+        assert not m3.is_blacklisted("a")
+
+
+# -- the worker orphan loop ---------------------------------------------------
+
+
+class TestOrphanRejoin:
+    def _ctx(self, monkeypatch, port, **env):
+        from horovod_tpu.runner.elastic.worker import ElasticWorkerContext
+
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(port))
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "hostA")
+        monkeypatch.setenv("HOROVOD_KV_RETRIES", "1")
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        return ElasticWorkerContext
+
+    def test_no_state_dir_means_head_203_path(self, monkeypatch):
+        """A/B arm: with HOROVOD_DRIVER_STATE_DIR unset the orphan loop
+        is disabled outright — the driver-loss deadline fires exactly as
+        at HEAD, with zero rejoin probes."""
+        from horovod_tpu.runner.network import free_port
+
+        cls = self._ctx(monkeypatch, free_port(),
+                        HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT="0.4")
+        lost = []
+        ctx = cls(on_driver_lost=lost.append)
+        assert ctx.rejoin_timeout() == 0.0
+        ctx.start_polling(interval=0.05)
+        deadline = time.time() + 20
+        while time.time() < deadline and not lost:
+            time.sleep(0.05)
+        ctx.stop_polling()
+        assert lost and lost[0] >= 0.4
+
+    def test_orphan_waits_past_lost_deadline_then_exits(
+            self, monkeypatch, tmp_path):
+        """Armed but no successor ever appears: the worker waits the
+        loss deadline PLUS the rejoin budget, then gives up."""
+        from horovod_tpu.runner.network import free_port
+
+        cls = self._ctx(monkeypatch, free_port(),
+                        HOROVOD_DRIVER_STATE_DIR=str(tmp_path),
+                        HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT="0.4",
+                        HOROVOD_DRIVER_REJOIN_TIMEOUT="1.0",
+                        HOROVOD_DRIVER_REJOIN_PROBE_INTERVAL="0.1")
+        lost = []
+        t0 = time.monotonic()
+        ctx = cls(on_driver_lost=lambda s: lost.append(
+            (s, time.monotonic() - t0)))
+        assert ctx.rejoin_timeout() == 1.0
+        ctx.start_polling(interval=0.05)
+        deadline = time.time() + 30
+        while time.time() < deadline and not lost:
+            time.sleep(0.05)
+        ctx.stop_polling()
+        assert lost, "orphan never gave up"
+        silent_s, wall = lost[0]
+        assert silent_s >= 1.4, lost  # lost deadline + rejoin budget
+
+    def test_orphan_rejoins_successor_driver(self, monkeypatch, tmp_path):
+        """The takeover path end to end at the worker layer: driver #1
+        dies; driver #2 (higher epoch) seeds at the old generation,
+        writes the endpoint record, publishes g+1 — the orphan repoints,
+        adopts the epoch, arms the hosts-updated notification, and its
+        heartbeats land on the NEW server."""
+        from horovod_tpu.elastic.runner import notification_manager
+
+        s1 = RendezvousServer()
+        s1.seed(driver_epoch=1)
+        s1.start()
+        s1.publish_epoch("world", {"hostA": b"{}"})
+        cls = self._ctx(monkeypatch, s1.port,
+                        HOROVOD_DRIVER_STATE_DIR=str(tmp_path),
+                        HOROVOD_DRIVER_EPOCH="1",
+                        HOROVOD_WORLD_VERSION="1",
+                        HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT="1.0",
+                        HOROVOD_DRIVER_REJOIN_TIMEOUT="60",
+                        HOROVOD_DRIVER_REJOIN_PROBE_INTERVAL="0.1")
+        ctx = cls()
+        notification_manager.clear()
+        ctx.start_polling(interval=0.05)
+        ctx.start_heartbeat(interval=0.2)
+        try:
+            s1.stop()  # driver #1 dies
+            s2 = RendezvousServer()
+            s2.seed(generation=1, driver_epoch=2)
+            s2.start()
+            store = driver_state.DriverStateStore(str(tmp_path), epoch=2)
+            store.publish_endpoint("127.0.0.1", s2.port, 1)
+            deadline = time.time() + 30
+            while time.time() < deadline and ctx.driver_epoch != 2:
+                time.sleep(0.05)
+            assert ctx.driver_epoch == 2, "never repointed"
+            assert os.environ["HOROVOD_RENDEZVOUS_PORT"] == str(s2.port)
+            s2.publish_epoch("world", {"hostA": b'{"process_id": 0}'})
+            deadline = time.time() + 20
+            while (time.time() < deadline
+                   and not notification_manager._pending):
+                time.sleep(0.05)
+            assert notification_manager._pending, "g+1 bump never armed"
+            deadline = time.time() + 20
+            while (time.time() < deadline
+                   and s2.heartbeat_age("hostA") is None):
+                time.sleep(0.05)
+            assert s2.heartbeat_age("hostA") is not None
+        finally:
+            ctx.stop_polling()
+            notification_manager.clear()
+            try:
+                s2.stop()
+            except Exception:
+                pass
+
+    def test_stale_endpoint_record_is_ignored(self, monkeypatch,
+                                              tmp_path):
+        """The dead driver's OWN record (epoch <= the worker's) must
+        never be followed — only a strictly higher epoch is a
+        successor."""
+        from horovod_tpu.runner.network import free_port
+
+        cls = self._ctx(monkeypatch, free_port(),
+                        HOROVOD_DRIVER_STATE_DIR=str(tmp_path),
+                        HOROVOD_DRIVER_EPOCH="2")
+        ctx = cls()
+        store = driver_state.DriverStateStore(str(tmp_path), epoch=2)
+        store.publish_endpoint("127.0.0.1", 1, 1)
+        ctx._next_rejoin_probe = 0.0
+        assert ctx._try_rejoin() is False
+        assert ctx.driver_epoch == 2
+
+
+# -- end-to-end: SIGKILL the driver mid-training ------------------------------
+
+# Workers redirect their own stdout/stderr to per-host files at startup:
+# their launcher-provided pipe dies WITH the driver, and a worker that
+# prints into a readerless pipe would take EPIPE — the exact coupling a
+# control-plane crash must not have.
+_E2E_WORKER = '''
+import os, sys
+sys.path.insert(0, {repo_root!r})
+host = os.environ["HOROVOD_HOSTNAME"]
+tmp = os.environ["TEST_TMP"]
+_fd = os.open(os.path.join(tmp, "worker-%s.log" % host),
+              os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+os.dup2(_fd, 1)
+os.dup2(_fd, 2)
+sys.stdout = os.fdopen(1, "w", buffering=1)
+sys.stderr = os.fdopen(2, "w", buffering=1)
+print("pid=%d host=%s" % (os.getpid(), host), flush=True)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HOROVOD_EVENT_LOG"] = os.path.join(
+    tmp, "events-%s.jsonl" % host)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from horovod_tpu._jax_compat import force_cpu_devices
+force_cpu_devices(1)
+import time
+import numpy as np
+import optax
+import horovod_tpu as hvd
+from horovod_tpu import abort, process_world
+from horovod_tpu.elastic import PeerShardedState, run as elastic_run
+from horovod_tpu.optimizer import ReduceSpec, init_sharded_state
+
+LR, MU, EPOCHS = 0.05, 0.9, 6
+W0 = np.linspace(0.5, -0.5, 8).astype(np.float32)
+
+
+def local_grad(w, e, r):
+    rng = np.random.RandomState(1000 + 10 * e + r)
+    A = rng.randn(16, 8).astype(np.float32)
+    return ((A.T @ (A @ w)) / 16.0).astype(np.float32)
+
+
+spec = ReduceSpec(
+    inner=optax.sgd(LR, momentum=MU), op="average", compression=None,
+    prescale_factor=1.0, postscale_factor=1.0, process_set=None,
+    num_groups=0, fusion_threshold_bytes=None, backward_passes_per_step=1,
+    sync_mode="sharded")
+n0 = process_world.size()
+params = {{"w": W0.copy()}}
+state = PeerShardedState(
+    params=params, opt_state=init_sharded_state(spec, params, world_size=n0),
+    sharded_optimizer=spec, epoch=0)
+
+
+def durable_restore():
+    # Registered ONLY to prove it never runs: the takeover recovery must
+    # land on the peer rung with zero durable reads.
+    print("DURABLE_RESTORE_USED", flush=True)
+    raise RuntimeError("durable restore must not run in this scenario")
+
+
+state.register_durable_restore(durable_restore)
+
+
+@elastic_run
+def train(state):
+    from horovod_tpu.parallel.hierarchical import _default_native_world
+
+    while state.epoch < EPOCHS:
+        e = state.epoch
+        if e >= 3:
+            # Gate: epochs 3+ run only AFTER the takeover driver has
+            # re-formed the world at g+1 (the test SIGKILLs driver #1
+            # once both ranks committed epoch 2). The abort poll is what
+            # breaks the wait: the successor posts abort/<g> before
+            # publishing g+1, driving this worker into the recovery
+            # ladder — deterministically, at a commit-consistent point.
+            deadline = time.time() + 180
+            while int(os.environ.get("HOROVOD_WORLD_VERSION", "0")) < 2:
+                abort.raise_if_aborted()
+                if time.time() > deadline:
+                    print("GATE TIMED OUT", flush=True)
+                    os._exit(9)
+                time.sleep(0.05)
+        r, n = process_world.rank(), process_world.size()
+        w = np.asarray(state.params["w"])
+        g = local_grad(w, e, r)
+        if n > 1:
+            world = _default_native_world()
+            g = np.asarray(world.allreduce(g, name="grad.%d" % e,
+                                           op="average"),
+                           dtype=np.float32)
+        tdef = jax.tree.structure(state.opt_state)
+        trace = np.asarray(jax.tree.leaves(state.opt_state)[0])
+        n_axis, s = trace.shape
+        g_rows = np.pad(g, (0, n_axis * s - g.size)).reshape(n_axis, s)
+        trace = (MU * trace + g_rows).astype(np.float32)
+        w = (w - LR * trace.reshape(-1)[: w.size]).astype(np.float32)
+        state.opt_state = jax.tree.unflatten(tdef, [trace])
+        state.params = {{"w": w}}
+        print("rank=%d epoch=%d np=%d gen=%s w0=%.6f" % (
+            r, e, n, os.environ.get("HOROVOD_WORLD_VERSION", "?"),
+            float(w[0])), flush=True)
+        state.epoch = e + 1
+        state.commit()
+    return state.epoch
+
+
+done = train(state)
+print("host=%s finished at epoch %d" % (host, done), flush=True)
+'''
+
+_DRIVER_RUNNER = '''
+import os, sys
+sys.path.insert(0, {repo_root!r})
+os.environ["HOROVOD_EVENT_LOG"] = os.path.join(
+    os.environ["TEST_TMP"], "events-driver.jsonl")
+from horovod_tpu.runner.elastic.driver import run_elastic
+from horovod_tpu.runner.launch import Settings
+
+settings = Settings(
+    num_proc=2, hosts=[],
+    command=[sys.executable, os.environ["TEST_WORKER"]],
+    cpu_mode=True, elastic=True, min_np=2, max_np=2,
+    discovery_script=os.environ["TEST_DISCOVER"],
+    elastic_timeout=120.0, env={{}})
+print("DRIVER PID=%d" % os.getpid(), flush=True)
+sys.exit(run_elastic(settings, sink=lambda s: print(s, flush=True)))
+'''
+
+
+def _expected_trajectory():
+    """The uninterrupted run: all 6 epochs on the 2-rank averaged
+    gradient (both workers survive the driver crash). Any loss of the
+    momentum state across the takeover diverges from this immediately."""
+    lr, mu = 0.05, 0.9
+
+    def local_grad(w, e, r):
+        rng = np.random.RandomState(1000 + 10 * e + r)
+        A = rng.randn(16, 8).astype(np.float32)
+        return ((A.T @ (A @ w)) / 16.0).astype(np.float32)
+
+    w = np.linspace(0.5, -0.5, 8).astype(np.float32)
+    m = np.zeros(8, np.float32)
+    out = {}
+    for e in range(6):
+        g = ((local_grad(w, e, 0) + local_grad(w, e, 1)) / 2.0
+             ).astype(np.float32)
+        m = (mu * m + g).astype(np.float32)
+        w = (w - lr * m).astype(np.float32)
+        out[e] = w.copy()
+    return out
+
+
+def _write_cluster(tmp_path):
+    import stat
+
+    worker = tmp_path / "failover_worker.py"
+    worker.write_text(_E2E_WORKER.format(repo_root=REPO_ROOT))
+    runner = tmp_path / "driver_runner.py"
+    runner.write_text(_DRIVER_RUNNER.format(repo_root=REPO_ROOT))
+    hosts_file = tmp_path / "hosts.txt"
+    hosts_file.write_text("localhost\n127.0.0.1\n")
+    discover = tmp_path / "discover.sh"
+    discover.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+    discover.chmod(discover.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env.update({
+        "TEST_TMP": str(tmp_path),
+        "TEST_WORKER": str(worker),
+        "TEST_DISCOVER": str(discover),
+        "HOROVOD_DRIVER_STATE_DIR": str(tmp_path / "driver-state"),
+        "HOROVOD_DRIVER_STATE_REFRESH": "0.5",
+        "HOROVOD_DRIVER_REJOIN_TIMEOUT": "120",
+        "HOROVOD_DRIVER_REJOIN_PROBE_INTERVAL": "0.2",
+        "HOROVOD_ELASTIC_DRIVER_LOST_TIMEOUT": "2.0",
+        "HOROVOD_KV_RETRIES": "1",
+        "HOROVOD_RECOVERY_BACKOFF_MAX": "0.2",
+        "HOROVOD_ABORT_POLL_INTERVAL": "0.2",
+        "JAX_PLATFORMS": "cpu",
+    })
+    return runner, env
+
+
+def _spawn_driver(runner, env):
+    return subprocess.Popen(
+        [sys.executable, str(runner)], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        start_new_session=True)
+
+
+def _wait_for_epoch(tmp_path, epoch, hosts=("localhost", "127.0.0.1"),
+                    timeout=240):
+    deadline = time.time() + timeout
+    needle = re.compile(rf"epoch={epoch} ")
+    while time.time() < deadline:
+        if all(
+            (tmp_path / f"worker-{h}.log").exists()
+            and needle.search((tmp_path / f"worker-{h}.log").read_text())
+            for h in hosts
+        ):
+            return
+        time.sleep(0.2)
+    logs = {h: (tmp_path / f"worker-{h}.log").read_text()
+            if (tmp_path / f"worker-{h}.log").exists() else "<missing>"
+            for h in hosts}
+    raise AssertionError(f"epoch {epoch} never reached: {logs}")
+
+
+class TestDriverFailoverE2E:
+    @pytest.mark.slow
+    def test_sigkill_driver_workers_rejoin_at_g_plus_1_on_peer_rung(
+            self, tmp_path):
+        """The acceptance e2e: SIGKILL the driver once both workers have
+        committed epoch 2; a supervisor relaunch takes over from the
+        snapshot; both workers rejoin at generation g+1 WITHOUT a
+        process restart; recovery lands on the peer rung with zero
+        durable reads; and the weight trajectory matches the
+        uninterrupted 2-rank run step for step."""
+        runner, env = _write_cluster(tmp_path)
+        d1 = _spawn_driver(runner, env)
+        d2 = None
+        try:
+            _wait_for_epoch(tmp_path, 2)
+            # Let the epoch-2 commits' replica PUTs + neighbor pulls
+            # settle so both ranks hold a complete in-memory set.
+            time.sleep(1.5)
+            faults.kill_driver(d1.pid)
+            d1.communicate(timeout=30)
+            assert d1.returncode == -signal.SIGKILL
+            # The supervisor relaunch.
+            d2 = _spawn_driver(runner, env)
+            out2, _ = d2.communicate(timeout=420)
+            assert d2.returncode == 0, out2
+        finally:
+            for proc in (d1, d2):
+                if proc is not None and proc.poll() is None:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+
+        logs = {h: (tmp_path / f"worker-{h}.log").read_text()
+                for h in ("localhost", "127.0.0.1")}
+        expected = _expected_trajectory()
+        pids_by_host = {}
+        for host, text in logs.items():
+            assert "finished at epoch 6" in text, (host, text)
+            assert "DURABLE_RESTORE_USED" not in text, (host, text)
+            assert "GATE TIMED OUT" not in text, (host, text)
+            pids = re.findall(r"^pid=(\d+) ", text, re.M)
+            pids_by_host[host] = pids
+            # No process restart: one worker process per host, ever.
+            assert len(set(pids)) == 1, (host, pids)
+            seen = {}
+            for match in re.finditer(
+                    r"rank=(\d+) epoch=(\d+) np=(\d+) gen=(\d+) "
+                    r"w0=(-?[0-9.]+)", text):
+                e, np_, gen = (int(match.group(2)), int(match.group(3)),
+                               int(match.group(4)))
+                w0 = float(match.group(5))
+                seen.setdefault(e, []).append((np_, gen, w0))
+            for e in range(6):
+                assert e in seen, (host, e, sorted(seen))
+                for np_, gen, w0 in seen[e]:
+                    # Both workers survive: np=2 for EVERY epoch, and
+                    # the trajectory is the uninterrupted one.
+                    assert np_ == 2, (host, e, np_)
+                    assert abs(w0 - float(expected[e][0])) < 2e-4, (
+                        host, e, w0, float(expected[e][0]))
+            # Generation fence: pre-crash epochs at g, post-takeover at
+            # g+1 (epoch 2 may legitimately replay at either side).
+            pre = {gen for _, gen, _ in seen[0]}
+            post = {gen for _, gen, _ in seen[5]}
+            assert max(post) == max(pre) + 1, (host, pre, post)
+
+        # The survivors' journals tell the peer-rung story: the ladder
+        # touched 'peer', never 'durable', with no fall-through.
+        for host in ("localhost", "127.0.0.1"):
+            events = [json.loads(l) for l in (
+                tmp_path / f"events-{host}.jsonl").read_text().splitlines()]
+            rungs = [e["rung"] for e in events if e["event"] == "recovery"]
+            assert "peer" in rungs, (host, rungs)
+            assert "durable" not in rungs, (host, rungs)
+            assert any(e["event"] == "peer_restore" for e in events), host
+            assert not any(e["event"] == "peer_fallback" for e in events)
+            assert any(e["event"] == "driver_rejoin"
+                       and e.get("driver_epoch") == 2
+                       for e in events), host
+
+        # The driver journal: a takeover at epoch 2 adopting both hosts.
+        devents = [json.loads(l) for l in (
+            tmp_path / "events-driver.jsonl").read_text().splitlines()]
+        takeovers = [e for e in devents if e["event"] == "driver_takeover"]
+        assert takeovers, devents
+        assert sorted(takeovers[-1]["adopted"]) == ["127.0.0.1",
+                                                    "localhost"]
+        assert takeovers[-1]["driver_epoch"] == 2
+        starts = [e for e in devents if e["event"] == "driver_start"]
+        assert any(e.get("takeover") for e in starts)
+        assert any(e["event"] == "job_complete" for e in devents)
+
+    @pytest.mark.slow
+    def test_sigstopped_stale_driver_stands_down_superseded(
+            self, tmp_path):
+        """Split-brain: driver #1 is SIGSTOP'd (not dead) through a
+        takeover; when resumed it must discover the higher-epoch
+        snapshot on its next refresh and exit EXIT_DRIVER_SUPERSEDED
+        WITHOUT terminating the workers the successor adopted — and the
+        job must still complete under driver #2."""
+        runner, env = _write_cluster(tmp_path)
+        d1 = _spawn_driver(runner, env)
+        d2 = None
+        try:
+            _wait_for_epoch(tmp_path, 2)
+            time.sleep(1.5)
+            os.kill(d1.pid, signal.SIGSTOP)  # hung, not crashed
+            d2 = _spawn_driver(runner, env)
+            # Wait until the successor owns the state dir (epoch 2 on
+            # disk) before resuming the zombie.
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                rec = driver_state.read_endpoint(
+                    str(tmp_path / "driver-state"))
+                if rec is not None and rec["driver_epoch"] >= 2:
+                    break
+                time.sleep(0.2)
+            else:
+                raise AssertionError("successor never published")
+            os.kill(d1.pid, signal.SIGCONT)
+            out1, _ = d1.communicate(timeout=120)
+            assert d1.returncode == EXIT_DRIVER_SUPERSEDED, (
+                d1.returncode, out1)
+            # Standing down touched nothing: the job completes under
+            # driver #2 with the same continuity contract as above.
+            out2, _ = d2.communicate(timeout=420)
+            assert d2.returncode == 0, out2
+        finally:
+            for proc in (d1, d2):
+                if proc is not None and proc.poll() is None:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        for host in ("localhost", "127.0.0.1"):
+            text = (tmp_path / f"worker-{host}.log").read_text()
+            assert "finished at epoch 6" in text, (host, text)
+            pids = re.findall(r"^pid=(\d+) ", text, re.M)
+            assert len(set(pids)) == 1, (host, pids)
+        devents = [json.loads(l) for l in (
+            tmp_path / "events-driver.jsonl").read_text().splitlines()]
+        assert any(e["event"] == "driver_superseded" for e in devents)

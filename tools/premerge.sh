@@ -56,10 +56,17 @@ echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # loss continuity, exactly one policy_decision record whose realized
 # goodput beats the no-action counterfactual, and an A/B arm proving
 # the plane is inert with HOROVOD_TARGET_GOODPUT unset.
-if ! timeout -k 10 1200 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
+# test_driver_failover.py is the control-plane fault-tolerance proof:
+# SIGKILL the driver mid-training -> supervisor relaunch takes over from
+# the durable snapshot, both workers rejoin at generation g+1 WITHOUT a
+# process restart, recovery lands on the peer rung (zero durable
+# reads), loss continuity is exact; the SIGSTOP'd stale-driver variant
+# stands down EXIT_DRIVER_SUPERSEDED with its writes 409-fenced; torn
+# snapshot writes (SIGKILL mid-save) restore the previous epoch.
+if ! timeout -k 10 1500 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
     tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py \
-    tests/test_policy.py -q \
+    tests/test_policy.py tests/test_driver_failover.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -230,6 +237,8 @@ try:
         "hvd_fsdp_prefetch_overlap_ratio",
         "hvd_policy_decisions_total",
         "hvd_policy_spare_hosts",
+        "hvd_driver_epoch",
+        "hvd_driver_lost_total",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
