@@ -32,6 +32,15 @@ numbers:
   ``hvd_param_gather_seconds``) and the derived
   ``fsdp_prefetch_overlap_ratio``.
 
+Communication health: the ``comms`` record (section 6, --smoke
+included) microprobes the interconnect, fits the online α–β link cost
+model (``horovod_tpu/comms_model.py``), reports fitted alpha/beta + bus
+bandwidth per (op, algorithm, link_class) and the efficiency ratio,
+checks the fit predicts observed per-bucket latency for all three
+sync-mode wires within ``HOROVOD_COMMS_FIT_TOLERANCE``, and A/B-tests
+model-guided autotune pruning against the exhaustive sweep — so the
+perf trajectory tracks communication health, not just throughput.
+
 Step-time breakdown: ``phase_span_medians_ms`` carries derived
 forward/backward/collective/optimizer_update medians (phase-probe
 programs differenced against the headline step — see section 4d), so
@@ -778,6 +787,18 @@ def main() -> int:
                 samples.append(dt)
                 try:
                     hvd.metrics.PARAM_GATHER_SECONDS.observe(dt)
+                    # Per-algorithm attribution for the comms model:
+                    # the probe IS the fsdp gather half, end to end —
+                    # total gathered bytes at this measured latency,
+                    # classed like any world-set collective would be.
+                    from horovod_tpu.ops.collective_ops import \
+                        _link_class_of
+                    from horovod_tpu.process_sets import \
+                        global_process_set
+                    hvd.comms_model.observe(
+                        "allgather", "fsdp",
+                        _link_class_of(global_process_set),
+                        _tree_bytes(params), dt)
                 except Exception:  # noqa: BLE001 — observability only
                     pass
             samples.sort()
@@ -950,6 +971,144 @@ def main() -> int:
             emit.update(
                 vs_baseline_machinery_int8=round(raw[0] / int8[0], 4))
 
+    # --- section 6: comms observatory lane — microprobe the interconnect,
+    # fit the online alpha-beta cost model, report fitted alpha/beta + bus
+    # bandwidth per (op, algorithm, link_class) and the live efficiency
+    # ratio, check the fit predicts the observed per-bucket latencies for
+    # all three sync-mode wires within a documented tolerance, and A/B the
+    # model-guided autotune pruning against the exhaustive sweep (the
+    # pruned grid must pin the SAME winner from the same measurements
+    # while dropping at least one dominated candidate). Runs in --smoke:
+    # the premerge gates assert this record. See docs/observability.md
+    # ("Communication cost model").
+    def run_comms():
+        import statistics as _stats
+
+        from horovod_tpu import comms_model as cm
+        from horovod_tpu.basics import _state as _hvd_state
+
+        # No reset: the flat fits come only from this lane's microprobe
+        # anyway (earlier sections are compiled), and the fsdp gather
+        # probe's (allgather|fsdp) attribution from section 4c2 should
+        # survive into the payload/snapshot.
+        model_ = cm.get_model()
+        topo = _hvd_state.topology
+        link = (topo.set_link_class(list(range(n)))
+                if topo is not None else "ici")
+        probe_sizes = (4096, 65536, 1 << 20)
+        probes = hvd.run_comms_microprobe(
+            sizes=probe_sizes, repeats=2 if smoke else 3)
+        observed = {
+            op: {nb: _stats.median(samples)
+                 for nb, samples in per_op.items()}
+            for op, per_op in probes.items()
+        }
+        # Fit-quality check: for each sync mode's wire, the fitted model
+        # must predict the observed per-bucket (= per-probe-payload)
+        # latency within HOROVOD_COMMS_FIT_TOLERANCE relative error
+        # (default 1.0 — a factor-2 band, generous because CPU-smoke
+        # medians of 2 are noisy; TPU runs can tighten it).
+        tolerance = float(os.environ.get(
+            "HOROVOD_COMMS_FIT_TOLERANCE", "1.0"))
+        # One wire table: the same per-mode collective halves the
+        # autotune predictor prices (a private copy here could silently
+        # drift from what predict_flush_cost actually uses).
+        per_mode_residual = {}
+        for mode, wire in cm._MODE_WIRE.items():
+            worst = 0.0
+            for nbytes in set().union(*[observed[op].keys()
+                                        for op, _ in wire]):
+                pred = 0.0
+                obs = 0.0
+                ok = True
+                for op, algo in wire:
+                    p = model_.predict(op, algo, link, nbytes)
+                    o = observed[op].get(nbytes)
+                    if p is None or o is None:
+                        ok = False
+                        break
+                    pred += p
+                    obs += o
+                if ok and obs > 0:
+                    worst = max(worst, abs(pred - obs) / obs)
+            per_mode_residual[mode] = round(worst, 4)
+        within = all(v <= tolerance for v in per_mode_residual.values())
+
+        # Model-guided autotune A/B on the plane the model prices (the
+        # host-observable collective latencies the fit came from):
+        # measure the FULL candidate grid once — one eager dispatch per
+        # fusion bucket the candidate's (threshold, segments) layout
+        # would emit over a synthetic 24-leaf gradient wire — then
+        # compare the exhaustive winner (argmin over all measurements)
+        # with the model-guided winner (argmin over the KEPT candidates,
+        # same measurements). Pruning must drop >=1 dominated point and
+        # keep the measured winner — the A/B the premerge gate asserts.
+        # The verdict is computed BEFORE the sweep, from the microprobe
+        # fit alone, exactly as AutotuneStep prunes before sampling.
+        import numpy as np
+
+        leaf_sizes = [(256 * 1024, "float32")] * 24  # 6 MiB wire
+        cands = [(64 * 1024, 1), (1 << 20, 1), (16 << 20, 1),
+                 (16 << 20, 2)]
+        verdict = cm.prune_candidates(cands, leaf_sizes, link)
+
+        def flush_buckets(threshold, segments):
+            return [b for run in cm.segment_byte_runs(leaf_sizes,
+                                                      segments)
+                    for b in cm.bucket_byte_sizes(run, threshold)]
+
+        def measure_flush(threshold, segments, repeats=2):
+            samples = []
+            arrays = [
+                np.ones((n, max(1, b // 4 // n)), np.float32)
+                for b in flush_buckets(threshold, segments)]
+            for a in arrays:  # warm each signature's executable
+                hvd.allreduce(a, op=hvd.Sum)
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for a in arrays:
+                    hvd.allreduce(a, op=hvd.Sum)
+                samples.append(time.perf_counter() - t0)
+            return _stats.median(samples)
+
+        measured = [measure_flush(t, s) for t, s in cands]
+        winner_ex = cands[int(np.argmin(measured))]
+        kept = verdict["kept"]
+        kept_times = [(t, c) for c, t in zip(cands, measured)
+                      if c in kept]
+        winner_guided = min(kept_times)[1] if kept_times else winner_ex
+
+        fits = {k: {kk: d.get(kk) for kk in (
+                    "alpha_s", "beta_s_per_byte",
+                    "bandwidth_bytes_per_second", "samples", "r2")}
+                for k, d in model_.payload()["fits"].items()}
+        eff = model_.efficiency()
+        return {
+            "link_class": link,
+            "fits": fits,
+            "efficiency_ratio": (round(eff, 4)
+                                 if eff is not None else None),
+            "residual_s": round(model_.residual_s(), 6),
+            "fit_tolerance": tolerance,
+            "per_mode_rel_residual": per_mode_residual,
+            "within_tolerance": within,
+            "autotune_grid": cands,
+            "autotune_measured_s": [round(t, 6) for t in measured],
+            "autotune_predicted_s": [
+                round(c, 6) if c is not None else None
+                for c in verdict["costs"]],
+            "autotune_pruned": len(verdict["pruned"]),
+            "autotune_pruned_candidates": verdict["pruned"],
+            "autotune_winner_exhaustive": winner_ex,
+            "autotune_winner_guided": winner_guided,
+        }
+
+    if not out_of_time():
+        comms = _with_retry("comms", run_comms, errors,
+                            allow_retry=single_controller)
+        if comms is not None:
+            emit.update(comms=comms)
+
     if errors:
         emit.record["errors"] = errors
     # One cache/dispatch snapshot per run: how many eager dispatches ran
@@ -1000,6 +1159,24 @@ def main() -> int:
                   file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — observability only
             print(f"# bench: trace snapshot failed: {exc}",
+                  file=sys.stderr)
+    # HOROVOD_COMMS_SNAPSHOT=/path: dump this run's comms-model payload
+    # (the same wire format a worker piggybacks on heartbeats) so the
+    # premerge gate can publish it to a live KV server as two ranks and
+    # fetch the cluster-merged GET /comms back over HTTP.
+    comms_path = os.environ.get("HOROVOD_COMMS_SNAPSHOT", "")
+    if comms_path:
+        try:
+            import json as _json
+
+            from horovod_tpu import comms_model as _comms_model
+
+            with open(comms_path, "w") as f:
+                _json.dump(_comms_model.get_model().payload(), f)
+            print(f"# bench: comms snapshot written to {comms_path}",
+                  file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — observability only
+            print(f"# bench: comms snapshot failed: {exc}",
                   file=sys.stderr)
     emit.update(bench_wall_time_s=round(time.perf_counter() - t_start, 1))
     return 0 if dist is not None else 1

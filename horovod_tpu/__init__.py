@@ -90,7 +90,7 @@ from .optimizer import (  # noqa: F401
     sharded_step_update,
     unshard_opt_state,
 )
-from .ops.collective_ops import cache_stats  # noqa: F401
+from .ops.collective_ops import cache_stats, run_comms_microprobe  # noqa: F401
 from .functions import (  # noqa: F401
     allgather_object,
     broadcast_object,
@@ -102,6 +102,7 @@ from .functions import (  # noqa: F401
 )
 from . import abort  # noqa: F401
 from . import autotune  # noqa: F401
+from . import comms_model  # noqa: F401
 from . import faults  # noqa: F401
 from . import metrics  # noqa: F401
 from . import peercheck  # noqa: F401

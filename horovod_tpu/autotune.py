@@ -83,7 +83,21 @@ class BayesianTuner:
 # step per candidate threshold, times a few steps, and pins the winner.
 
 _tuned: dict = {"threshold": None, "segments": None, "sync_mode": None,
-                "aborted": False, "history": []}
+                "aborted": False, "history": [], "pruned": []}
+
+
+def model_guided_enabled() -> bool:
+    """Model-guided autotune mode (``HOROVOD_AUTOTUNE_MODEL_GUIDED=1``):
+    the warmup tuner prices every grid candidate with the communication
+    observatory's fitted α–β model (``comms_model.predict_flush_cost``)
+    and prunes dominated grid points before sweeping them — the joint
+    grid goes from exhaustive to guided. Off by default (the exhaustive
+    sweep is the reference contract), and inert even when armed until
+    the model has fitted samples AND a traced flush has noted its leaf
+    layout — a cold process sweeps the full grid exactly as before."""
+    from .utils.env import get_bool
+
+    return get_bool("HOROVOD_AUTOTUNE_MODEL_GUIDED", False)
 
 
 def _record_trial(tunable: str, seconds: float) -> None:
@@ -180,6 +194,7 @@ def autotune_state() -> dict:
         "sync_mode": _tuned["sync_mode"],
         "samples": len(_tuned["history"]),
         "history": list(_tuned["history"]),
+        "pruned": list(_tuned["pruned"]),
     }
 
 
@@ -213,6 +228,19 @@ class AutotuneStep:
     overlap scheduler's factory supplies them) the warmup grid is the
     joint (fusion threshold, segment count K) product — the two knobs
     trade against each other, so they are sampled and pinned together.
+
+    **Model-guided pruning** (``HOROVOD_AUTOTUNE_MODEL_GUIDED=1``, off
+    by default): after the first sampling window — whose trace notes the flush's
+    leaf layout on the communication observatory — every remaining grid
+    candidate is priced with the fitted α–β cost model
+    (``comms_model.predict_flush_cost``: segment, bucket, and price each
+    collective half per the candidate's threshold/segments/sync_mode),
+    and candidates whose predicted cost exceeds the best prediction by
+    more than ``HOROVOD_AUTOTUNE_PRUNE_MARGIN`` are dropped before they
+    cost a sampling window each. The kept list is rank 0's, broadcast
+    through the same exchange the winner rides, so the per-window traced
+    collective sequence stays rank-identical by construction; a cold
+    model (no samples, no noted layout) leaves the grid untouched.
 
     Window timing ends in ONE value fetch of the smallest output leaf —
     ``block_until_ready`` can return early on tunneled backends; a value
@@ -252,6 +280,7 @@ class AutotuneStep:
         else:
             self._cands = list(thresholds or DEFAULT_THRESHOLDS)
         self._poisoned = False
+        self._prune_checked = False
         self._iters = max(1, int(iters))
         self._win = 1 + self._iters  # 1 compile/settle call + timed calls
         self._calls = 0
@@ -280,6 +309,92 @@ class AutotuneStep:
             return
         probe = min(leaves, key=lambda l: l.size)
         np.asarray(probe)  # value fetch: proves execution finished
+
+    def _broadcast_decision(self, decision):
+        """Rank 0's value, everywhere (the same exchange :meth:`_finish`
+        pins the winner with — single-process worlds pass through)."""
+        from .process_world import size as _psize
+
+        if _psize() > 1:
+            from .process_world import broadcast_object_host
+
+            return broadcast_object_host(
+                decision, name="autotune/model-guided-prune")
+        import jax
+
+        if jax.process_count() > 1:
+            from .functions import broadcast_object
+
+            return broadcast_object(
+                decision, name="autotune/model-guided-prune")
+        return decision
+
+    def _maybe_prune(self) -> None:
+        """Model-guided grid pruning, run ONCE after the first window.
+
+        The first window's trace noted the flush's leaf layout on the
+        communication observatory (``ops/fusion``), so from here every
+        remaining candidate's wire can be priced with the fitted α–β
+        model and dominated grid points dropped before they cost a
+        sampling window each. Rank-identical by construction: every
+        rank computes its verdict from its local model, then adopts
+        RANK 0's kept list through the same broadcast the final winner
+        rides — so the candidate schedule (which fixes the traced
+        collective sequence per window) can never diverge across ranks.
+        The already-sampled first candidate is always kept; any failure
+        leaves the full grid intact."""
+        if self._prune_checked:
+            return
+        self._prune_checked = True
+        if not model_guided_enabled():
+            return
+        # LOCAL pricing may fail safe (kept_idx=None = no pruning): rank
+        # 0's verdict is what everyone adopts, so a rank-local pricing
+        # failure cannot diverge the schedule. The BROADCAST must NOT be
+        # swallowed here: an asymmetric broadcast failure would leave
+        # ranks on different grids, so it propagates to __call__'s
+        # handler, which aborts rank-identically (_abort).
+        kept_idx = None
+        try:
+            from . import comms_model
+
+            model = comms_model.get_model()
+            leaf_sizes = model.leaf_sizes()
+            if model.ready() and leaf_sizes and len(self._cands) > 1:
+                from .ops.collective_ops import _link_class_of
+                from .process_sets import global_process_set
+
+                link_class = _link_class_of(global_process_set)
+                verdict = comms_model.prune_candidates(
+                    self._cands[1:], leaf_sizes, link_class)
+                # kept is an order-preserving subsequence of the tail:
+                # recover indices with a two-pointer walk (id()/set
+                # matching would misbehave on duplicate grid values).
+                kept_idx = []
+                ki = 0
+                kept_list = verdict["kept"]
+                for i, c in enumerate(self._cands[1:]):
+                    if ki < len(kept_list) and kept_list[ki] == c:
+                        kept_idx.append(i)
+                        ki += 1
+        except Exception as e:  # noqa: BLE001 — pricing is an optimization
+            get_logger().debug("autotune: model-guided pricing skipped: %s",
+                               e)
+            kept_idx = None
+        kept_idx = self._broadcast_decision(kept_idx)
+        if kept_idx is None:
+            return
+        tail = list(self._cands[1:])
+        pruned = [c for i, c in enumerate(tail) if i not in kept_idx]
+        if not pruned:
+            return
+        self._cands = [self._cands[0]] + [
+            tail[i] for i in kept_idx if 0 <= i < len(tail)]
+        _tuned["pruned"].extend(pruned)
+        get_logger().info(
+            "autotune: model-guided pruning dropped %d dominated "
+            "candidate(s) %s; sweeping %d of the original grid",
+            len(pruned), pruned, len(self._cands))
 
     def _pin(self, cand) -> None:
         """Pin one candidate process-wide: the threshold, plus jointly
@@ -423,6 +538,12 @@ class AutotuneStep:
                 dt = (self._clock() - self._t0) / self._iters
                 self._samples.append((self._cands[idx], dt))
                 _record_trial(self._axes_name(), dt)
+                if idx == 0:
+                    # The first window's trace has noted the flush's
+                    # leaf layout: prune dominated grid points before
+                    # they each cost a sampling window (model-guided
+                    # mode; no-op when the comms model is cold).
+                    self._maybe_prune()
                 if idx + 1 == len(self._cands):
                     self._finish()
             return out
@@ -449,7 +570,11 @@ def maybe_autotune_step(jitted, segment_candidates=None,
     (threshold, segments) grid; ``sync_mode_candidates`` adds the
     sync_mode axis (see :func:`tuned_sync_mode` for its layout caveat —
     the stock factories do not pass it; :func:`tune_step_sync_mode` is
-    the mode-agnostic harness).
+    the mode-agnostic harness). When the communication observatory has a
+    fitted α–β model, the grid is swept model-guided: dominated
+    candidates are pruned after the first window (rank-identically —
+    see :meth:`AutotuneStep._maybe_prune` and docs/observability.md's
+    "Communication cost model" section).
 
     At most ONE tuner is live per process: the threshold is
     process-global, so a second factory call before the first tuner

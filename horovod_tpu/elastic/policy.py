@@ -11,7 +11,10 @@ ElasticDriver` consults from its monitor loop. It
    (over ``HOROVOD_STRAGGLER_WINDOW`` seconds) of each host's straggler
    score (mean arrival lateness behind the earliest rank, offset-
    corrected, from :func:`horovod_tpu.tracing.compute_skew`) and,
-   optionally, heartbeat-age drift — never a single spike;
+   optionally, heartbeat-age drift and the comms model's
+   predicted-vs-observed residual (``HOROVOD_POLICY_COMMS_RESIDUAL`` —
+   a link going bad shows up as a residual before it shows up as skew;
+   see ``horovod_tpu/comms_model.py``) — never a single spike;
 2. gates every **voluntary** resize on the SLO knob
    ``HOROVOD_TARGET_GOODPUT``: a drain only fires when the measured loss
    fraction drags projected goodput below the target AND the predicted
@@ -101,6 +104,14 @@ class PolicyController:
         # seconds is straggler evidence too (a degrading host beats late
         # before it stops beating). 0 disables the channel.
         self.hb_drift_s = get_float("HOROVOD_POLICY_HB_DRIFT", 0.0)
+        # Comms-residual channel: a host whose collectives run this many
+        # seconds slower than its own fitted alpha-beta model predicts
+        # (hvd_comms_residual_seconds, shipped on heartbeats and merged
+        # by GET /comms) is straggler evidence too — a link going bad
+        # shows up as a residual before it shows up as cross-rank skew.
+        # 0 disables the channel.
+        self.comms_residual_s = get_float(
+            "HOROVOD_POLICY_COMMS_RESIDUAL", 0.0)
         self.interval_s = get_float("HOROVOD_POLICY_INTERVAL", 5.0)
         self.horizon_s = get_float("HOROVOD_POLICY_HORIZON", 600.0)
         self.realize_window_s = get_float(
@@ -115,6 +126,7 @@ class PolicyController:
         self._lock = threading.Lock()
         self._ewma: dict[str, float] = {}
         self._hb_ewma: dict[str, float] = {}
+        self._res_ewma: dict[str, float] = {}
         self._above_since: dict[str, float] = {}
         self._last_observe_t: float | None = None
         self._last_worst: dict | None = None
@@ -158,14 +170,19 @@ class PolicyController:
 
     def observe(self, skew: Mapping[str, Any],
                 hb_ages: Mapping[str, float],
-                world_hosts: Sequence[str]) -> None:
+                world_hosts: Sequence[str],
+                comms_residuals: Mapping[str, float] | None = None) -> None:
         """Fold one evidence snapshot into the per-host EWMAs.
 
         ``skew`` is :func:`tracing.compute_skew` output (the server's
         ``/stragglers`` body); ``hb_ages`` the server-clock heartbeat
-        ages. Hosts outside the current world are dropped from the EWMA
-        state (a departed host must not carry stale condemnation back in
-        through the spare tier)."""
+        ages; ``comms_residuals`` (optional) the per-host
+        predicted-vs-observed residual seconds from the cluster-merged
+        comms model (the server's ``/comms`` body ``"residuals"`` map) —
+        the third evidence channel, armed by
+        ``HOROVOD_POLICY_COMMS_RESIDUAL``. Hosts outside the current
+        world are dropped from the EWMA state (a departed host must not
+        carry stale condemnation back in through the spare tier)."""
         now = self._clock()
         world = set(world_hosts)
         # Per-host straggler score: mean lateness across the host's ranks
@@ -192,9 +209,11 @@ class PolicyController:
             alpha = max(min(dt / max(self.window_s, 1e-6), 1.0), 0.0)
             if scores:
                 self._last_worst = skew.get("worst")
-            for state in (self._ewma, self._hb_ewma, self._above_since):
+            for state in (self._ewma, self._hb_ewma, self._res_ewma,
+                          self._above_since):
                 for host in [h for h in state if h not in world]:
                     del state[host]
+            residuals = dict(comms_residuals or {})
             for host in world:
                 has_evidence = host in scores
                 if has_evidence:
@@ -207,13 +226,35 @@ class PolicyController:
                 age = float(hb_ages.get(host, 0.0) or 0.0)
                 hb_prev = self._hb_ewma.get(host, 0.0)
                 self._hb_ewma[host] = hb_prev + alpha * (age - hb_prev)
+                # Comms-residual channel: same blindness contract as the
+                # skew EWMA — a host whose model stopped shipping is
+                # FROZEN, not reset (the degrading host most likely to
+                # stop shipping must not self-pardon).
+                has_res = host in residuals
+                if has_res:
+                    try:
+                        res = float(residuals[host])
+                    except (TypeError, ValueError):
+                        res = float("nan")
+                    if not (res >= 0.0):  # malformed/NaN = blind:
+                        has_res = False   # frozen, never a fake 0.0
+                    else:
+                        res_prev = self._res_ewma.get(host, 0.0)
+                        self._res_ewma[host] = res_prev + alpha * (
+                            res - res_prev)
                 # Sustained-evidence clock: the drain threshold must hold
                 # CONTINUOUSLY for window_s — one spiky instance resets.
                 hb_condemned = (self.hb_drift_s > 0
                                 and self._hb_ewma[host] >= self.hb_drift_s)
-                if ewma >= self.drain_skew_s or hb_condemned:
+                res_condemned = (
+                    self.comms_residual_s > 0
+                    and self._res_ewma.get(host, 0.0)
+                    >= self.comms_residual_s)
+                if (ewma >= self.drain_skew_s or hb_condemned
+                        or res_condemned):
                     self._above_since.setdefault(host, now)
-                elif has_evidence or self.hb_drift_s > 0:
+                elif (has_evidence or self.hb_drift_s > 0
+                      or (self.comms_residual_s > 0 and has_res)):
                     self._above_since.pop(host, None)
                 try:
                     _metrics.POLICY_STRAGGLER_EWMA.set(ewma, host=host)
@@ -237,6 +278,8 @@ class PolicyController:
                 "ewma": {h: float(v) for h, v in self._ewma.items()},
                 "hb_ewma": {h: float(v)
                             for h, v in self._hb_ewma.items()},
+                "res_ewma": {h: float(v)
+                             for h, v in self._res_ewma.items()},
                 "above_ages": {h: max(now - t, 0.0)
                                for h, t in self._above_since.items()},
                 "resize_cost": self._resize_cost_ewma,
@@ -252,7 +295,8 @@ class PolicyController:
         now = self._clock()
         with self._lock:
             for key, target in (("ewma", self._ewma),
-                                ("hb_ewma", self._hb_ewma)):
+                                ("hb_ewma", self._hb_ewma),
+                                ("res_ewma", self._res_ewma)):
                 values = state.get(key)
                 if isinstance(values, Mapping):
                     for h, v in values.items():
@@ -314,10 +358,16 @@ class PolicyController:
                 if self.hb_drift_s > 0:
                     score = max(
                         score, self._hb_ewma.get(h, 0.0) - self.hb_drift_s)
+                if self.comms_residual_s > 0:
+                    # The residual IS seconds of per-collective lateness
+                    # the model cannot explain — directly comparable to
+                    # the skew score's lateness seconds.
+                    score = max(score, self._res_ewma.get(h, 0.0))
                 candidates.append((score, h))
             worst = dict(self._last_worst) if self._last_worst else None
             ewma_snapshot = dict(self._ewma)
             hb_snapshot = dict(self._hb_ewma)
+            res_snapshot = dict(self._res_ewma)
             above = {h: now - t for h, t in self._above_since.items()}
         if not candidates:
             return None
@@ -343,6 +393,8 @@ class PolicyController:
                                  for h, v in ewma_snapshot.items()},
             "hb_age_ewma_s": {h: round(v, 6)
                               for h, v in hb_snapshot.items()},
+            "comms_residual_ewma_s": {h: round(v, 6)
+                                      for h, v in res_snapshot.items()},
             "sustained_s": {h: round(v, 3) for h, v in above.items()},
             "window_s": self.window_s,
             "drain_skew_s": self.drain_skew_s,

@@ -27,6 +27,9 @@ kill workers by behavior flag). This module generalizes that into named
 - ``driver.takeover``    — the restarted driver's snapshot-load/adopt
   path (``raise`` fails the takeover so the supervisor retries;
   ``delay`` widens the orphan window)
+- ``comms.link``         — every comms-model observation of a measured
+  collective; ``delay`` inflates the observed latency (a deterministic
+  slow link, the injector the residual-gauge chaos tests ride)
 - ``kv.serve``           — every request the rendezvous KV server
   handles; firing (drop semantics) closes the connection without
   answering — to the client that is a transport failure, exactly a
@@ -97,6 +100,11 @@ SPARE_PROMOTE = "spare.promote"
 DRIVER_SNAPSHOT = "driver.snapshot"
 DRIVER_TAKEOVER = "driver.takeover"
 KV_SERVE = "kv.serve"
+# Every comms-model observation of a measured collective: ``delay``
+# inflates the observed latency (a deterministically degraded link —
+# the injector behind the hvd_comms_residual_seconds chaos tests);
+# ``drop`` loses the sample, never the op.
+COMMS_LINK = "comms.link"
 
 _MODES = ("drop", "delay", "raise", "hang")
 _DEFAULT_HANG_S = 3600.0

@@ -716,6 +716,33 @@ POLICY_STRAGGLER_EWMA = gauge(
     "EWMA (over HOROVOD_STRAGGLER_WINDOW) of each host's straggler "
     "score — the sustained-evidence signal the drain decision "
     "thresholds on.", ("host",))
+# Communication observatory (horovod_tpu/comms_model.py): the fitted
+# α–β link cost model exported as a live roofline. Bandwidth = 1/β per
+# (link class, op, algorithm); latency = α per link class; efficiency =
+# EWMA of (α–β-predicted / achieved) per dispatch; residual = EWMA of
+# seconds the achieved latency exceeds the prediction — the
+# link-degradation signal elastic/policy.py consumes as a second
+# straggler-evidence channel.
+LINK_BANDWIDTH = gauge(
+    "hvd_link_bandwidth_bytes_per_second",
+    "Fitted link bandwidth (1/beta of the online alpha-beta cost "
+    "model), by link class, collective op, and algorithm.",
+    ("link_class", "op", "algorithm"))
+LINK_LATENCY = gauge(
+    "hvd_link_latency_seconds",
+    "Fitted per-collective launch latency (alpha of the online "
+    "alpha-beta cost model), by link class and collective op.",
+    ("link_class", "op"))
+COLLECTIVE_EFFICIENCY = gauge(
+    "hvd_collective_efficiency_ratio",
+    "EWMA of achieved vs alpha-beta-predicted collective latency "
+    "(predicted/observed; 1.0 = on the model's roofline, <1 = "
+    "underperforming it).")
+COMMS_RESIDUAL = gauge(
+    "hvd_comms_residual_seconds",
+    "EWMA of seconds each observed collective ran SLOWER than the "
+    "fitted alpha-beta prediction — a link going bad shows up here "
+    "before it shows up as cross-rank skew.")
 # Control-plane fault tolerance (driver crash-restart takeover; the
 # rendezvous server mirrors the epoch and driver-lost counts into the
 # /metrics scrape so operators see control-plane flaps before the
@@ -753,6 +780,16 @@ def _materialize_checkpoint_cells() -> None:
     RESIDENT_BYTES.labels(kind="params", sync_mode="fsdp")
     DRIVER_EPOCH.labels()
     DRIVER_TAKEOVERS.labels()
+    # Comms-observatory zero cells: a job that never fitted a model
+    # still reports the roofline series at 0, so the premerge scrape
+    # gate can assert the instruments exist and dashboards can tell
+    # "no model yet" from "not measuring".
+    for lc in ("ici", "dcn"):
+        LINK_LATENCY.labels(link_class=lc, op="allreduce")
+        LINK_BANDWIDTH.labels(link_class=lc, op="allreduce",
+                              algorithm="flat")
+    COLLECTIVE_EFFICIENCY.labels()
+    COMMS_RESIDUAL.labels()
 
 
 _materialize_checkpoint_cells()

@@ -293,6 +293,31 @@ def _native_set_for(ps, world) -> int:
     return cache[ps.process_set_id]
 
 
+def _link_class_of(ps) -> str:
+    """The worst link class spanned by a process set (the comms model's
+    ``link_class`` attribution for its flat eager collectives), from the
+    init-time topology; falls back to the process-count heuristic when
+    uninitialized. Cached per set id ON the Topology instance — the
+    class is static within a world epoch and this sits on the eager
+    dispatch hot path; an elastic re-init builds a fresh Topology, so
+    the cache dies with the old world (keying a module map by id(topo)
+    would alias a recycled address onto stale classes)."""
+    try:
+        from ..basics import _state
+
+        topo = _state.topology
+        if topo is not None:
+            cache = topo.__dict__.setdefault("_link_class_by_set", {})
+            cls = cache.get(ps.process_set_id)
+            if cls is None:
+                cls = topo.set_link_class(ps.ranks)
+                cache[ps.process_set_id] = cls
+            return cls
+    except Exception:  # noqa: BLE001 — attribution is best-effort
+        pass
+    return "dcn" if jax.process_count() > 1 else "ici"
+
+
 def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     ps = _resolve_process_set(process_set)
     mesh = ps.mesh
@@ -360,13 +385,16 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
     # async flavors live in the runtime backend) — and blocking inside the
     # ticket window is what lets the stall inspector see execution hangs,
     # not just dispatch.
+    link_class = _link_class_of(ps)
     ticket = get_inspector().begin(f"{kind}[{x.shape}]")
     t_exec = _time.perf_counter()
     try:
         # tracing.span triple-emits: the host Chrome-trace activity (plus
         # its xprof annotation) AND a cross-rank step-tracer span — the
         # per-collective record the merged /timeline and the skew gauges
-        # are built from.
+        # are built from. The args carry the comms model's attribution
+        # vocabulary (bytes / algorithm / link_class) so shipped spans
+        # can be re-ingested by comms_model.ingest_steps.
         with _tracing.span(
             kind,
             "collective",
@@ -374,12 +402,26 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
                 "shape": list(x.shape),
                 "dtype": str(x.dtype),
                 "cache": "miss" if missed else "hit",
+                "bytes": nbytes,
+                "op": kind,
+                "algorithm": "flat",
+                "link_class": link_class,
             },
         ):
             out = compiled(x)
             jax.block_until_ready(out)
-            _metrics.COLLECTIVE_LATENCY.observe(
-                _time.perf_counter() - t_exec, kind=kind)
+            dt = _time.perf_counter() - t_exec
+            _metrics.COLLECTIVE_LATENCY.observe(dt, kind=kind)
+            try:
+                # Every timed eager dispatch is an alpha-beta sample:
+                # one flat collective of `nbytes` over this set's worst
+                # link class took `dt` seconds (compile excluded —
+                # t_exec starts after get_or_build).
+                from .. import comms_model as _comms_model
+
+                _comms_model.observe(kind, "flat", link_class, nbytes, dt)
+            except Exception:  # noqa: BLE001 — the model is advisory
+                pass
             return out
     finally:
         get_inspector().end(ticket)
@@ -789,3 +831,52 @@ def barrier(process_set=None) -> None:
         ps,
     )
     jax.block_until_ready(out)
+
+
+def run_comms_microprobe(process_set=None, sizes=None,
+                         repeats: int = 3) -> dict:
+    """Seed the communication observatory with an explicit payload sweep
+    over a process set — the jax-side driver of
+    ``comms_model.microprobe``.
+
+    Runs eager allreduce / reducescatter / allgather dispatches at each
+    payload size (stacked-rank convention, float32); every dispatch's
+    measured latency feeds the α–β model automatically through
+    ``_eager_dispatch`` (compile time excluded — the first call of each
+    signature warms the executable cache before the timed repeats). In
+    SPMD worlds this is collective: every rank must call it at the same
+    program point, like any eager collective. Returns
+    ``{op: {nbytes: samples}}`` with the nbytes as dispatched (the
+    stacked payload, matching ``hvd_collective_payload_bytes``).
+    """
+    import numpy as np
+
+    from .. import comms_model as _comms_model
+
+    ps = _resolve_process_set(process_set)
+    n = ps.size()
+    sizes = [int(s) for s in (sizes or _comms_model.DEFAULT_PROBE_SIZES)]
+    out: dict[str, dict] = {}
+    for op_name, run in (
+        ("allreduce", lambda a: allreduce(a, op=Sum, process_set=ps)),
+        ("reducescatter",
+         lambda a: reducescatter(a, op=Sum, process_set=ps)),
+        ("allgather", lambda a: allgather(a, process_set=ps)),
+    ):
+        per_op: dict[int, list] = {}
+        for nbytes in sizes:
+            # Per-rank rows of n*k elements so reducescatter's dim-0
+            # divisibility holds; stacked payload = n * row bytes.
+            elems = max(n, (nbytes // 4 // n) * n)
+            x = np.ones((n, elems), np.float32)
+            run(x)  # warm the executable cache (compile excluded anyway)
+            import time as _time
+
+            for _ in range(max(1, int(repeats))):
+                t0 = _time.perf_counter()
+                jax.block_until_ready(run(x))
+                per_op.setdefault(int(x.size) * 4, []).append(
+                    _time.perf_counter() - t0)
+        out[op_name] = per_op
+    _comms_model.get_model().note_probe()
+    return out

@@ -151,6 +151,23 @@ def bucket_leaves(
     return buckets
 
 
+def _note_leaf_sizes(tensors) -> None:
+    """Record the flush's leaf layout ``[(nbytes, dtype), ...]`` on the
+    communication observatory (trace-time static facts — the input the
+    model-guided autotune predictor prices candidate thresholds and
+    segment counts against; see ``comms_model.predict_flush_cost``).
+    Never raises: observability must not break tracing."""
+    try:
+        from .. import comms_model
+
+        comms_model.get_model().note_leaf_sizes([
+            (int(t.size) * jnp.dtype(t.dtype).itemsize, str(t.dtype))
+            for t in tensors
+        ])
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
+
+
 def _reduce_bucket(flat, op, axis_name, prescale_factor, postscale_factor):
     from .collective_ops import _allreduce_traced
 
@@ -186,6 +203,7 @@ def fused_allreduce(
             _reduce_bucket(t, op, axis_name, prescale_factor, postscale_factor)
             for t in tensors
         ]
+    _note_leaf_sizes(tensors)
     buckets = bucket_leaves(tensors, threshold_bytes)
     out: list[Any] = [None] * len(tensors)
     for bi, bucket in (
@@ -317,6 +335,7 @@ def fused_reducescatter(
         raise ValueError(f"fused_reducescatter supports Sum/Average, got {op!r}")
     n = int(world_size)
     tensors = [jnp.asarray(t) for t in tensors]
+    _note_leaf_sizes(tensors)
     sizes = shard_ownership(tensors, n)
     scale = postscale_factor / n if op == Average else postscale_factor
     out: list[Any] = [None] * len(tensors)

@@ -725,6 +725,14 @@ def overlap_gradient_sync(
                      or spec.process_set.axis_name)
     k = num_segments if num_segments is not None else overlap_segments()
     leaves, treedef = jax.tree.flatten(params)
+    # Note the FULL leaf layout before segmentation: the per-segment
+    # wires below note only their subsets, and the model-guided autotune
+    # predictor prices candidates against the whole flush.
+    import jax.numpy as jnp
+
+    from ..ops.fusion import _note_leaf_sizes
+
+    _note_leaf_sizes([jnp.asarray(l) for l in leaves])
     new_leaves = list(leaves)
     for si, idx in enumerate(segment_leaves(leaves, k)):
         synced = _segment_sync(

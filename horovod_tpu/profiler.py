@@ -70,14 +70,18 @@ def summary() -> dict:
     counters callers keep asking the timeline for — executable-cache
     hits/misses/size, per-kind eager-dispatch counts
     (``hvd.cache_stats()``), the elastic goodput ledger (productive
-    vs. lost wall time, see ``horovod_tpu.metrics.GoodputTracker``), and
-    the straggler view from the cross-rank tracing plane (this rank's
+    vs. lost wall time, see ``horovod_tpu.metrics.GoodputTracker``), the
+    straggler view from the cross-rank tracing plane (this rank's
     measured clock offset ± error, plus — when a rendezvous KV is
     configured — the server-computed per-collective arrival-skew
-    attribution). ``bench.py`` emits this once per run so every
-    benchmark record carries the cache/goodput behavior that produced it.
+    attribution), and the communication observatory's fitted α–β model
+    (``"comms"``: per-key fits with sample counts, the
+    predicted-vs-observed residual, the efficiency EWMA — reset via
+    ``comms_model.reset_for_testing()``). ``bench.py`` emits this once
+    per run so every benchmark record carries the cache/goodput behavior
+    that produced it.
     """
-    from . import metrics, tracing
+    from . import comms_model, metrics, tracing
     from .ops.collective_ops import cache_stats
 
     return {
@@ -87,6 +91,7 @@ def summary() -> dict:
         "checkpoint": metrics.checkpoint_summary(),
         "stragglers": tracing.straggler_summary(),
         "fsdp": metrics.fsdp_summary(),
+        "comms": comms_model.summary(),
         **cache_stats(),
     }
 

@@ -957,9 +957,22 @@ class ElasticDriver:
         except Exception as e:  # noqa: BLE001 — evidence is best-effort
             self._log.debug("elastic: straggler summary failed: %s", e)
             skew = {}
+        # Comms-residual channel: per-host predicted-vs-observed
+        # residual seconds from the cluster-merged alpha-beta model —
+        # the link-degradation evidence that leads the skew signal.
+        # Gated on the channel knob: the merge JSON-parses every
+        # worker's heartbeat body on the single-threaded server, work
+        # the controller would never read with the channel off.
+        residuals: dict = {}
+        if self._policy.comms_residual_s > 0:
+            try:
+                residuals = (self._server.comms_summary()
+                             .get("residuals") or {})
+            except Exception as e:  # noqa: BLE001 — evidence best-effort
+                self._log.debug("elastic: comms summary failed: %s", e)
         world_names = [h.hostname for h in self._world_hosts]
         self._policy.observe(skew, self._server.heartbeat_ages(),
-                             world_names)
+                             world_names, comms_residuals=residuals)
         decision = self._policy.decide(world_names,
                                        self._warm_spare_count())
         if decision is not None and decision.host in self._workers:

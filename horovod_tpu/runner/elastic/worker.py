@@ -534,6 +534,20 @@ class ElasticWorkerContext:
                 body["metrics"] = _metrics.snapshot()
             except Exception:  # noqa: BLE001 — liveness beats observability
                 pass
+            try:
+                # Communication observatory: the fitted alpha-beta model
+                # rides the same beat (bounded: a handful of fits), so
+                # the driver's GET /comms serves a cluster-merged view
+                # and its policy plane sees per-host residuals. A PARKED
+                # spare never ships one — like its trace window, its
+                # dummy launch-env rank label would shadow a real rank's
+                # model in the per-rank merge.
+                if not self.parked:
+                    from ... import comms_model as _comms_model
+
+                    body["comms"] = _comms_model.get_model().payload()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
         payload = json.dumps(body).encode()
         try:
             t_send = clock.now()

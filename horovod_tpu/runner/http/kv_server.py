@@ -61,6 +61,16 @@ serves the per-collective arrival-skew attribution as JSON, and the
 read-only ``/timeline`` and ``/stragglers`` routes share ``/metrics``'s
 auth exemption (trace viewers can't HMAC either). See
 ``docs/timeline.md``.
+
+Communication observatory (``horovod_tpu.comms_model``): each worker's
+heartbeat also piggybacks its fitted α–β link cost model (``"comms"``
+key); ``GET /comms`` (auth-exempt, read-only) serves the cluster-merged
+view — per-rank fits, effective-sample-weighted cluster aggregates per
+(op, algorithm, link_class), and the per-host predicted-vs-observed
+residuals the self-healing policy consumes as a second
+straggler-evidence channel. A cold cluster serves an explicit
+``insufficient_samples`` body, never a 500. See
+``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -73,6 +83,7 @@ from typing import Callable
 from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
+from ... import comms_model as _comms_model
 from ... import faults
 from ... import metrics as _metrics
 from ... import peercheck as _peercheck
@@ -217,6 +228,10 @@ class _KVHandler(BaseHTTPRequestHandler):
             return self._serve_json(
                 lambda httpd: _compute_cluster_skew(httpd)[0],
                 "application/json")
+        if self.path == "/comms":
+            # Same exemption as /metrics: read-only operational
+            # telemetry (the cluster-merged alpha-beta link cost model).
+            return self._serve_json(_render_comms, "application/json")
         if not self._authenticate():
             return
         store = self.server.store  # type: ignore[attr-defined]
@@ -489,6 +504,38 @@ def _compute_cluster_skew(httpd) -> tuple[dict, dict[str, dict]]:
                 skew_s=worst["skew_s"], collective=worst["name"],
                 step=worst["step"])
     return skew, payloads
+
+
+def _comms_payloads(httpd) -> dict[str, dict]:
+    """Per-rank comms-model payloads, as piggybacked on heartbeat PUTs
+    (the ``"comms"`` key of each heartbeat body), keyed by host.
+    Malformed heartbeats are skipped — same tolerance as the metrics
+    piggyback."""
+    with httpd.lock:
+        raw = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
+    out: dict[str, dict] = {}
+    for host, body in raw.items():
+        try:
+            hb = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if not isinstance(hb, dict):
+            continue
+        comms = hb.get("comms")
+        if isinstance(comms, dict):
+            out[host] = comms
+    return out
+
+
+def _render_comms(httpd) -> dict:
+    """``GET /comms``: the cluster-merged α–β link cost model. A world
+    where nothing fitted yet (cold start, parked spares, single-device
+    smoke) serves an explicit ``insufficient_samples`` body — never a
+    500 (``comms_model.merge_payloads`` owns that contract)."""
+    merged = _comms_model.merge_payloads(_comms_payloads(httpd))
+    with httpd.lock:
+        merged["generation"] = httpd.version
+    return merged
 
 
 def _render_cluster_metrics(httpd) -> str:
@@ -796,6 +843,14 @@ class RendezvousServer:
         """The arrival-skew attribution (what ``GET /stragglers``
         serves), rendered in-process."""
         return _compute_cluster_skew(self._httpd)[0]
+
+    def comms_summary(self) -> dict:
+        """The cluster-merged α–β link cost model (what ``GET /comms``
+        serves), rendered in-process. Its ``"residuals"`` map (host →
+        worst predicted-vs-observed residual seconds) is the second
+        straggler-evidence channel the elastic driver feeds
+        ``elastic/policy.py``."""
+        return _render_comms(self._httpd)
 
     def trace_payload(self, host: str) -> dict | None:
         """The last trace payload a host shipped, parsed, or None."""

@@ -139,11 +139,70 @@ class Topology:
         coords = getattr(device, "coords", None)
         return tuple(coords) if coords is not None else None
 
+    # -- link classification (the comms model's topology leg) ----------------
+
+    def link_class(self, rank_a: int, rank_b: int) -> str:
+        """Classify the rank-pair link: ``"self"`` (same device),
+        ``"ici"`` (torus-connected — same host, or coordinate-bearing
+        devices on the same slice: on TPU pods ICI spans hosts within a
+        slice), or ``"dcn"`` (cross-slice, or cross-host without
+        coordinates — the data-center network). This is the
+        ``link_class`` label vocabulary of the α–β cost model
+        (``horovod_tpu.comms_model``)."""
+        if rank_a == rank_b:
+            return "self"
+        da, db = self.devices[rank_a], self.devices[rank_b]
+        if da.process_index == db.process_index:
+            return "ici"
+        slice_a = getattr(da, "slice_index", 0) or 0
+        slice_b = getattr(db, "slice_index", 0) or 0
+        if (self.device_coords(da) is not None
+                and self.device_coords(db) is not None
+                and slice_a == slice_b):
+            return "ici"
+        return "dcn"
+
+    def set_link_class(self, ranks: Sequence[int]) -> str:
+        """The WORST link class spanned by a process set's ranks (the
+        class its flat collectives are bottlenecked on): ``"dcn"`` if
+        any member pair crosses DCN, else ``"ici"``. Degenerate sets
+        (zero/one rank — a parked spare's view, a single-device world)
+        classify as ``"ici"``: the collective is local or absent."""
+        ranks = list(ranks)
+        if len(ranks) < 2:
+            return "ici"
+        anchor = ranks[0]
+        for r in ranks[1:]:
+            if self.link_class(anchor, r) == "dcn":
+                return "dcn"
+        return "ici"
+
+    def link_class_matrix(self) -> dict[str, int]:
+        """Unordered rank-pair counts by link class — the summary
+        :meth:`describe` renders and ``/comms`` consumers use to weight
+        per-class fits. Empty for degenerate (<2 rank) worlds."""
+        counts: dict[str, int] = {}
+        for i in range(self.num_devices):
+            for j in range(i + 1, self.num_devices):
+                cls = self.link_class(i, j)
+                counts[cls] = counts.get(cls, 0) + 1
+        return counts
+
     def describe(self) -> str:
         lines = [
             f"world: {self.size} device rank(s) across "
             f"{self.cross_size} host(s)"
         ]
+        # Link structure summary: pair counts by class. Degenerate
+        # worlds (a parked spare's empty view, a single-device world)
+        # must render a valid — if trivial — model, never raise.
+        matrix = self.link_class_matrix()
+        if matrix:
+            pairs = " ".join(f"{cls}={n}"
+                             for cls, n in sorted(matrix.items()))
+            lines.append(f"links: {pairs}")
+        else:
+            lines.append("links: none (degenerate single-rank world)")
         for i, d in enumerate(self.devices):
             coords = self.device_coords(d)
             lines.append(

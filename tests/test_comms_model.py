@@ -1,0 +1,774 @@
+"""Communication observatory tests (ISSUE 11 acceptance proof).
+
+Layers, mirroring the plane's architecture:
+
+- :class:`~horovod_tpu.comms_model.LinkFit` / ``CommsModel`` fit math:
+  exact α–β recovery from synthetic timings, min-sample and
+  degenerate-payload gating, EWMA drift toward a changed link,
+  malformed-payload tolerance in the cluster merge;
+- ``Topology.link_class`` on CPU meshes and synthetic TPU-shaped device
+  sets (intra-host ICI, intra-slice cross-host ICI, cross-slice DCN),
+  plus the ``describe()`` link-matrix summary and its
+  degenerate-world contract;
+- the 2-worker ``GET /comms`` HTTP merge e2e with per-rank labels and
+  the cold-server ``insufficient_samples`` (never-a-500) contract;
+- the predicted-vs-observed residual channel: the ``comms.link`` faults
+  injector deterministically degrades one host's link, the residual
+  flags THAT host through the merged ``/comms`` body, and
+  ``elastic/policy.py`` converts the sustained residual into a drain
+  decision (the second straggler-evidence channel);
+- model-guided autotune: dominance pruning math, the rank-identical
+  kept-list contract, and the transparent tuner pruning its grid after
+  the first window.
+"""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from horovod_tpu import comms_model as cm
+from horovod_tpu import faults
+from horovod_tpu import metrics as hvd_metrics
+from horovod_tpu.topology import Topology
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observatory():
+    cm.reset_for_testing()
+    faults.reset()
+    yield
+    cm.reset_for_testing()
+    faults.reset()
+
+
+def _line(alpha, beta):
+    return lambda nbytes: alpha + beta * nbytes
+
+
+def _seed(model, alpha=1e-3, beta=2e-9, sizes=(1024, 65536, 1 << 20),
+          repeats=3, op="allreduce", algorithm="flat", link="ici"):
+    f = _line(alpha, beta)
+    for nbytes in sizes:
+        for _ in range(repeats):
+            model.observe(op, algorithm, link, nbytes, f(nbytes))
+
+
+# ---------------------------------------------------------------------------
+# Fit math
+# ---------------------------------------------------------------------------
+
+
+class TestLinkFit:
+    def test_exact_alpha_beta_recovery(self):
+        """Samples exactly on a line recover α and β exactly (weighted
+        least squares on collinear points is exact regardless of the
+        decay weights)."""
+        fit = cm.LinkFit()
+        for nbytes in (1024, 65536, 1 << 20):
+            for _ in range(3):
+                fit.observe(nbytes, _line(1e-3, 2e-9)(nbytes))
+        d = fit.as_dict()
+        assert d["ready"]
+        assert math.isclose(d["alpha_s"], 1e-3, rel_tol=1e-5)
+        assert math.isclose(d["beta_s_per_byte"], 2e-9, rel_tol=1e-5)
+        assert math.isclose(d["bandwidth_bytes_per_second"], 5e8,
+                            rel_tol=1e-4)
+        # Collinear data: residual variance ~0, so the CIs are ~0 too.
+        assert d["alpha_ci95_s"] < 1e-8
+        assert d["r2"] > 0.999
+        pred = fit.predict(10 << 20)
+        assert math.isclose(pred, _line(1e-3, 2e-9)(10 << 20),
+                            rel_tol=1e-5)
+
+    def test_min_sample_gating(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_COMMS_MIN_SAMPLES", "4")
+        fit = cm.LinkFit()
+        fit.observe(1024, 1e-3)
+        fit.observe(65536, 2e-3)
+        fit.observe(1 << 20, 3e-3)
+        assert not fit.ready()  # 3 < min_samples
+        fit.observe(1 << 21, 4e-3)
+        assert fit.ready()
+
+    def test_single_payload_size_never_fits_beta(self):
+        """All samples at ONE payload size: β is unidentifiable — the
+        fit must gate itself (ready=False) and degrade to the latency
+        mean instead of inventing a slope."""
+        fit = cm.LinkFit()
+        for _ in range(20):
+            fit.observe(65536, 5e-3)
+        assert not fit.ready()
+        d = fit.as_dict()
+        assert d["beta_s_per_byte"] is None
+        assert d["bandwidth_bytes_per_second"] is None
+        assert math.isclose(fit.predict(1 << 20), 5e-3, rel_tol=1e-6)
+
+    def test_nan_and_negative_samples_ignored(self):
+        fit = cm.LinkFit()
+        fit.observe(float("nan"), 1e-3)
+        fit.observe(1024, float("nan"))
+        fit.observe(1024, -1.0)
+        assert fit.count == 0
+
+    def test_ewma_drift_tracks_a_degrading_link(self):
+        """A link that re-fits: after a regime change the decayed stats
+        pull the fitted β toward the NEW line instead of averaging the
+        two forever."""
+        fit = cm.LinkFit()
+        for _ in range(10):
+            for nbytes in (1024, 65536, 1 << 20):
+                fit.observe(nbytes, _line(1e-3, 1e-9)(nbytes))
+        for _ in range(40):
+            for nbytes in (1024, 65536, 1 << 20):
+                fit.observe(nbytes, _line(5e-3, 5e-9)(nbytes))
+        beta = fit.as_dict()["beta_s_per_byte"]
+        assert abs(beta - 5e-9) < abs(beta - 1e-9)
+
+
+class TestCommsModel:
+    def test_insufficient_samples_payload_never_raises(self):
+        p = cm.get_model().payload()
+        assert p["status"] == "insufficient_samples"
+        assert p["fits"] == {}
+        assert p["samples_total"] == 0
+        json.dumps(p)  # wire-serializable
+
+    def test_fallback_chain_prices_unfitted_algorithms(self):
+        model = cm.get_model()
+        _seed(model)  # only (allreduce, flat, ici) is fitted
+        assert model.predict("reducescatter", "rs_ag", "ici",
+                             1 << 20) is not None
+        assert model.predict("allgather", "fsdp", "dcn",
+                             1 << 20) is not None
+
+    def test_residual_and_efficiency_track_pre_update_prediction(self):
+        model = cm.get_model()
+        _seed(model)
+        assert model.residual_s() < 1e-4
+        # A burst 50ms above the model: the residual must register
+        # BEFORE the drifting fit absorbs the new regime.
+        for _ in range(4):
+            model.observe("allreduce", "flat", "ici", 65536,
+                          _line(1e-3, 2e-9)(65536) + 0.05)
+        assert model.residual_s() > 0.02
+        eff = model.efficiency()
+        assert eff is not None and eff < 1.0
+
+    def test_ingest_steps_parses_bucket_names_and_args(self):
+        model = cm.get_model()
+        steps = [{"spans": [
+            {"name": "allreduce.bucket0.1048576B", "cat": "collective",
+             "dur": 0.004},
+            {"name": "allreduce", "cat": "collective", "dur": 0.002,
+             "args": {"bytes": 65536, "op": "allreduce",
+                      "algorithm": "flat", "link_class": "ici"}},
+            {"name": "forward", "cat": "phase", "dur": 1.0},   # not comm
+            {"name": "allreduce.bucket1.9B", "cat": "collective",
+             "dur": "garbage"},                                # malformed
+            "not-a-span",
+        ]}, "not-a-step"]
+        assert model.ingest_steps(steps) == 2
+
+    def test_nan_sample_never_poisons_the_ewmas(self):
+        """One NaN duration (a broken clock, a malformed shipped span)
+        must not NaN-poison the residual/efficiency EWMAs forever."""
+        model = cm.get_model()
+        _seed(model)
+        model.observe("allreduce", "flat", "ici", 65536, float("nan"))
+        model.observe("allreduce", "flat", "ici", float("nan"), 1e-3)
+        assert model.ingest_steps([{"spans": [
+            {"name": "allreduce.bucket0.65536B", "cat": "collective",
+             "dur": float("nan")}]}]) == 0
+        assert model.residual_s() == model.residual_s()  # not NaN
+        eff = model.efficiency()
+        assert eff is None or eff == eff
+        # A NaN residual in a shipped payload must not reach the merged
+        # /comms body (json with NaN is not valid JSON).
+        p = dict(model.payload(), rank="0", host="h",
+                 residual_s=float("nan"))
+        merged = cm.merge_payloads({"h": p})
+        json.dumps(merged)
+        assert merged["residuals"]["h"] == 0.0
+
+    def test_inf_sample_never_poisons_a_ready_fit(self):
+        """inf passes a bare `>= 0` check but drives the decayed sums to
+        inf, turning β into NaN while ready() stays True — the fit would
+        predict NaN into the gauges and /comms forever."""
+        model = cm.get_model()
+        _seed(model)
+        fit = model._fit_for("allreduce", "flat", "ici", create=False)
+        before = fit.predict(1 << 20)
+        model.observe("allreduce", "flat", "ici", float("inf"), 1e-3)
+        model.observe("allreduce", "flat", "ici", 65536, float("inf"))
+        fit.observe(float("inf"), 1e-3)   # the inner guard, directly
+        assert fit.ready()
+        after = fit.predict(1 << 20)
+        assert after is not None and math.isfinite(after)
+        assert math.isclose(after, before, rel_tol=1e-6)
+        json.loads(json.dumps(model.payload()))  # strict round-trip
+
+    def test_leaf_notes_keep_the_largest_flush(self):
+        model = cm.get_model()
+        model.note_leaf_sizes([(1024, "float32")] * 4)
+        model.note_leaf_sizes([(1 << 20, "float32")] * 8)   # full flush
+        model.note_leaf_sizes([(2048, "float32")] * 2)      # one segment
+        assert sum(b for b, _ in model.leaf_sizes()) == 8 << 20
+
+
+# ---------------------------------------------------------------------------
+# Bucket/segment mirrors (must match the fusion pass bit for bit)
+# ---------------------------------------------------------------------------
+
+
+class TestFusionMirrors:
+    def _leaves(self):
+        import jax.numpy as jnp
+
+        sizes = [64, 4096, 128, 70000, 64, 64, 9000, 512]
+        leaves = [jnp.ones((s,), jnp.float32) for s in sizes]
+        leaves.append(jnp.ones((256,), jnp.bfloat16))  # dtype break
+        return leaves
+
+    def test_bucket_byte_sizes_mirrors_bucket_leaves(self):
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops.fusion import bucket_leaves
+
+        leaves = self._leaves()
+        layout = [(int(l.size) * jnp.dtype(l.dtype).itemsize,
+                   str(l.dtype)) for l in leaves]
+        for threshold in (0, 256, 4096, 1 << 20):
+            want = [
+                sum(int(leaves[i].size)
+                    * jnp.dtype(leaves[i].dtype).itemsize for i in b)
+                for b in bucket_leaves(leaves, threshold)
+            ]
+            assert cm.bucket_byte_sizes(layout, threshold) == want
+
+    def test_segment_byte_runs_mirrors_segment_leaves(self):
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops.fusion import segment_leaves
+
+        leaves = self._leaves()
+        layout = [(int(l.size) * jnp.dtype(l.dtype).itemsize,
+                   str(l.dtype)) for l in leaves]
+        for k in (1, 2, 4, 16):
+            want = [[layout[i] for i in run]
+                    for run in segment_leaves(leaves, k)]
+            assert cm.segment_byte_runs(layout, k) == want
+
+
+# ---------------------------------------------------------------------------
+# Topology link classification
+# ---------------------------------------------------------------------------
+
+
+class _Dev:
+    platform = "tpu"
+
+    def __init__(self, id, process_index, coords=None, slice_index=0):
+        self.id = id
+        self.process_index = process_index
+        if coords is not None:
+            self.coords = coords
+        self.slice_index = slice_index
+        self.core_on_chip = 0
+
+
+class TestTopologyLinkClass:
+    def test_cpu_mesh_is_all_ici(self):
+        import jax
+
+        topo = Topology(jax.devices())
+        n = topo.size
+        assert n == 8
+        assert topo.link_class(0, 0) == "self"
+        for j in range(1, n):
+            assert topo.link_class(0, j) == "ici"
+        assert topo.set_link_class(list(range(n))) == "ici"
+        assert topo.link_class_matrix() == {"ici": n * (n - 1) // 2}
+
+    def test_tpu_shapes(self):
+        devs = [
+            _Dev(0, 0, coords=(0, 0, 0), slice_index=0),
+            _Dev(1, 0, coords=(1, 0, 0), slice_index=0),
+            _Dev(2, 1, coords=(2, 0, 0), slice_index=0),  # cross-host ICI
+            _Dev(3, 2, coords=(0, 0, 0), slice_index=1),  # cross-slice DCN
+        ]
+        topo = Topology(devs)
+        by_id = {d.id: topo.rank_of(d) for d in devs}
+        assert topo.link_class(by_id[0], by_id[1]) == "ici"   # same host
+        assert topo.link_class(by_id[0], by_id[2]) == "ici"   # same slice
+        assert topo.link_class(by_id[0], by_id[3]) == "dcn"   # cross slice
+        assert topo.set_link_class(list(by_id.values())) == "dcn"
+        assert topo.set_link_class([by_id[0], by_id[1], by_id[2]]) == "ici"
+
+    def test_coordless_cross_process_is_dcn(self):
+        class _Cpu:
+            platform = "cpu"
+
+            def __init__(self, id, process_index):
+                self.id = id
+                self.process_index = process_index
+
+        topo = Topology([_Cpu(0, 0), _Cpu(1, 1)])
+        assert topo.link_class(0, 1) == "dcn"
+
+    def test_describe_renders_link_matrix(self):
+        import jax
+
+        text = Topology(jax.devices()).describe()
+        assert "links: ici=28" in text
+
+    def test_describe_degenerate_worlds_never_raise(self):
+        import jax
+
+        # Single-device world: a valid, degenerate model — not a crash.
+        text = Topology(jax.devices()[:1]).describe()
+        assert "links: none" in text
+        # A parked spare's empty view.
+        empty = Topology([])
+        assert "links: none" in empty.describe()
+        assert empty.set_link_class([]) == "ici"
+        assert empty.link_class_matrix() == {}
+
+
+# ---------------------------------------------------------------------------
+# Cluster merge + GET /comms HTTP e2e
+# ---------------------------------------------------------------------------
+
+
+def _payload_for(rank, host, residual=0.0, alpha=1e-3, beta=2e-9):
+    model = cm.CommsModel()
+    _seed(model, alpha=alpha, beta=beta)
+    p = model.payload()
+    p.update(rank=str(rank), host=host, residual_s=residual)
+    return p
+
+
+class TestMerge:
+    def test_merge_two_ranks_weighted_cluster_view(self):
+        pa = _payload_for(0, "hostA", alpha=1e-3, beta=2e-9)
+        pb = _payload_for(1, "hostB", residual=0.4, alpha=3e-3, beta=4e-9)
+        merged = cm.merge_payloads({"hostA": pa, "hostB": pb})
+        assert merged["status"] == "ok"
+        assert sorted(merged["ranks"]) == ["0", "1"]
+        assert merged["ranks"]["1"]["host"] == "hostB"
+        agg = merged["cluster"]["allreduce|flat|ici"]
+        assert agg["ranks"] == 2
+        assert 1e-3 < agg["alpha_s"] < 3e-3      # weighted between ranks
+        assert merged["residuals"] == {"hostA": 0.0, "hostB": 0.4}
+
+    def test_merge_tolerates_malformed_payloads(self):
+        good = _payload_for(0, "hostA")
+        merged = cm.merge_payloads({
+            "hostA": good,
+            "h1": "garbage",
+            "h2": 42,
+            "h3": {"rank": "3", "host": "h3", "fits": "not-a-dict",
+                   "residual_s": "NaNsense"},
+            "h4": {"rank": "4", "fits": {"badkey": {"alpha_s": 1},
+                                         "allreduce|flat|ici": "nope"}},
+        })
+        assert merged["status"] == "ok"
+        assert "0" in merged["ranks"]
+        assert merged["ranks"]["3"]["residual_s"] == 0.0
+        assert merged["ranks"]["4"]["fits"] == {}
+
+    def test_merge_rejects_nonfinite_fit_values(self):
+        """A NaN/inf fit or efficiency in one rank's payload must not
+        poison the cluster aggregate or leak bare NaN into the /comms
+        JSON body (json.dumps serializes NaN, strict parsers don't)."""
+        good = _payload_for(0, "hostA", alpha=1e-3, beta=2e-9)
+        bad = _payload_for(1, "hostB", alpha=1e-3, beta=2e-9)
+        for d in bad["fits"].values():
+            d["alpha_s"] = float("nan")
+        bad["efficiency"] = float("inf")
+        bad["samples_total"] = float("inf")
+        merged = cm.merge_payloads({"hostA": good, "hostB": bad})
+        agg = merged["cluster"]["allreduce|flat|ici"]
+        assert agg["ranks"] == 1                  # NaN fit skipped
+        assert math.isclose(agg["alpha_s"], 1e-3, rel_tol=0.1)
+        assert merged["ranks"]["1"]["efficiency"] is None
+        assert merged["ranks"]["1"]["samples_total"] == 0
+        assert "NaN" not in json.dumps(merged)
+        assert "Infinity" not in json.dumps(merged)
+
+    def test_merge_keeps_colliding_rank_labels_apart(self):
+        """HOROVOD_RANK unset defaults every worker's self-reported rank
+        to \"0\" (single-controller / torch surfaces): the merge must
+        keep every host's model visible, not last-writer-wins one."""
+        pa = _payload_for(0, "hostA", alpha=1e-3, beta=2e-9)
+        pb = _payload_for(0, "hostB", residual=0.3, alpha=3e-3, beta=4e-9)
+        merged = cm.merge_payloads({"hostA": pa, "hostB": pb})
+        assert len(merged["ranks"]) == 2
+        hosts = {r["host"] for r in merged["ranks"].values()}
+        assert hosts == {"hostA", "hostB"}
+        assert merged["cluster"]["allreduce|flat|ici"]["ranks"] == 2
+        assert merged["residuals"]["hostB"] == 0.3
+
+    def test_merge_empty_is_insufficient_samples(self):
+        merged = cm.merge_payloads({})
+        assert merged["status"] == "insufficient_samples"
+        assert merged["ranks"] == {}
+
+
+class TestCommsEndpoint:
+    def test_two_worker_http_merge_e2e(self):
+        from horovod_tpu.runner.http.kv_server import (
+            KVClient,
+            RendezvousServer,
+        )
+
+        server = RendezvousServer(host="127.0.0.1")
+        server.start()
+        try:
+            client = KVClient("127.0.0.1", server.port)
+            for host, rank, residual in (("hostA", 0, 0.0),
+                                         ("hostB", 1, 0.3)):
+                client.put("heartbeat", host, json.dumps({
+                    "rank": rank, "steps": 5, "commits": 1,
+                    "comms": _payload_for(rank, host, residual),
+                }).encode())
+            # A malformed heartbeat must not break the merge.
+            client.put("heartbeat", "hostC", b"not json")
+            url = f"http://127.0.0.1:{server.port}/comms"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+            assert body["status"] == "ok"
+            assert sorted(body["ranks"]) == ["0", "1"]
+            assert body["ranks"]["0"]["host"] == "hostA"
+            assert body["ranks"]["1"]["fits"][
+                "allreduce|flat|ici"]["ready"]
+            assert body["cluster"]["allreduce|flat|ici"]["ranks"] == 2
+            assert body["residuals"]["hostB"] == pytest.approx(0.3)
+            assert body["generation"] == server.generation
+            # In-process view matches the HTTP one.
+            assert server.comms_summary()["residuals"] == \
+                body["residuals"]
+        finally:
+            server.stop()
+
+    def test_cold_server_serves_insufficient_samples_not_500(self):
+        from horovod_tpu.runner.http.kv_server import RendezvousServer
+
+        server = RendezvousServer(host="127.0.0.1")
+        server.start()
+        try:
+            url = f"http://127.0.0.1:{server.port}/comms"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                assert r.status == 200
+                body = json.loads(r.read())
+            assert body["status"] == "insufficient_samples"
+            assert body["ranks"] == {}
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Residual channel: faults-plane link degradation -> gauge -> policy
+# ---------------------------------------------------------------------------
+
+
+class TestResidualChannel:
+    def test_delayed_link_flags_the_right_host(self):
+        """The canonical slow-link injector (``comms.link`` delay)
+        degrades hostB's observations; the residual surfaces through
+        the merged ``/comms`` body against hostB — and ONLY hostB."""
+        a, b = cm.CommsModel(), cm.CommsModel()
+        for model in (a, b):
+            _seed(model)
+        for _ in range(4):
+            a.observe("allreduce", "flat", "ici", 65536,
+                      _line(1e-3, 2e-9)(65536))
+        # Deterministic degradation of b's link: every observation runs
+        # 0.2s late.
+        faults.inject("comms.link", "delay", arg=0.2, at=1, count=8)
+        for _ in range(4):
+            b.observe("allreduce", "flat", "ici", 65536,
+                      _line(1e-3, 2e-9)(65536))
+        faults.clear("comms.link")
+        assert b.residual_s() > 0.1
+        assert a.residual_s() < 0.02
+        pa = dict(a.payload(), rank="0", host="hostA")
+        pb = dict(b.payload(), rank="1", host="hostB")
+        merged = cm.merge_payloads({"hostA": pa, "hostB": pb})
+        assert merged["residuals"]["hostB"] > 0.1
+        assert merged["residuals"]["hostA"] < 0.02
+        # The scrape gauge carries the degraded value (per-process; the
+        # cluster scrape adds host/rank labels from the heartbeat).
+        assert hvd_metrics.COMMS_RESIDUAL.labels().get() > 0.1
+
+    def test_policy_converts_sustained_residual_into_drain(self,
+                                                           monkeypatch):
+        """The second straggler-evidence channel: a sustained per-host
+        residual (no skew evidence at all) condemns the degraded host
+        and passes the SLO gate — and healthy residuals reset the
+        sustained clock."""
+        from horovod_tpu.elastic.policy import PolicyController
+
+        monkeypatch.setenv("HOROVOD_TARGET_GOODPUT", "0.9")
+        monkeypatch.setenv("HOROVOD_STRAGGLER_WINDOW", "1.0")
+        monkeypatch.setenv("HOROVOD_POLICY_DRAIN_SKEW", "5.0")  # skew off
+        monkeypatch.setenv("HOROVOD_POLICY_COMMS_RESIDUAL", "0.3")
+        monkeypatch.setenv("HOROVOD_POLICY_REALIZE_WINDOW", "2.0")
+        monkeypatch.setenv("HOROVOD_POLICY_RESIZE_COST", "1.0")
+        clock = [0.0]
+        c = PolicyController(min_np=1, clock=lambda: clock[0])
+        world = ["good", "bad"]
+        blind = {"ranks": {}, "worst": None}
+
+        for t in (0.0, 0.6, 1.2):
+            clock[0] = t
+            c.note_rate(2.0)
+            c.observe(blind, {}, world,
+                      comms_residuals={"good": 0.0, "bad": 0.5})
+        decision = c.decide(world, spares_ready=1)
+        assert decision is not None
+        assert decision.action == "drain"
+        assert decision.host == "bad"
+        assert decision.evidence["comms_residual_ewma_s"]["bad"] > 0.2
+        assert decision.evidence["comms_residual_ewma_s"]["good"] < 0.05
+
+        # Healthy residual evidence RESETS the sustained clock.
+        c2 = PolicyController(min_np=1, clock=lambda: clock[0])
+        clock[0] = 0.0
+        c2.note_rate(2.0)
+        c2.observe(blind, {}, world,
+                   comms_residuals={"good": 0.0, "bad": 0.5})
+        clock[0] = 0.6
+        c2.note_rate(2.0)
+        c2.observe(blind, {}, world,
+                   comms_residuals={"good": 0.0, "bad": 0.0})  # healed
+        clock[0] = 1.4
+        c2.note_rate(2.0)
+        c2.observe(blind, {}, world,
+                   comms_residuals={"good": 0.0, "bad": 0.5})
+        assert c2.decide(world, spares_ready=1) is None  # clock restarted
+
+    def test_malformed_residual_is_blind_not_healthy(self, monkeypatch):
+        """A non-numeric (or NaN) residual must FREEZE the host's EWMA —
+        folding a fake 0.0 would let a condemned host self-pardon during
+        its own sensor outage."""
+        from horovod_tpu.elastic.policy import PolicyController
+
+        monkeypatch.setenv("HOROVOD_TARGET_GOODPUT", "0.9")
+        monkeypatch.setenv("HOROVOD_STRAGGLER_WINDOW", "1.0")
+        monkeypatch.setenv("HOROVOD_POLICY_COMMS_RESIDUAL", "0.3")
+        clock = [0.0]
+        c = PolicyController(min_np=1, clock=lambda: clock[0])
+        blind = {"ranks": {}, "worst": None}
+        c.observe(blind, {}, ["bad"], comms_residuals={"bad": 0.5})
+        condemned = dict(c._res_ewma)
+        clock[0] = 0.5
+        c.observe(blind, {}, ["bad"],
+                  comms_residuals={"bad": "not-a-number"})
+        clock[0] = 1.0
+        c.observe(blind, {}, ["bad"],
+                  comms_residuals={"bad": float("nan")})
+        assert c._res_ewma == condemned  # frozen, not decayed toward 0
+        assert "bad" in c._above_since   # condemnation clock kept
+
+    def test_residual_state_survives_export_restore(self, monkeypatch):
+        from horovod_tpu.elastic.policy import PolicyController
+
+        monkeypatch.setenv("HOROVOD_TARGET_GOODPUT", "0.9")
+        monkeypatch.setenv("HOROVOD_POLICY_COMMS_RESIDUAL", "0.2")
+        clock = [0.0]
+        c = PolicyController(min_np=1, clock=lambda: clock[0])
+        c.observe({"ranks": {}, "worst": None}, {}, ["h"],
+                  comms_residuals={"h": 0.7})
+        state = c.export_state()
+        assert state["res_ewma"]["h"] > 0
+        c2 = PolicyController(min_np=1, clock=lambda: clock[0])
+        c2.restore_state(state)
+        assert c2._res_ewma["h"] == pytest.approx(state["res_ewma"]["h"])
+
+
+# ---------------------------------------------------------------------------
+# Model-guided autotune pruning
+# ---------------------------------------------------------------------------
+
+
+LEAVES_6MB = [(256 * 1024, "float32")] * 24
+
+
+class TestPruning:
+    def test_dominated_candidates_pruned_winner_kept(self):
+        model = cm.get_model()
+        _seed(model)  # alpha 1ms, beta 2e-9: launch count dominates
+        cands = [(64 * 1024, 1), (1 << 20, 1), (16 << 20, 1),
+                 (16 << 20, 2)]
+        verdict = cm.prune_candidates(cands, LEAVES_6MB, "ici")
+        assert (16 << 20, 1) in verdict["kept"]
+        assert (64 * 1024, 1) in verdict["pruned"]  # 24 launches vs 1
+        assert len(verdict["costs"]) == len(cands)
+        assert all(c is not None for c in verdict["costs"])
+        # Deterministic: same inputs, same verdict (rank-identity
+        # reduces to broadcasting identical inputs).
+        again = cm.prune_candidates(cands, LEAVES_6MB, "ici")
+        assert again["kept"] == verdict["kept"]
+
+    def test_cold_model_prunes_nothing(self):
+        cands = [64 * 1024, 16 << 20]
+        verdict = cm.prune_candidates(cands, LEAVES_6MB, "ici")
+        assert verdict["kept"] == cands
+        assert verdict["pruned"] == []
+        assert verdict["costs"] == [None, None]
+
+    def test_margin_widens_the_kept_set(self):
+        model = cm.get_model()
+        _seed(model)
+        cands = [(64 * 1024, 1), (16 << 20, 1)]
+        tight = cm.prune_candidates(cands, LEAVES_6MB, "ici", margin=1.1)
+        loose = cm.prune_candidates(cands, LEAVES_6MB, "ici",
+                                    margin=1000.0)
+        assert tight["pruned"] == [(64 * 1024, 1)]
+        assert loose["pruned"] == []
+
+    def test_sync_mode_axis_priced_per_wire(self):
+        model = cm.get_model()
+        _seed(model)
+        ar = cm.predict_flush_cost(LEAVES_6MB, 16 << 20, 1, "allreduce")
+        sh = cm.predict_flush_cost(LEAVES_6MB, 16 << 20, 1, "sharded")
+        fs = cm.predict_flush_cost(LEAVES_6MB, 16 << 20, 1, "fsdp")
+        # Two collective halves per bucket cost more than one.
+        assert sh > ar and fs > ar
+
+    def test_transparent_tuner_prunes_after_first_window(self,
+                                                         monkeypatch):
+        """AutotuneStep in model-guided mode: after the first sampling
+        window (whose trace noted the leaf layout), dominated candidates
+        vanish from the grid, the sweep finishes early, and the winner
+        comes from the kept set."""
+        import horovod_tpu as hvd
+        from horovod_tpu.autotune import AutotuneStep
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_MODEL_GUIDED", "1")
+        model = cm.get_model()
+        _seed(model)
+        model.note_leaf_sizes(LEAVES_6MB)
+
+        class _FakeJit:
+            cleared = 0
+
+            def __call__(self, x):
+                return x
+
+            def clear_cache(self):
+                self.cleared += 1
+
+        clock = {"now": 0.0}
+
+        def tick():
+            clock["now"] += 1.0
+            return clock["now"]
+
+        try:
+            # Grid ordered so the dominated 64 KiB candidate (24
+            # launches vs 1 on the 6 MiB wire) sits in the TAIL — the
+            # already-sampled first candidate is always kept by design.
+            tuner = AutotuneStep(
+                _FakeJit(), thresholds=(16 << 20, 64 * 1024, 1 << 20),
+                iters=1, clock=tick, segment_candidates=(1,))
+            assert len(tuner._cands) == 3
+            calls = 0
+            while tuner._hvd_tuning and calls < 50:
+                tuner(1.0)
+                calls += 1
+            assert (64 * 1024, 1) not in tuner._cands
+            assert len(tuner._cands) == 2
+            state = hvd.autotune.autotune_state()
+            assert (64 * 1024, 1) in state["pruned"]
+            # Only the kept candidates were ever sampled.
+            assert len(tuner._samples) == 2
+            assert hvd.autotune.tuned_threshold() in (1 << 20, 16 << 20)
+        finally:
+            hvd.autotune.set_tuned_threshold(None)
+            hvd.autotune.set_tuned_segments(None)
+            hvd.autotune._tuned["history"].clear()
+            hvd.autotune._tuned["pruned"].clear()
+
+    def test_tuner_grid_untouched_when_mode_off(self, monkeypatch):
+        from horovod_tpu.autotune import AutotuneStep
+
+        monkeypatch.delenv("HOROVOD_AUTOTUNE_MODEL_GUIDED",
+                           raising=False)
+        model = cm.get_model()
+        _seed(model)
+        model.note_leaf_sizes(LEAVES_6MB)
+
+        class _FakeJit:
+            def __call__(self, x):
+                return x
+
+            def clear_cache(self):
+                pass
+
+        import horovod_tpu as hvd
+
+        clock = {"now": 0.0}
+
+        def tick():
+            clock["now"] += 1.0
+            return clock["now"]
+
+        try:
+            tuner = AutotuneStep(
+                _FakeJit(), thresholds=(64 * 1024, 1 << 20, 16 << 20),
+                iters=1, clock=tick)
+            while tuner._hvd_tuning:
+                tuner(1.0)
+            assert len(tuner._samples) == 3  # full exhaustive sweep
+        finally:
+            hvd.autotune.set_tuned_threshold(None)
+            hvd.autotune._tuned["history"].clear()
+
+
+# ---------------------------------------------------------------------------
+# Scrape surface
+# ---------------------------------------------------------------------------
+
+
+class TestScrapeSurface:
+    def test_zero_cells_exist_before_any_fit(self):
+        hvd_metrics.reset_for_testing()
+        parsed = hvd_metrics.validate_prometheus_text(
+            hvd_metrics.render())
+        for name in ("hvd_link_bandwidth_bytes_per_second",
+                     "hvd_link_latency_seconds",
+                     "hvd_collective_efficiency_ratio",
+                     "hvd_comms_residual_seconds"):
+            assert parsed[name]["samples"], name
+
+    def test_fitted_model_exports_gauges(self):
+        model = cm.get_model()
+        _seed(model, alpha=1e-3, beta=2e-9)
+        parsed = hvd_metrics.validate_prometheus_text(
+            hvd_metrics.render())
+        samples = dict(
+            (tuple(sorted(l.items())), v)
+            for l, v in parsed["hvd_link_bandwidth_bytes_per_second"]
+            ["samples"])
+        key = tuple(sorted({"link_class": "ici", "op": "allreduce",
+                            "algorithm": "flat"}.items()))
+        assert samples[key] == pytest.approx(5e8, rel=1e-3)
+
+    def test_eager_dispatch_feeds_the_model(self, hvd):
+        """The real wire: every timed eager collective is an α–β sample
+        tagged (op, flat, link class of the set)."""
+        import numpy as np
+
+        n = hvd.size()
+        for elems in (64, 4096):
+            for _ in range(3):
+                hvd.allreduce(np.ones((n, elems), np.float32),
+                              op=hvd.Sum)
+        model = cm.get_model()
+        fit = model._fit_for("allreduce", "flat", "ici")
+        assert fit is not None and fit.count >= 6
+        assert model.payload()["status"] == "ok"

@@ -77,7 +77,8 @@ echo "== premerge gate 3/4: bench.py --smoke perf lane (8-dev CPU mesh, 2 steps/
 blog="$(mktemp "${TMPDIR:-/tmp}/_bench.XXXXXX.log")"
 msnap="$(mktemp "${TMPDIR:-/tmp}/_metrics.XXXXXX.json")"
 tsnap="$(mktemp "${TMPDIR:-/tmp}/_trace.XXXXXX.json")"
-trap 'rm -f "$t1log" "$blog" "$msnap" "$tsnap"' EXIT
+csnap="$(mktemp "${TMPDIR:-/tmp}/_comms.XXXXXX.json")"
+trap 'rm -f "$t1log" "$blog" "$msnap" "$tsnap" "$csnap"' EXIT
 # Scrape/timeline artifacts survive the run for build archiving.
 ARTIFACTS="${PREMERGE_ARTIFACTS:-${TMPDIR:-/tmp}/premerge-artifacts}"
 mkdir -p "$ARTIFACTS"
@@ -90,6 +91,7 @@ mkdir -p "$ARTIFACTS"
 if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     HOROVOD_METRICS_SNAPSHOT="$msnap" \
     HOROVOD_TRACE_SNAPSHOT="$tsnap" \
+    HOROVOD_COMMS_SNAPSHOT="$csnap" \
     python bench.py --smoke | tee "$blog"; then
     echo "premerge: bench smoke failed" >&2
     exit 1
@@ -151,15 +153,39 @@ if r_fsdp >= 0.40 * r_mono:
         f"{r_fsdp / r_mono:.1%} of monolithic (must be < 40%: the "
         f"params-sharded-at-rest contract; fsdp={r_fsdp}, "
         f"monolithic={r_mono})")
+comms = last.get("comms") or {}
+if not comms:
+    sys.exit("premerge comms lane: bench record has no 'comms' section")
+if not comms.get("within_tolerance"):
+    sys.exit(
+        "premerge comms lane: fitted alpha-beta model missed the observed "
+        f"per-bucket latencies (per-mode rel residuals "
+        f"{comms.get('per_mode_rel_residual')!r} vs tolerance "
+        f"{comms.get('fit_tolerance')!r})")
+if comms.get("autotune_pruned", 0) < 1:
+    sys.exit(
+        "premerge comms lane: model-guided autotune pruned no dominated "
+        f"candidate (grid {comms.get('autotune_grid')!r}, predicted "
+        f"{comms.get('autotune_predicted_s')!r})")
+if comms.get("autotune_winner_guided") != comms.get(
+        "autotune_winner_exhaustive"):
+    sys.exit(
+        "premerge comms lane: model-guided pruning changed the autotune "
+        f"winner (exhaustive={comms.get('autotune_winner_exhaustive')!r}, "
+        f"guided={comms.get('autotune_winner_guided')!r})")
 print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded}, "
       f"fsdp={fsdp}, resident fsdp/mono={r_fsdp / r_mono:.1%})")
+print(f"premerge comms lane: ok (pruned {comms['autotune_pruned']} of "
+      f"{len(comms.get('autotune_grid') or [])} candidates, winner "
+      f"{comms['autotune_winner_guided']!r} matches exhaustive; fit "
+      f"residuals {comms.get('per_mode_rel_residual')})")
 EOF
 then
     echo "premerge: perf lane failed" >&2
     exit 1
 fi
 
-echo "== premerge gate 4/4: /metrics scrape + /timeline merge lane =="
+echo "== premerge gate 4/4: /metrics scrape + /timeline + /comms merge lane =="
 # End-to-end over the REAL plumbing: the bench run's instrument snapshot
 # is published to a live RendezvousServer via the same heartbeat PUT
 # workers use, then scraped back over plain HTTP from GET /metrics; the
@@ -173,7 +199,7 @@ echo "== premerge gate 4/4: /metrics scrape + /timeline merge lane =="
 # any line flunks the strict Prometheus-text validator, or the core
 # instrument set (collective dispatch histograms, heartbeat gauge,
 # goodput counters) is absent.
-if ! JAX_PLATFORMS=cpu python - "$msnap" "$tsnap" "$ARTIFACTS" <<'EOF'
+if ! JAX_PLATFORMS=cpu python - "$msnap" "$tsnap" "$ARTIFACTS" "$csnap" <<'EOF'
 import copy
 import json
 import os
@@ -193,13 +219,24 @@ with open(sys.argv[2]) as f:
 if not isinstance(trace, dict) or not trace.get("steps"):
     sys.exit("premerge timeline lane: bench wrote an empty trace payload")
 artifacts = sys.argv[3]
+with open(sys.argv[4]) as f:
+    comms = json.load(f)
+if not isinstance(comms, dict) or comms.get("status") != "ok":
+    sys.exit("premerge comms lane: bench wrote no fitted comms payload "
+             f"(status={comms.get('status') if isinstance(comms, dict) else comms!r})")
 server = RendezvousServer(host="127.0.0.1")
 server.start()
 server.set_cluster_info(world_np=1)
 try:
     client = KVClient("127.0.0.1", server.port)
     client.put("heartbeat", socket.gethostname(), json.dumps(
-        {"rank": 0, "steps": 1, "commits": 0, "metrics": snap}).encode())
+        {"rank": 0, "steps": 1, "commits": 0, "metrics": snap,
+         "comms": dict(comms, rank="0", host="bench-r0")}).encode())
+    # A second rank's comms payload (relabeled) so GET /comms proves the
+    # cluster merge over the real heartbeat plumbing with >=2 ranks.
+    client.put("heartbeat", "bench-r1", json.dumps(
+        {"rank": 1, "steps": 1, "commits": 0,
+         "comms": dict(comms, rank="1", host="bench-r1")}).encode())
     # Publish the bench trace as rank 0, plus a relabeled copy as rank 1
     # whose wall clocks are shifted +5s with the matching measured
     # offset (-5s): after correction both ranks must land on one
@@ -239,6 +276,10 @@ try:
         "hvd_policy_spare_hosts",
         "hvd_driver_epoch",
         "hvd_driver_lost_total",
+        "hvd_link_bandwidth_bytes_per_second",
+        "hvd_link_latency_seconds",
+        "hvd_collective_efficiency_ratio",
+        "hvd_comms_residual_seconds",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -278,6 +319,26 @@ try:
         sys.exit(
             f"premerge timeline lane: expected >=2 rank tracks, got "
             f"pids={sorted(pids)}")
+    # Cluster-merged comms model over HTTP: >=2 rank payloads, fitted.
+    curl = f"http://127.0.0.1:{server.port}/comms"
+    with urllib.request.urlopen(curl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge comms lane: {curl} answered {r.status}")
+        cbody = r.read()
+    cmerged = json.loads(cbody)
+    if cmerged.get("status") != "ok":
+        sys.exit(
+            f"premerge comms lane: /comms status "
+            f"{cmerged.get('status')!r} (expected 'ok')")
+    crank_payloads = cmerged.get("ranks") or {}
+    if len(crank_payloads) < 2:
+        sys.exit(
+            f"premerge comms lane: expected >=2 rank payloads in the "
+            f"/comms merge, got {sorted(crank_payloads)}")
+    if not cmerged.get("cluster"):
+        sys.exit("premerge comms lane: /comms cluster aggregate is empty")
+    with open(os.path.join(artifacts, "comms.json"), "wb") as f:
+        f.write(cbody)
     with open(os.path.join(artifacts, "timeline.json"), "wb") as f:
         f.write(tbody)
     with open(os.path.join(artifacts, "metrics_snapshot.json"), "w") as f:
@@ -288,6 +349,9 @@ try:
           f"{dispatches:.0f} dispatches in the latency histogram)")
     print(f"premerge timeline lane: ok ({len(spans)} spans across "
           f"{len(pids)} rank tracks; archived to {artifacts})")
+    print(f"premerge comms lane: ok (/comms merged "
+          f"{len(crank_payloads)} rank payloads, "
+          f"{len(cmerged['cluster'])} cluster fit keys)")
 finally:
     server.stop()
 EOF
