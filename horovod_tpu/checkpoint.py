@@ -114,17 +114,24 @@ def atomic_read(path: str):
 
 
 def rotate_slots(store: MutableMapping, key: str, value,
-                 prev_suffix: str = ".prev") -> None:
+                 prev_suffix: str = ".prev", depth: int = 1) -> None:
     """The mapping flavor of :func:`atomic_install`: install ``value`` at
-    ``key``, retaining the previous value at ``<key><prev_suffix>``.
+    ``key``, retaining the previous value at ``<key><prev_suffix>`` (and,
+    for ``depth`` > 1, older ones at ``<key><prev_suffix*2>``, …).
 
     Callers hold whatever lock guards ``store``; the rotation itself is
-    two plain assignments, so there is never a state with the current slot
-    empty. Used by the peer-replica pool (:mod:`horovod_tpu.peercheck`)
-    and the KV server's ``peerstate`` scope so both sides of the
-    replication plane rotate identically."""
-    if key in store:
-        store[f"{key}{prev_suffix}"] = store[key]
+    plain assignments oldest-first, so there is never a state with the
+    current slot empty. Used by the peer-replica pool
+    (:mod:`horovod_tpu.peercheck`) and the KV server's ``peerstate``
+    scope so both sides of the replication plane rotate identically.
+    ``depth`` 1 is the historical two-slot behavior; the integrity plane
+    deepens to 2 because its quarantine can condemn up to one full
+    commit of detection latency — the clean fall-back commit must
+    survive one extra rotation."""
+    for i in range(max(1, depth), 0, -1):
+        src = key + prev_suffix * (i - 1)
+        if src in store:
+            store[key + prev_suffix * i] = store[src]
     store[key] = value
 
 

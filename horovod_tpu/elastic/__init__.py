@@ -5,3 +5,7 @@ from .state import (  # noqa: F401
     TpuState,
 )
 from .runner import run  # noqa: F401
+from ..integrity import (  # noqa: F401
+    consume_skip_ahead,
+    observe_loss,
+)

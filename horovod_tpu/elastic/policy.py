@@ -112,6 +112,15 @@ class PolicyController:
         # 0 disables the channel.
         self.comms_residual_s = get_float(
             "HOROVOD_POLICY_COMMS_RESIDUAL", 0.0)
+        # Integrity-strikes channel (the fourth evidence source): a host
+        # the cross-rank voting plane has named divergent this many
+        # times is condemned outright — and, uniquely, BYPASSES the SLO
+        # gate when drained (corruption is a correctness problem; no
+        # goodput arithmetic makes keeping a corrupting host worthwhile).
+        # 0 disables the channel (the driver's direct
+        # HOROVOD_INTEGRITY_ACTION=drain path is then the only actuator).
+        self.integrity_strikes = int(get_float(
+            "HOROVOD_POLICY_INTEGRITY_STRIKES", 0.0))
         self.interval_s = get_float("HOROVOD_POLICY_INTERVAL", 5.0)
         self.horizon_s = get_float("HOROVOD_POLICY_HORIZON", 600.0)
         self.realize_window_s = get_float(
@@ -127,6 +136,7 @@ class PolicyController:
         self._ewma: dict[str, float] = {}
         self._hb_ewma: dict[str, float] = {}
         self._res_ewma: dict[str, float] = {}
+        self._integrity: dict[str, int] = {}
         self._above_since: dict[str, float] = {}
         self._last_observe_t: float | None = None
         self._last_worst: dict | None = None
@@ -140,6 +150,15 @@ class PolicyController:
     def enabled(self) -> bool:
         return self.target is not None
 
+    @property
+    def armed(self) -> bool:
+        """Whether :meth:`decide` can produce ANY decision: the goodput
+        SLO channel (``HOROVOD_TARGET_GOODPUT``) or the integrity-strikes
+        channel (``HOROVOD_POLICY_INTEGRITY_STRIKES``) — the latter is a
+        correctness channel and must not require a throughput SLO to be
+        configured before a corrupting host can be drained."""
+        return self.enabled or self.integrity_strikes > 0
+
     # -- sensor intake -------------------------------------------------------
 
     def note_rate(self, rate: float | None) -> None:
@@ -150,6 +169,18 @@ class PolicyController:
             return
         with self._lock:
             self._rate_samples.append((self._clock(), float(rate)))
+
+    def note_integrity(self, host: str) -> None:
+        """One integrity-divergence strike against ``host`` (the driver
+        calls this on every vote that names it). Accumulates for the
+        life of the host's membership — a corrupting host does not earn
+        forgiveness by corrupting slowly."""
+        with self._lock:
+            self._integrity[host] = self._integrity.get(host, 0) + 1
+
+    def integrity_strike_count(self, host: str) -> int:
+        with self._lock:
+            return self._integrity.get(host, 0)
 
     def note_resize_cost(self, seconds: float) -> None:
         """The driver measured one reconfiguration (abort → publish →
@@ -210,7 +241,7 @@ class PolicyController:
             if scores:
                 self._last_worst = skew.get("worst")
             for state in (self._ewma, self._hb_ewma, self._res_ewma,
-                          self._above_since):
+                          self._integrity, self._above_since):
                 for host in [h for h in state if h not in world]:
                     del state[host]
             residuals = dict(comms_residuals or {})
@@ -282,6 +313,7 @@ class PolicyController:
                              for h, v in self._res_ewma.items()},
                 "above_ages": {h: max(now - t, 0.0)
                                for h, t in self._above_since.items()},
+                "integrity_strikes": dict(self._integrity),
                 "resize_cost": self._resize_cost_ewma,
             }
 
@@ -304,6 +336,13 @@ class PolicyController:
                             target[str(h)] = float(v)
                         except (TypeError, ValueError):
                             continue
+            strikes = state.get("integrity_strikes")
+            if isinstance(strikes, Mapping):
+                for h, n in strikes.items():
+                    try:
+                        self._integrity[str(h)] = int(n)
+                    except (TypeError, ValueError):
+                        continue
             ages = state.get("above_ages")
             if isinstance(ages, Mapping):
                 for h, age in ages.items():
@@ -334,7 +373,7 @@ class PolicyController:
         sustained evidence, replacement availability, and SLO math all
         say a proactive drain pays for its re-rendezvous. Returns None
         (hold) otherwise. Fires the ``policy.decide`` fault point."""
-        if not self.enabled:
+        if not self.armed:
             return None
         if faults.fire(faults.POLICY_DECIDE):
             return None  # injected drop: this evaluation never happened
@@ -345,6 +384,28 @@ class PolicyController:
             if (self._last_action_t is not None
                     and now - self._last_action_t < self.cooldown_s):
                 return None
+            # Integrity-strikes channel: a host the voting plane has
+            # named divergent >= the strike threshold is drained on
+            # bitwise evidence — no sustained window (the strikes ARE
+            # the confirmations) and no SLO gate (correctness beats
+            # throughput arithmetic). Replacement availability still
+            # applies below.
+            integrity_hosts = []
+            if self.integrity_strikes > 0:
+                # Strikes live for the host's MEMBERSHIP: prune departed
+                # hosts here too, because in strikes-only arming (no
+                # goodput SLO) observe() — the usual pruning site —
+                # never runs, and a drained host re-entering through the
+                # spare tier must not be instantly re-drained on strikes
+                # from its previous membership.
+                world = set(world_hosts)
+                for h in [h for h in self._integrity if h not in world]:
+                    del self._integrity[h]
+                integrity_hosts = sorted(
+                    ((n, h) for h in world_hosts
+                     if (n := self._integrity.get(h, 0))
+                     >= self.integrity_strikes),
+                    reverse=True)
             # A host's effective score is the larger of its two evidence
             # channels: mean collective lateness, or heartbeat-age excess
             # past the drift threshold (lateness the collectives will see
@@ -369,6 +430,25 @@ class PolicyController:
             hb_snapshot = dict(self._hb_ewma)
             res_snapshot = dict(self._res_ewma)
             above = {h: now - t for h, t in self._above_since.items()}
+        if integrity_hosts:
+            strikes, host = integrity_hosts[0]
+            if spares_ready <= 0 and len(world_hosts) - 1 < self._min_np:
+                return None  # nobody to backfill: hold (fences still up)
+            return PolicyDecision(
+                action="drain", host=host,
+                reason=(f"integrity divergence: {strikes} strike(s) >= "
+                        f"HOROVOD_POLICY_INTEGRITY_STRIKES="
+                        f"{self.integrity_strikes}"),
+                evidence={
+                    "integrity_strikes": {h: n for n, h in integrity_hosts},
+                    "straggler_ewma_s": {h: round(v, 6)
+                                         for h, v in ewma_snapshot.items()},
+                },
+                predicted={"integrity_strikes": strikes,
+                           "slo_bypassed": True},
+                t_decided=now)
+        if not self.enabled:
+            return None  # strikes-only arming: no SLO channel to evaluate
         if not candidates:
             return None
         score, host = max(candidates)

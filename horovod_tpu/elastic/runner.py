@@ -29,6 +29,7 @@ from .. import metrics as _metrics
 from ..exceptions import (
     HorovodInternalError,
     HostsUpdatedInterrupt,
+    LossSpikeError,
     RecoveryExhaustedError,
     RemovedFromWorldError,
 )
@@ -109,6 +110,18 @@ def run(func):
        (the orbax/pickle checkpoint layer) when registered, else rung 1
        again.
 
+    A :class:`~horovod_tpu.exceptions.LossSpikeError` (the
+    ``HOROVOD_LOSS_SPIKE_SIGMA`` detector, raised by
+    ``integrity.observe_loss``) takes a dedicated path: a **storage-free
+    rewind** to the last commit — the local snapshot, completed through
+    the peer rung when the state's commits are shard-local — that never
+    climbs the ladder, journals a ``rewind`` event, counts
+    ``hvd_rewinds_total{reason="loss_spike"}``, and is bounded by its
+    own ``HOROVOD_REWIND_MAX`` storm breaker (past the cap, spikes ride
+    the normal ladder). The training loop should consume
+    ``integrity.consume_skip_ahead()`` after a rewind so the poison
+    batch does not replay.
+
     A **storm breaker** caps the ladder: after
     ``HOROVOD_RECOVERY_MAX_ATTEMPTS`` consecutive no-progress failures
     (default 10; 0 disables) the loop raises
@@ -151,6 +164,7 @@ def run(func):
         max_recovery = get_int("HOROVOD_RECOVERY_MAX_ATTEMPTS", 10)
         recovery_backoff_max = get_float("HOROVOD_RECOVERY_BACKOFF_MAX", 5.0)
         consecutive_failures = 0
+        consecutive_rewinds = 0
         commits_before_attempt = 0
         goodput = _metrics.goodput()
         _metrics.event("elastic_run_start")
@@ -265,9 +279,11 @@ def run(func):
                         "rendezvous", time.perf_counter() - t_attempt)
                 # Progress (a commit landed inside the attempt) resets the
                 # storm breaker: distinct one-off failures across a long
-                # job are routine churn, not a livelock.
+                # job are routine churn, not a livelock. The rewind storm
+                # breaker resets on the same evidence.
                 if _counters.commits > commits_before_attempt:
                     consecutive_failures = 0
+                    consecutive_rewinds = 0
                 consecutive_failures += 1
                 # Re-baseline NOW, not only at the next post-sync snapshot:
                 # a failure raised before that snapshot (sync itself
@@ -280,98 +296,176 @@ def run(func):
                 # a clean slate (a re-abort in the NEW world re-arms both).
                 abort.consume()
                 stall.get_inspector().failed = False
-                if max_recovery > 0 and consecutive_failures >= max_recovery:
-                    log.error(
-                        "elastic: %d consecutive recovery attempts with no "
-                        "progress (HOROVOD_RECOVERY_MAX_ATTEMPTS=%d); "
-                        "giving up", consecutive_failures, max_recovery,
-                    )
-                    _metrics.event(
-                        "recovery_exhausted", generation=_generation(),
-                        failures=consecutive_failures, error=str(e)[:300])
-                    raise RecoveryExhaustedError(
-                        f"{consecutive_failures} consecutive recovery "
-                        f"attempts failed with no progress (last: {e})"
-                    ) from e
-                rung_n = min(consecutive_failures, 4)
-                if rung_n == 2 and getattr(
-                        state, "peer_restore_pending", lambda: False)():
-                    # The state reports its local snapshot cannot re-form
-                    # the world (shard-local commit after a peer death):
-                    # rung 2's rank-0 sync cannot help either — escalate
-                    # straight to the peer rung.
-                    rung_n = 3
-                if rung_n == 3 and not getattr(
-                        state, "peer_restore_armed", lambda: False)():
-                    rung_n = 4  # no replica plane: the durable rung is next
-                rung = _RUNGS[rung_n]
-                _metrics.RECOVERIES.inc(rung=rung)
-                _metrics.event(
-                    "recovery", generation=_generation(), rung=rung,
-                    failures=consecutive_failures, error=str(e)[:300])
-                t_restore = time.perf_counter()
-                if rung == "restore":
-                    log.warning(
-                        "elastic: internal failure (%s); restoring last "
-                        "commit (recovery rung 'restore')", e)
-                    if basics.is_initialized():
-                        state.restore()
-                elif rung == "rendezvous":
-                    log.warning(
-                        "elastic: internal failure (%s); escalating to full "
-                        "re-rendezvous + sync from rank 0, skipping local "
-                        "restore (recovery rung 'rendezvous')", e)
-                else:
-                    restored = False
-                    if rung == "peer":
-                        log.warning(
-                            "elastic: internal failure (%s); escalating to "
-                            "peer-replica restore (recovery rung 'peer')", e)
-                        try:
-                            restored = state.restore_peer()
-                        except Exception as pe:  # noqa: BLE001
-                            log.error(
-                                "elastic: peer-replica restore failed (%s); "
-                                "falling through to the durable rung", pe)
-                        if restored:
-                            # Every storage-free recovery leaves the same
-                            # postmortem the durable path would: the
-                            # flight record of this rank's last K steps,
-                            # replica-pool state included.
-                            from .. import tracing
+                # Storage-free rewind-on-spike: a LossSpikeError is a
+                # VOLUNTARY rollback — the world did not fail, the DATA
+                # did. Rewind to the last commit (completed through the
+                # peer rung when the state's commits are shard-local)
+                # without climbing the escalation ladder, bounded by the
+                # HOROVOD_REWIND_MAX storm breaker (a commit landing
+                # resets it; past the cap a spike rides the normal
+                # ladder like any failure).
+                handled_rewind = False
+                rewind_cap = None
+                if isinstance(e, LossSpikeError):
+                    from .. import integrity
 
-                            tracing.dump_flight_record(
-                                "peer_restore", generation=_generation())
-                        else:
-                            _metrics.event(
-                                "peer_fallback", generation=_generation())
-                            _metrics.RECOVERIES.inc(rung="durable")
-                            rung = "durable"
-                    if rung == "durable" and not restored:
+                    rewind_cap = integrity.rewind_max()
+                if (rewind_cap is not None
+                        and (rewind_cap <= 0
+                             or consecutive_rewinds < rewind_cap)):
+                    from .. import integrity, tracing
+
+                    consecutive_rewinds += 1
+                    # Voluntary: not a ladder step, not storm evidence.
+                    consecutive_failures -= 1
+                    log.warning(
+                        "elastic: %s — storage-free rewind to the last "
+                        "commit (%d consecutive; "
+                        "HOROVOD_REWIND_MAX=%d)",
+                        e, consecutive_rewinds, rewind_cap)
+                    t_restore = time.perf_counter()
+                    rewound = True
+                    try:
+                        if basics.is_initialized():
+                            state.restore()
+                        if getattr(state, "peer_restore_pending",
+                                   lambda: False)():
+                            # Shard-local snapshot: the peer rung is the
+                            # storage-free completion of this rewind.
+                            rewound = bool(state.restore_peer())
+                    except Exception as pe:  # noqa: BLE001
+                        log.error(
+                            "elastic: spike rewind could not restore "
+                            "(%s); falling through to the recovery "
+                            "ladder", pe)
+                        rewound = False
+                    goodput.add_lost(
+                        "restore", time.perf_counter() - t_restore)
+                    if rewound:
+                        handled_rewind = True
+                        integrity.record_rewind(
+                            "loss_spike", generation=_generation(),
+                            consecutive=consecutive_rewinds,
+                            detail=str(e))
+                        tracing.dump_flight_record(
+                            "rewind", generation=_generation())
+                    else:
+                        consecutive_failures += 1  # ladder after all
+                elif rewind_cap is not None:
+                    log.error(
+                        "elastic: loss-spike rewind storm breaker "
+                        "tripped (%d consecutive rewinds with no "
+                        "commit; HOROVOD_REWIND_MAX=%d) — escalating "
+                        "through the normal recovery ladder",
+                        consecutive_rewinds, rewind_cap)
+                    _metrics.event(
+                        "rewind_storm", generation=_generation(),
+                        consecutive=consecutive_rewinds)
+                if not handled_rewind:
+                    if (max_recovery > 0
+                            and consecutive_failures >= max_recovery):
+                        log.error(
+                            "elastic: %d consecutive recovery attempts "
+                            "with no progress "
+                            "(HOROVOD_RECOVERY_MAX_ATTEMPTS=%d); "
+                            "giving up", consecutive_failures,
+                            max_recovery,
+                        )
+                        _metrics.event(
+                            "recovery_exhausted",
+                            generation=_generation(),
+                            failures=consecutive_failures,
+                            error=str(e)[:300])
+                        raise RecoveryExhaustedError(
+                            f"{consecutive_failures} consecutive recovery "
+                            f"attempts failed with no progress (last: {e})"
+                        ) from e
+                    rung_n = min(consecutive_failures, 4)
+                    if rung_n == 2 and getattr(
+                            state, "peer_restore_pending", lambda: False)():
+                        # The state reports its local snapshot cannot
+                        # re-form the world (shard-local commit after a
+                        # peer death): rung 2's rank-0 sync cannot help
+                        # either — escalate straight to the peer rung.
+                        rung_n = 3
+                    if rung_n == 3 and not getattr(
+                            state, "peer_restore_armed", lambda: False)():
+                        rung_n = 4  # no replica plane: durable is next
+                    rung = _RUNGS[rung_n]
+                    _metrics.RECOVERIES.inc(rung=rung)
+                    _metrics.event(
+                        "recovery", generation=_generation(), rung=rung,
+                        failures=consecutive_failures, error=str(e)[:300])
+                    t_restore = time.perf_counter()
+                    if rung == "restore":
                         log.warning(
-                            "elastic: internal failure (%s); escalating to "
-                            "durable checkpoint restore (recovery rung "
-                            "'durable')", e)
-                        try:
-                            restored = state.restore_durable()
-                        except Exception as ce:  # noqa: BLE001
-                            log.error(
-                                "elastic: durable restore failed (%s); "
-                                "falling back to the in-memory commit", ce)
-                        if not restored:
-                            _metrics.event(
-                                "checkpoint_fallback",
-                                generation=_generation(),
-                                durable_restored=False)
-                            if basics.is_initialized():
-                                state.restore()
-                        else:
-                            _metrics.event(
-                                "checkpoint_fallback",
-                                generation=_generation(),
-                                durable_restored=True)
-                goodput.add_lost(
-                    "restore", time.perf_counter() - t_restore)
+                            "elastic: internal failure (%s); restoring "
+                            "last commit (recovery rung 'restore')", e)
+                        if basics.is_initialized():
+                            state.restore()
+                    elif rung == "rendezvous":
+                        log.warning(
+                            "elastic: internal failure (%s); escalating "
+                            "to full re-rendezvous + sync from rank 0, "
+                            "skipping local restore (recovery rung "
+                            "'rendezvous')", e)
+                    else:
+                        restored = False
+                        if rung == "peer":
+                            log.warning(
+                                "elastic: internal failure (%s); "
+                                "escalating to peer-replica restore "
+                                "(recovery rung 'peer')", e)
+                            try:
+                                restored = state.restore_peer()
+                            except Exception as pe:  # noqa: BLE001
+                                log.error(
+                                    "elastic: peer-replica restore "
+                                    "failed (%s); falling through to "
+                                    "the durable rung", pe)
+                            if restored:
+                                # Every storage-free recovery leaves the
+                                # same postmortem the durable path
+                                # would: the flight record of this
+                                # rank's last K steps, replica-pool
+                                # state included.
+                                from .. import tracing
+
+                                tracing.dump_flight_record(
+                                    "peer_restore",
+                                    generation=_generation())
+                            else:
+                                _metrics.event(
+                                    "peer_fallback",
+                                    generation=_generation())
+                                _metrics.RECOVERIES.inc(rung="durable")
+                                rung = "durable"
+                        if rung == "durable" and not restored:
+                            log.warning(
+                                "elastic: internal failure (%s); "
+                                "escalating to durable checkpoint "
+                                "restore (recovery rung 'durable')", e)
+                            try:
+                                restored = state.restore_durable()
+                            except Exception as ce:  # noqa: BLE001
+                                log.error(
+                                    "elastic: durable restore failed "
+                                    "(%s); falling back to the "
+                                    "in-memory commit", ce)
+                            if not restored:
+                                _metrics.event(
+                                    "checkpoint_fallback",
+                                    generation=_generation(),
+                                    durable_restored=False)
+                                if basics.is_initialized():
+                                    state.restore()
+                            else:
+                                _metrics.event(
+                                    "checkpoint_fallback",
+                                    generation=_generation(),
+                                    durable_restored=True)
+                    goodput.add_lost(
+                        "restore", time.perf_counter() - t_restore)
                 skip_sync = False
                 t_backoff = time.perf_counter()
                 time.sleep(min(

@@ -254,12 +254,56 @@ class TpuState(State):
         n = self._state_world_size()
         return n is not None and n != basics.size()
 
+    def _integrity_precommit(self) -> None:
+        """Defense-plane commit prologue (inert with every knob unset):
+        an abort armed while any abort-posting defense is live means the
+        state reaching this commit may already be condemned (voted
+        divergent, non-finite, or spiked) — raising HERE, before the
+        snapshot rotates, keeps the last-good snapshot/replica group
+        intact for the rewind instead of burning the rotation on a
+        poisoned commit. Gating on the voting knob alone would let a
+        commit racing a nonfinite/spike abort overwrite the very state
+        the ladder is about to restore. The SIGTERM drain still wins (a
+        draining worker must reach its clean EXIT_REMOVED)."""
+        from .. import abort, integrity
+        from ..ops import fusion
+        from .runner import drain_requested
+
+        armed = (integrity.enabled()
+                 or fusion.nonfinite_action() is not None
+                 or integrity.loss_spike_sigma() is not None)
+        if armed and not drain_requested():
+            abort.raise_if_aborted()
+
+    def _integrity_fingerprint(self, step: int, shard=None) -> None:
+        """Fingerprint the committed snapshot for the cross-rank voting
+        plane (every HOROVOD_INTEGRITY_INTERVAL commits; inert unarmed).
+        The digest covers what the sync contract replicates bitwise —
+        everything under allreduce, params under the ZeRO-1 sharded
+        mode; fsdp rows verify per-shard only."""
+        from .. import integrity
+
+        if not integrity.enabled() or self._saved is None:
+            return
+        mode = "allreduce"
+        if self._sharded_spec is not None:
+            mode = getattr(self._sharded_spec, "sync_mode", "sharded")
+        integrity.maybe_fingerprint(
+            self._saved.get("params"), self._saved.get("opt_state"),
+            step, sync_mode=mode, shard=shard)
+
     def commit(self) -> None:
+        from .. import integrity
+
+        self._integrity_precommit()
+        self._commit_count = getattr(self, "_commit_count", 0) + 1
         self._saved = {
             "params": _to_host(self.params),
             "opt_state": _to_host(self.opt_state),
             **{k: getattr(self, k) for k in self._extras},
         }
+        self._saved = integrity.maybe_corrupt_snapshot(self._saved)
+        self._integrity_fingerprint(self._commit_count)
         self.check_host_updates()
 
     def restore(self) -> None:
@@ -322,7 +366,31 @@ class TpuState(State):
         extras = broadcast_object({k: getattr(self, k) for k in self._extras})
         for k, v in extras.items():
             setattr(self, k, v)
+        self._sync_commit_counter()
         self.commit()
+
+    def _sync_commit_counter(self) -> None:
+        """Re-align the commit counter across the re-formed world (the
+        monolithic mirror of PeerShardedState's replica baseline):
+        integrity fingerprints group-match by (generation, step), so a
+        replacement rank's fresh counter would diverge from the
+        survivors' forever — silently disarming the voting plane after
+        the first membership change. Rank 0's counter wins for
+        everyone (rank-identical even when rank 0 IS the replacement:
+        the steps restart together and the bumped generation keeps the
+        new groups sorting newest). Only the voting plane reads this
+        counter, so the broadcast is gated on its knob — with the
+        plane unarmed, sync()'s collective schedule stays bit-for-bit
+        HEAD (the inertness contract; the env is job-wide, so the gate
+        is rank-identical). PeerShardedState overrides this to a no-op:
+        that flavor fingerprints by ``_commit_seq``, which its own
+        sync() already broadcasts unconditionally (it also keys
+        replica-group assembly)."""
+        from .. import integrity
+
+        if integrity.enabled():
+            self._commit_count = int(broadcast_object(
+                getattr(self, "_commit_count", 0)))
 
     def _looks_sharded(self) -> bool:
         """Distinguish the stacked sharded layout from a monolithic one
@@ -475,6 +543,9 @@ class PeerShardedState(TpuState):
     def commit(self) -> None:
         import pickle
 
+        from .. import integrity
+
+        self._integrity_precommit()
         self._commit_seq += 1
         r, n = self._rank_world()
         row, layout = self._own_row(r)
@@ -490,6 +561,15 @@ class PeerShardedState(TpuState):
             "world": n,
             **{k: getattr(self, k) for k in self._extras},
         }
+        # SDC injection point: grad.corrupt mutates the committed
+        # snapshot — fingerprint AND replica both see the corruption
+        # (self-consistent digests, detectable only by cross-rank vote).
+        self._saved = integrity.maybe_corrupt_snapshot(self._saved)
+        row = self._saved["row"]
+        param_row = self._saved["param_row"]
+        self._integrity_fingerprint(
+            self._commit_seq,
+            shard=(row, param_row) if param_row is not None else row)
         payload = pickle.dumps({
             "row": row,
             "layout": layout,
@@ -501,7 +581,7 @@ class PeerShardedState(TpuState):
             # metadata), keeping the whole commit ~1/n.
             "params": (self._saved["params"]
                        if r == 0 and param_layout == "full" else None),
-            "param_row": self._saved["param_row"],
+            "param_row": param_row,
             "param_layout": param_layout,
             "param_meta": param_meta,
         })
@@ -568,13 +648,28 @@ class PeerShardedState(TpuState):
         # would otherwise diverge from the survivors' forever — silently
         # disabling the peer rung after the first membership change. The
         # baseline reads PRIOR generations only (frozen by the server's
-        # fence), so every rank of the new generation computes the same
-        # value regardless of how formation interleaves with commits.
+        # fence) — but max() with the LOCAL counter is not rank-identical
+        # on its own: a survivor whose final pre-abort commit never
+        # landed in the pool (the replica PUT raced the abort or the
+        # fence) counts one ahead of the baseline the replacements
+        # computed, and from then on the two ranks label the same
+        # training step with different counters — replica groups never
+        # complete and the integrity vote compares DIFFERENT commits
+        # under the same (generation, step) key, condemning a healthy
+        # rank by drift. Rank 0's value wins for everyone: rank-identity
+        # is the contract, and the bumped generation keeps the re-formed
+        # world's groups distinct from any same-numbered old ones.
         self._commit_seq = max(
             self._commit_seq,
             self._replicator.latest_step(
                 before_generation=self._replicator.generation()))
+        self._commit_seq = int(broadcast_object(self._commit_seq))
         super().sync()
+
+    def _sync_commit_counter(self) -> None:
+        """No-op: this flavor fingerprints by ``_commit_seq``, already
+        world-aligned in :meth:`sync` — the base counter broadcast would
+        be a dead collective here."""
 
     def install_full(self, params, opt_state, **extras) -> None:
         """Install an externally restored FULL state — the durable rung's

@@ -25,6 +25,21 @@ class HorovodInternalError(HorovodTpuError):
     """
 
 
+class LossSpikeError(HorovodInternalError):
+    """The loss-spike detector tripped (``HOROVOD_LOSS_SPIKE_SIGMA``).
+
+    Raised by :func:`horovod_tpu.integrity.observe_loss` when the
+    training loss jumps more than the configured sigma above its EWMA
+    trend (or goes non-finite). Subclasses ``HorovodInternalError`` so
+    every existing recovery path treats it as a failure; the elastic
+    loop additionally special-cases it as a **storage-free rewind** —
+    restore the last commit (completed through the peer rung when the
+    state's commits are shard-local), count/journal the rewind, and
+    continue with a skip-ahead so the poison batch does not replay —
+    bounded by the ``HOROVOD_REWIND_MAX`` storm breaker.
+    """
+
+
 class RecoveryExhaustedError(HorovodTpuError):
     """The elastic recovery storm breaker tripped.
 

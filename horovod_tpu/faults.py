@@ -34,6 +34,16 @@ kill workers by behavior flag). This module generalizes that into named
   handles; firing (drop semantics) closes the connection without
   answering — to the client that is a transport failure, exactly a
   driver mid-crash
+- ``grad.corrupt``       — every elastic state commit's host snapshot;
+  the ``corrupt[:nbits]`` mode flips seeded bits in the committed state
+  bytes — a host whose memory/FPU silently computed wrong answers (SDC),
+  the canonical injector the integrity voting plane
+  (``horovod_tpu/integrity.py``) exists to catch
+- ``peer.corrupt``       — every peer-replica wire blob after encoding;
+  ``corrupt`` flips bits in the ENCODED record (header digest already
+  computed), modeling a bit-flip on the wire — the KV server's
+  install-time verification must reject it (422) with the previous good
+  replica intact
 
 The canonical **control-plane injectors** are these three plus
 :func:`kill_driver` (SIGKILL the driver process — the KV server dies
@@ -66,7 +76,14 @@ Env (reaches subprocess workers; parsed lazily on first ``fire``)::
 
 Spec grammar: ``point=mode[:arg]@N[xC]`` — arm on the Nth hit (1-based,
 default 1) for C consecutive hits (default 1); ``arg`` is seconds for
-``delay``/``hang``. Points are cheap no-ops when nothing is armed.
+``delay``/``hang``, or the bit-flip count for ``corrupt`` (default 64).
+Points are cheap no-ops when nothing is armed.
+
+The ``corrupt`` mode only acts at call sites that pass payload bytes
+through :func:`corrupt_payload` (the SDC injectors ``grad.corrupt`` /
+``peer.corrupt``); at a plain :func:`fire` site it is a no-op. The flips
+are seeded from the point name and hit index, so the same spec mutates
+the same bits every run — chaos tests assert exact trajectories.
 
 Process-level helpers (``suspend``/``resume``/``kill_process``) wrap the
 signals subprocess chaos tests need: SIGSTOP simulates the hung-but-alive
@@ -105,10 +122,18 @@ KV_SERVE = "kv.serve"
 # the injector behind the hvd_comms_residual_seconds chaos tests);
 # ``drop`` loses the sample, never the op.
 COMMS_LINK = "comms.link"
+# Silent-data-corruption injectors (the integrity defense plane's chaos
+# points): grad.corrupt mutates a rank's committed state snapshot
+# (self-consistent digests — only cross-rank voting can see it);
+# peer.corrupt mutates the encoded replica wire blob (digest mismatch —
+# the server's install gate must reject it).
+GRAD_CORRUPT = "grad.corrupt"
+PEER_CORRUPT = "peer.corrupt"
 
-_MODES = ("drop", "delay", "raise", "hang")
+_MODES = ("drop", "delay", "raise", "hang", "corrupt")
 _DEFAULT_HANG_S = 3600.0
 _DEFAULT_DELAY_S = 0.1
+_DEFAULT_CORRUPT_BITS = 64
 
 
 class InjectedFault(OSError):
@@ -218,31 +243,108 @@ class _Registry:
             self._load_env_locked()
             return dict(self._specs)
 
-    def fire(self, point: str) -> bool:
-        """One hit at an injection point.
+    def armed(self, point: str) -> bool:
+        """Cheap armed-at-all check (any mode, any window) — call sites
+        whose payload plumbing has a real cost (serializing state bytes
+        for ``corrupt_payload``) gate on this so the unarmed path stays
+        free. Does NOT count a hit."""
+        with self._lock:
+            self._load_env_locked()
+            return point in self._specs
 
-        Returns True when the caller must DROP the operation (skip it with
-        that call site's drop semantics), False to proceed. ``delay``/
-        ``hang`` sleep here then proceed; ``raise`` raises InjectedFault.
-        """
+    def _take_hit(self, point: str) -> tuple[FaultSpec | None, int]:
+        """Count one hit; return (armed spec or None, hit index)."""
         with self._lock:
             self._load_env_locked()
             hit = self._hits.get(point, 0) + 1
             self._hits[point] = hit  # counted even unarmed: tests assert
             spec = self._specs.get(point)  # exact attempt trajectories
             if spec is None or not spec.armed_for(hit):
-                return False
+                return None, hit
             self._fired[point] = self._fired.get(point, 0) + 1
+            return spec, hit
+
+    def fire(self, point: str) -> bool:
+        """One hit at an injection point.
+
+        Returns True when the caller must DROP the operation (skip it with
+        that call site's drop semantics), False to proceed. ``delay``/
+        ``hang`` sleep here then proceed; ``raise`` raises InjectedFault;
+        ``corrupt`` is a no-op here (it only acts through
+        :func:`corrupt_payload`).
+        """
+        spec, hit = self._take_hit(point)
+        if spec is None:
+            return False
         # Actions run OUTSIDE the lock (sleeps must not serialize peers).
         if spec.mode == "drop":
             return True
+        if spec.mode == "corrupt":
+            return False  # acts only through corrupt_payload
+        self._side_action(spec, point, hit)
+        return False
+
+    @staticmethod
+    def _side_action(spec: FaultSpec, point: str, hit: int) -> None:
+        """The delay/hang/raise action shared by :func:`fire` and
+        :func:`corrupt_payload` (one dispatch so the two injection
+        surfaces cannot drift apart); other modes are a no-op here."""
         if spec.mode == "delay":
             time.sleep(spec.arg if spec.arg is not None else _DEFAULT_DELAY_S)
-            return False
-        if spec.mode == "hang":
+        elif spec.mode == "hang":
             time.sleep(spec.arg if spec.arg is not None else _DEFAULT_HANG_S)
-            return False
-        raise InjectedFault(f"injected fault at {point!r} (hit {hit})")
+        elif spec.mode == "raise":
+            raise InjectedFault(f"injected fault at {point!r} (hit {hit})")
+
+    def corrupt_payload(self, point: str, data: bytes) -> bytes:
+        """One hit at a payload-mutating injection point.
+
+        With a ``corrupt`` spec armed for this hit, returns ``data`` with
+        ``arg`` (default 64) bit flips at positions seeded from the point
+        name and hit index — deterministic by construction. Other armed
+        modes keep their :func:`fire` semantics (``raise`` raises,
+        ``delay``/``hang`` sleep, ``drop`` is a no-op — there is nothing
+        to drop, the caller keeps its payload). Unarmed: ``data`` back
+        untouched."""
+        spec, hit = self._take_hit(point)
+        if spec is None:
+            return data
+        if spec.mode != "corrupt":
+            self._side_action(spec, point, hit)
+            return data
+        return flip_bits(
+            data,
+            nbits=(int(spec.arg) if spec.arg is not None
+                   else _DEFAULT_CORRUPT_BITS),
+            seed=f"{point}#{hit}")
+
+
+def flip_bits(data: bytes, nbits: int, seed: str) -> bytes:
+    """Flip ``nbits`` deterministically seeded bit positions of ``data``
+    (with replacement — an even number of hits on one bit cancels, like
+    real upsets). Pure stdlib: positions come from sha256 of the seed,
+    extended counter-mode, so the same (payload length, nbits, seed)
+    flips the same bits on every run and every host."""
+    import hashlib
+
+    if not data or nbits <= 0:
+        return data
+    buf = bytearray(data)
+    total_bits = len(buf) * 8
+    stream = b""
+    counter = 0
+    positions: list[int] = []
+    while len(positions) < nbits:
+        if len(stream) < 8:
+            stream += hashlib.sha256(
+                f"{seed}:{counter}".encode()).digest()
+            counter += 1
+        pos = int.from_bytes(stream[:8], "big") % total_bits
+        stream = stream[8:]
+        positions.append(pos)
+    for pos in positions:
+        buf[pos // 8] ^= 1 << (pos % 8)
+    return bytes(buf)
 
 
 _registry = _Registry()
@@ -255,6 +357,8 @@ hits = _registry.hits
 fired = _registry.fired
 active = _registry.active
 fire = _registry.fire
+armed = _registry.armed
+corrupt_payload = _registry.corrupt_payload
 
 
 # -- process-level chaos helpers (subprocess tests) --------------------------

@@ -760,6 +760,27 @@ DRIVER_TAKEOVERS = counter(
     "hvd_driver_takeovers_total",
     "Driver restarts that resumed a prior control-plane snapshot "
     "(crash-restart takeovers).")
+# Silent-data-corruption defense plane (horovod_tpu/integrity.py):
+# cross-rank fingerprint voting, non-finite tripwires, and storage-free
+# rewind-on-spike. The divergence counter is driver-side (the voter);
+# the rendezvous server additionally mirrors a zero-materialized total
+# into the scrape so the instrument exists before any corruption.
+INTEGRITY_CHECKS = counter(
+    "hvd_integrity_checks_total",
+    "State fingerprints computed by this rank for the cross-rank "
+    "integrity voting plane (every HOROVOD_INTEGRITY_INTERVAL commits).")
+INTEGRITY_DIVERGENCE = counter(
+    "hvd_integrity_divergence_total",
+    "Cross-rank integrity votes that named this host's replica state "
+    "divergent (silent data corruption evidence).", ("host",))
+NONFINITE_STEPS = counter(
+    "hvd_nonfinite_steps_total",
+    "Steps whose reduced gradients carried NaN/Inf, by the configured "
+    "tripwire action (HOROVOD_NONFINITE_ACTION).", ("action",))
+REWINDS = counter(
+    "hvd_rewinds_total",
+    "Storage-free rewinds to the last commit, by trigger reason "
+    "(loss_spike).", ("reason",))
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -790,6 +811,14 @@ def _materialize_checkpoint_cells() -> None:
                               algorithm="flat")
     COLLECTIVE_EFFICIENCY.labels()
     COMMS_RESIDUAL.labels()
+    # Integrity defense plane zero cells: a job that never corrupted,
+    # never tripped, and never rewound still reports the series at 0 —
+    # the premerge scrape gate asserts they exist, and dashboards can
+    # tell "clean run" from "not measuring".
+    INTEGRITY_CHECKS.labels()
+    for action in ("warn", "skip", "abort"):
+        NONFINITE_STEPS.labels(action=action)
+    REWINDS.labels(reason="loss_spike")
 
 
 _materialize_checkpoint_cells()

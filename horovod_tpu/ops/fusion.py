@@ -120,6 +120,89 @@ def segment_leaves(
     return out
 
 
+def nonfinite_action() -> str | None:
+    """The non-finite tripwire knob, read at TRACE time (like the fusion
+    threshold): ``HOROVOD_NONFINITE_ACTION`` = ``warn`` (count/journal),
+    ``skip`` (drop the step's update rank-identically), or ``abort``
+    (arm the coordinated abort → elastic recovery). Unset/invalid =
+    None — the flush traces bit-for-bit as before (no ``is_finite`` HLO
+    anywhere)."""
+    import os
+
+    action = os.environ.get("HOROVOD_NONFINITE_ACTION", "").strip().lower()
+    return action if action in ("warn", "skip", "abort") else None
+
+
+def all_finite(tree):
+    """Scalar bool: every float leaf of ``tree`` is finite — the cheap
+    ``isfinite`` reduction the tripwire fuses into the flush (per-bucket
+    reductions that XLA folds into the unpack copies it already emits).
+    Non-float leaves are finite by definition."""
+    import jax
+
+    flags = [jnp.isfinite(leaf).all()
+             for leaf in jax.tree.leaves(tree)
+             if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating)]
+    if not flags:
+        return jnp.asarray(True)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def psum_flag(flag, axis_name):
+    """Make a per-rank finite flag rank-identical: True only when EVERY
+    rank's flag is True (one scalar ``psum`` — the only collective the
+    tripwire ever adds, and only on the sharded/fsdp halves, whose
+    reduce-scattered gradients differ per rank; the allreduce path's
+    reduced buckets are already identical everywhere)."""
+    from jax import lax
+
+    bad = jnp.where(flag, 0.0, 1.0).astype(jnp.float32)
+    return lax.psum(bad, axis_name) == 0.0
+
+
+def guard_updates(updates, new_state, old_state, finite):
+    """The ``skip`` action: select zero updates and the UN-advanced
+    optimizer state when ``finite`` is False — the step's poisoned
+    arithmetic is computed and discarded (``where`` is a select, so the
+    NaNs in the dead branch never contaminate the kept one). The
+    decision is a scalar, identical on every rank by the caller's
+    contract, so no state ever diverges."""
+    import jax
+
+    guarded_updates = jax.tree.map(
+        lambda u: jnp.where(finite, u, jnp.zeros_like(u)), updates)
+    guarded_state = jax.tree.map(
+        lambda new, old: jnp.where(finite, new, old), new_state, old_state)
+    return guarded_updates, guarded_state
+
+
+def note_finite_traced(finite, action: str, axis_name=None) -> None:
+    """Ship the traced finite flag to the host tripwire accountant
+    (:func:`horovod_tpu.integrity.note_nonfinite`) via a debug callback.
+    The local axis index rides along as a VALUE so the host side counts
+    each step once (smallest index seen = this process's own shard) —
+    conditioning the callback itself on the index would need a
+    partition-id XLA op the SPMD partitioner rejects. Callback emission
+    failures are swallowed at trace time: the guard semantics
+    (:func:`guard_updates`) never depend on the callback."""
+    import jax
+    from jax import lax
+
+    from .. import integrity
+
+    try:
+        idx = lax.axis_index(axis_name) if axis_name is not None else 0
+    except Exception:  # noqa: BLE001 — outside a mapped axis
+        idx = 0
+    try:
+        jax.debug.callback(integrity.note_nonfinite, action, finite, idx)
+    except Exception:  # noqa: BLE001 — observability only
+        pass
+
+
 def bucket_leaves(
     leaves: Sequence[Any], threshold_bytes: int | None = None
 ) -> list[list[int]]:

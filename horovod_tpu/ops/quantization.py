@@ -64,12 +64,56 @@ def _sround(x, salt=None):
     return jnp.clip(jnp.floor(x + u), -127, 127).astype(jnp.int8)
 
 
+#: Saturation bound for non-finite quantizer input (largest finite f32).
+_F32_MAX = float(np.finfo(np.float32).max)
+
+
+def _tripwire_armed() -> bool:
+    """Trace-time read of the non-finite tripwire knob: armed, the
+    quantizer must PROPAGATE non-finite input detectably instead of
+    saturating it away — saturation upstream of the tripwire's
+    post-reduce ``isfinite`` check would silently disable the detector
+    the moment int8 compression is turned on. One parser for the knob
+    (fusion's, imported lazily like this module's other fusion uses) so
+    the two planes can never disagree about what "armed" means."""
+    from .fusion import nonfinite_action
+
+    return nonfinite_action() is not None
+
+
 def _quantize_blocks(flat_f32, salt=None):
-    """[m] f32 -> (int8 [m], scales f32 [m/BLOCK]); m % BLOCK == 0."""
+    """[m] f32 -> (int8 [m], scales f32 [m/BLOCK]); m % BLOCK == 0.
+
+    Non-finite input never poisons a block's scale silently: a NaN
+    reaching the per-block ``max(abs(...))`` used to produce a garbage
+    scale — every element of that block then dequantized to NaN/garbage
+    *silently*, and under the RS/AG halves the garbage shard spread to
+    every rank. Instead:
+
+    - Tripwire UNARMED (``HOROVOD_NONFINITE_ACTION`` unset): input is
+      SATURATED before the scale is computed (NaN -> 0, ±Inf ->
+      ±f32-max), bounding the damage to the bad elements themselves (an
+      Inf clamps to the block's ±127 extreme; a NaN contributes zero)
+      while the wire never amplifies.
+    - Tripwire ARMED: a block containing any non-finite element is
+      emitted with ``scale = +Inf`` — every dequantized element of that
+      block is ±Inf/NaN, the reduction sums propagate it to EVERY rank
+      rank-identically, and the post-reduce ``isfinite`` tripwire fires
+      exactly as it does under ``compression=none`` (the tripwire stays
+      the authoritative detector; quantization never masks it).
+
+    See the int8 guard table in docs/perf.md.
+    """
     rows = flat_f32.reshape(-1, BLOCK)
-    scale = jnp.max(jnp.abs(rows), axis=1) / 127.0
-    safe = jnp.where(scale == 0.0, 1.0, scale)
-    q = _sround(rows / safe[:, None], salt)
+    saturated = jnp.clip(jnp.nan_to_num(rows, nan=0.0, posinf=_F32_MAX,
+                                        neginf=-_F32_MAX),
+                         -_F32_MAX, _F32_MAX)
+    scale = jnp.max(jnp.abs(saturated), axis=1) / 127.0
+    if _tripwire_armed():
+        bad = ~jnp.isfinite(rows).all(axis=1)
+        scale = jnp.where(bad, jnp.inf, scale)
+    safe = jnp.where(jnp.isfinite(scale) & (scale != 0.0), scale, 1.0)
+    q = _sround(saturated / safe[:, None], salt)
     return q.reshape(-1), scale
 
 

@@ -40,6 +40,38 @@ from .ops import collective_ops
 from .ops.fusion import fused_allreduce
 
 
+def _tripwire_flag(reduced, axis_name=None, rank_identical=True):
+    """Non-finite tripwire entry (``HOROVOD_NONFINITE_ACTION``): returns
+    ``(action, finite_flag)`` over the REDUCED gradients, or
+    ``(None, None)`` when unarmed — the flush then traces bit-for-bit as
+    before. The flag is made rank-identical (one scalar psum) when the
+    caller's reduced view differs per rank (the sharded/fsdp halves);
+    the allreduce path's output is already identical everywhere, so the
+    skip decision needs no extra collective there. The flag also ships
+    to the host accountant (counter + journal + optional coordinated
+    abort) via a debug callback."""
+    from .ops import fusion
+
+    action = fusion.nonfinite_action()
+    if action is None:
+        return None, None
+    flag = fusion.all_finite(reduced)
+    if not rank_identical and axis_name is not None:
+        flag = fusion.psum_flag(flag, axis_name)
+    fusion.note_finite_traced(flag, action, axis_name)
+    return action, flag
+
+
+def _tripwire_guard(action, flag, updates, new_state, old_state):
+    """Apply the ``skip`` action (zero updates + un-advanced state) when
+    armed; pass-through otherwise."""
+    if action != "skip" or flag is None:
+        return updates, new_state
+    from .ops import fusion
+
+    return fusion.guard_updates(updates, new_state, old_state, flag)
+
+
 def _record_flush(sync_mode: str, wire_leaves, threshold_bytes,
                   itemsize_override: int | None = None) -> None:
     """Metrics-plane instrumentation of a gradient-sync flush.
@@ -661,9 +693,13 @@ def sharded_step_update(spec, grads, local_state, params, axis_name=None,
             spec.prescale_factor, spec.postscale_factor,
             spec.fusion_threshold_bytes, spec.num_groups,
             world_size=n, quant_salt=salt)
+    action, flag = _tripwire_flag(grad_shards, axis_name,
+                                  rank_identical=False)
     param_shards = _local_shards(params, axis_name, n)
     updates, new_inner = spec.inner.update(
         grad_shards, inner_local, param_shards)
+    updates, new_inner = _tripwire_guard(action, flag, updates, new_inner,
+                                         inner_local)
     new_param_shards = optax.apply_updates(param_shards, updates)
     new_local = _SaltState(new_inner, salt + 1) if int8 else new_inner
     if not gather:
@@ -824,12 +860,26 @@ def DistributedOptimizer(
                     "sync_mode='fsdp' update needs params= (this rank's "
                     "parameter shards — the shard-local update reads "
                     "them)")
+            from .ops.collective_ops import _effective_traced_axis
+
+            effective = _effective_traced_axis(ps) or axis_name
+            # Tripwire on the reduce-scattered shards: per-rank views,
+            # so the skip decision rides one scalar psum to stay
+            # rank-identical (state divergence would be worse than the
+            # NaN it guards against).
+            action, flag = _tripwire_flag(grads, effective,
+                                          rank_identical=False)
             if int8:
                 inner_local, salt = state.inner_state, state.counter
                 upd, new_inner = optimizer.update(grads, inner_local,
                                                   params)
+                upd, new_inner = _tripwire_guard(action, flag, upd,
+                                                 new_inner, inner_local)
                 return upd, _SaltState(new_inner, salt + 1)
-            return optimizer.update(grads, state, params)
+            upd, new_inner = optimizer.update(grads, state, params)
+            upd, new_inner = _tripwire_guard(action, flag, upd, new_inner,
+                                             state)
+            return upd, new_inner
 
         init_fsdp._hvd_reduce_spec = spec
         update_fsdp._hvd_reduce_spec = spec
@@ -864,9 +914,13 @@ def DistributedOptimizer(
                 grads, op, effective, compression, prescale_factor,
                 postscale_factor, fusion_threshold_bytes, num_groups,
                 world_size=n, quant_salt=salt)
+            action, flag = _tripwire_flag(grad_shards, effective,
+                                          rank_identical=False)
             param_shards = _local_shards(params, effective, n)
             updates_sh, new_inner = optimizer.update(
                 grad_shards, inner_local, param_shards)
+            updates_sh, new_inner = _tripwire_guard(
+                action, flag, updates_sh, new_inner, inner_local)
             updates_full = _gather_param_shards(
                 updates_sh, params, compression, effective, n,
                 fusion_threshold_bytes, num_groups, quant_salt=salt)
@@ -890,13 +944,25 @@ def DistributedOptimizer(
             return state
 
         def update_fn(grads, state, params=None):
+            from .ops.collective_ops import _effective_traced_axis
+
+            effective = _effective_traced_axis(ps) or axis_name
             if int8:
                 reduced = reduce_fn(grads, salt=state.counter)
+                # Allreduce output is rank-identical by construction —
+                # the skip decision needs no extra collective.
+                action, flag = _tripwire_flag(reduced, effective)
                 updates, new_inner = optimizer.update(
                     reduced, state.inner_state, params)
+                updates, new_inner = _tripwire_guard(
+                    action, flag, updates, new_inner, state.inner_state)
                 return updates, _SaltState(new_inner, state.counter + 1)
             reduced = reduce_fn(grads)
-            return optimizer.update(reduced, state, params)
+            action, flag = _tripwire_flag(reduced, effective)
+            updates, new_inner = optimizer.update(reduced, state, params)
+            updates, new_inner = _tripwire_guard(action, flag, updates,
+                                                 new_inner, state)
+            return updates, new_inner
 
         update_fn._hvd_reduce_spec = spec
         return optax.GradientTransformation(init_fn, update_fn)
@@ -919,11 +985,17 @@ def DistributedOptimizer(
         is_boundary = (count % k) == 0
 
         def at_boundary(operand):
+            from .ops.collective_ops import _effective_traced_axis
+
             acc_g, inner = operand
             mean_g = jax.tree.map(lambda g: g / k, acc_g)
             salt = (count // k).astype(jnp.uint32) if int8 else None
             reduced = reduce_fn(mean_g, salt=salt)
+            action, flag = _tripwire_flag(
+                reduced, _effective_traced_axis(ps) or axis_name)
             updates, new_inner = optimizer.update(reduced, inner, params)
+            updates, new_inner = _tripwire_guard(action, flag, updates,
+                                                 new_inner, inner)
             return updates, new_inner, jax.tree.map(jnp.zeros_like, acc_g)
 
         def between(operand):

@@ -74,6 +74,20 @@ def max_record_bytes() -> int:
     return get_int("HOROVOD_PEERCHECK_MAX_BYTES", 256 << 20)
 
 
+def retention_depth() -> int:
+    """How many ``.prev`` generations each slot rotation retains (pool-
+    and server-side alike). The historical default is 1 (current +
+    ``.prev``). An armed integrity plane keeps 2: its vote lags the
+    condemned commit by up to one full commit (heartbeat cadence +
+    driver tick), so both the condemned commit AND the one a racing rank
+    lands meanwhile can be quarantined — assembly still needs one clean
+    complete group underneath. Unarmed, nothing changes (inertness)."""
+    from . import integrity
+
+    return get_int("HOROVOD_PEER_RETAIN",
+                   2 if integrity.enabled() else 1)
+
+
 class ReplicaCorruptError(ValueError):
     """A replica record failed decoding or checksum verification."""
 
@@ -209,7 +223,7 @@ class ReplicaPool:
                 # install): keep the slot, don't rotate prev away.
                 return existing
             rotate_slots(self._slots, str(record.rank), record,
-                         prev_suffix=PREV_SUFFIX)
+                         prev_suffix=PREV_SUFFIX, depth=retention_depth())
             count = len(self._slots)
         try:
             _metrics.PEER_POOL_REPLICAS.set(count)
@@ -341,6 +355,13 @@ class PeerReplicator:
             world_size=self.world_size(), payload=payload,
             has_params=has_params)
         blob = encode_record(record)
+        # SDC injection point: peer.corrupt flips bits in the ENCODED
+        # wire blob (header digest already computed) — a bit-flip on the
+        # wire, which the server's install-time verification must reject
+        # (422) with the previous good replica left authoritative. The
+        # local pool below installs the pre-encoding record, exactly as
+        # a real wire flip would leave it.
+        blob = faults.corrupt_payload(faults.PEER_CORRUPT, blob)
         shipped = False
         try:
             if faults.fire(faults.PEER_REPLICATE):
@@ -437,6 +458,32 @@ class PeerReplicator:
                  if r.generation < before_generation]
         return max(steps, default=0)
 
+    def quarantined(self) -> Mapping[str, Mapping]:
+        """The server's integrity-quarantine map (rank →
+        ``{generation, step, host}``), consulted at assembly time so a
+        vote-condemned rank's records are dropped from the LOCAL pool
+        too (the KV-side eviction cannot reach copies already pulled).
+        Empty when the voting plane is unarmed (the inertness contract:
+        no extra request), no server is reachable, or nothing is
+        quarantined. Best-effort: an unreachable server degrades to no
+        filter — exactly the pre-voting behavior."""
+        from . import integrity
+
+        if not integrity.enabled():
+            return {}
+        client = self.client()
+        if client is None:
+            return {}
+        try:
+            view = client.integrity_view()
+            quarantine = view.get("quarantined")
+            return quarantine if isinstance(quarantine, Mapping) else {}
+        except Exception as e:  # noqa: BLE001 — filter is best-effort
+            self._log.warning(
+                "peercheck: cannot read the integrity quarantine (%s); "
+                "assembling unfiltered", e)
+            return {}
+
     def assemble(self,
                  current_generation: int | None = None
                  ) -> list[ReplicaRecord]:
@@ -449,10 +496,28 @@ class PeerReplicator:
         otherwise (the ladder's cue to fall through to durable)."""
         if current_generation is None:
             current_generation = self.generation()
+        quarantine = self.quarantined()
         groups: dict[tuple[int, int], dict[int, ReplicaRecord]] = {}
         for record in self.fetch_all():
             if record.generation > current_generation:
                 continue  # not our lineage: a fenced-off future/foreign gen
+            entry = quarantine.get(str(record.rank))
+            if entry is not None and _condemned(record, entry):
+                # The integrity vote named this rank's replica state
+                # divergent at (generation, step): every record it
+                # committed from that point on is suspect — including
+                # the copies already pulled into THIS rank's local pool
+                # before the vote landed (self-consistent checksums;
+                # eviction on the KV cannot reach them). Dropping them
+                # here makes assembly fall back to the last commit the
+                # vote did not condemn.
+                self._log.error(
+                    "peercheck: dropping replica of rank %d at (gen %d, "
+                    "step %d) — integrity-quarantined since (gen %s, "
+                    "step %s)", record.rank, record.generation,
+                    record.step, entry.get("generation"),
+                    entry.get("step"))
+                continue
             slot = groups.setdefault(record.group(), {})
             held = slot.get(record.rank)
             if held is None or len(record.payload) >= len(held.payload):
@@ -481,6 +546,25 @@ class PeerReplicator:
             return [members[r] for r in range(world)]
         raise ReplicaUnavailableError(
             "no complete replica set: " + "; ".join(reasons))
+
+
+def _condemned(record: ReplicaRecord, entry: Mapping) -> bool:
+    """True when ``record`` falls inside a quarantine entry's condemned
+    range: from the back-dated start (``from_generation``/``from_step``
+    — the vote's own group when no back-date applies) through the
+    generation the vote fired in. A later generation's records are a
+    DIFFERENT owner of the reused rank id (the re-formed world) and pass
+    — matching the KV fence, which lifts on the first
+    strictly-newer-generation write."""
+    try:
+        fence_gen = int(entry.get("generation", -1))
+        start_gen = int(entry.get("from_generation", fence_gen))
+        start_step = int(entry.get("from_step", entry.get("step", 0)))
+        return (record.generation <= fence_gen
+                and (record.generation, record.step)
+                >= (start_gen, start_step))
+    except (TypeError, ValueError):
+        return False
 
 
 _active: PeerReplicator | None = None

@@ -81,7 +81,7 @@ def summary() -> dict:
     per run so every benchmark record carries the cache/goodput behavior
     that produced it.
     """
-    from . import comms_model, metrics, tracing
+    from . import comms_model, integrity, metrics, tracing
     from .ops.collective_ops import cache_stats
 
     return {
@@ -92,6 +92,7 @@ def summary() -> dict:
         "stragglers": tracing.straggler_summary(),
         "fsdp": metrics.fsdp_summary(),
         "comms": comms_model.summary(),
+        "integrity": integrity.summary(),
         **cache_stats(),
     }
 

@@ -163,6 +163,17 @@ class ElasticDriver:
         self._spare_procs: dict[str, WorkerProc] = {}
         self._rate_state: dict[str, tuple[float, float]] = {}
         self._last_policy_tick = 0.0
+        # Integrity defense plane (horovod_tpu/integrity.py): the driver
+        # is the voter — armed by HOROVOD_INTEGRITY_INTERVAL in the
+        # shared env, independent of the goodput-policy SLO knob
+        # (corruption is a correctness problem, not a throughput one).
+        self._integrity_strikes: dict[str, int] = {}
+        self._last_integrity_tick = 0.0
+        self._last_integrity_hb_version = -1
+        self._integrity_acted_group: tuple[int, int] = (-1, -1)
+        # (rank, condemned digest) of the last NAMED divergent vote —
+        # the 2-voter continuity resolution's memory.
+        self._last_outlier: tuple[int, str] | None = None
         self._draining = False
         self._superseded = False
         self._last_state_save = 0.0
@@ -203,6 +214,22 @@ class ElasticDriver:
                        for n, w in self._spare_procs.items()},
             "blacklist": self._manager.export_blacklist(),
             "driver_lost_counts": dict(self._driver_lost_counts),
+            "integrity_strikes": dict(self._integrity_strikes),
+            # The last-voted group rides along too: workers keep staging
+            # the same fingerprint on every heartbeat, so a takeover
+            # driver re-voting the identical (generation, step) group
+            # would double-count the strike — one real divergence event
+            # must cost exactly one confirmation.
+            "integrity_acted_group": list(self._integrity_acted_group),
+            "integrity_last_outlier": (list(self._last_outlier)
+                                       if self._last_outlier else None),
+            # The KV quarantine rides along: the acted-group watermark
+            # above stops the takeover driver from RE-voting the group,
+            # so without this a condemned rank's proven-corrupt replicas
+            # would be assembly-eligible on the successor's fresh server
+            # — permanently, if the corrupt host died with the old
+            # driver and never fingerprints again.
+            "integrity_quarantine": self._server.quarantine_export(),
             "policy": self._policy.export_state(),
             # The job HMAC secret: the takeover driver must serve (and
             # sign) with the SAME key the orphaned workers hold, or
@@ -528,6 +555,28 @@ class ElasticDriver:
         _metrics.DRIVER_EPOCH.set(self._store.epoch)
         self._manager.restore_blacklist(snap.get("blacklist"))
         self._policy.restore_state(snap.get("policy"))
+        acted = snap.get("integrity_acted_group")
+        if (isinstance(acted, (list, tuple)) and len(acted) == 2):
+            try:
+                self._integrity_acted_group = (int(acted[0]), int(acted[1]))
+            except (TypeError, ValueError):
+                pass
+        outlier = snap.get("integrity_last_outlier")
+        if (isinstance(outlier, (list, tuple)) and len(outlier) == 2):
+            try:
+                self._last_outlier = (int(outlier[0]), str(outlier[1]))
+            except (TypeError, ValueError):
+                pass
+        self._server.restore_quarantine(snap.get("integrity_quarantine"))
+        strikes = snap.get("integrity_strikes")
+        if isinstance(strikes, dict):
+            # A persistently-corrupting host must not get a clean record
+            # just because the control plane flapped.
+            for host, n in strikes.items():
+                try:
+                    self._integrity_strikes[str(host)] = int(n)
+                except (TypeError, ValueError):
+                    continue
         counts = snap.get("driver_lost_counts")
         if isinstance(counts, dict):
             for host, n in counts.items():
@@ -792,7 +841,8 @@ class ElasticDriver:
     # -- proactive drain (policy + preemption notices) ------------------------
 
     def _drain_host(self, name: str, why: str, decision=None,
-                    action: str = "drain") -> None:
+                    action: str = "drain",
+                    abort_posted: bool = False) -> None:
         """Proactively drain one world host through the existing
         SIGTERM→final-commit path, then re-form the world without it.
 
@@ -835,7 +885,8 @@ class ElasticDriver:
                 "grace; escalating to SIGKILL", name, grace)
         _metrics.event("policy_drain", generation=gen, host=name,
                        action=action, reason=why, rc=rc)
-        self._post_abort(f"proactive drain of {name} ({why})")
+        if not abort_posted:
+            self._post_abort(f"proactive drain of {name} ({why})")
         terminate_worker(self._workers.pop(name))
         self._launched_at.pop(name, None)
         self._server.clear_heartbeat(name)
@@ -900,6 +951,185 @@ class ElasticDriver:
         except (ValueError, OSError):
             return None
 
+    # -- integrity tick (the cross-rank vote) ---------------------------------
+
+    def _integrity_tick(self) -> None:
+        """One voting pass over the fingerprints piggybacked on the
+        worker heartbeats (armed by ``HOROVOD_INTEGRITY_INTERVAL`` —
+        independent of the goodput policy: corruption is correctness).
+        The newest COMPLETE (generation, step) group is voted once; a
+        named outlier is journaled, counted, its replica PUTs fenced on
+        the KV (the corrupt record evicted, ``.prev`` retained), its
+        strike fed to the policy controller, and — under
+        ``HOROVOD_INTEGRITY_ACTION=drain`` — its host drained through
+        the existing actuators with the coordinated abort posted FIRST
+        (survivors must stop rotating replica slots before the drain
+        grace lets them advance past the last good group)."""
+        from ... import integrity
+
+        if not integrity.enabled():
+            return
+        now = time.monotonic()
+        if now - self._last_integrity_tick < 0.25:
+            return
+        self._last_integrity_tick = now
+        # Idle ticks are one integer compare: the heartbeat store's
+        # mutation counter gates the JSON parse of every rank's
+        # (metrics/comms-fattened) heartbeat body.
+        hbv = self._server.heartbeat_version()
+        if hbv == self._last_integrity_hb_version:
+            return
+        self._last_integrity_hb_version = hbv
+        if not self._world_hosts:
+            return
+        # (records, vote) through the server's hb_version-keyed cache —
+        # shared with the live-vote fence and GET /integrity, so one
+        # heartbeat mutation costs one parse+vote process-wide. The
+        # cache votes with the server's world_np, which the driver set
+        # to len(world hosts) at publish (one worker per host).
+        records, voted = self._server.integrity_vote_cached()
+        if not records or voted is None:
+            return
+        group, verdict = voted
+        if group <= self._integrity_acted_group:
+            return
+        self._integrity_acted_group = group
+        if not verdict.get("divergent"):
+            # A clean complete vote resets the strike counters:
+            # HOROVOD_INTEGRITY_CONFIRMATIONS means CONSECUTIVE
+            # divergent votes (the knob exists to tolerate transient
+            # wire corruption), so two unrelated one-off events with
+            # clean votes between them must not accumulate into a
+            # drain. The policy channel's strikes (note_integrity) stay
+            # cumulative by design — that knob is membership-lifetime.
+            if self._integrity_strikes or self._last_outlier is not None:
+                self._integrity_strikes.clear()
+                self._last_outlier = None
+                self._save_state()
+            return
+        gen = self._server.generation
+        if (verdict.get("ambiguous") and verdict.get("voters") == 2
+                and self._last_outlier is not None):
+            # Continuity resolution: with 2 voters a PERSISTENT
+            # corruption makes every vote after the first ambiguous
+            # (the outlier's prev digest — its own condemned record —
+            # disagrees with the peer's), so confirmations >= 2 could
+            # never accumulate. But if the previously named rank's prev
+            # IS the exact digest the last vote condemned, the
+            # ambiguity is that same corruption persisting across
+            # intervals — attribute it to the same rank.
+            lrank, ldigest = self._last_outlier
+            rec = records.get(int(lrank)) or {}
+            prev = rec.get("prev")
+            prev_digest = (prev.get("digest")
+                           if isinstance(prev, dict) else None)
+            if prev_digest and prev_digest == ldigest:
+                verdict = dict(verdict, ambiguous=False,
+                               method="continuity",
+                               outlier_rank=rec.get("rank", lrank),
+                               outlier_host=rec.get("host"))
+        host = verdict.get("outlier_host")
+        rank = verdict.get("outlier_rank")
+        if verdict.get("ambiguous") or not host:
+            self._log.error(
+                "elastic: integrity vote at group %s is DIVERGENT but "
+                "ambiguous (%d voters, digests %s) — no host named, no "
+                "action taken", group, verdict.get("voters"),
+                verdict.get("digests"))
+            _metrics.event(
+                "integrity_divergence", generation=gen, host=None,
+                rank=None, ambiguous=True, step=group[1],
+                group_generation=group[0], voters=verdict.get("voters"),
+                digests=verdict.get("digests"))
+            # The watermark advanced: persist it, or a takeover driver
+            # re-votes this still-staged group and journals a duplicate
+            # ambiguous event (the named/clean branches already save).
+            self._save_state()
+            return
+        out_rec = records.get(int(rank)) or {}
+        if out_rec.get("digest"):
+            # Remembered for the 2-voter continuity resolution above.
+            self._last_outlier = (int(rank), str(out_rec["digest"]))
+        # Confirmations are per MEMBERSHIP, like the policy channel's
+        # strikes: a departed host's count must not survive into its
+        # re-entry through the spare tier (the clean-vote clear alone
+        # cannot guarantee it — another host's persistent divergence
+        # can keep clean complete votes from ever landing).
+        world = {h.hostname for h in self._world_hosts}
+        for h in [h for h in self._integrity_strikes if h not in world]:
+            del self._integrity_strikes[h]
+        self._integrity_strikes[host] = (
+            self._integrity_strikes.get(host, 0) + 1)
+        strikes = self._integrity_strikes[host]
+        self._log.error(
+            "elastic: integrity vote named %s (rank %s) DIVERGENT at "
+            "generation %d step %d (method=%s, strike %d) — silent data "
+            "corruption evidence", host, rank, group[0], group[1],
+            verdict.get("method"), strikes)
+        _metrics.INTEGRITY_DIVERGENCE.inc(host=host)
+        self._server.record_integrity_divergence(host)
+        _metrics.event(
+            "integrity_divergence", generation=gen, host=host, rank=rank,
+            ambiguous=False, step=group[1], group_generation=group[0],
+            method=verdict.get("method"), voters=verdict.get("voters"),
+            digests=verdict.get("digests"), strikes=strikes)
+        # Post-hoc evidence, like the policy drain's: the condemned
+        # host's last shipped trace window rides a driver-side flight
+        # record.
+        payload = self._server.trace_payload(host) or {}
+        _metrics.FLIGHT_DUMPS.inc(reason="integrity_divergence")
+        _metrics.event(
+            "flight_record", generation=gen,
+            reason="integrity_divergence", host=host,
+            steps=(payload.get("steps") or [])[-2:],
+            digests=verdict.get("digests"))
+        # Fence + evict BEFORE anything else: the corrupt shard must be
+        # out of the assembly set before any recovery can read it. If
+        # the outlier's own PREVIOUS fingerprint already disagreed with
+        # its peers' (every record ships its prior digest inline), the
+        # corruption predates this vote — condemn from that step, so a
+        # detection that lagged one interval cannot leave a known-bad
+        # replica eligible for peer-rung assembly (the ladder then
+        # falls through to durable: correctness over storage-freeness).
+        qgen, qstep = group
+        try:
+            outlier_prev = (records.get(int(rank)) or {}).get("prev") or {}
+            peer_prevs = {
+                ((rec.get("prev") or {}).get("digest"))
+                for r2, rec in records.items() if int(r2) != int(rank)}
+            if (outlier_prev.get("digest") and len(peer_prevs) == 1
+                    and None not in peer_prevs
+                    and outlier_prev["digest"] not in peer_prevs):
+                qstep = int(outlier_prev.get("step", qstep))
+                # The prev may belong to a PRIOR world generation (a
+                # re-form landed between the two intervals): condemn
+                # from its own generation, not the vote's, or the
+                # known-bad prior-generation replica stays eligible.
+                qgen = int(outlier_prev.get("generation", qgen))
+        except (TypeError, ValueError):
+            pass
+        self._server.quarantine_rank(rank, host, generation=group[0],
+                                     step=group[1],
+                                     from_generation=qgen, from_step=qstep)
+        self._policy.note_integrity(host)
+        self._save_state()
+        if (integrity.integrity_action() == "drain"
+                and strikes >= integrity.confirmations()
+                and host in self._workers):
+            # Abort FIRST: survivors stop committing (and rotating the
+            # last good replica group away) within one abort-poll
+            # interval; the condemned host's final commit is fenced by
+            # the quarantine anyway, so the graceful-drain ordering
+            # buys nothing here.
+            self._post_abort(
+                f"integrity divergence on {host} (rank {rank}, "
+                f"generation {group[0]} step {group[1]})")
+            self._drain_host(
+                host,
+                f"integrity divergence (strike {strikes}, method "
+                f"{verdict.get('method')})",
+                action="drain", abort_posted=True)
+
     # -- policy tick ---------------------------------------------------------
 
     def _update_world_rate(self) -> None:
@@ -949,30 +1179,34 @@ class ElasticDriver:
         version = self._server.generation
         self._ensure_spares(version)
         self._handle_preempt_notices(version)
-        if not self._policy.enabled:
+        if not self._policy.armed:
             return  # inert: no evidence gathering, no decisions
-        self._update_world_rate()
-        try:
-            skew = self._server.straggler_summary()
-        except Exception as e:  # noqa: BLE001 — evidence is best-effort
-            self._log.debug("elastic: straggler summary failed: %s", e)
-            skew = {}
-        # Comms-residual channel: per-host predicted-vs-observed
-        # residual seconds from the cluster-merged alpha-beta model —
-        # the link-degradation evidence that leads the skew signal.
-        # Gated on the channel knob: the merge JSON-parses every
-        # worker's heartbeat body on the single-threaded server, work
-        # the controller would never read with the channel off.
-        residuals: dict = {}
-        if self._policy.comms_residual_s > 0:
-            try:
-                residuals = (self._server.comms_summary()
-                             .get("residuals") or {})
-            except Exception as e:  # noqa: BLE001 — evidence best-effort
-                self._log.debug("elastic: comms summary failed: %s", e)
         world_names = [h.hostname for h in self._world_hosts]
-        self._policy.observe(skew, self._server.heartbeat_ages(),
-                             world_names, comms_residuals=residuals)
+        if self._policy.enabled:
+            # Goodput-evidence intake only serves the SLO channel; the
+            # integrity-strikes channel (armed without a target) decides
+            # on the vote tick's strike counts alone.
+            self._update_world_rate()
+            try:
+                skew = self._server.straggler_summary()
+            except Exception as e:  # noqa: BLE001 — evidence best-effort
+                self._log.debug("elastic: straggler summary failed: %s", e)
+                skew = {}
+            # Comms-residual channel: per-host predicted-vs-observed
+            # residual seconds from the cluster-merged alpha-beta model —
+            # the link-degradation evidence that leads the skew signal.
+            # Gated on the channel knob: the merge JSON-parses every
+            # worker's heartbeat body on the single-threaded server, work
+            # the controller would never read with the channel off.
+            residuals: dict = {}
+            if self._policy.comms_residual_s > 0:
+                try:
+                    residuals = (self._server.comms_summary()
+                                 .get("residuals") or {})
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    self._log.debug("elastic: comms summary failed: %s", e)
+            self._policy.observe(skew, self._server.heartbeat_ages(),
+                                 world_names, comms_residuals=residuals)
         decision = self._policy.decide(world_names,
                                        self._warm_spare_count())
         if decision is not None and decision.host in self._workers:
@@ -1140,7 +1374,14 @@ class ElasticDriver:
             if need_reconfigure:
                 self._reconfigure()
                 continue
-            # 1c. Self-healing policy plane: warm-spare reconciliation,
+            # 1c. Integrity defense plane: vote the piggybacked
+            # fingerprints, fence/drain a corrupting host. Failures are
+            # logged, never fatal — same contract as the policy brain.
+            try:
+                self._integrity_tick()
+            except Exception as e:  # noqa: BLE001
+                self._log.warning("elastic: integrity tick failed: %s", e)
+            # 1d. Self-healing policy plane: warm-spare reconciliation,
             # preemption notices, and (when HOROVOD_TARGET_GOODPUT arms
             # it) straggler-drain decisions. Policy failures are logged,
             # never fatal — a broken brain must not kill the body.
@@ -1148,7 +1389,7 @@ class ElasticDriver:
                 self._policy_tick()
             except Exception as e:  # noqa: BLE001
                 self._log.warning("elastic: policy tick failed: %s", e)
-            # 1d. Durable control plane: periodic snapshot refresh — the
+            # 1e. Durable control plane: periodic snapshot refresh — the
             # mutation paths save eagerly, but worker PIDs and policy
             # EWMAs drift between mutations and a takeover should resume
             # the freshest view (also the stale-driver tripwire: a

@@ -548,6 +548,24 @@ class ElasticWorkerContext:
                     body["comms"] = _comms_model.get_model().payload()
             except Exception:  # noqa: BLE001 — observability only
                 pass
+        try:
+            # Integrity defense plane: the latest state fingerprint
+            # rides the beat (tiny — one digest + a few summaries) so
+            # the driver's voting tick sees every rank's record without
+            # a new route or poll loop. Armed by its own knob
+            # (HOROVOD_INTEGRITY_INTERVAL), independent of the metrics
+            # piggyback — corruption detection is correctness, not
+            # telemetry. A PARKED spare has no world rank and ships
+            # nothing (its launch-env rank label would collide with a
+            # live rank's in the vote grouping).
+            if not self.parked:
+                from ... import integrity as _integrity
+
+                rec = _integrity.heartbeat_payload()
+                if rec is not None:
+                    body["integrity"] = rec
+        except Exception:  # noqa: BLE001 — liveness beats the defense
+            pass
         payload = json.dumps(body).encode()
         try:
             t_send = clock.now()

@@ -85,6 +85,7 @@ from urllib.request import Request, urlopen
 
 from ... import comms_model as _comms_model
 from ... import faults
+from ... import integrity as _integrity
 from ... import metrics as _metrics
 from ... import peercheck as _peercheck
 from ... import tracing as _tracing
@@ -232,6 +233,11 @@ class _KVHandler(BaseHTTPRequestHandler):
             # Same exemption as /metrics: read-only operational
             # telemetry (the cluster-merged alpha-beta link cost model).
             return self._serve_json(_render_comms, "application/json")
+        if self.path == "/integrity":
+            # Same exemption: the collected integrity fingerprints (one
+            # per rank, piggybacked on heartbeats) plus the live vote —
+            # the SDC defense plane's observability window.
+            return self._serve_json(_render_integrity, "application/json")
         if not self._authenticate():
             return
         store = self.server.store  # type: ignore[attr-defined]
@@ -294,6 +300,82 @@ class _KVHandler(BaseHTTPRequestHandler):
                     f"(world owned by driver epoch {current})").encode()
         return None
 
+    def _integrity_quarantine_locked(self, key: str) -> bytes | None:
+        """The integrity-vote fence on the ``peerstate`` scope (under
+        the server lock): a rank named divergent by the voting plane has
+        its replica PUTs rejected with 409 until a write arrives from a
+        STRICTLY newer world generation (the re-formed world reuses the
+        rank id for a healthy worker) — a corrupt shard must never
+        displace a good replica. Headerless writes from a quarantined
+        rank are rejected too: a corrupt host replaying unfenced is
+        exactly who this fence exists for."""
+        base = key
+        while base.endswith(_peercheck.PREV_SUFFIX):
+            base = base[:-len(_peercheck.PREV_SUFFIX)]
+        raw = self.headers.get(GENERATION_HEADER)
+        try:
+            gen = int(raw) if raw is not None else None
+        except ValueError:
+            gen = None
+        quarantine = getattr(self.server, "integrity_quarantine", None)
+        entry = (quarantine or {}).get(base)
+        if entry is not None:
+            if entry.get("lifted"):
+                # Tombstone: the formal fence is down (PUTs flow, the
+                # condemned range still filters assembly) — but the
+                # LIVE-vote fence must keep evaluating, or a rank id
+                # re-condemned in a later generation would go unfenced
+                # during the vote-to-driver-tick window.
+                return self._live_vote_fence_locked(base, gen)
+            if gen is not None and gen > int(entry.get("generation", 0)):
+                # New world owns the rank id again: lift the PUT fence
+                # but TOMBSTONE the entry instead of deleting it — the
+                # condemned (possibly back-dated) range still filters
+                # peer-rung assembly, or a failure before the new
+                # generation's replica group completes could fall back
+                # to and install the proven-corrupt old records.
+                entry["lifted"] = True
+                return None
+            self.server.fenced += 1  # type: ignore[attr-defined]
+            return (f"integrity quarantine: rank {base} was voted "
+                    f"divergent at generation {entry.get('generation')} "
+                    f"step {entry.get('step')} (host "
+                    f"{entry.get('host')}); replica PUTs are fenced "
+                    "until a newer generation").encode()
+        return self._live_vote_fence_locked(base, gen)
+
+    def _live_vote_fence_locked(self, base: str, gen: int | None
+                                ) -> bytes | None:
+        """The formal quarantine lands only on the driver's next monitor
+        tick — latency a corrupt rank's NEXT commit can race, rotating
+        the last good ``.prev`` away before ``quarantine_rank`` evicts
+        anything. The server already holds every rank's fingerprint
+        (heartbeat piggyback), so the fence votes inline: a replica PUT
+        from the named outlier of a complete unambiguous divergent vote
+        is rejected unless it proves a strictly newer world generation.
+        Unarmed plane → no fingerprint has ever ridden a heartbeat → the
+        ``integrity_seen`` latch short-circuits before any heartbeat
+        body is parsed (inertness); armed, the parse+vote is cached per
+        heartbeat mutation (``hb_version``), not re-run per PUT."""
+        if not getattr(self.server, "integrity_seen", False):
+            return None
+        _records, voted = _cached_integrity_vote(self.server, locked=True)
+        if voted is None:
+            return None
+        (vgen, vstep), verdict = voted
+        if not verdict.get("divergent") or verdict.get("ambiguous"):
+            return None
+        try:
+            outlier = int(verdict["outlier_rank"])
+        except (KeyError, TypeError, ValueError):
+            return None
+        if str(outlier) != base or (gen is not None and gen > vgen):
+            return None
+        self.server.fenced += 1  # type: ignore[attr-defined]
+        return (f"integrity live-vote fence: rank {base} is the outlier "
+                f"of a divergent vote at generation {vgen} step {vstep}; "
+                "replica PUT rejected pending driver quarantine").encode()
+
     def _drain_and_413(self, length: int, reason: bytes):
         """Reject an oversize body WITHOUT buffering it: the backstop
         must bound server memory, not just storage — the whole control
@@ -333,15 +415,21 @@ class _KVHandler(BaseHTTPRequestHandler):
                 return self._reply(422, why.encode())
         with self.server.lock:  # type: ignore[attr-defined]
             rejected = self._fence_check_locked()
+            if rejected is None and scope == PEERSTATE_SCOPE:
+                rejected = self._integrity_quarantine_locked(key)
             if rejected is None:
                 if scope == PEERSTATE_SCOPE:
                     # Rotate, don't overwrite: <rank> + <rank>.prev, via
                     # the same helper as the durable .prev file — the
                     # previous good commit survives until this one is
-                    # verified and installed.
+                    # verified and installed. An armed integrity plane
+                    # keeps one slot more: its quarantine condemns up to
+                    # a commit of detection latency, and assembly must
+                    # still find an uncondemned group underneath.
                     rotate_slots(
                         self.server.store.setdefault(scope, {}),  # type: ignore[attr-defined]
-                        key, body, prev_suffix=_peercheck.PREV_SUFFIX)
+                        key, body, prev_suffix=_peercheck.PREV_SUFFIX,
+                        depth=_peercheck.retention_depth())
                 else:
                     self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
                 if scope == HEARTBEAT_SCOPE:
@@ -349,6 +437,14 @@ class _KVHandler(BaseHTTPRequestHandler):
                     # clock (driver-side monotonic; worker clocks
                     # irrelevant).
                     self.server.hb_times[key] = time.monotonic()  # type: ignore[attr-defined]
+                    # Arm/refresh the live-vote fence: a cheap substring
+                    # scan (no JSON parse) latches integrity_seen, and
+                    # the mutation counter invalidates the vote cache.
+                    self.server.hb_version = (  # type: ignore[attr-defined]
+                        getattr(self.server, "hb_version", 0) + 1)
+                    if (not getattr(self.server, "integrity_seen", False)
+                            and b'"integrity"' in body):
+                        self.server.integrity_seen = True  # type: ignore[attr-defined]
         if rejected is not None:
             return self._reply(409, rejected)
         if scope == HEARTBEAT_SCOPE:
@@ -538,6 +634,86 @@ def _render_comms(httpd) -> dict:
     return merged
 
 
+def _integrity_records(httpd, locked: bool = False) -> dict[int, dict]:
+    """Per-rank integrity fingerprints, as piggybacked on heartbeat PUTs
+    (the ``"integrity"`` key of each heartbeat body), keyed by the
+    record's self-reported rank. Malformed heartbeats are skipped. Pass
+    ``locked=True`` from a caller already holding ``httpd.lock`` (it is
+    not reentrant)."""
+    if locked:
+        raw = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
+    else:
+        with httpd.lock:
+            raw = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
+    out: dict[int, dict] = {}
+    for host, body in raw.items():
+        try:
+            hb = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if not isinstance(hb, dict):
+            continue
+        rec = hb.get("integrity")
+        if not isinstance(rec, dict):
+            continue
+        try:
+            rank = int(rec.get("rank", 0))
+        except (TypeError, ValueError):
+            continue
+        # Colliding self-reported ranks: freshest record wins, so a
+        # stale zombie's payload cannot shadow the live rank's.
+        held = out.get(rank)
+        if held is None or rec.get("t", 0) >= held.get("t", 0):
+            out[rank] = rec
+    return out
+
+
+def _cached_integrity_vote(server, locked: bool = False):
+    """(records, voted) for the current heartbeat store, cached per
+    (``hb_version``, ``world_np``) mutation — repeated replica PUTs and
+    idle ``GET /integrity`` polls (the scraper, every peer-rung
+    assembly's quarantine fetch) cost one integer compare instead of a
+    JSON parse of every fattened heartbeat body plus a re-vote."""
+    world_np = getattr(server, "world_np", 0)
+    key = (getattr(server, "hb_version", 0), world_np)
+    cached = getattr(server, "integrity_vote_cache", None)
+    if cached is not None and cached[0] == key:
+        return cached[1], cached[2]
+    records = _integrity_records(server, locked=locked)
+    if not records:
+        voted = None
+    else:
+        voted = _integrity.vote_latest(records, world_np or len(records))
+    server.integrity_vote_cache = (key, records, voted)
+    return records, voted
+
+
+def _render_integrity(httpd) -> dict:
+    """``GET /integrity``: the collected fingerprints plus the newest
+    complete group's vote. A world where nothing fingerprinted yet
+    (plane unarmed, cold start) serves an explicit ``no_records`` body —
+    never a 500."""
+    records, voted = _cached_integrity_vote(httpd)
+    with httpd.lock:
+        generation = httpd.version
+        world_np = getattr(httpd, "world_np", 0)
+        quarantined = dict(getattr(httpd, "integrity_quarantine", {}))
+        divergence = dict(getattr(httpd, "integrity_divergence", {}))
+    out = {
+        "status": "ok" if records else "no_records",
+        "generation": generation,
+        "world_size": world_np,
+        "records": {str(r): rec for r, rec in sorted(records.items())},
+        "quarantined": quarantined,
+        "divergence_counts": divergence,
+        "vote": None,
+    }
+    if voted is not None:
+        (gen, step), verdict = voted
+        out["vote"] = {"group": [gen, step], **verdict}
+    return out
+
+
 def _render_cluster_metrics(httpd) -> str:
     """The driver's cluster-wide scrape: driver-plane gauges built from
     live server state, then every worker snapshot found piggybacked on a
@@ -551,6 +727,10 @@ def _render_cluster_metrics(httpd) -> str:
         policy_actions = dict(getattr(httpd, "policy_actions", {}))
         driver_epoch = getattr(httpd, "driver_epoch", 0)
         driver_lost = dict(getattr(httpd, "driver_lost", {}))
+        integrity_div = dict(getattr(httpd, "integrity_divergence", {}))
+        quarantined = sum(
+            1 for e in getattr(httpd, "integrity_quarantine", {}).values()
+            if not e.get("lifted"))  # tombstones only filter assembly
         now = time.monotonic()
         ages = {h: now - t for h, t in httpd.hb_times.items()}
         payloads = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
@@ -606,6 +786,22 @@ def _render_cluster_metrics(httpd) -> str:
             "host, plus the unlabeled job-wide total.",
             [({}, sum(driver_lost.values()))]
             + [({"host": h}, n) for h, n in sorted(driver_lost.items())]),
+        # Integrity defense plane (driver-side vote outcomes): the
+        # unlabeled sample is the job-wide total, zero-materialized so
+        # the scrape gate can assert the instrument before any
+        # corruption ever happens.
+        _metrics.make_family(
+            "hvd_integrity_divergence_total", "counter",
+            "Cross-rank integrity votes that named a host's replica "
+            "state divergent (silent data corruption evidence), by "
+            "host, plus the unlabeled job-wide total.",
+            [({}, sum(integrity_div.values()))]
+            + [({"host": h}, n)
+               for h, n in sorted(integrity_div.items())]),
+        _metrics.make_family(
+            "hvd_integrity_quarantined_ranks", "gauge",
+            "Ranks whose peer-replica PUTs are currently fenced by an "
+            "integrity-vote quarantine.", [({}, quarantined)]),
     ]
     groups: list = [({}, driver_families)]
     steps_samples: list = []
@@ -695,6 +891,16 @@ class RendezvousServer:
         self._httpd.policy_actions = {}  # type: ignore[attr-defined]
         self._httpd.driver_epoch = 0  # type: ignore[attr-defined]
         self._httpd.driver_lost = {}  # type: ignore[attr-defined]
+        self._httpd.integrity_quarantine = {}  # type: ignore[attr-defined]
+        self._httpd.integrity_divergence = {}  # type: ignore[attr-defined]
+        # Inertness latch + vote cache for the live-vote fence: until a
+        # heartbeat actually carries an integrity fingerprint, peerstate
+        # PUTs must not pay a JSON parse of every heartbeat body; once
+        # armed, the parse+vote runs once per heartbeat mutation, not
+        # once per replica PUT.
+        self._httpd.integrity_seen = False  # type: ignore[attr-defined]
+        self._httpd.hb_version = 0  # type: ignore[attr-defined]
+        self._httpd.integrity_vote_cache = None  # type: ignore[attr-defined]
         self._httpd.straggler_logged = set()  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
@@ -829,6 +1035,83 @@ class RendezvousServer:
             self._httpd.store.get(  # type: ignore[attr-defined]
                 PREEMPT_SCOPE, {}).pop(host, None)
 
+    # -- integrity defense plane ----------------------------------------------
+
+    def heartbeat_version(self) -> int:
+        """Monotonic heartbeat-store mutation counter (bumped on every
+        heartbeat PUT and ``clear_heartbeat``): lets pollers skip
+        re-parsing every heartbeat body when nothing has changed."""
+        return getattr(self._httpd, "hb_version", 0)
+
+    def integrity_records(self) -> dict[int, dict]:
+        """Per-rank integrity fingerprints from the heartbeat piggyback
+        — what the driver's voting tick consumes."""
+        return _integrity_records(self._httpd)
+
+    def integrity_vote_cached(self):
+        """(records, voted) via the ``(hb_version, world_np)``-keyed
+        cache shared with the live-vote fence and ``GET /integrity`` —
+        the driver's voting tick must not re-parse every heartbeat body
+        when the in-process fence already did."""
+        return _cached_integrity_vote(self._httpd)
+
+    def integrity_summary(self) -> dict:
+        """The collected records + live vote (what ``GET /integrity``
+        serves over HTTP), rendered in-process."""
+        return _render_integrity(self._httpd)
+
+    def record_integrity_divergence(self, host: str) -> None:
+        """Count one divergence vote against ``host`` into the scrape's
+        ``hvd_integrity_divergence_total{host}``."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            counts = self._httpd.integrity_divergence  # type: ignore[attr-defined]
+            counts[host] = counts.get(host, 0) + 1
+
+    def quarantine_rank(self, rank, host: str, generation: int,
+                        step: int, from_generation: int | None = None,
+                        from_step: int | None = None) -> None:
+        """Fence a divergent rank's peer-replica PUTs and EVICT its
+        current ``peerstate`` record (the corrupt shard): the retained
+        ``.prev`` slot — the last commit the vote did not condemn —
+        stays, so peer-rung assembly falls back one commit instead of
+        installing corruption. The fence lifts when a write arrives from
+        a strictly newer world generation (the re-formed world reuses
+        the rank id for a healthy worker). ``generation``/``step`` are
+        the VOTE's group (the fence-lift anchor);
+        ``from_generation``/``from_step`` (default: the same group) are
+        where the condemned range STARTS — a vote that back-dated the
+        corruption to a prior generation's fingerprint condemns that
+        generation's replica records too."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            self._httpd.integrity_quarantine[str(rank)] = {  # type: ignore[attr-defined]
+                "host": str(host),
+                "generation": int(generation),
+                "step": int(step),
+                "from_generation": int(generation if from_generation is None
+                                       else from_generation),
+                "from_step": int(step if from_step is None else from_step),
+                "t": time.time(),
+            }
+            self._httpd.store.get(  # type: ignore[attr-defined]
+                PEERSTATE_SCOPE, {}).pop(str(rank), None)
+
+    def quarantine_export(self) -> dict:
+        """The integrity-quarantine map (incl. tombstones), JSON-able —
+        persisted in the driver snapshot so a takeover driver's fresh
+        server re-fences a condemned rank instead of re-admitting its
+        proven-corrupt replicas to peer-rung assembly."""
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return {r: dict(e) for r, e in
+                    self._httpd.integrity_quarantine.items()}  # type: ignore[attr-defined]
+
+    def restore_quarantine(self, entries) -> None:
+        if not isinstance(entries, dict):
+            return
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            for r, e in entries.items():
+                if isinstance(e, dict):
+                    self._httpd.integrity_quarantine[str(r)] = dict(e)  # type: ignore[attr-defined]
+
     def metrics_text(self) -> str:
         """The scrape body, rendered in-process (what ``GET /metrics``
         serves over HTTP)."""
@@ -939,6 +1222,10 @@ class RendezvousServer:
                 HEARTBEAT_SCOPE, {}).pop(host, None)
             self._httpd.store.get(  # type: ignore[attr-defined]
                 TRACE_SCOPE, {}).pop(host, None)
+            # The departed host's fingerprint left the record set: the
+            # live-vote fence must not keep serving a vote over it.
+            self._httpd.hb_version = (  # type: ignore[attr-defined]
+                getattr(self._httpd, "hb_version", 0) + 1)
 
     def stop(self) -> None:
         self._httpd.shutdown()
@@ -1028,6 +1315,14 @@ class KVClient:
             if e.code == 404:
                 return None
             raise
+
+    def integrity_view(self) -> dict:
+        """``GET /integrity`` (auth-exempt): the SDC defense plane's
+        collected fingerprints, live vote, and quarantine map — what the
+        peer-replica assembly consults so a condemned rank's records are
+        dropped from its LOCAL pool too, not just evicted from the KV."""
+        with self._request("GET", "/integrity") as r:
+            return json.loads(r.read().decode())
 
     def keys(self, scope: str) -> list[str]:
         with self._request("GET", f"/_scope/{scope}") as r:

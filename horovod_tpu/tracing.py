@@ -637,6 +637,17 @@ def dump_flight_record(reason: str, generation: int | None = None,
                 snap["peer_pool"] = pool
         except Exception:  # noqa: BLE001 — the dump must still land
             pass
+        # Integrity-plane state rides too (when it ever engaged): the
+        # last staged fingerprint and the tripwire/rewind counters —
+        # the first questions after a divergence names this rank.
+        try:
+            from . import integrity
+
+            isum = integrity.flight_summary()
+            if isum is not None:
+                snap["integrity"] = isum
+        except Exception:  # noqa: BLE001 — the dump must still land
+            pass
         metrics.FLIGHT_DUMPS.inc(reason=reason)
         metrics.event(
             "flight_record", generation=generation, reason=reason,

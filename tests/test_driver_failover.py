@@ -381,6 +381,46 @@ class TestControlPlaneResume:
         b.restore_state(None)  # malformed input is a no-op
         b.restore_state({"ewma": "nope"})
 
+    def test_integrity_vote_state_survives_takeover(self, tmp_path,
+                                                    monkeypatch):
+        """The acted-group watermark rides the snapshot with the strike
+        counts: workers keep staging the same fingerprint on every
+        heartbeat, so a takeover driver re-voting the identical
+        (generation, step) group would double-count the strike and
+        break the HOROVOD_INTEGRITY_CONFIRMATIONS contract."""
+        from horovod_tpu.runner.elastic.discovery import (
+            FixedHostDiscovery,
+        )
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+        from horovod_tpu.runner.hosts import HostInfo
+        from horovod_tpu.runner.launch import Settings
+
+        monkeypatch.setenv("HOROVOD_DRIVER_STATE_DIR", str(tmp_path))
+        settings = Settings(
+            num_proc=1, hosts=[], command=["true"], elastic=True,
+            min_np=1, max_np=1, discovery_script=None)
+        a = ElasticDriver(
+            settings,
+            discovery=FixedHostDiscovery([HostInfo("localhost", 1)]))
+        a._integrity_acted_group = (2, 40)
+        a._integrity_strikes["h1"] = 1
+        a._server.quarantine_rank(1, "h1", generation=2, step=40,
+                                  from_generation=1, from_step=30)
+        a._store.save(a._snapshot_record())
+        b = ElasticDriver(
+            settings,
+            discovery=FixedHostDiscovery([HostInfo("localhost", 1)]))
+        assert b._prepare_takeover()
+        assert b._integrity_acted_group == (2, 40)
+        assert b._integrity_strikes == {"h1": 1}
+        # The KV quarantine survives onto the successor's fresh server:
+        # the acted-group watermark suppresses a re-vote, so without
+        # this the condemned rank's replicas would be assembly-eligible
+        # again (permanently, if the corrupt host died with driver A).
+        q = b._server.quarantine_export()
+        assert q["1"]["host"] == "h1" and q["1"]["generation"] == 2
+        assert q["1"]["from_generation"] == 1 and q["1"]["from_step"] == 30
+
     def test_blacklist_cooldown_survives_restart(self):
         from horovod_tpu.runner.elastic.discovery import (
             FixedHostDiscovery,

@@ -525,6 +525,38 @@ class TestPeerShardedState:
         # Baseline = survivors' last prior-gen step (2) + this commit.
         assert {r.step for r in records} == {3}, records
 
+    def test_sync_broadcasts_commit_counter_rank_identically(
+            self, hvd, kv_server, monkeypatch):
+        """max(own, baseline) alone is NOT rank-identical: a survivor
+        whose final pre-abort commit never landed in the pool (replica
+        PUT raced the abort/fence) counts one ahead of the baseline the
+        replacements computed — from then on the ranks label the same
+        training step differently, replica groups never complete, and
+        the integrity vote compares DIFFERENT commits under one
+        (generation, step) key. sync() must adopt rank 0's counter."""
+        from horovod_tpu.elastic import state as state_mod
+
+        genbox = [0]
+        _, _, _, states = _build_states(kv_server, n=2, genbox=genbox)
+        survivor = states[1]
+        # The racing commit: the snapshot lands locally, the replica
+        # PUT does not — the survivor's counter now leads the pool.
+        monkeypatch.setattr(survivor._replicator, "replicate",
+                            lambda *a, **k: None)
+        survivor.epoch += 1
+        survivor.commit()  # local counter 2, pool still at step 1
+        kv_server.publish_epoch("world", {})
+        genbox[0] = 1
+        # Rank 0 broadcasts its counter (simulated: broadcast_object
+        # returns the agreed world value, as the real collective does).
+        monkeypatch.setattr(
+            state_mod, "broadcast_object",
+            lambda obj: 1 if isinstance(obj, int) else obj)
+        survivor.sync()
+        # Post-sync commit advanced FROM the broadcast baseline (1),
+        # not from the survivor's raced-ahead local counter (2).
+        assert survivor._commit_seq == 2
+
     def test_commit_journal_and_instruments(self, hvd, kv_server,
                                             monkeypatch, tmp_path):
         jpath = tmp_path / "events.jsonl"

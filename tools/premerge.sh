@@ -63,10 +63,20 @@ echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # reads), loss continuity is exact; the SIGSTOP'd stale-driver variant
 # stands down EXIT_DRIVER_SUPERSEDED with its writes 409-fenced; torn
 # snapshot writes (SIGKILL mid-save) restore the previous epoch.
-if ! timeout -k 10 1500 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
+# test_integrity.py is the data-plane (SDC) defense proof: a
+# grad.corrupt-injected rank is named by the cross-rank digest vote
+# within one integrity interval, its host drained and the warm spare
+# promoted at g+1 with recovery on the peer rung and final weights
+# exact vs the clean run; the vote fences the corrupt replica's
+# peerstate PUT so it never displaces a good shard; non-finite
+# tripwires skip the poisoned step rank-identically; the loss-spike
+# detector rewinds storage-free with skip-ahead + a storm breaker; and
+# the A/B arm proves every knob unset is bit-for-bit inert.
+if ! timeout -k 10 1800 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
     tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py \
-    tests/test_policy.py tests/test_driver_failover.py -q \
+    tests/test_policy.py tests/test_driver_failover.py \
+    tests/test_integrity.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -185,7 +195,7 @@ then
     exit 1
 fi
 
-echo "== premerge gate 4/4: /metrics scrape + /timeline + /comms merge lane =="
+echo "== premerge gate 4/4: /metrics scrape + /timeline + /comms + /integrity lane =="
 # End-to-end over the REAL plumbing: the bench run's instrument snapshot
 # is published to a live RendezvousServer via the same heartbeat PUT
 # workers use, then scraped back over plain HTTP from GET /metrics; the
@@ -207,7 +217,9 @@ import socket
 import sys
 import urllib.request
 
-from horovod_tpu import metrics
+import numpy as np
+
+from horovod_tpu import integrity, metrics
 from horovod_tpu.runner.http.kv_server import KVClient, RendezvousServer
 
 with open(sys.argv[1]) as f:
@@ -226,16 +238,27 @@ if not isinstance(comms, dict) or comms.get("status") != "ok":
              f"(status={comms.get('status') if isinstance(comms, dict) else comms!r})")
 server = RendezvousServer(host="127.0.0.1")
 server.start()
-server.set_cluster_info(world_np=1)
+server.set_cluster_info(world_np=2)
 try:
     client = KVClient("127.0.0.1", server.port)
+    # Two ranks' integrity fingerprints of the SAME state (the bitwise-
+    # agreement steady state) piggyback the heartbeats, so GET
+    # /integrity proves the voting plane's collection + vote over the
+    # real plumbing with >=2 rank digests.
+    iparams = {"w": np.arange(8, dtype=np.float32)}
+    iopt = {"m": np.zeros(8, dtype=np.float32)}
+    irecs = [integrity.make_record(iparams, iopt, step=3, rank=r,
+                                   host=f"bench-r{r}", generation=1)
+             for r in (0, 1)]
     client.put("heartbeat", socket.gethostname(), json.dumps(
         {"rank": 0, "steps": 1, "commits": 0, "metrics": snap,
+         "integrity": irecs[0],
          "comms": dict(comms, rank="0", host="bench-r0")}).encode())
     # A second rank's comms payload (relabeled) so GET /comms proves the
     # cluster merge over the real heartbeat plumbing with >=2 ranks.
     client.put("heartbeat", "bench-r1", json.dumps(
         {"rank": 1, "steps": 1, "commits": 0,
+         "integrity": irecs[1],
          "comms": dict(comms, rank="1", host="bench-r1")}).encode())
     # Publish the bench trace as rank 0, plus a relabeled copy as rank 1
     # whose wall clocks are shifted +5s with the matching measured
@@ -280,6 +303,13 @@ try:
         "hvd_link_latency_seconds",
         "hvd_collective_efficiency_ratio",
         "hvd_comms_residual_seconds",
+        # SDC defense plane: zero-materialized so a clean run still
+        # reports the instruments (clean run != not measuring).
+        "hvd_integrity_checks_total",
+        "hvd_integrity_divergence_total",
+        "hvd_integrity_quarantined_ranks",
+        "hvd_nonfinite_steps_total",
+        "hvd_rewinds_total",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -337,6 +367,33 @@ try:
             f"/comms merge, got {sorted(crank_payloads)}")
     if not cmerged.get("cluster"):
         sys.exit("premerge comms lane: /comms cluster aggregate is empty")
+    # Integrity voting plane over HTTP: both piggybacked fingerprints
+    # collected, and the newest complete group votes clean (bitwise
+    # agreement is the steady state the plane certifies).
+    iurl = f"http://127.0.0.1:{server.port}/integrity"
+    with urllib.request.urlopen(iurl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge integrity lane: {iurl} answered {r.status}")
+        ibody = r.read()
+    imerged = json.loads(ibody)
+    if imerged.get("status") != "ok":
+        sys.exit(f"premerge integrity lane: /integrity status "
+                 f"{imerged.get('status')!r} (expected 'ok')")
+    irank_recs = imerged.get("records") or {}
+    if len(irank_recs) < 2:
+        sys.exit(
+            f"premerge integrity lane: expected >=2 rank digests in the "
+            f"/integrity collection, got {sorted(irank_recs)}")
+    if any(not rec.get("digest") for rec in irank_recs.values()):
+        sys.exit("premerge integrity lane: a collected record carries "
+                 "no state digest")
+    ivote = imerged.get("vote")
+    if not ivote or ivote.get("divergent") or ivote.get("voters", 0) < 2:
+        sys.exit(
+            f"premerge integrity lane: expected a clean 2-voter verdict "
+            f"on the newest complete group, got {ivote!r}")
+    with open(os.path.join(artifacts, "integrity.json"), "wb") as f:
+        f.write(ibody)
     with open(os.path.join(artifacts, "comms.json"), "wb") as f:
         f.write(cbody)
     with open(os.path.join(artifacts, "timeline.json"), "wb") as f:
@@ -352,6 +409,9 @@ try:
     print(f"premerge comms lane: ok (/comms merged "
           f"{len(crank_payloads)} rank payloads, "
           f"{len(cmerged['cluster'])} cluster fit keys)")
+    print(f"premerge integrity lane: ok (/integrity collected "
+          f"{len(irank_recs)} rank digests, clean "
+          f"{ivote['voters']}-voter verdict)")
 finally:
     server.stop()
 EOF
