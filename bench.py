@@ -42,9 +42,14 @@ model-guided autotune pruning against the exhaustive sweep — so the
 perf trajectory tracks communication health, not just throughput.
 
 Step-time breakdown: ``phase_span_medians_ms`` carries derived
-forward/backward/collective/optimizer_update medians (phase-probe
-programs differenced against the headline step — see section 4d), so
-BENCH_r*.json records where the step time goes, not just throughput.
+forward_backward/collective/optimizer_update medians (phase-probe
+programs differenced against the headline step — see section 4d; the
+phase vocabulary is ``horovod_tpu.attribution.PHASE_SPAN_NAMES``, the
+one constant set the elastic step and the attribution plane share), and
+the ``attribution`` record (section 7) carries the framework-side
+compute/exposed_comm/straggler_wait/overhead decomposition + MFU of the
+same step, so BENCH_r*.json records where the step time goes, not just
+throughput.
 
 Robustness contract (VERDICT r3 #1): every section is wrapped in
 ``_with_retry`` — one retry on transient remote-compile/transport errors
@@ -335,23 +340,14 @@ def _time_steps(step, state, batch, warmup=4, iters=20, repeats=3):
 # CPU-mesh run uses 32x32 inputs where this constant doesn't apply.
 RESNET50_TRAIN_FLOPS_PER_IMAGE_224 = 3 * 2 * 4.089e9
 
-# bf16 peak FLOPs/s per chip by device kind (dense, no sparsity).
-_CHIP_PEAK_FLOPS = {
-    "v6e": 918e12,
-    "v6 lite": 918e12,
-    "v5p": 459e12,
-    "v5e": 197e12,
-    "v5 lite": 197e12,
-    "v4": 275e12,
-}
-
 
 def _chip_peak_flops(device) -> float | None:
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _CHIP_PEAK_FLOPS.items():
-        if key in kind:
-            return peak
-    return None
+    # The per-chip peak table lives in the framework now
+    # (attribution.CHIP_PEAK_FLOPS) so any workload can price MFU via
+    # hvd.set_model_flops_per_step; bench keeps this accessor shape.
+    from horovod_tpu.attribution import peak_flops_for_kind
+
+    return peak_flops_for_kind(getattr(device, "device_kind", ""))
 
 
 # BERT-Large analytic FLOPs/token (fwd), masked-position head:
@@ -568,6 +564,15 @@ def main() -> int:
         timing = dict(warmup=1, iters=2, repeats=1)
 
     peak = _chip_peak_flops(jax.devices()[0]) if on_tpu else None
+
+    # Declare the model's analytic FLOPs to the attribution plane (MFU
+    # promotion): every synced tracer step now exports hvd_mfu_ratio and
+    # the phase gauges ride the metrics snapshot into the premerge
+    # scrape gate. The 224x224 constant is only honest on TPU; the
+    # CPU-mesh smoke leaves it unset (the gauge stays zero-materialized).
+    if on_tpu and image == 224:
+        hvd.set_model_flops_per_step(
+            RESNET50_TRAIN_FLOPS_PER_IMAGE_224 * global_batch)
 
     # --- section 1 (headline): DistributedOptimizer (fused allreduce +
     # bf16 wire). Emitted immediately so a later flake cannot erase it.
@@ -851,34 +856,24 @@ def main() -> int:
             record["param_gather_probe_ms"] = round(t_gather * 1e3, 3)
             emit.update(**record)
 
-    # --- section 4d: per-phase step-time breakdown — forward / backward /
-    # collective / optimizer_update medians, derived by differencing
-    # phase-probe programs against the headline dist step (one jitted SPMD
-    # program cannot be phase-timed from the host, so the probes isolate
-    # prefixes of the step):
-    #   forward          = t(loss only)
-    #   backward         = t(value_and_grad) - forward
+    # --- section 4d: per-phase step-time breakdown — forward_backward /
+    # collective / optimizer_update medians (the attribution plane's
+    # shared phase-span vocabulary, horovod_tpu/attribution.py), derived
+    # by differencing phase-probe programs against the headline dist step
+    # (one jitted SPMD program cannot be phase-timed from the host, so
+    # the probes isolate prefixes of the step):
+    #   forward_backward = t(value_and_grad)
     #   optimizer_update = t(grad + bare update, no sync) - t(value_and_grad)
     #   collective       = t(dist step) - t(no-sync step)
-    # Recorded as spans on the tracing plane (so the trace snapshot and
-    # the premerge /timeline lane carry the breakdown) and as
-    # phase_span_medians_ms in this record.
+    # Recorded as a SYNCED step on the tracing plane — so the trace
+    # snapshot and the premerge /timeline + /criticalpath lanes carry
+    # the breakdown, and attribution.note_step prices the phase gauges —
+    # and as phase_span_medians_ms in this record.
     def run_phases():
         import jax
         from jax.sharding import PartitionSpec as P
 
-        from horovod_tpu import tracing
-
-        def fwd_fn(p, stats, b):
-            x, y = b
-            logits, _ = model.apply(
-                {"params": p, "batch_stats": stats}, x, train=True,
-                mutable=["batch_stats"])
-            return jax.lax.pmean(loss_fn(logits, y), axis)
-
-        fwd_prog = jax.jit(jax.shard_map(
-            fwd_fn, mesh=mesh, in_specs=(P(), P(), P(axis)),
-            out_specs=P(), check_vma=False))
+        from horovod_tpu import attribution, tracing
 
         def grad_fn(p, stats, b):
             x, y = b
@@ -917,7 +912,6 @@ def main() -> int:
                              / timing["iters"])
             return statistics.median(times)
 
-        t_fwd = time_fn(lambda: fwd_prog(p0, s0, batch))
         t_grad = time_fn(lambda: grad_prog(p0, s0, batch)[0])
 
         raw_opt = optax.sgd(0.1, momentum=0.9)
@@ -926,20 +920,25 @@ def main() -> int:
             nosync_step, fresh_state(raw_opt), batch, **timing)
         t_full = dist[0]
         phases = {
-            "forward": t_fwd,
-            "backward": max(t_grad - t_fwd, 0.0),
-            "optimizer_update": max(t_nosync - t_grad, 0.0),
-            "collective": max(t_full - t_nosync, 0.0),
+            attribution.SPAN_FORWARD_BACKWARD: max(t_grad, 0.0),
+            attribution.SPAN_OPTIMIZER_UPDATE: max(t_nosync - t_grad, 0.0),
+            attribution.SPAN_COLLECTIVE: max(t_full - t_nosync, 0.0),
         }
         # One representative step on the tracer: the derived phase spans
         # laid back to back, so the shipped/archived timeline carries the
-        # breakdown visually.
+        # breakdown visually. Marked synced — the durations ARE measured
+        # wall time — so attribution.note_step decomposes it into the
+        # phase/exposed-comm/MFU gauges the scrape gate asserts, and the
+        # shipped payload gives /criticalpath a real group to analyze.
         t_base = tracing.clock_sync().now()
         tracer = tracing.get_tracer()
-        with tracer.step_scope("bench_phases"):
+        with tracer.step_scope("bench_phases") as rec:
+            rec.synced = True
             cursor = t_base
             for name, dur in phases.items():
-                cat = ("collective" if name == "collective" else "phase")
+                cat = (attribution.CAT_COLLECTIVE
+                       if name == attribution.SPAN_COLLECTIVE
+                       else attribution.CAT_PHASE)
                 tracer.record(name, cat, cursor, dur,
                               args={"derived": True})
                 cursor += dur
@@ -950,6 +949,7 @@ def main() -> int:
                                     allow_retry=single_controller)
         if phase_medians is not None:
             emit.update(phase_span_medians_ms=phase_medians)
+
 
     # --- section 5: int8 (EQuARX-style) wire, machinery-forced — the
     # quantize -> exchange -> dequant round trip demonstrably executes
@@ -1108,6 +1108,42 @@ def main() -> int:
                             allow_retry=single_controller)
         if comms is not None:
             emit.update(comms=comms)
+
+    # --- section 7: attribution lane — the framework-side decomposition
+    # of the bench_phases step (compute / exposed_comm / straggler_wait /
+    # overhead summing to the step wall time), the measured
+    # overlap-hidden ratio, MFU (TPU only — the analytic constant), and
+    # the alpha-beta model's predicted-vs-observed exposed-comm residual
+    # (real now: section 6 just fitted the model). BENCH_r*.json thereby
+    # records where the step time went through the SAME plane operators
+    # scrape, not just the bench-local medians. Runs in --smoke: the
+    # premerge /criticalpath gate rides the trace this lane's
+    # bench_phases step shipped.
+    def run_attribution():
+        from horovod_tpu import attribution
+
+        summary = attribution.summary()
+        last = summary.get("last_step") or {}
+        return {
+            "phases_ms": {k: round(v * 1e3, 3)
+                          for k, v in (last.get("phases") or {}).items()},
+            "wall_ms": (round(last["wall_s"] * 1e3, 3)
+                        if last.get("wall_s") is not None else None),
+            "overlap_hidden_ratio": last.get("overlap_hidden_ratio"),
+            "mfu": last.get("mfu"),
+            "exposed_comm_predicted_s":
+                summary.get("exposed_comm_predicted_s"),
+            "exposed_comm_residual_s":
+                summary.get("exposed_comm_residual_s"),
+            "sentinel_steps": (summary.get("sentinel") or {}).get(
+                "steps_observed"),
+        }
+
+    if dist is not None and not out_of_time():
+        att_lane = _with_retry("attribution", run_attribution, errors,
+                               allow_retry=single_controller)
+        if att_lane is not None:
+            emit.update(attribution=att_lane)
 
     if errors:
         emit.record["errors"] = errors

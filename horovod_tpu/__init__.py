@@ -101,6 +101,8 @@ from .functions import (  # noqa: F401
     to_local,
 )
 from . import abort  # noqa: F401
+from . import attribution  # noqa: F401
+from .attribution import set_model_flops_per_step  # noqa: F401
 from . import autotune  # noqa: F401
 from . import comms_model  # noqa: F401
 from . import faults  # noqa: F401

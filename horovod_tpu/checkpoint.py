@@ -113,6 +113,22 @@ def atomic_read(path: str):
             continue
 
 
+def rotate_file(path: str, prev_suffix: str = ".prev") -> None:
+    """Retire the current file at ``path`` to ``<path><prev_suffix>``
+    (atomic rename; a missing current file is a no-op).
+
+    The size-gated flavor of the one-``.prev``-slot rotation contract
+    (:func:`atomic_install` keeps the previous checkpoint this way;
+    :func:`rotate_slots` is the mapping flavor): the lifecycle journal
+    (``metrics.EventJournal``) rotates through this when
+    ``HOROVOD_EVENT_LOG_MAX_BYTES`` caps it, so at most two caps' worth
+    of history exist and a reader of either slot sees whole files."""
+    try:
+        os.replace(path, path + prev_suffix)
+    except FileNotFoundError:
+        pass
+
+
 def rotate_slots(store: MutableMapping, key: str, value,
                  prev_suffix: str = ".prev", depth: int = 1) -> None:
     """The mapping flavor of :func:`atomic_install`: install ``value`` at

@@ -772,6 +772,51 @@ def predict_flush_cost(leaf_sizes: Sequence[tuple[int, str]],
     return total
 
 
+def predict_step_comm_s(sync_mode: str | None = None,
+                        link_class: str = "ici",
+                        threshold_bytes: int | None = None,
+                        num_segments: int | None = None,
+                        model: CommsModel | None = None) -> float | None:
+    """The fitted model's price for this process's gradient wire under
+    the LIVE fusion configuration — the per-step communication roofline
+    the attribution plane compares the *observed* exposed-comm phase
+    against (``profiler.summary()["attribution"]``'s
+    ``exposed_comm_predicted_s`` / ``exposed_comm_residual_s``).
+
+    Unspecified axes resolve exactly like the wire itself would:
+    threshold/segments through ``ops.fusion`` (autotune pin > config >
+    env; jax-free env fallback on the driver), sync mode through the
+    ``HOROVOD_SYNC_MODE`` contract. None until the model has both a
+    ready fit and a noted leaf layout.
+    """
+    model = model or get_model()
+    leaf_sizes = model.leaf_sizes()
+    if not leaf_sizes:
+        return None
+    if threshold_bytes is None or num_segments is None:
+        try:
+            from .ops.fusion import fusion_threshold_bytes, overlap_segments
+
+            if threshold_bytes is None:
+                threshold_bytes = fusion_threshold_bytes()
+            if num_segments is None:
+                num_segments = overlap_segments()
+        except Exception:  # noqa: BLE001 — driver side: jax-free env read
+            from .utils.env import get_int as _get_int
+
+            if threshold_bytes is None:
+                threshold_bytes = _get_int("HOROVOD_FUSION_THRESHOLD",
+                                           64 * 1024 * 1024)
+            if num_segments is None:
+                num_segments = max(
+                    1, _get_int("HOROVOD_OVERLAP_SEGMENTS", 4))
+    if sync_mode is None:
+        sync_mode = (os.environ.get("HOROVOD_SYNC_MODE", "")
+                     .strip().lower() or "allreduce")
+    return predict_flush_cost(leaf_sizes, threshold_bytes, num_segments,
+                              sync_mode, link_class, model=model)
+
+
 def candidate_axes(candidate) -> tuple[int, int, str]:
     """Normalize an autotune grid candidate — an int threshold or a
     ``(threshold[, segments][, sync_mode])`` tuple — to

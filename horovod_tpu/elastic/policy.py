@@ -112,6 +112,15 @@ class PolicyController:
         # 0 disables the channel.
         self.comms_residual_s = get_float(
             "HOROVOD_POLICY_COMMS_RESIDUAL", 0.0)
+        # Step-regression channel: the attribution plane's sentinel
+        # (kv_server regression_suspects) names the critical-path
+        # gating host of a drifting step phase with its excess seconds
+        # over the EWMA baseline — lateness the collectives feel every
+        # step, directly comparable to the skew score. A host whose
+        # sustained excess crosses this many seconds is straggler
+        # evidence. 0 disables the channel (advisory-only sentinel).
+        self.step_regression_s = get_float(
+            "HOROVOD_POLICY_STEP_REGRESSION", 0.0)
         # Integrity-strikes channel (the fourth evidence source): a host
         # the cross-rank voting plane has named divergent this many
         # times is condemned outright — and, uniquely, BYPASSES the SLO
@@ -136,6 +145,7 @@ class PolicyController:
         self._ewma: dict[str, float] = {}
         self._hb_ewma: dict[str, float] = {}
         self._res_ewma: dict[str, float] = {}
+        self._regr_ewma: dict[str, float] = {}
         self._integrity: dict[str, int] = {}
         self._above_since: dict[str, float] = {}
         self._last_observe_t: float | None = None
@@ -202,7 +212,9 @@ class PolicyController:
     def observe(self, skew: Mapping[str, Any],
                 hb_ages: Mapping[str, float],
                 world_hosts: Sequence[str],
-                comms_residuals: Mapping[str, float] | None = None) -> None:
+                comms_residuals: Mapping[str, float] | None = None,
+                regression_excess: Mapping[str, float] | None = None
+                ) -> None:
         """Fold one evidence snapshot into the per-host EWMAs.
 
         ``skew`` is :func:`tracing.compute_skew` output (the server's
@@ -211,9 +223,14 @@ class PolicyController:
         predicted-vs-observed residual seconds from the cluster-merged
         comms model (the server's ``/comms`` body ``"residuals"`` map) —
         the third evidence channel, armed by
-        ``HOROVOD_POLICY_COMMS_RESIDUAL``. Hosts outside the current
-        world are dropped from the EWMA state (a departed host must not
-        carry stale condemnation back in through the spare tier)."""
+        ``HOROVOD_POLICY_COMMS_RESIDUAL``; ``regression_excess``
+        (optional) the attribution plane's {host: excess seconds over
+        the per-phase step-time baseline} suspect map
+        (``RendezvousServer.regression_suspects``) — the step-regression
+        channel, armed by ``HOROVOD_POLICY_STEP_REGRESSION``. Hosts
+        outside the current world are dropped from the EWMA state (a
+        departed host must not carry stale condemnation back in through
+        the spare tier)."""
         now = self._clock()
         world = set(world_hosts)
         # Per-host straggler score: mean lateness across the host's ranks
@@ -241,10 +258,12 @@ class PolicyController:
             if scores:
                 self._last_worst = skew.get("worst")
             for state in (self._ewma, self._hb_ewma, self._res_ewma,
-                          self._integrity, self._above_since):
+                          self._regr_ewma, self._integrity,
+                          self._above_since):
                 for host in [h for h in state if h not in world]:
                     del state[host]
             residuals = dict(comms_residuals or {})
+            regressions = dict(regression_excess or {})
             for host in world:
                 has_evidence = host in scores
                 if has_evidence:
@@ -273,6 +292,23 @@ class PolicyController:
                         res_prev = self._res_ewma.get(host, 0.0)
                         self._res_ewma[host] = res_prev + alpha * (
                             res - res_prev)
+                # Step-regression channel: same shape as the residual
+                # channel. The suspect map carries an entry for every
+                # world host when the channel is fed (0.0 = measured
+                # healthy), so absence here means the attribution plane
+                # was blind this tick — frozen, never a fake 0.0.
+                has_regr = host in regressions
+                if has_regr:
+                    try:
+                        regr = float(regressions[host])
+                    except (TypeError, ValueError):
+                        regr = float("nan")
+                    if not (regr >= 0.0):
+                        has_regr = False
+                    else:
+                        regr_prev = self._regr_ewma.get(host, 0.0)
+                        self._regr_ewma[host] = regr_prev + alpha * (
+                            regr - regr_prev)
                 # Sustained-evidence clock: the drain threshold must hold
                 # CONTINUOUSLY for window_s — one spiky instance resets.
                 hb_condemned = (self.hb_drift_s > 0
@@ -281,11 +317,16 @@ class PolicyController:
                     self.comms_residual_s > 0
                     and self._res_ewma.get(host, 0.0)
                     >= self.comms_residual_s)
+                regr_condemned = (
+                    self.step_regression_s > 0
+                    and self._regr_ewma.get(host, 0.0)
+                    >= self.step_regression_s)
                 if (ewma >= self.drain_skew_s or hb_condemned
-                        or res_condemned):
+                        or res_condemned or regr_condemned):
                     self._above_since.setdefault(host, now)
                 elif (has_evidence or self.hb_drift_s > 0
-                      or (self.comms_residual_s > 0 and has_res)):
+                      or (self.comms_residual_s > 0 and has_res)
+                      or (self.step_regression_s > 0 and has_regr)):
                     self._above_since.pop(host, None)
                 try:
                     _metrics.POLICY_STRAGGLER_EWMA.set(ewma, host=host)
@@ -311,6 +352,8 @@ class PolicyController:
                             for h, v in self._hb_ewma.items()},
                 "res_ewma": {h: float(v)
                              for h, v in self._res_ewma.items()},
+                "regr_ewma": {h: float(v)
+                              for h, v in self._regr_ewma.items()},
                 "above_ages": {h: max(now - t, 0.0)
                                for h, t in self._above_since.items()},
                 "integrity_strikes": dict(self._integrity),
@@ -328,7 +371,8 @@ class PolicyController:
         with self._lock:
             for key, target in (("ewma", self._ewma),
                                 ("hb_ewma", self._hb_ewma),
-                                ("res_ewma", self._res_ewma)):
+                                ("res_ewma", self._res_ewma),
+                                ("regr_ewma", self._regr_ewma)):
                 values = state.get(key)
                 if isinstance(values, Mapping):
                     for h, v in values.items():
@@ -424,11 +468,17 @@ class PolicyController:
                     # the model cannot explain — directly comparable to
                     # the skew score's lateness seconds.
                     score = max(score, self._res_ewma.get(h, 0.0))
+                if self.step_regression_s > 0:
+                    # The regression excess IS seconds of per-step
+                    # lateness over the host's own baseline — the same
+                    # unit again.
+                    score = max(score, self._regr_ewma.get(h, 0.0))
                 candidates.append((score, h))
             worst = dict(self._last_worst) if self._last_worst else None
             ewma_snapshot = dict(self._ewma)
             hb_snapshot = dict(self._hb_ewma)
             res_snapshot = dict(self._res_ewma)
+            regr_snapshot = dict(self._regr_ewma)
             above = {h: now - t for h, t in self._above_since.items()}
         if integrity_hosts:
             strikes, host = integrity_hosts[0]
@@ -475,6 +525,8 @@ class PolicyController:
                               for h, v in hb_snapshot.items()},
             "comms_residual_ewma_s": {h: round(v, 6)
                                       for h, v in res_snapshot.items()},
+            "step_regression_ewma_s": {h: round(v, 6)
+                                       for h, v in regr_snapshot.items()},
             "sustained_s": {h: round(v, 3) for h, v in above.items()},
             "window_s": self.window_s,
             "drain_skew_s": self.drain_skew_s,

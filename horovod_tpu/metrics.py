@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Any, Iterable, Mapping, Sequence
 
+from .attribution import PHASE_WALL, STEP_PHASES
+
 # ---------------------------------------------------------------------------
 # Bucket ladders (fixed per signal class, so per-rank snapshots merge).
 # ---------------------------------------------------------------------------
@@ -781,6 +783,35 @@ REWINDS = counter(
     "hvd_rewinds_total",
     "Storage-free rewinds to the last commit, by trigger reason "
     "(loss_spike).", ("reason",))
+# Step-time attribution plane (horovod_tpu/attribution.py): per-step
+# wall-time decomposition, exposed-communication accounting, MFU, and
+# the regression sentinel. Updated on every SYNCED step by
+# attribution.note_step (the tracer's step-end hook).
+STEP_PHASE_SECONDS = gauge(
+    "hvd_step_phase_seconds",
+    "Last synced step's wall time by attribution phase "
+    "(compute|exposed_comm|straggler_wait|overhead); the four phases "
+    "sum to the step wall time.", ("phase",))
+EXPOSED_COMM = gauge(
+    "hvd_exposed_comm_seconds",
+    "Collective wall time of the last synced step NOT hidden under "
+    "concurrent compute spans (straggler wait included) — what the "
+    "overlap scheduler and fsdp prefetch failed to hide.")
+OVERLAP_HIDDEN = gauge(
+    "hvd_overlap_hidden_ratio",
+    "Fraction of the last synced step's collective wall time hidden "
+    "under concurrent compute spans (measured by interval arithmetic, "
+    "vs the bench-derived hvd_fsdp_prefetch_overlap_ratio probe).")
+MFU_RATIO = gauge(
+    "hvd_mfu_ratio",
+    "Model FLOPs utilization of the last synced step: "
+    "hvd.set_model_flops_per_step / (step wall x per-process peak "
+    "FLOPs); 0 until the model declares its FLOPs.")
+STEP_REGRESSION_SCORE = gauge(
+    "hvd_step_regression_score",
+    "Regression-sentinel drift score per attribution phase (positive "
+    "excess over the EWMA baseline in deviations; alarm at "
+    "HOROVOD_STEP_REGRESSION_SIGMA).", ("phase",))
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -819,6 +850,17 @@ def _materialize_checkpoint_cells() -> None:
     for action in ("warn", "skip", "abort"):
         NONFINITE_STEPS.labels(action=action)
     REWINDS.labels(reason="loss_spike")
+    # Attribution-plane zero cells: a job that never synced a step (or
+    # never declared its FLOPs) still reports the series at 0, so the
+    # premerge scrape gate can assert the instruments exist and
+    # dashboards can tell "no regression" from "not measuring".
+    for phase in STEP_PHASES:
+        STEP_PHASE_SECONDS.labels(phase=phase)
+    for phase in STEP_PHASES + (PHASE_WALL,):
+        STEP_REGRESSION_SCORE.labels(phase=phase)
+    EXPOSED_COMM.labels()
+    OVERLAP_HIDDEN.labels()
+    MFU_RATIO.labels()
 
 
 _materialize_checkpoint_cells()
@@ -979,12 +1021,44 @@ class EventJournal:
     restarts); ``t_mono`` is ``time.monotonic()`` (in-process ordering
     immune to NTP steps). Writes are flushed per line under a lock so a
     SIGKILL mid-run loses at most the record being written.
+
+    **Rotation** (``HOROVOD_EVENT_LOG_MAX_BYTES``, 0 = unbounded): a
+    long elastic run's journal would otherwise grow without bound. When
+    the file crosses the cap after a write, it is retired to
+    ``<path>.prev`` — the same one-``.prev``-slot contract as
+    :func:`checkpoint.rotate_slots` / ``atomic_install``, via
+    :func:`checkpoint.rotate_file` — and a fresh file opens. The
+    rotation happens under the write lock between whole lines and the
+    rename is atomic, so a tailing reader sees complete records only,
+    never a torn one; at most two caps' worth of history exist on disk.
     """
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
         self._fh = open(path, "a", encoding="utf-8")
+
+    @staticmethod
+    def max_bytes() -> int:
+        """Rotation cap (``HOROVOD_EVENT_LOG_MAX_BYTES``; 0 = off).
+        Re-read per write so long-lived processes honor env changes."""
+        try:
+            return int(os.environ.get(
+                "HOROVOD_EVENT_LOG_MAX_BYTES", "0") or 0)
+        except ValueError:
+            return 0
+
+    def _rotate_locked(self) -> None:
+        # Lazy import: checkpoint.py imports this module at its top.
+        from .checkpoint import rotate_file
+
+        self._fh.close()
+        try:
+            rotate_file(self.path)
+        finally:
+            # Reopen even when the rename failed (read-only dir): the
+            # journal keeps appending rather than dying over rotation.
+            self._fh = open(self.path, "a", encoding="utf-8")
 
     def event(self, name: str, generation: int | None = None,
               **fields: Any) -> None:
@@ -997,9 +1071,15 @@ class EventJournal:
         }
         record.update(fields)
         line = json.dumps(record, default=str)
+        limit = self.max_bytes()
         with self._lock:
             self._fh.write(line + "\n")
             self._fh.flush()
+            if limit > 0 and self._fh.tell() >= limit:
+                try:
+                    self._rotate_locked()
+                except OSError:
+                    pass
 
     def close(self) -> None:
         with self._lock:

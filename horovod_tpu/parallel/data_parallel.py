@@ -1003,10 +1003,16 @@ def make_elastic_train_step(
 
         from .. import tracing
 
+        from .. import attribution
+
         # The elastic step's phases ARE host-separable (compiled local
         # leg, host collective leg, compiled apply), so each gets a real
-        # span — the per-phase breakdown the cross-rank timeline merges.
-        with tracing.span("forward_backward", "phase"):
+        # span — the per-phase breakdown the cross-rank timeline merges
+        # and the attribution plane decomposes. Names come from the one
+        # shared vocabulary (attribution.PHASE_SPAN_NAMES) so bench's
+        # phase lane and this step cannot drift.
+        with tracing.span(attribution.SPAN_FORWARD_BACKWARD,
+                          attribution.CAT_PHASE):
             loss, grads = grad_step(params, batch)
         nprocs = int(os.environ.get("HOROVOD_NUM_PROCESSES", "1") or 1)
         if nprocs > 1 and jax.process_count() == 1:
@@ -1026,7 +1032,8 @@ def make_elastic_train_step(
             # f64; f32/bf16/f16 accumulate in f32 and cast back.
             from ..ops.collective_ops import Sum, grouped_allreduce
 
-            with tracing.span("collective", "collective",
+            with tracing.span(attribution.SPAN_COLLECTIVE,
+                              attribution.CAT_COLLECTIVE,
                               args={"plane": "host"}):
                 n_local = float(mesh.size)
                 leaves, treedef = jax.tree.flatten(grads)
@@ -1056,7 +1063,8 @@ def make_elastic_train_step(
                             np.asarray(r) / total_n).astype(leaves[i].dtype)
                 grads = jax.tree.unflatten(treedef, out)
                 loss = jnp.asarray(global_loss, jnp.float32)
-        with tracing.span("optimizer_update", "phase"):
+        with tracing.span(attribution.SPAN_OPTIMIZER_UPDATE,
+                          attribution.CAT_PHASE):
             params, opt_state = apply_step(params, opt_state, grads)
         return params, opt_state, loss
 

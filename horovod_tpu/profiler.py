@@ -77,11 +77,17 @@ def summary() -> dict:
     attribution), and the communication observatory's fitted α–β model
     (``"comms"``: per-key fits with sample counts, the
     predicted-vs-observed residual, the efficiency EWMA — reset via
-    ``comms_model.reset_for_testing()``). ``bench.py`` emits this once
-    per run so every benchmark record carries the cache/goodput behavior
-    that produced it.
+    ``comms_model.reset_for_testing()``), and the step-time attribution
+    plane (``"attribution"``: the last synced step's
+    compute/exposed_comm/straggler_wait/overhead decomposition, MFU
+    when ``hvd.set_model_flops_per_step`` declared the model's FLOPs,
+    the predicted-vs-observed exposed-comm residual, and the local
+    regression sentinel's state — see docs/observability.md "Step-time
+    attribution"). ``bench.py`` emits this once per run so every
+    benchmark record carries the cache/goodput behavior that produced
+    it.
     """
-    from . import comms_model, integrity, metrics, tracing
+    from . import attribution, comms_model, integrity, metrics, tracing
     from .ops.collective_ops import cache_stats
 
     return {
@@ -93,6 +99,7 @@ def summary() -> dict:
         "fsdp": metrics.fsdp_summary(),
         "comms": comms_model.summary(),
         "integrity": integrity.summary(),
+        "attribution": attribution.summary(),
         **cache_stats(),
     }
 

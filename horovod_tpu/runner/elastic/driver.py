@@ -1205,8 +1205,25 @@ class ElasticDriver:
                                  .get("residuals") or {})
                 except Exception as e:  # noqa: BLE001 — best-effort
                     self._log.debug("elastic: comms summary failed: %s", e)
+            # Step-regression channel: the attribution sentinel's
+            # {suspect host: excess seconds} map. Every world host gets
+            # an explicit 0.0 when the channel is fed (measured
+            # healthy), so a cleared alarm RESETS the condemnation
+            # clock instead of freezing it; knob-gated like the
+            # comms channel (the analysis runs on the server either
+            # way — this only gates the controller's intake).
+            regression: dict | None = None
+            if self._policy.step_regression_s > 0:
+                try:
+                    regression = {h: 0.0 for h in world_names}
+                    regression.update(self._server.regression_suspects())
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    self._log.debug(
+                        "elastic: regression suspects failed: %s", e)
+                    regression = None
             self._policy.observe(skew, self._server.heartbeat_ages(),
-                                 world_names, comms_residuals=residuals)
+                                 world_names, comms_residuals=residuals,
+                                 regression_excess=regression)
         decision = self._policy.decide(world_names,
                                        self._warm_spare_count())
         if decision is not None and decision.host in self._workers:

@@ -81,8 +81,10 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 from urllib.error import HTTPError
+from urllib.parse import parse_qs, urlsplit
 from urllib.request import Request, urlopen
 
+from ... import attribution as _attribution
 from ... import comms_model as _comms_model
 from ... import faults
 from ... import integrity as _integrity
@@ -162,6 +164,42 @@ PEERSTATE_SCOPE = _peercheck.PEERSTATE_SCOPE
 _TRACE_MAX_BYTES = 1 << 20
 
 
+def timeline_max_events() -> int:
+    """Span-event cap for UNFILTERED ``GET /timeline`` bodies
+    (``HOROVOD_TIMELINE_MAX_EVENTS``, default 200000; 0 disables): a
+    large world's full merge can run to hundreds of MB, so past the cap
+    the server answers **413** and the caller must bound the request
+    with ``?steps=N`` / ``?rank=R``. Filtered requests are never capped
+    (the caller already bounded them), and ``/criticalpath`` is never
+    capped (its body is the small per-group analysis, not the raw
+    spans). Documented in docs/timeline.md."""
+    return get_int("HOROVOD_TIMELINE_MAX_EVENTS", 200000)
+
+
+def _trace_query(query: str) -> tuple[int | None, str | None] | None:
+    """Parse the shared ``?steps=N&rank=R`` trace-route filters.
+    Returns (steps, rank), or None when a value is malformed (400)."""
+    try:
+        q = parse_qs(query, keep_blank_values=False)
+    except ValueError:
+        return None
+    steps = None
+    rank = None
+    if "steps" in q:
+        try:
+            steps = int(q["steps"][-1])
+        except (ValueError, IndexError):
+            return None
+        if steps <= 0:
+            return None
+    if "rank" in q:
+        rank = q["rank"][-1]
+    unknown = set(q) - {"steps", "rank"}
+    if unknown:
+        return None
+    return steps, rank
+
+
 def env_generation() -> int | None:
     """The launcher-written world generation, or None outside elastic
     worlds (static/manual launches are never fenced)."""
@@ -219,12 +257,16 @@ class _KVHandler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802
         if self._serve_fault():
             return
+        route = urlsplit(self.path)
         if self.path == "/metrics":
             # Unauthenticated by design: Prometheus scrapers can't HMAC.
             return self._serve_metrics()
-        if self.path == "/timeline":
-            # Same exemption: Perfetto/curl can't sign; read-only.
-            return self._serve_json(_render_timeline, "application/json")
+        if route.path in ("/timeline", "/criticalpath"):
+            # Same exemption: Perfetto/curl can't sign; read-only. Both
+            # routes take ?steps=N / ?rank=R so large-world scrapes stay
+            # bounded; an unfiltered body past the event cap answers 413
+            # (see timeline_max_events).
+            return self._serve_trace_route(route.path, route.query)
         if self.path == "/stragglers":
             return self._serve_json(
                 lambda httpd: _compute_cluster_skew(httpd)[0],
@@ -432,6 +474,12 @@ class _KVHandler(BaseHTTPRequestHandler):
                         depth=_peercheck.retention_depth())
                 else:
                     self.server.store.setdefault(scope, {})[key] = body  # type: ignore[attr-defined]
+                if scope == TRACE_SCOPE:
+                    # Attribution cache key: one bump per trace mutation
+                    # so /criticalpath and the regression sentinel
+                    # re-analyze exactly when new spans arrive.
+                    self.server.trace_version = (  # type: ignore[attr-defined]
+                        getattr(self.server, "trace_version", 0) + 1)
                 if scope == HEARTBEAT_SCOPE:
                     # Liveness plane: stamp the receive time on the SERVER
                     # clock (driver-side monotonic; worker clocks
@@ -469,6 +517,36 @@ class _KVHandler(BaseHTTPRequestHandler):
         if rejected is not None:
             return self._reply(409, rejected)
         self._reply(200, b"")
+
+    def _serve_trace_route(self, path: str, query: str):
+        parsed = _trace_query(query)
+        if parsed is None:
+            return self._reply(
+                400, b"bad query: use ?steps=N (positive int) "
+                     b"and/or ?rank=R")
+        steps, rank = parsed
+        if path == "/timeline" and steps is None and rank is None:
+            # The cap guards /timeline only: its body scales with the
+            # raw span count. /criticalpath serves the small per-group
+            # analysis (computed cached on every scrape regardless), so
+            # capping it would deny the route while protecting nothing.
+            cap = timeline_max_events()
+            if cap > 0:
+                count = _timeline_span_count(self.server)
+                if count > cap:
+                    return self._reply(
+                        413,
+                        (f"merged trace holds {count} span events > cap "
+                         f"{cap} (HOROVOD_TIMELINE_MAX_EVENTS); bound "
+                         f"the request with ?steps=N and/or ?rank=R"
+                         ).encode())
+        if path == "/criticalpath":
+            render = (lambda httpd:
+                      _render_criticalpath(httpd, steps=steps, rank=rank))
+        else:
+            render = (lambda httpd:
+                      _render_timeline(httpd, steps=steps, rank=rank))
+        return self._serve_json(render, "application/json")
 
     def _serve_metrics(self):
         try:
@@ -516,14 +594,30 @@ def _trace_payloads(httpd) -> dict[str, dict]:
     return out
 
 
-def _render_timeline(httpd) -> dict:
+def _timeline_span_count(httpd) -> int:
+    """Span events an unfiltered /timeline body would carry (the 413
+    cap's cheap estimate — no JSON re-render)."""
+    total = 0
+    for payload in _trace_payloads(httpd).values():
+        for steprec in payload.get("steps", ()) or ():
+            if isinstance(steprec, dict):
+                total += len(steprec.get("spans", ()) or ())
+    return total
+
+
+def _render_timeline(httpd, steps: int | None = None,
+                     rank: str | None = None) -> dict:
     """The merged cross-rank trace: every shipped payload's spans on one
     server timebase (each rank's measured clock offset applied), one
     Chrome-trace process track per rank. Loadable directly in Perfetto /
-    chrome://tracing."""
+    chrome://tracing. ``steps`` keeps only each rank's last N buffered
+    steps; ``rank`` keeps one rank's track — the ``?steps=N`` /
+    ``?rank=R`` query filters that keep large-world scrapes bounded."""
     payloads = _trace_payloads(httpd)
     events: list[dict] = []
     for host, payload in sorted(payloads.items()):
+        if rank is not None and str(payload.get("rank", "?")) != str(rank):
+            continue
         try:
             pid = int(payload.get("rank", 0))
         except (TypeError, ValueError):
@@ -536,7 +630,10 @@ def _render_timeline(httpd) -> dict:
                        "args": {"name": f"rank {pid} ({host})"}})
         events.append({"name": "process_sort_index", "ph": "M", "pid": pid,
                        "args": {"sort_index": pid}})
-        for steprec in payload.get("steps", ()) or ():
+        steprecs = list(payload.get("steps", ()) or ())
+        if steps is not None and steps > 0:
+            steprecs = steprecs[-steps:]  # ring order: oldest first
+        for steprec in steprecs:
             if not isinstance(steprec, dict):
                 continue
             for sp in steprec.get("spans", ()) or ():
@@ -600,6 +697,127 @@ def _compute_cluster_skew(httpd) -> tuple[dict, dict[str, dict]]:
                 skew_s=worst["skew_s"], collective=worst["name"],
                 step=worst["step"])
     return skew, payloads
+
+
+def _cluster_attribution(httpd) -> dict:
+    """The full-cluster step attribution (``attribution.analyze_cluster``
+    over the shipped payloads), cached per trace-store mutation
+    (``trace_version``) so repeated scrapes and replica polls cost one
+    integer compare. A cache MISS additionally folds any new
+    (generation, step) groups into the server's regression sentinel —
+    the one place the sentinel ticks, so it advances exactly once per
+    new sampled step no matter how many routes render it."""
+    with httpd.lock:
+        version = getattr(httpd, "trace_version", 0)
+        cached = getattr(httpd, "attrib_cache", None)
+    if cached is not None and cached[0] == version:
+        return cached[1]
+    analysis = _attribution.analyze_cluster(_trace_payloads(httpd))
+    _sentinel_fold(httpd, analysis)
+    with httpd.lock:
+        httpd.attrib_cache = (version, analysis)
+    return analysis
+
+
+def _sentinel_fold(httpd, analysis: dict) -> None:
+    """Feed NEW (generation, step) groups into the server's regression
+    sentinel (EWMA baseline per phase over the cluster-mean
+    decomposition), journal a ``step_regression`` event for each phase
+    that newly crosses the drift threshold — naming the suspect rank the
+    group's critical path gated on — and refresh the advisory
+    ``regression_suspects`` map ({host: excess seconds}) the self-healing
+    policy may consult (``HOROVOD_POLICY_STEP_REGRESSION``)."""
+    sentinel = getattr(httpd, "attrib_sentinel", None)
+    if sentinel is None:
+        return
+    with httpd.lock:
+        folded = httpd.attrib_folded
+        new = [g for g in analysis.get("groups", ())
+               if (g["generation"], g["step"]) not in folded]
+        folded.update((g["generation"], g["step"]) for g in new)
+        if len(folded) > 4096:
+            # Evict the OLDEST keys only: the per-rank ring advances
+            # monotonically, so a low (generation, step) can never
+            # reappear in the payloads — while an arbitrary set.pop()
+            # could evict a still-buffered group and double-fold it
+            # into the sentinel on the next mutation.
+            for key in sorted(folded)[:len(folded) - 2048]:
+                folded.discard(key)
+    suspects: dict[str, float] = {}
+    for g in new:
+        ranks = g.get("ranks") or {}
+        if not ranks:
+            continue
+        phases = {
+            p: sum(d["phases"].get(p, 0.0) for d in ranks.values())
+            / len(ranks)
+            for p in _attribution.STEP_PHASES
+        }
+        verdict = sentinel.observe(phases, wall=g.get("wall_s"))
+        alarmed = sorted(sentinel.snapshot()["alarmed"])
+        if verdict["alarms"]:
+            _metrics.event(
+                "step_regression",
+                generation=g["generation"], step=g["step"],
+                phases=verdict["alarms"],
+                scores={p: verdict["scores"].get(p)
+                        for p in verdict["alarms"]},
+                excess_s={p: verdict["excess_s"].get(p)
+                          for p in verdict["alarms"]},
+                suspect_rank=g.get("suspect_rank"),
+                suspect_host=g.get("suspect_host"))
+        # Advisory policy channel: while ANY phase is in alarm, the
+        # latest group's critical-path suspect carries the worst
+        # alarmed excess (seconds — directly comparable to the skew
+        # and comms-residual lateness channels). No alarm = empty map.
+        if alarmed and g.get("suspect_host"):
+            suspects = {
+                str(g["suspect_host"]): max(
+                    (verdict["excess_s"].get(p, 0.0) for p in alarmed),
+                    default=0.0)
+            }
+        elif not alarmed:
+            suspects = {}
+    if new:
+        with httpd.lock:
+            httpd.regression_suspects = suspects
+
+
+def _render_criticalpath(httpd, steps: int | None = None,
+                         rank: str | None = None) -> dict:
+    """``GET /criticalpath``: the merged per-step attribution — per-rank
+    phase decomposition (phases sum to each rank's step wall time), the
+    cluster critical path with a named gating rank per collective
+    barrier, per-rank MFU where the model declared its FLOPs, and the
+    regression sentinel's state. A world with no synced samples yet
+    (cold start, ``HOROVOD_TRACE_SAMPLE=0``) serves an explicit
+    ``insufficient_samples`` body — never a 500. ``steps``/``rank`` are
+    the bounding query filters (applied to the cached full analysis)."""
+    analysis = _cluster_attribution(httpd)
+    groups = list(analysis.get("groups", ()))
+    if steps is not None and steps > 0:
+        groups = groups[-steps:]
+    if rank is not None:
+        groups = [
+            dict(g, ranks={r: d for r, d in g.get("ranks", {}).items()
+                           if r == str(rank)})
+            for g in groups
+        ]
+        groups = [g for g in groups if g["ranks"]]
+    with httpd.lock:
+        generation = httpd.version
+        sentinel = getattr(httpd, "attrib_sentinel", None)
+        suspects = dict(getattr(httpd, "regression_suspects", {}))
+    return {
+        "status": "ok" if groups else "insufficient_samples",
+        "generation": generation,
+        "groups": groups,
+        "regression": {
+            "sentinel": (sentinel.snapshot()
+                         if sentinel is not None else None),
+            "suspects": suspects,
+        },
+    }
 
 
 def _comms_payloads(httpd) -> dict[str, dict]:
@@ -835,6 +1053,14 @@ def _render_cluster_metrics(httpd) -> str:
         "hvd_worker_commits_total", "counter",
         "State commits reported on each worker's last heartbeat.",
         commit_samples))
+    # Tick the step-attribution plane on every scrape (cached per trace
+    # mutation, so an idle poll costs one integer compare): the
+    # regression sentinel must advance on the operator's regular
+    # /metrics cadence even when nobody fetches /criticalpath.
+    try:
+        _cluster_attribution(httpd)
+    except Exception:  # noqa: BLE001 — attribution must not kill the scrape
+        pass
     # Straggler attribution from the tracing plane: per-rank arrival skew
     # against the earliest rank on matched collectives/steps (shipped
     # trace payloads, offset-corrected), and a per-host score the
@@ -902,6 +1128,17 @@ class RendezvousServer:
         self._httpd.hb_version = 0  # type: ignore[attr-defined]
         self._httpd.integrity_vote_cache = None  # type: ignore[attr-defined]
         self._httpd.straggler_logged = set()  # type: ignore[attr-defined]
+        # Step-attribution plane: the analysis cache (keyed by the trace
+        # mutation counter), the regression sentinel, the set of
+        # (generation, step) groups already folded into it, and the
+        # advisory {host: excess seconds} suspect map the policy may
+        # consult (HOROVOD_POLICY_STEP_REGRESSION).
+        self._httpd.trace_version = 0  # type: ignore[attr-defined]
+        self._httpd.attrib_cache = None  # type: ignore[attr-defined]
+        self._httpd.attrib_sentinel = (  # type: ignore[attr-defined]
+            _attribution.RegressionSentinel())
+        self._httpd.attrib_folded = set()  # type: ignore[attr-defined]
+        self._httpd.regression_suspects = {}  # type: ignore[attr-defined]
         # Key snapshot at construction: the job's secret must not drift
         # under a live server (and env edits elsewhere must not rekey it).
         self._httpd.secret = _secret.current_key()  # type: ignore[attr-defined]
@@ -1122,6 +1359,26 @@ class RendezvousServer:
         serves over HTTP), rendered in-process."""
         return _render_timeline(self._httpd)
 
+    def criticalpath_summary(self, steps: int | None = None,
+                             rank: str | None = None) -> dict:
+        """The merged step attribution (what ``GET /criticalpath``
+        serves over HTTP), rendered in-process."""
+        return _render_criticalpath(self._httpd, steps=steps, rank=rank)
+
+    def regression_suspects(self) -> dict[str, float]:
+        """The regression sentinel's advisory {host: excess seconds}
+        map — non-empty only while a phase baseline is in alarm, naming
+        the critical path's gating host. The elastic driver feeds this
+        to the policy controller when ``HOROVOD_POLICY_STEP_REGRESSION``
+        arms that channel. Ticks the (cached) analysis first so the map
+        reflects the latest shipped traces."""
+        try:
+            _cluster_attribution(self._httpd)
+        except Exception:  # noqa: BLE001 — advisory channel
+            pass
+        with self._httpd.lock:  # type: ignore[attr-defined]
+            return dict(getattr(self._httpd, "regression_suspects", {}))
+
     def straggler_summary(self) -> dict:
         """The arrival-skew attribution (what ``GET /stragglers``
         serves), rendered in-process."""
@@ -1151,6 +1408,11 @@ class RendezvousServer:
         with self._httpd.lock:  # type: ignore[attr-defined]
             self._httpd.store.clear()  # type: ignore[attr-defined]
             self._httpd.version += 1  # type: ignore[attr-defined]
+            # Trace scope went with the store: invalidate the cached
+            # attribution analysis or /criticalpath would keep serving
+            # the dead world's groups.
+            self._httpd.trace_version = (  # type: ignore[attr-defined]
+                getattr(self._httpd, "trace_version", 0) + 1)
             return self._httpd.version  # type: ignore[attr-defined]
 
     def publish_epoch(self, scope_prefix: str, data: dict[str, bytes],
@@ -1226,6 +1488,10 @@ class RendezvousServer:
             # live-vote fence must not keep serving a vote over it.
             self._httpd.hb_version = (  # type: ignore[attr-defined]
                 getattr(self._httpd, "hb_version", 0) + 1)
+            # Its trace payload left too: the attribution cache must
+            # re-analyze without the departed rank's spans.
+            self._httpd.trace_version = (  # type: ignore[attr-defined]
+                getattr(self._httpd, "trace_version", 0) + 1)
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -326,6 +326,17 @@ class StepTracer:
                 "args": {"synced": rec.synced},
             })
             self._ring.append(rec.as_dict())
+        if rec.synced:
+            # Synced steps carry REAL wall time, so they feed the
+            # attribution plane: phase decomposition, exposed-comm and
+            # MFU gauges, the local regression sentinel. Un-synced
+            # steps time async dispatch only and would report garbage.
+            try:
+                from . import attribution
+
+                attribution.note_step(rec.as_dict())
+            except Exception:  # noqa: BLE001 — attribution is advisory
+                pass
         if rec.ship:
             ship_async(self.payload())
 
@@ -378,10 +389,13 @@ class StepTracer:
 
     def payload(self) -> dict:
         """The wire format shipped to ``PUT /trace/<host>`` and merged by
-        ``GET /timeline``."""
+        ``GET /timeline`` / ``GET /criticalpath``. When the model's
+        FLOPs-per-step were declared (``hvd.set_model_flops_per_step``)
+        they ride along so the driver's critical-path merge can report
+        per-rank MFU."""
         from . import metrics
 
-        return {
+        out = {
             "rank": _rank(),
             "host": _host(),
             "generation": metrics.default_generation(),
@@ -390,6 +404,17 @@ class StepTracer:
             "t_ship": self.clock.now(),
             "steps": self.ring_snapshot(),
         }
+        try:
+            from . import attribution
+
+            flops, peak = attribution.model_flops()
+            if flops:
+                out["model_flops_per_step"] = flops
+            if peak:
+                out["peak_flops_per_rank"] = peak
+        except Exception:  # noqa: BLE001 — attribution is advisory
+            pass
+        return out
 
 
 class _StepScope:
@@ -646,6 +671,19 @@ def dump_flight_record(reason: str, generation: int | None = None,
             isum = integrity.flight_summary()
             if isum is not None:
                 snap["integrity"] = isum
+        except Exception:  # noqa: BLE001 — the dump must still land
+            pass
+        # Attribution rides too: the last synced step's phase
+        # decomposition (where DID the wall time go before the wedge),
+        # and — for a wedged collective still open — the gating rank
+        # the cluster's partial critical path names (best-effort fetch
+        # from GET /criticalpath; the first postmortem question).
+        try:
+            from . import attribution
+
+            asum = attribution.flight_summary(snap)
+            if asum is not None:
+                snap["attribution"] = asum
         except Exception:  # noqa: BLE001 — the dump must still land
             pass
         metrics.FLIGHT_DUMPS.inc(reason=reason)

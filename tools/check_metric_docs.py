@@ -1,0 +1,93 @@
+"""Static consistency check: code-registered metrics vs the docs table.
+
+Every ``hvd_*`` instrument name registered anywhere in ``horovod_tpu/``
+(``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` registry calls
+and the KV server's literal ``make_family(...)`` driver gauges) must
+appear in docs/observability.md's metric tables, and every ``hvd_*``
+name a table documents must be registered in code. The table drifted in
+every PR since the metrics plane landed; this pass (wired as a
+``tools/premerge.sh`` lane and a tier-1 test) makes the drift a CI
+failure that NAMES the missing metrics instead of a docs bug found at
+incident time.
+
+Exit 0 when the two sets match; exit 1 listing the mismatch otherwise.
+Pure stdlib static analysis — no framework import, no jax.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs", "observability.md")
+
+#: A registry call (or a literal driver-family construction) whose first
+#: argument is the metric name. ``\s*`` spans newlines under re.S so the
+#: black-wrapped multi-line forms match too.
+_REGISTER_RE = re.compile(
+    r"\b(?:counter|gauge|histogram|make_family)\(\s*"
+    r"['\"](hvd_[A-Za-z0-9_]+)['\"]", re.S)
+
+#: A metric-table row: a pipe-table line whose first cell is a
+#: backticked hvd_* name (labels like ``{phase}`` may trail the name).
+_TABLE_ROW_RE = re.compile(r"^\|\s*`(hvd_[A-Za-z0-9_]+)")
+
+
+def code_metrics(root: str = REPO) -> dict[str, list[str]]:
+    """{metric name: [files registering it]} over horovod_tpu/*.py."""
+    out: dict[str, list[str]] = {}
+    pkg = os.path.join(root, "horovod_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            rel = os.path.relpath(path, root)
+            for name in _REGISTER_RE.findall(text):
+                out.setdefault(name, []).append(rel)
+    return out
+
+
+def doc_metrics(path: str = DOCS) -> set[str]:
+    """hvd_* names documented in observability.md's metric tables."""
+    out: set[str] = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            m = _TABLE_ROW_RE.match(line.strip())
+            if m:
+                out.add(m.group(1))
+    return out
+
+
+def main() -> int:
+    registered = code_metrics()
+    documented = doc_metrics()
+    undocumented = sorted(set(registered) - documented)
+    unregistered = sorted(documented - set(registered))
+    if not undocumented and not unregistered:
+        print(f"check_metric_docs: ok ({len(registered)} registered "
+              f"instruments all tabulated in docs/observability.md)")
+        return 0
+    if undocumented:
+        print("check_metric_docs: registered in code but MISSING from "
+              "docs/observability.md's metric tables:", file=sys.stderr)
+        for name in undocumented:
+            print(f"  {name}  (registered in "
+                  f"{', '.join(sorted(set(registered[name])))})",
+                  file=sys.stderr)
+    if unregistered:
+        print("check_metric_docs: documented in the metric tables but "
+              "registered NOWHERE in horovod_tpu/:", file=sys.stderr)
+        for name in unregistered:
+            print(f"  {name}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

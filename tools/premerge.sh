@@ -11,6 +11,16 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+echo "== premerge gate 0/4: metric-docs consistency (static lane) =="
+# Every hvd_* instrument registered in code must appear in
+# docs/observability.md's metric tables and vice versa — the table
+# drifted in every PR since the metrics plane landed; this makes the
+# drift a named CI failure instead of a docs bug found at incident time.
+if ! python tools/check_metric_docs.py; then
+    echo "premerge: metric-docs consistency lane failed" >&2
+    exit 1
+fi
+
 echo "== premerge gate 1/4: tier-1 tests =="
 t1log="$(mktemp "${TMPDIR:-/tmp}/_t1.XXXXXX.log")"  # per-run: concurrent
 trap 'rm -f "$t1log"' EXIT                          # premerges must not clobber
@@ -195,7 +205,7 @@ then
     exit 1
 fi
 
-echo "== premerge gate 4/4: /metrics scrape + /timeline + /comms + /integrity lane =="
+echo "== premerge gate 4/4: /metrics scrape + /timeline + /criticalpath + /comms + /integrity lane =="
 # End-to-end over the REAL plumbing: the bench run's instrument snapshot
 # is published to a live RendezvousServer via the same heartbeat PUT
 # workers use, then scraped back over plain HTTP from GET /metrics; the
@@ -310,6 +320,14 @@ try:
         "hvd_integrity_quarantined_ranks",
         "hvd_nonfinite_steps_total",
         "hvd_rewinds_total",
+        # Step-time attribution plane: zero-materialized likewise; the
+        # bench's synced bench_phases step sets the phase/exposed-comm
+        # gauges to real values.
+        "hvd_step_phase_seconds",
+        "hvd_exposed_comm_seconds",
+        "hvd_overlap_hidden_ratio",
+        "hvd_mfu_ratio",
+        "hvd_step_regression_score",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -349,6 +367,50 @@ try:
         sys.exit(
             f"premerge timeline lane: expected >=2 rank tracks, got "
             f"pids={sorted(pids)}")
+    # Step attribution over HTTP: the 2-rank bench trace must analyze
+    # into a per-rank phase decomposition whose phases sum to the step
+    # wall time within 5%, with a named gating rank on every
+    # critical-path collective (the ISSUE-13 acceptance contract).
+    aurl = f"http://127.0.0.1:{server.port}/criticalpath"
+    with urllib.request.urlopen(aurl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge attribution lane: {aurl} answered "
+                     f"{r.status}")
+        abody = r.read()
+    cpath = json.loads(abody)
+    if cpath.get("status") != "ok":
+        sys.exit(
+            f"premerge attribution lane: /criticalpath status "
+            f"{cpath.get('status')!r} (expected 'ok' — did the bench "
+            f"trace lose its synced bench_phases step?)")
+    agroups = cpath.get("groups") or []
+    newest = agroups[-1]
+    aranks = newest.get("ranks") or {}
+    if len(aranks) < 2:
+        sys.exit(
+            f"premerge attribution lane: expected >=2 rank "
+            f"decompositions, got {sorted(aranks)}")
+    for arank, ainfo in aranks.items():
+        total = sum((ainfo.get("phases") or {}).values())
+        wall = ainfo.get("wall_s") or 0.0
+        if wall <= 0 or abs(total - wall) > 0.05 * wall:
+            sys.exit(
+                f"premerge attribution lane: rank {arank} phases sum to "
+                f"{total:.6f}s vs step wall {wall:.6f}s (must agree "
+                f"within 5%; phases={ainfo.get('phases')})")
+    acolls = [n for n in (newest.get("critical_path") or [])
+              if n.get("kind") == "collective"]
+    if not acolls:
+        sys.exit("premerge attribution lane: critical path has no "
+                 "collective barrier nodes")
+    unnamed = [n for n in acolls if not n.get("gating_rank")
+               and n.get("gating_rank") != 0]
+    if unnamed:
+        sys.exit(
+            f"premerge attribution lane: critical-path collectives "
+            f"without a named gating rank: {unnamed[:3]}")
+    with open(os.path.join(artifacts, "criticalpath.json"), "wb") as f:
+        f.write(abody)
     # Cluster-merged comms model over HTTP: >=2 rank payloads, fitted.
     curl = f"http://127.0.0.1:{server.port}/comms"
     with urllib.request.urlopen(curl, timeout=10) as r:
@@ -406,6 +468,9 @@ try:
           f"{dispatches:.0f} dispatches in the latency histogram)")
     print(f"premerge timeline lane: ok ({len(spans)} spans across "
           f"{len(pids)} rank tracks; archived to {artifacts})")
+    print(f"premerge attribution lane: ok (/criticalpath analyzed "
+          f"{len(agroups)} group(s), {len(aranks)} rank decompositions, "
+          f"{len(acolls)} gated collective(s) on the critical path)")
     print(f"premerge comms lane: ok (/comms merged "
           f"{len(crank_payloads)} rank payloads, "
           f"{len(cmerged['cluster'])} cluster fit keys)")
