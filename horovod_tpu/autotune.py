@@ -83,7 +83,8 @@ class BayesianTuner:
 # step per candidate threshold, times a few steps, and pins the winner.
 
 _tuned: dict = {"threshold": None, "segments": None, "sync_mode": None,
-                "aborted": False, "history": [], "pruned": []}
+                "algorithm": None, "aborted": False, "history": [],
+                "pruned": []}
 
 
 def model_guided_enabled() -> bool:
@@ -158,6 +159,32 @@ def set_tuned_segments(num_segments: int | None) -> None:
         None if num_segments is None else int(num_segments))
 
 
+def tuned_algorithm() -> str | None:
+    """The pinned comms-planner collective algorithm (None = untuned —
+    the planner prices per bucket; see ``ops/comms_planner.py``). The
+    fourth joint-grid axis: a concrete pin overrides the per-bucket
+    pricing for EVERY planned bucket, which is what lets one sampling
+    window measure one schedule; the ``"auto"`` pin records that the
+    sweep measured the un-pinned per-bucket mode and chose it (the
+    planner treats it exactly like no pin)."""
+    return _tuned["algorithm"]
+
+
+def set_tuned_algorithm(algorithm: str | None) -> None:
+    """Pin (or clear, with None) the planner's collective algorithm.
+    Wins over per-bucket pricing in ``comms_planner.plan_bucket``;
+    ``"auto"`` is a valid decision meaning per-bucket pricing won the
+    sweep."""
+    if algorithm is not None and algorithm != "auto":
+        from .ops.comms_planner import PLANNER_ALGORITHMS
+
+        if algorithm not in PLANNER_ALGORITHMS:
+            raise ValueError(
+                f"unknown algorithm {algorithm!r}; expected one of "
+                f"{PLANNER_ALGORITHMS + ('auto',)}")
+    _tuned["algorithm"] = algorithm
+
+
 def tuned_sync_mode() -> str | None:
     """The pinned gradient sync mode (None = untuned; env/default rule).
 
@@ -192,6 +219,7 @@ def autotune_state() -> dict:
         "fusion_threshold": fusion_threshold_bytes(),
         "overlap_segments": _tuned["segments"],
         "sync_mode": _tuned["sync_mode"],
+        "algorithm": _tuned["algorithm"],
         "samples": len(_tuned["history"]),
         "history": list(_tuned["history"]),
         "pruned": list(_tuned["pruned"]),
@@ -253,26 +281,32 @@ class AutotuneStep:
 
     def __init__(self, jitted, thresholds=None, iters: int = 3,
                  clock=None, segment_candidates=None,
-                 sync_mode_candidates=None):
+                 sync_mode_candidates=None, algorithm_candidates=None):
         import time as _time
 
         self._fn = jitted
         self._tune_segments = segment_candidates is not None
         self._tune_sync = sync_mode_candidates is not None
-        if self._tune_segments or self._tune_sync:
+        self._tune_algorithm = algorithm_candidates is not None
+        if self._tune_segments or self._tune_sync or self._tune_algorithm:
             # Joint grid over the axes present — (threshold[, segments]
-            # [, sync_mode]). Every axis changes the traced program, so
-            # they pin together per window and broadcast together at
-            # finish. The sync_mode axis carries the caveat in
-            # :func:`tuned_sync_mode`: the mode fixes the optimizer-state
-            # LAYOUT, so only a step whose callable re-reads the pin per
-            # trace (a factory rebuilt per window, or a mode-agnostic
-            # harness like tune_step_sync_mode's) can ride this axis —
-            # the stock factories tune threshold/segments only.
+            # [, sync_mode][, algorithm]). Every axis changes the traced
+            # program, so they pin together per window and broadcast
+            # together at finish. The sync_mode axis carries the caveat
+            # in :func:`tuned_sync_mode`: the mode fixes the
+            # optimizer-state LAYOUT, so only a step whose callable
+            # re-reads the pin per trace (a factory rebuilt per window,
+            # or a mode-agnostic harness like tune_step_sync_mode's) can
+            # ride this axis — the stock factories tune
+            # threshold/segments (and, when the comms planner is live,
+            # the algorithm axis: a re-trace re-plans, so the pin takes
+            # effect in place).
             self._cands = [
                 (int(t),)
                 + ((int(s),) if self._tune_segments else ())
                 + ((str(m),) if self._tune_sync else ())
+                + ((str(a),) if self._tune_algorithm else ())
+                for a in (algorithm_candidates or (None,))
                 for m in (sync_mode_candidates or (None,))
                 for s in (segment_candidates or (None,))
                 for t in (thresholds or DEFAULT_THRESHOLDS)
@@ -296,6 +330,8 @@ class AutotuneStep:
             axes.append("overlap_segments")
         if self._tune_sync:
             axes.append("sync_mode")
+        if self._tune_algorithm:
+            axes.append("algorithm")
         return "+".join(axes)
 
     def _fetch_probe(self, out) -> None:
@@ -398,8 +434,9 @@ class AutotuneStep:
 
     def _pin(self, cand) -> None:
         """Pin one candidate process-wide: the threshold, plus jointly
-        the segments and/or sync_mode axes when tuned."""
-        if not (self._tune_segments or self._tune_sync):
+        the segments, sync_mode, and/or algorithm axes when tuned."""
+        if not (self._tune_segments or self._tune_sync
+                or self._tune_algorithm):
             set_tuned_threshold(cand)
             return
         cand = tuple(cand)
@@ -410,6 +447,9 @@ class AutotuneStep:
             i += 1
         if self._tune_sync:
             set_tuned_sync_mode(cand[i])
+            i += 1
+        if self._tune_algorithm:
+            set_tuned_algorithm(cand[i])
 
     def _finish(self) -> None:
         import json
@@ -561,7 +601,8 @@ _active_tuner: list = []  # at most one in-flight warmup tuner per process
 
 
 def maybe_autotune_step(jitted, segment_candidates=None,
-                        sync_mode_candidates=None):
+                        sync_mode_candidates=None,
+                        algorithm_candidates=None):
     """Wrap ``jitted`` in transparent warmup tuning when
     ``HOROVOD_AUTOTUNE=1`` (env or config) — the factory entry point.
 
@@ -570,11 +611,14 @@ def maybe_autotune_step(jitted, segment_candidates=None,
     (threshold, segments) grid; ``sync_mode_candidates`` adds the
     sync_mode axis (see :func:`tuned_sync_mode` for its layout caveat —
     the stock factories do not pass it; :func:`tune_step_sync_mode` is
-    the mode-agnostic harness). When the communication observatory has a
-    fitted α–β model, the grid is swept model-guided: dominated
-    candidates are pruned after the first window (rank-identically —
-    see :meth:`AutotuneStep._maybe_prune` and docs/observability.md's
-    "Communication cost model" section).
+    the mode-agnostic harness); ``algorithm_candidates`` adds the comms
+    planner's collective-algorithm axis (the step factories pass
+    ``comms_planner.autotune_candidates()`` — non-None only when
+    ``HOROVOD_COMMS_PLANNER=auto`` and >1 algorithm is eligible). When
+    the communication observatory has a fitted α–β model, the grid is
+    swept model-guided: dominated candidates are pruned after the first
+    window (rank-identically — see :meth:`AutotuneStep._maybe_prune`
+    and docs/observability.md's "Communication cost model" section).
 
     At most ONE tuner is live per process: the threshold is
     process-global, so a second factory call before the first tuner
@@ -593,7 +637,8 @@ def maybe_autotune_step(jitted, segment_candidates=None,
         _active_tuner[0]._co_steps.append(jitted)
         return jitted
     tuner = AutotuneStep(jitted, segment_candidates=segment_candidates,
-                         sync_mode_candidates=sync_mode_candidates)
+                         sync_mode_candidates=sync_mode_candidates,
+                         algorithm_candidates=algorithm_candidates)
     _active_tuner[:] = [tuner]
     return tuner
 

@@ -69,14 +69,21 @@ from .utils.env import get_float, get_int
 #: Canonical link classes (`link_class` label values).
 LINK_CLASSES = ("ici", "dcn")
 
-#: Canonical algorithm tags (`algorithm` label values).
-ALGORITHMS = ("flat", "hierarchical", "rs_ag", "fsdp")
+#: Canonical algorithm tags (`algorithm` label values). ``rhd`` and
+#: ``two_level`` are the comms planner's scheduled algorithms
+#: (``ops/comms_planner.py``) — each gets its own LinkFit, which is what
+#: closes the model's own training loop: plans are priced by fits the
+#: planned dispatches themselves feed.
+ALGORITHMS = ("flat", "hierarchical", "rs_ag", "fsdp", "rhd", "two_level")
 
 #: Span-name vocabulary carrying static bucket bytes (ops/fusion.py's
-#: ``annotate_collective`` names and the eager dispatch span args).
+#: ``annotate_collective`` names and the eager dispatch span args). A
+#: trailing ``.<algorithm>`` names the planner's chosen schedule
+#: (``allreduce.bucket0.1048576B.two_level``); absent = flat.
 _BUCKET_NAME_RE = re.compile(
     r"^(?P<op>allreduce|reducescatter|allgather)\."
-    r"(?:bucket\d+\.)?(?P<bytes>\d+)B$")
+    r"(?:bucket\d+\.)?(?P<bytes>\d+)B"
+    r"(?:\.(?P<algo>[a-z0-9_]+))?$")
 
 
 def min_samples() -> int:
@@ -191,6 +198,13 @@ class LinkFit:
             if beta is None:
                 return max(alpha, 0.0)
             return max(alpha + beta * float(nbytes), 0.0)
+
+    def solved(self) -> tuple[float, float | None]:
+        """The current (alpha, beta) — beta None when only one payload
+        size was ever seen (a latency mean). The planner's snapshot
+        entry (``ops/comms_planner._synced_snapshot``)."""
+        with self._lock:
+            return self._solve_locked()
 
     def as_dict(self) -> dict:
         """JSON-able fit summary (the ``/comms`` payload entry)."""
@@ -353,8 +367,10 @@ class CommsModel:
                     op = m.group("op")
                 if nbytes is None or op is None:
                     continue
-                algorithm = str(args.get("algorithm", "flat")) \
-                    if isinstance(args, Mapping) else "flat"
+                name_algo = (m.group("algo") or "flat") \
+                    if m is not None else "flat"
+                algorithm = str(args.get("algorithm", name_algo)) \
+                    if isinstance(args, Mapping) else name_algo
                 link = str(args.get("link_class", "ici")) \
                     if isinstance(args, Mapping) else "ici"
                 self.observe(op, algorithm, link, nbytes, dur)
@@ -400,6 +416,36 @@ class CommsModel:
             if fit is not None and fit.ready():
                 return fit.predict(nbytes)
         return None
+
+    def predict_exact(self, op: str, algorithm: str, link_class: str,
+                      nbytes: float) -> float | None:
+        """Predicted seconds from the EXACT (op, algorithm, link_class)
+        key only — no fallback chain. The comms planner prices candidate
+        algorithms against each other, where the chain's cross-algorithm
+        substitutions would collapse every candidate onto one fit."""
+        fit = self._fit_for(op, algorithm, link_class)
+        if fit is None or not fit.ready():
+            return None
+        return fit.predict(nbytes)
+
+    def fit_snapshot(self, ops: Sequence[str] | None = None,
+                     algorithms: Sequence[str] | None = None
+                     ) -> dict[str, tuple[float, float | None]]:
+        """``{key: (alpha, beta)}`` over the READY fits (optionally
+        filtered by op/algorithm) — the rank-portable form the planner
+        broadcasts so every rank plans from rank 0's model."""
+        with self._lock:
+            fits = dict(self._fits)
+        out: dict[str, tuple[float, float | None]] = {}
+        for (op, algorithm, link_class), fit in fits.items():
+            if ops is not None and op not in ops:
+                continue
+            if algorithms is not None and algorithm not in algorithms:
+                continue
+            if not fit.ready():
+                continue
+            out[key_of(op, algorithm, link_class)] = fit.solved()
+        return out
 
     def ready(self) -> bool:
         with self._lock:
@@ -466,6 +512,17 @@ class CommsModel:
         status = ("ok" if any(d.get("ready") for d in fit_dicts.values())
                   else "insufficient_samples")
         eff = self.efficiency()
+        # The comms planner's plan table rides along so GET /comms shows
+        # WHY each bucket got its schedule (algorithm + provenance:
+        # fitted model vs static_crossover vs a pin). Best-effort and
+        # jax-guarded: on a driver-side import (no jax) the planner leg
+        # degrades to an explicit disabled marker — never an error.
+        try:
+            from .ops.comms_planner import summary as _planner_summary
+
+            planner = _planner_summary()
+        except Exception:  # noqa: BLE001 — the plan view is advisory
+            planner = {"enabled": False}
         return {
             "rank": _rank(),
             "host": _host(),
@@ -476,6 +533,7 @@ class CommsModel:
             "samples_total": sum(d["samples"] for d in fit_dicts.values()),
             "probes": probes,
             "fits": fit_dicts,
+            "planner": planner,
         }
 
     def summary(self) -> dict:
@@ -619,6 +677,7 @@ def merge_payloads(payloads: Mapping[str, Mapping]) -> dict:
             # heartbeat can share a reassigned rank). Qualify by host so
             # no worker's model is silently last-writer-wins dropped.
             rank = f"{rank}@{hostname}"
+        planner = payload.get("planner")
         ranks[rank] = {
             "host": hostname,
             "status": str(payload.get("status", "insufficient_samples")),
@@ -626,6 +685,8 @@ def merge_payloads(payloads: Mapping[str, Mapping]) -> dict:
             "efficiency": eff,
             "samples_total": samples_total,
             "fits": clean_fits,
+            "planner": (dict(planner) if isinstance(planner, Mapping)
+                        else {"enabled": False}),
         }
         residuals[hostname] = max(residuals.get(hostname, 0.0), resid)
         for key, d in clean_fits.items():
@@ -745,27 +806,70 @@ _MODE_WIRE = {
     "fsdp": (("allgather", "fsdp"), ("reducescatter", "fsdp")),
 }
 
+#: The comms planner's schedule vocabulary (mirrored from
+#: ``ops/comms_planner.PLANNER_ALGORITHMS`` so this module stays
+#: importable jax-free; ``auto`` names the un-pinned planner axis).
+PLANNER_ALGORITHM_NAMES = ("flat", "rhd", "two_level", "auto")
+
+
+def _planned_wire_algorithm(op: str, label: str, bucket_bytes: int,
+                            algorithm: str | None) -> str:
+    """The fit key a bucket's collective half should be priced under.
+
+    ``algorithm`` explicit (an autotune candidate's axis): ``flat``
+    keeps the mode's historical label (``flat``/``rs_ag``/``fsdp`` —
+    those fits ARE the flat schedule's samples); a planner algorithm
+    names its own key. ``None``/``auto``: ask the live planner what it
+    would schedule for this bucket, so the prediction prices the
+    PLANNED wire, not an assumed flat ring — degrading to the label
+    when the planner is off or unimportable (driver-side, jax-free)."""
+    if algorithm is not None and algorithm not in (None, "auto"):
+        return label if algorithm == "flat" else algorithm
+    try:
+        from .ops.comms_planner import enabled, planned_algorithm
+
+        if enabled():
+            from .ops.comms_planner import default_world_size
+
+            # sync=False: this predictor runs on rank-local paths (the
+            # attribution plane's status thread, autotune pricing) that
+            # must never block in the planner's snapshot broadcast.
+            planned = planned_algorithm(op, bucket_bytes,
+                                        default_world_size(), sync=False)
+            if planned != "flat":
+                return planned
+    except Exception:  # noqa: BLE001 — planner is advisory here
+        pass
+    return label
+
 
 def predict_flush_cost(leaf_sizes: Sequence[tuple[int, str]],
                        threshold_bytes: int,
                        num_segments: int = 1,
                        sync_mode: str = "allreduce",
                        link_class: str = "ici",
-                       model: CommsModel | None = None) -> float | None:
+                       model: CommsModel | None = None,
+                       algorithm: str | None = None) -> float | None:
     """Predicted per-step communication seconds for one autotune
     candidate: segment the leaf layout, bucket each run under the
     candidate threshold, and price every bucket's collective halves with
     the fitted α–β model (fallback chain in :meth:`CommsModel.predict`).
-    None when the model cannot price the wire yet."""
+    ``algorithm`` — the joint grid's planner axis — prices the halves
+    under that schedule's fit keys; None/``auto`` prices whatever the
+    live planner would schedule per bucket (flat when it is off), so
+    model-guided pruning and the attribution plane's exposed-comm
+    residual see the PLANNED wire. None when the model cannot price the
+    wire yet."""
     model = model or get_model()
     wire = _MODE_WIRE.get(str(sync_mode) or "allreduce",
                           _MODE_WIRE["allreduce"])
     total = 0.0
     for run in segment_byte_runs(leaf_sizes, num_segments):
         for bucket_bytes in bucket_byte_sizes(run, threshold_bytes):
-            for op, algorithm in wire:
-                cost = model.predict(op, algorithm, link_class,
-                                     bucket_bytes)
+            for op, label in wire:
+                algo = _planned_wire_algorithm(op, label, bucket_bytes,
+                                               algorithm)
+                cost = model.predict(op, algo, link_class, bucket_bytes)
                 if cost is None:
                     return None
                 total += cost
@@ -817,21 +921,29 @@ def predict_step_comm_s(sync_mode: str | None = None,
                               sync_mode, link_class, model=model)
 
 
-def candidate_axes(candidate) -> tuple[int, int, str]:
+def candidate_axes(candidate) -> tuple[int, int, str, str | None]:
     """Normalize an autotune grid candidate — an int threshold or a
-    ``(threshold[, segments][, sync_mode])`` tuple — to
-    ``(threshold, segments, sync_mode)``."""
+    ``(threshold[, segments][, sync_mode][, algorithm])`` tuple — to
+    ``(threshold, segments, sync_mode, algorithm)``. String items are
+    assigned by vocabulary membership: planner algorithm names
+    (:data:`PLANNER_ALGORITHM_NAMES`) land on the algorithm axis,
+    anything else is a sync mode; ``algorithm`` is None when the grid
+    has no planner axis."""
     if isinstance(candidate, (tuple, list)):
         threshold = int(candidate[0])
         segments = 1
         sync_mode = "allreduce"
+        algorithm = None
         for item in candidate[1:]:
             if isinstance(item, str):
-                sync_mode = item
+                if item in PLANNER_ALGORITHM_NAMES:
+                    algorithm = item
+                else:
+                    sync_mode = item
             else:
                 segments = int(item)
-        return threshold, segments, sync_mode
-    return int(candidate), 1, "allreduce"
+        return threshold, segments, sync_mode, algorithm
+    return int(candidate), 1, "allreduce", None
 
 
 def prune_candidates(candidates: Sequence[Any],
@@ -866,11 +978,11 @@ def prune_candidates(candidates: Sequence[Any],
     costs: list[float | None] = []
     modes: list[str] = []
     for cand in candidates:
-        threshold, segments, sync_mode = candidate_axes(cand)
+        threshold, segments, sync_mode, algorithm = candidate_axes(cand)
         modes.append(sync_mode)
         costs.append(predict_flush_cost(
             leaf_sizes, threshold, segments, sync_mode, link_class,
-            model=model))
+            model=model, algorithm=algorithm))
     if not leaf_sizes:
         return {"kept": list(candidates), "pruned": [], "costs": costs}
     best_by_mode: dict[str, float] = {}

@@ -812,6 +812,25 @@ STEP_REGRESSION_SCORE = gauge(
     "Regression-sentinel drift score per attribution phase (positive "
     "excess over the EWMA baseline in deviations; alarm at "
     "HOROVOD_STEP_REGRESSION_SIGMA).", ("phase",))
+# Comms planner (ops/comms_planner.py): per-bucket collective algorithm
+# selection. Plans count decisions entering the plan cache; replans
+# count elastic generation fences that invalidated it; dispatch counts
+# planned collective emissions by (op, algorithm) — traced emissions
+# count once per TRACE (the hvd_grad_sync_* contract), eager ones per
+# dispatch.
+PLANNER_PLANS = counter(
+    "hvd_planner_plans_total",
+    "Comms-planner bucket schedule decisions computed (cache misses of "
+    "the per-generation plan table).")
+PLANNER_REPLANS = counter(
+    "hvd_planner_replans_total",
+    "Comms-planner plan-table invalidations at elastic generation "
+    "fences (every cached plan re-derives in the new world).")
+PLANNER_DISPATCH = counter(
+    "hvd_planner_dispatch_total",
+    "Planned collective emissions by op and chosen algorithm (traced "
+    "emissions count once per trace; eager ones per dispatch).",
+    ("op", "algorithm"))
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -842,6 +861,15 @@ def _materialize_checkpoint_cells() -> None:
                               algorithm="flat")
     COLLECTIVE_EFFICIENCY.labels()
     COMMS_RESIDUAL.labels()
+    # Comms-planner zero cells: a run that never planned (knob unset)
+    # still reports the series at 0 — the premerge scrape gate asserts
+    # they exist, and dashboards can tell "planner off" from "not
+    # measuring".
+    PLANNER_PLANS.labels()
+    PLANNER_REPLANS.labels()
+    for op in ("allreduce", "reducescatter", "allgather"):
+        for algo in ("flat", "rhd", "two_level"):
+            PLANNER_DISPATCH.labels(op=op, algorithm=algo)
     # Integrity defense plane zero cells: a job that never corrupted,
     # never tripped, and never rewound still reports the series at 0 —
     # the premerge scrape gate asserts they exist, and dashboards can

@@ -303,22 +303,31 @@ def _link_class_of(ps) -> str:
     the cache dies with the old world (keying a module map by id(topo)
     would alias a recycled address onto stale classes)."""
     try:
+        import os
+
         from ..basics import _state
 
         topo = _state.topology
         if topo is not None:
             cache = topo.__dict__.setdefault("_link_class_by_set", {})
-            cls = cache.get(ps.process_set_id)
+            # The declared-fabric override participates in the key: the
+            # classification is a function of (set, live map), and a
+            # bench/test that declares an emulated fabric mid-run must
+            # not be served the previous fabric's cached class.
+            key = (ps.process_set_id,
+                   os.environ.get("HOROVOD_LINK_CLASS_MAP", ""))
+            cls = cache.get(key)
             if cls is None:
                 cls = topo.set_link_class(ps.ranks)
-                cache[ps.process_set_id] = cls
+                cache[key] = cls
             return cls
     except Exception:  # noqa: BLE001 — attribution is best-effort
         pass
     return "dcn" if jax.process_count() > 1 else "ici"
 
 
-def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
+def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=(),
+                    plan_spec=None):
     ps = _resolve_process_set(process_set)
     mesh = ps.mesh
     axis = ps.axis_name
@@ -331,7 +340,35 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
             f"a compiled step, call this op under shard_map over axis "
             f"{axis!r} instead."
         )
-    key = (kind, x.shape, str(x.dtype), ps.process_set_id, extra_key)
+    nbytes = int(x.size) * x.dtype.itemsize
+    # Comms-planner leg (``ops/comms_planner.py``): ops that supply a
+    # ``plan_spec`` — ``(op_name, builder)`` where ``builder(plan)``
+    # yields the planned traced fn — may take a non-flat schedule for
+    # this payload on the GLOBAL set (subset axes keep flat: their rank
+    # positions do not map onto the topology's island layout). The
+    # chosen algorithm joins the executable-cache key (it changes the
+    # compiled program) and is what the span/metrics/model see.
+    algorithm = "flat"
+    planner_live = False
+    plan_sig: tuple = ()
+    if plan_spec is not None and n > 1 and ps.process_set_id == 0:
+        from . import comms_planner
+
+        if comms_planner.enabled():
+            planner_live = True
+            op_name, builder = plan_spec
+            plan = comms_planner.plan_bucket(op_name, nbytes, n)
+            if plan is not None and plan.algorithm != "flat":
+                algorithm = plan.algorithm
+                traced_fn = builder(plan)
+                # The island layout joins the key: a two_level
+                # executable is compiled FOR a fabric, and a mid-run
+                # HOROVOD_LINK_CLASS_MAP change (the supported
+                # emulated-fabric flow) must rebuild, not silently
+                # reuse the old islands' schedule.
+                plan_sig = (plan.islands,)
+    key = (kind, x.shape, str(x.dtype), ps.process_set_id, extra_key,
+           algorithm) + plan_sig
 
     def build():
         def shard_fn(v):
@@ -357,9 +394,12 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
 
     mark_cycle()
     _dispatch_counts[kind] += 1
-    nbytes = int(x.size) * x.dtype.itemsize
     _metrics.COLLECTIVE_DISPATCH.inc(kind=kind)
     _metrics.COLLECTIVE_BYTES.observe(nbytes, kind=kind)
+    if planner_live:
+        from . import comms_planner
+
+        comms_planner.note_dispatch(plan_spec[0], algorithm)
     cache = global_cache()
     # Attribution by THIS call's builder running, not by diffing the
     # global miss counter — a concurrent miss on another key inside this
@@ -404,7 +444,7 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
                 "cache": "miss" if missed else "hit",
                 "bytes": nbytes,
                 "op": kind,
-                "algorithm": "flat",
+                "algorithm": algorithm,
                 "link_class": link_class,
             },
         ):
@@ -414,12 +454,15 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=()):
             _metrics.COLLECTIVE_LATENCY.observe(dt, kind=kind)
             try:
                 # Every timed eager dispatch is an alpha-beta sample:
-                # one flat collective of `nbytes` over this set's worst
-                # link class took `dt` seconds (compile excluded —
-                # t_exec starts after get_or_build).
+                # one collective of `nbytes` over this set's worst link
+                # class took `dt` seconds (compile excluded — t_exec
+                # starts after get_or_build). The EXECUTED algorithm is
+                # what gets attributed, so each schedule trains its own
+                # LinkFit instead of conflating into the flat one.
                 from .. import comms_model as _comms_model
 
-                _comms_model.observe(kind, "flat", link_class, nbytes, dt)
+                _comms_model.observe(kind, algorithm, link_class, nbytes,
+                                     dt)
             except Exception:  # noqa: BLE001 — the model is advisory
                 pass
             return out
@@ -489,8 +532,24 @@ def allreduce(
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
     )
+    plan_spec = None
+    if op in (Sum, Average):
+
+        def _planned_allreduce(plan):
+            def traced_planned(t):
+                from . import comms_planner
+
+                out = comms_planner.apply_allreduce_scaled(
+                    plan, t.ravel(), ps.axis_name, op == Average,
+                    prescale_factor, postscale_factor)
+                return out.reshape(t.shape)
+
+            return traced_planned
+
+        plan_spec = ("allreduce", _planned_allreduce)
     return _eager_dispatch(
-        "allreduce", traced, tensor, ps, (op, prescale_factor, postscale_factor)
+        "allreduce", traced, tensor, ps,
+        (op, prescale_factor, postscale_factor), plan_spec=plan_spec
     )
 
 
@@ -515,12 +574,17 @@ def grouped_allreduce(
     if traced_axis is not None:
         from .fusion import fused_allreduce
 
+        try:
+            group_world = ps.size() or None
+        except Exception:  # noqa: BLE001 — pre-init: planner stays off
+            group_world = None
         return fused_allreduce(
             list(tensors),
             op=op,
             axis_name=traced_axis,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
+            world_size=group_world,
         )
     tensors = list(tensors)
     # Type-based dispatch (see _native_world_if_per_process): a group of
@@ -591,7 +655,18 @@ def allgather(tensor, process_set=None, name: str | None = None):
     def traced(x):
         return _allgather_traced(x, ps.axis_name)
 
-    return _eager_dispatch("allgather", traced, tensor, ps)
+    def _planned_allgather(plan):
+        def traced_planned(t):
+            from . import comms_planner
+
+            full = comms_planner.apply_allgather_row(
+                plan, t.ravel(), ps.axis_name)
+            return full.reshape((plan.world * t.shape[0],) + t.shape[1:])
+
+        return traced_planned
+
+    return _eager_dispatch("allgather", traced, tensor, ps,
+                           plan_spec=("allgather", _planned_allgather))
 
 
 def broadcast(tensor, root_rank: int, process_set=None, name: str | None = None):
@@ -768,8 +843,21 @@ def reducescatter(
             x, op, ps.axis_name, prescale_factor, postscale_factor
         )
 
+    def _planned_reducescatter(plan):
+        def traced_planned(t):
+            from . import comms_planner
+
+            row = comms_planner.apply_reducescatter_scaled(
+                plan, t.ravel(), ps.axis_name, op == Average,
+                prescale_factor, postscale_factor)
+            return row.reshape((t.shape[0] // plan.world,) + t.shape[1:])
+
+        return traced_planned
+
     return _eager_dispatch(
-        "reducescatter", traced, tensor, ps, (op, prescale_factor, postscale_factor)
+        "reducescatter", traced, tensor, ps,
+        (op, prescale_factor, postscale_factor),
+        plan_spec=("reducescatter", _planned_reducescatter)
     )
 
 
@@ -853,9 +941,27 @@ def run_comms_microprobe(process_set=None, sizes=None,
 
     from .. import comms_model as _comms_model
 
+    import contextlib
+
     ps = _resolve_process_set(process_set)
     n = ps.size()
     sizes = [int(s) for s in (sizes or _comms_model.DEFAULT_PROBE_SIZES)]
+    # With the comms planner live, the sweep runs once per algorithm
+    # ELIGIBLE FOR EACH OP (forced pin per pass) so every schedule
+    # seeds its own (op, algorithm, link_class) LinkFit — the
+    # per-algorithm ground truth plan pricing closes its loop on.
+    # Planner off: one flat pass, exactly as before. The RETURNED
+    # samples stay flat-only either way: callers take medians per
+    # payload size (the bench fit-tolerance lane), and mixing
+    # schedules with different cost curves into one list would skew
+    # them — the non-flat passes exist to feed the model, which reads
+    # the per-algorithm attribution straight off the dispatch path.
+    planner_live = False
+    from . import comms_planner
+
+    if comms_planner.enabled() and n > 1 and ps.process_set_id == 0:
+        planner_live = True
+        islands = comms_planner._islands_for(n)
     out: dict[str, dict] = {}
     for op_name, run in (
         ("allreduce", lambda a: allreduce(a, op=Sum, process_set=ps)),
@@ -863,20 +969,30 @@ def run_comms_microprobe(process_set=None, sizes=None,
          lambda a: reducescatter(a, op=Sum, process_set=ps)),
         ("allgather", lambda a: allgather(a, process_set=ps)),
     ):
+        algorithms: tuple = (
+            comms_planner.eligible_algorithms(op_name, n, islands)
+            if planner_live else (None,))
         per_op: dict[int, list] = {}
-        for nbytes in sizes:
-            # Per-rank rows of n*k elements so reducescatter's dim-0
-            # divisibility holds; stacked payload = n * row bytes.
-            elems = max(n, (nbytes // 4 // n) * n)
-            x = np.ones((n, elems), np.float32)
-            run(x)  # warm the executable cache (compile excluded anyway)
-            import time as _time
+        for algorithm in algorithms:
+            ctx = (comms_planner.forced(algorithm)
+                   if algorithm is not None else contextlib.nullcontext())
+            keep = algorithm in (None, "flat")
+            with ctx:
+                for nbytes in sizes:
+                    # Per-rank rows of n*k elements so reducescatter's
+                    # dim-0 divisibility holds; stacked payload = n *
+                    # row bytes.
+                    elems = max(n, (nbytes // 4 // n) * n)
+                    x = np.ones((n, elems), np.float32)
+                    run(x)  # warm the executable cache
+                    import time as _time
 
-            for _ in range(max(1, int(repeats))):
-                t0 = _time.perf_counter()
-                jax.block_until_ready(run(x))
-                per_op.setdefault(int(x.size) * 4, []).append(
-                    _time.perf_counter() - t0)
+                    for _ in range(max(1, int(repeats))):
+                        t0 = _time.perf_counter()
+                        jax.block_until_ready(run(x))
+                        if keep:
+                            per_op.setdefault(int(x.size) * 4, []).append(
+                                _time.perf_counter() - t0)
         out[op_name] = per_op
     _comms_model.get_model().note_probe()
     return out

@@ -257,6 +257,53 @@ def _reduce_bucket(flat, op, axis_name, prescale_factor, postscale_factor):
     return _allreduce_traced(flat, op, axis_name, prescale_factor, postscale_factor)
 
 
+def _plan_bucket(op_name: str, nbytes: int, axis_name, world_size,
+                 candidates=None):
+    """The comms planner's schedule for one bucket, or None (planner
+    off, world unknown, hierarchical axis tuple — the two-level mesh
+    already owns its schedule). None → the caller keeps its original
+    flat code path, which is the ``HOROVOD_COMMS_PLANNER``-unset
+    bit-for-bit contract."""
+    if world_size is None or isinstance(axis_name, (tuple, list)):
+        return None
+    from . import comms_planner
+
+    if not comms_planner.enabled():
+        return None
+    plan = comms_planner.plan_bucket(op_name, int(nbytes), int(world_size),
+                                     candidates)
+    if plan is None or plan.algorithm == "flat":
+        # The flat choice keeps the original emission but still counts
+        # in the per-algorithm dispatch ledger (honest labeling); one
+        # count per TRACE, the hvd_grad_sync_* contract.
+        if plan is not None:
+            comms_planner.note_dispatch(op_name, "flat")
+        return None
+    comms_planner.note_dispatch(op_name, plan.algorithm)
+    return plan
+
+
+def _bucket_suffix(plan) -> str:
+    """The annotation-name leg naming a non-flat schedule — parsed back
+    out by ``comms_model._BUCKET_NAME_RE`` so re-ingested spans feed
+    the right per-algorithm fit."""
+    return "" if plan is None else f".{plan.algorithm}"
+
+
+def _reduce_bucket_planned(flat, op, axis_name, prescale_factor,
+                           postscale_factor, plan):
+    """One planned (non-flat) SUM/Average bucket allreduce: the
+    planner's canonical scale-order wrapper (shared with the eager
+    builders in ``collective_ops``) owns pre/post scaling and the
+    Average divisor, mirroring the flat path's order of operations."""
+    from . import comms_planner
+    from .collective_ops import Average
+
+    return comms_planner.apply_allreduce_scaled(
+        plan, flat, axis_name, op == Average, prescale_factor,
+        postscale_factor)
+
+
 def fused_allreduce(
     tensors: Sequence[Any],
     op,
@@ -265,6 +312,7 @@ def fused_allreduce(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     issue_reversed: bool = False,
+    world_size: int | None = None,
 ) -> list[Any]:
     """Allreduce a list of tensors with static bucketing (traced regime).
 
@@ -273,10 +321,17 @@ def fused_allreduce(
     leaves' gradients materialize first, so reverse emission puts each
     HLO next to the point its operands become ready (results are
     identical either way; only the program order hint changes).
+
+    ``world_size`` (the process-set size as a static int) arms the
+    comms planner: with ``HOROVOD_COMMS_PLANNER`` set and the size
+    known, each Sum/Average bucket's collective algorithm is chosen per
+    bucket (flat ring / recursive halving–doubling / two-level
+    ICI×DCN — ``ops/comms_planner.py``); unset or unknown, every bucket
+    keeps the flat emission bit-for-bit.
     """
     tensors = [jnp.asarray(t) for t in tensors]
     from ..profiler import annotate_collective
-    from .collective_ops import Adasum
+    from .collective_ops import Adasum, Average, Sum
 
     if op == Adasum:
         # Adasum's scale factors are whole-vector dot products — packing
@@ -287,6 +342,7 @@ def fused_allreduce(
             for t in tensors
         ]
     _note_leaf_sizes(tensors)
+    plannable = op in (Sum, Average)
     buckets = bucket_leaves(tensors, threshold_bytes)
     out: list[Any] = [None] * len(tensors)
     for bi, bucket in (
@@ -297,6 +353,24 @@ def fused_allreduce(
         # (the tracing plane's per-collective vocabulary, trace-time leg).
         nbytes = sum(int(tensors[i].size)
                      * jnp.dtype(tensors[i].dtype).itemsize for i in bucket)
+        plan = (_plan_bucket("allreduce", nbytes, axis_name, world_size)
+                if plannable else None)
+        if plan is not None:
+            flats = [tensors[i].ravel() for i in bucket]
+            with annotate_collective(
+                    f"allreduce.bucket{bi}.{nbytes}B{_bucket_suffix(plan)}"):
+                packed = (flats[0] if len(bucket) == 1
+                          else jnp.concatenate(flats))
+                reduced = _reduce_bucket_planned(
+                    packed, op, axis_name, prescale_factor,
+                    postscale_factor, plan)
+            offset = 0
+            for i in bucket:
+                n = tensors[i].size
+                out[i] = reduced[offset:offset + n].reshape(
+                    tensors[i].shape)
+                offset += n
+            continue
         if len(bucket) == 1:
             i = bucket[0]
             with annotate_collective(f"allreduce.bucket{bi}.{nbytes}B"):
@@ -326,6 +400,7 @@ def fused_allreduce_pytree(
     threshold_bytes: int | None = None,
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
+    world_size: int | None = None,
 ):
     """Allreduce every leaf of a pytree (the gradient pytree) with fusion."""
     import jax
@@ -338,6 +413,7 @@ def fused_allreduce_pytree(
         threshold_bytes=threshold_bytes,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
+        world_size=world_size,
     )
     return jax.tree.unflatten(treedef, reduced)
 
@@ -429,13 +505,21 @@ def fused_reducescatter(
         bucket_sizes = [sizes[i] for i in bucket]
         nbytes = sum(int(tensors[i].size)
                      * jnp.dtype(tensors[i].dtype).itemsize for i in bucket)
-        with annotate_collective(f"reducescatter.bucket{bi}.{nbytes}B"):
+        plan = _plan_bucket("reducescatter", nbytes, axis_name, n)
+        with annotate_collective(
+                f"reducescatter.bucket{bi}.{nbytes}B{_bucket_suffix(plan)}"):
             flat = _pack_shard_rows(
                 [tensors[i] for i in bucket], bucket_sizes, n).ravel()
             if prescale_factor != 1.0:
                 flat = flat * jnp.asarray(prescale_factor, flat.dtype)
-            row = lax.psum_scatter(
-                flat, axis_name, scatter_dimension=0, tiled=True)
+            if plan is not None:
+                from . import comms_planner
+
+                row = comms_planner.apply_reducescatter_sum(
+                    plan, flat, axis_name)
+            else:
+                row = lax.psum_scatter(
+                    flat, axis_name, scatter_dimension=0, tiled=True)
             if scale != 1.0:
                 row = row * jnp.asarray(scale, row.dtype)
         for i, shard in zip(bucket, _split_shard_row(row, bucket_sizes)):
@@ -477,8 +561,16 @@ def fused_allgather_shards(
                else jnp.concatenate([shards[i] for i in bucket]))
         nbytes = sum(n * s * jnp.dtype(shards[i].dtype).itemsize
                      for i, s in zip(bucket, bucket_sizes))
-        with annotate_collective(f"allgather.bucket{bi}.{nbytes}B"):
-            full = lax.all_gather(row, axis_name, axis=0, tiled=True)
+        plan = _plan_bucket("allgather", nbytes, axis_name, n)
+        with annotate_collective(
+                f"allgather.bucket{bi}.{nbytes}B{_bucket_suffix(plan)}"):
+            if plan is not None:
+                from . import comms_planner
+
+                full = comms_planner.apply_allgather_row(
+                    plan, row, axis_name)
+            else:
+                full = lax.all_gather(row, axis_name, axis=0, tiled=True)
         grid = full.reshape(n, -1)
         offset = 0
         for i, s in zip(bucket, bucket_sizes):

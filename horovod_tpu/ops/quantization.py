@@ -119,13 +119,16 @@ def _quantize_blocks(flat_f32, salt=None):
 
 def int8_allreduce_flat(flat, axis_name: str, world_size: int,
                         op: str = "average", prescale_factor: float = 1.0,
-                        postscale_factor: float = 1.0, salt=None):
+                        postscale_factor: float = 1.0, salt=None,
+                        groups=None):
     """Quantized allreduce of a flat tensor inside a shard_map trace.
 
     ``world_size`` must be the axis size as a Python int (shapes depend
     on it). ``salt`` is an optional caller-threaded step counter folded
-    into the stochastic-rounding hash (see :func:`_sround`). Returns f32
-    with ``flat``'s shape; the caller casts back.
+    into the stochastic-rounding hash (see :func:`_sround`). ``groups``
+    scopes the exchange to ``axis_index_groups`` sub-rings of
+    ``world_size`` members each (the comms planner's two-level cross
+    leg). Returns f32 with ``flat``'s shape; the caller casts back.
     """
     n = int(world_size)
     m = int(flat.size)
@@ -150,10 +153,11 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
     scale = scale.reshape(n, rows_per_chunk)
     # No summation on the wire: chunk j (int8 + scales) goes to rank j.
     recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True).reshape(n, rows_per_chunk, BLOCK)
+                          tiled=True, axis_index_groups=groups
+                          ).reshape(n, rows_per_chunk, BLOCK)
     recv_scale = lax.all_to_all(
         scale[:, :, None], axis_name, split_axis=0, concat_axis=0,
-        tiled=True).reshape(n, rows_per_chunk)
+        tiled=True, axis_index_groups=groups).reshape(n, rows_per_chunk)
     # Dequantize + reduce in f32 locally.
     total = jnp.sum(recv.astype(jnp.float32)
                     * recv_scale[:, :, None], axis=0)
@@ -162,8 +166,10 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
     # Requantize MY reduced chunk, share it with everyone.
     q2, scale2 = _quantize_blocks(total.reshape(-1), salt)
     gathered = lax.all_gather(
-        q2.reshape(rows_per_chunk, BLOCK), axis_name)      # [n, r, B]
-    gathered_scale = lax.all_gather(scale2, axis_name)     # [n, r]
+        q2.reshape(rows_per_chunk, BLOCK), axis_name,
+        axis_index_groups=groups)                          # [n, r, B]
+    gathered_scale = lax.all_gather(scale2, axis_name,
+                                    axis_index_groups=groups)  # [n, r]
     out = (gathered.astype(jnp.float32)
            * gathered_scale[:, :, None]).reshape(-1)[:m]
     if postscale_factor != 1.0:
@@ -171,25 +177,89 @@ def int8_allreduce_flat(flat, axis_name: str, world_size: int,
     return out
 
 
-def _reduce_scattered_rows(rows, axis_name, n, op, salt):
+def _reduce_scattered_rows(rows, axis_name, n, op, salt, groups=None):
     """Quantized exchange of a ``(n, R')`` block (``R' % BLOCK == 0``):
     each rank ends with row ``r`` REDUCED — the first half of the EQuARX
     allreduce (quantize → all_to_all → dequant-sum), with no requant/
-    all_gather tail. Returns the reduced f32 row of length ``R'``."""
+    all_gather tail. Returns the reduced f32 row of length ``R'``.
+    ``groups`` scopes the exchange to ``axis_index_groups`` sub-rings of
+    size ``n`` (the comms planner's two-level intra-island leg)."""
     rows_per_chunk = rows.shape[1] // BLOCK
     q, scale = _quantize_blocks(rows.reshape(-1), salt)
     q = q.reshape(n, rows_per_chunk, BLOCK)
     scale = scale.reshape(n, rows_per_chunk)
     recv = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
-                          tiled=True).reshape(n, rows_per_chunk, BLOCK)
+                          tiled=True, axis_index_groups=groups
+                          ).reshape(n, rows_per_chunk, BLOCK)
     recv_scale = lax.all_to_all(
         scale[:, :, None], axis_name, split_axis=0, concat_axis=0,
-        tiled=True).reshape(n, rows_per_chunk)
+        tiled=True, axis_index_groups=groups).reshape(n, rows_per_chunk)
     total = jnp.sum(recv.astype(jnp.float32)
                     * recv_scale[:, :, None], axis=0)
     if op == "average":
         total = total / n
     return total.reshape(-1)
+
+
+def int8_two_level_allreduce_flat(flat, axis_name: str, islands,
+                                  op: str = "average",
+                                  prescale_factor: float = 1.0,
+                                  postscale_factor: float = 1.0,
+                                  salt=None):
+    """Two-level (ICI×DCN) int8 allreduce of a flat tensor, quantized
+    PER LEG — the comms planner's ``two_level`` schedule for the int8
+    wire (``HOROVOD_COMMS_PLANNER``; see ``ops/comms_planner.py``):
+
+    1. intra-island quantized reduce-scatter (int8 all_to_all over the
+       island's ``axis_index_groups`` sub-ring + local dequant-sum) —
+       each rank keeps ``1/L`` of the payload;
+    2. cross-island quantized allreduce of that shard (the full EQuARX
+       exchange over the position-matched cross groups) — only the
+       shard crosses DCN, and it crosses at ~1 byte/element;
+    3. intra-island int8 allgather (quantize → all_gather int8+scales →
+       dequantize).
+
+    Every leg re-quantizes its input with its own blockwise scales, so
+    the wire is int8 end to end and the per-leg error is bounded the
+    same way the flat EQuARX exchange's is. ``islands`` is the regular
+    island layout the plan carries (equal sizes, ≥2 islands). Returns
+    f32 with ``flat``'s shape; callers cast."""
+    from ..profiler import annotate_collective
+    from .comms_planner import _two_level_groups
+
+    # One grouping convention for the int8 and f32 wires: the planner's
+    # helper owns the (local, cross) construction, so the two schedules
+    # can never silently diverge on the position mapping.
+    groups, cross = _two_level_groups(islands)
+    L = len(groups[0])
+    G = len(groups)
+    m = int(flat.size)
+    x = flat.astype(jnp.float32)
+    if prescale_factor != 1.0:
+        x = x * prescale_factor
+    # Pad so each island rank's shard is whole blocks.
+    chunk_elems = -(-m // (L * BLOCK)) * BLOCK
+    xp = jnp.pad(x, (0, L * chunk_elems - m))
+    with annotate_collective("int8_two_level.rs_local"):
+        shard = _reduce_scattered_rows(
+            xp.reshape(L, chunk_elems), axis_name, L, "sum", salt,
+            groups=groups)
+    with annotate_collective("int8_two_level.allreduce_cross"):
+        shard = int8_allreduce_flat(
+            shard, axis_name, G, op="sum", salt=salt, groups=cross)
+    if op == "average":
+        shard = shard / (L * G)
+    with annotate_collective("int8_two_level.ag_local"):
+        q, scale = _quantize_blocks(shard.reshape(-1), salt)
+        gathered = lax.all_gather(q.reshape(-1, BLOCK), axis_name,
+                                  axis_index_groups=groups)
+        gathered_scale = lax.all_gather(scale, axis_name,
+                                        axis_index_groups=groups)
+    out = (gathered.astype(jnp.float32)
+           * gathered_scale[:, :, None]).reshape(-1)[:m]
+    if postscale_factor != 1.0:
+        out = out * postscale_factor
+    return out
 
 
 def int8_fused_reducescatter(
@@ -358,11 +428,31 @@ def int8_fused_allreduce(
             else enumerate(buckets)):
         flats = [floats[j] for j in bucket]
         packed = flats[0] if len(bucket) == 1 else jnp.concatenate(flats)
-        with annotate_collective(f"int8_allreduce.bucket{bi}"):
-            reduced = int8_allreduce_flat(
-                packed, axis_name, world_size, op=op,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor, salt=salt)
+        # Comms-planner leg: the int8 wire may take the two-level
+        # schedule (per-leg quantization) on a multi-island fabric.
+        # ``rhd`` is never a candidate here — the EQuARX exchange is
+        # already an all_to_all/all_gather pair, not a ring, so the
+        # halving–doubling latency argument does not apply to it. The
+        # bucket bytes offered to the planner are the WIRE bytes
+        # (~2/element: int8 out + int8 back), matching what the fitted
+        # per-algorithm model observes for this exchange.
+        from .fusion import _bucket_suffix, _plan_bucket
+
+        plan = _plan_bucket(
+            "allreduce", 2 * int(packed.size), axis_name, world_size,
+            candidates=("flat", "two_level"))
+        with annotate_collective(
+                f"int8_allreduce.bucket{bi}{_bucket_suffix(plan)}"):
+            if plan is not None and plan.algorithm == "two_level":
+                reduced = int8_two_level_allreduce_flat(
+                    packed, axis_name, plan.islands, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor, salt=salt)
+            else:
+                reduced = int8_allreduce_flat(
+                    packed, axis_name, world_size, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor, salt=salt)
         offset = 0
         for j in bucket:
             i = float_idx[j]

@@ -194,6 +194,7 @@ def _reduce_grads(
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor,
         issue_reversed=issue_reversed,
+        world_size=world_size,
     )
     restored = [
         compression.decompress(r, ctx) for r, ctx in zip(reduced, ctxs)
@@ -270,8 +271,11 @@ def _reducescatter_grads(
     if isinstance(axis_name, (tuple, list)):
         raise ValueError(
             "sync_mode='sharded' does not compose with the hierarchical "
-            "(cross, local) mesh; use the flat axis (the two-level "
-            "reduction already reduce-scatters its local leg)")
+            "(cross, local) mesh; use the flat axis — for ICI x DCN "
+            "hierarchy on the flat axis, set HOROVOD_COMMS_PLANNER and "
+            "the planner's two_level schedule gives the RS/AG halves "
+            "the same intra-island/cross-island composition per bucket "
+            "(ops/comms_planner.py)")
     if world_size is None:
         raise ValueError(
             "sync_mode='sharded' needs a known process-set size at trace "

@@ -261,7 +261,24 @@ def _check_flat_axis(axis_name, what: str, sync_mode: str = "sharded"):
             f"local leg"
             + (" — and the fsdp shard ownership map is defined over ONE "
                "world axis" if sync_mode == "fsdp" else "")
-            + ")")
+            + "). For ICI x DCN hierarchy WITH this sync mode, set "
+            "HOROVOD_COMMS_PLANNER: the planner's two_level schedule "
+            "composes the same legs per bucket on the flat axis "
+            "(ops/comms_planner.py)")
+
+
+def _planner_autotune_candidates():
+    """The comms planner's algorithm axis for the transparent tuner —
+    non-None only when ``HOROVOD_COMMS_PLANNER=auto`` and more than one
+    algorithm is eligible for this world (``comms_planner
+    .autotune_candidates``). Guarded: the factories must build even
+    when the planner cannot introspect the world yet."""
+    try:
+        from ..ops.comms_planner import autotune_candidates
+
+        return autotune_candidates()
+    except Exception:  # noqa: BLE001
+        return None
 
 
 def shard_state(tree, mesh=None, axis_name: str | None = None):
@@ -376,7 +393,9 @@ def make_train_step(
     # tuner owns re-tracing (clear_cache) and the watch defers while a
     # tuning window is live so its pipeline drain cannot bias a sample.
     return _StallWatchedStep(
-        maybe_autotune_step(jax.jit(sharded, donate_argnums=donate_argnums)),
+        maybe_autotune_step(
+            jax.jit(sharded, donate_argnums=donate_argnums),
+            algorithm_candidates=_planner_autotune_candidates()),
         "train_step")
 
 
@@ -417,7 +436,8 @@ def _make_sharded_train_step(loss_fn, spec, mesh, axis_name, donate,
         )
         return _StallWatchedStep(
             maybe_autotune_step(
-                jax.jit(sharded, donate_argnums=donate_argnums)),
+                jax.jit(sharded, donate_argnums=donate_argnums),
+                algorithm_candidates=_planner_autotune_candidates()),
             "train_step")
 
     core = jax.jit(
@@ -571,7 +591,9 @@ def _make_fsdp_train_step(loss_fn, spec, mesh, axis_name, donate,
     )
     donate_argnums = (0, 1) if donate else ()
     return _StallWatchedStep(
-        maybe_autotune_step(jax.jit(sharded, donate_argnums=donate_argnums)),
+        maybe_autotune_step(
+            jax.jit(sharded, donate_argnums=donate_argnums),
+            algorithm_candidates=_planner_autotune_candidates()),
         name_prefix)
 
 
@@ -881,7 +903,8 @@ def make_overlapped_train_step(
     return _StallWatchedStep(
         maybe_autotune_step(
             jax.jit(sharded, donate_argnums=donate_argnums),
-            segment_candidates=seg_cands),
+            segment_candidates=seg_cands,
+            algorithm_candidates=_planner_autotune_candidates()),
         "overlapped_train_step")
 
 

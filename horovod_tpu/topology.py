@@ -18,9 +18,70 @@ Horovod world facts (rank / local_rank / cross_rank) from it:
 
 from __future__ import annotations
 
+import os
 from typing import Any, Sequence
 
 import numpy as np
+
+#: Per-link-class α–β seeds ``{class: (alpha_s, beta_s_per_byte)}`` — the
+#: comms planner's static crossover inputs before the online model has a
+#: ready fit for a key (``ops/comms_planner.py``). Deliberately coarse
+#: (ICI ≈ tens of GB/s at µs launch, DCN ≈ single-digit GB/s at tens of
+#: µs): the planner only compares candidates against each other, so the
+#: RATIO between classes is what the crossover depends on, and the live
+#: α–β fit replaces these the moment it is ready.
+LINK_CLASS_SEEDS: dict[str, tuple[float, float]] = {
+    "ici": (2.0e-6, 1.0 / 45e9),
+    "dcn": (50.0e-6, 1.0 / 2.5e9),
+    "self": (0.0, 0.0),
+}
+
+
+def link_seed(link_class: str) -> tuple[float, float]:
+    """The seed ``(alpha_s, beta_s_per_byte)`` for a link class (unknown
+    classes price as DCN — the conservative choice)."""
+    return LINK_CLASS_SEEDS.get(str(link_class), LINK_CLASS_SEEDS["dcn"])
+
+
+def parse_link_class_map(spec: str) -> list[list[int]] | None:
+    """Parse the ``HOROVOD_LINK_CLASS_MAP`` fabric declaration.
+
+    Grammar (docs/perf.md "Algorithm selection"): semicolon-separated ICI
+    islands, each a comma-separated list of global ranks and/or ``a-b``
+    ranges — ``"0-3;4-7"`` declares two 4-rank slices whose intra-island
+    links are ICI and whose cross-island links are DCN. The override
+    exists so CPU tests and benches can emulate a multi-slice fabric,
+    and so multi-slice worlds whose devices expose no ``slice_index``
+    can declare theirs. Returns None for an empty/invalid spec (invalid
+    maps must never take down init — the topology falls back to the
+    device-derived classification).
+    """
+    spec = (spec or "").strip()
+    if not spec:
+        return None
+    islands: list[list[int]] = []
+    seen: set[int] = set()
+    try:
+        for part in spec.split(";"):
+            ranks: list[int] = []
+            for item in part.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                if "-" in item:
+                    lo, hi = item.split("-", 1)
+                    ranks.extend(range(int(lo), int(hi) + 1))
+                else:
+                    ranks.append(int(item))
+            if not ranks:
+                return None
+            if seen & set(ranks):
+                return None  # overlapping islands: malformed
+            seen.update(ranks)
+            islands.append(sorted(ranks))
+    except ValueError:
+        return None
+    return islands if islands else None
 
 
 def _device_sort_key(device: Any):
@@ -141,6 +202,47 @@ class Topology:
 
     # -- link classification (the comms model's topology leg) ----------------
 
+    def link_class_map(self) -> list[list[int]] | None:
+        """The ``HOROVOD_LINK_CLASS_MAP`` islands covering THIS world, or
+        None (no/invalid override, or one that names ranks outside the
+        world). Read dynamically — benches and tests declare an emulated
+        fabric after init — and parse-cached per distinct env value."""
+        raw = os.environ.get("HOROVOD_LINK_CLASS_MAP", "")
+        cached = getattr(self, "_lcm_cache", None)
+        if cached is not None and cached[0] == raw:
+            return cached[1]
+        islands = parse_link_class_map(raw)
+        if islands is not None:
+            covered = {r for isl in islands for r in isl}
+            if not covered <= set(range(self.num_devices)):
+                islands = None  # names ranks this world does not have
+        self._lcm_cache = (raw, islands)
+        return islands
+
+    def ici_islands(self) -> list[list[int]]:
+        """Ranks grouped into ICI islands — the comms planner's
+        ``two_level`` grouping (intra-island legs ride ICI, the
+        cross-island leg rides DCN). The ``HOROVOD_LINK_CLASS_MAP``
+        override wins (ranks it omits become single-rank islands);
+        otherwise devices group by slice (coordinate-bearing) or by
+        process — the same facts :meth:`link_class` classifies by, so
+        the two views can never disagree about which pairs are ICI."""
+        mapped = self.link_class_map()
+        if mapped is not None:
+            covered = {r for isl in mapped for r in isl}
+            extras = [[r] for r in range(self.num_devices)
+                      if r not in covered]
+            return [list(isl) for isl in mapped] + extras
+        by_key: dict[Any, list[int]] = {}
+        for i, d in enumerate(self.devices):
+            coords = self.device_coords(d)
+            if coords is not None:
+                key = ("slice", getattr(d, "slice_index", 0) or 0)
+            else:
+                key = ("proc", d.process_index)
+            by_key.setdefault(key, []).append(i)
+        return [sorted(v) for _, v in sorted(by_key.items())]
+
     def link_class(self, rank_a: int, rank_b: int) -> str:
         """Classify the rank-pair link: ``"self"`` (same device),
         ``"ici"`` (torus-connected — same host, or coordinate-bearing
@@ -151,6 +253,12 @@ class Topology:
         (``horovod_tpu.comms_model``)."""
         if rank_a == rank_b:
             return "self"
+        mapped = self.link_class_map()
+        if mapped is not None:
+            for island in mapped:
+                if rank_a in island:
+                    return "ici" if rank_b in island else "dcn"
+            return "dcn"  # ranks the map omits: conservative cross-class
         da, db = self.devices[rank_a], self.devices[rank_b]
         if da.process_index == db.process_index:
             return "ici"
@@ -203,6 +311,21 @@ class Topology:
             lines.append(f"links: {pairs}")
         else:
             lines.append("links: none (degenerate single-rank world)")
+        if self.link_class_map() is not None:
+            lines.append(
+                "islands (HOROVOD_LINK_CLASS_MAP): "
+                + " ".join("[" + ",".join(map(str, isl)) + "]"
+                           for isl in self.ici_islands()))
+        # Comms-planner view: the chosen collective algorithm per op at a
+        # representative payload, with provenance (fitted model vs static
+        # crossover) — why a bucket got its schedule. Best-effort: a cold
+        # or disabled planner renders a one-liner, never raises.
+        try:
+            from .ops.comms_planner import describe_plans
+
+            lines.extend(describe_plans(self))
+        except Exception:  # noqa: BLE001 — description must never fail
+            pass
         for i, d in enumerate(self.devices):
             coords = self.device_coords(d)
             lines.append(
