@@ -193,6 +193,49 @@ if comms.get("autotune_winner_guided") != comms.get(
         "premerge comms lane: model-guided pruning changed the autotune "
         f"winner (exhaustive={comms.get('autotune_winner_exhaustive')!r}, "
         f"guided={comms.get('autotune_winner_guided')!r})")
+planner = last.get("planner") or {}
+if not planner or planner.get("skipped"):
+    sys.exit("premerge planner lane: bench record has no 'planner' "
+             f"section (got {planner!r})")
+if planner.get("split_selected_algorithm") != "two_level":
+    sys.exit(
+        "premerge planner lane: the planner picked "
+        f"{planner.get('split_selected_algorithm')!r} on the emulated "
+        "2-slice DCN split (must schedule two_level for "
+        f"above-crossover buckets; bucket_bytes="
+        f"{planner.get('bucket_bytes')!r})")
+pp, pf = (planner.get("split_predicted_planned_s"),
+          planner.get("split_predicted_flat_s"))
+if pp is None or pf is None or pp >= pf:
+    sys.exit(
+        "premerge planner lane: the planned schedule's predicted cost "
+        f"does not beat flat on the emulated split (planned={pp!r}, "
+        f"flat={pf!r})")
+if planner.get("uniform_selected_algorithm") != "flat":
+    sys.exit(
+        "premerge planner lane: the planner left flat on a uniform "
+        "single-class fabric (picked "
+        f"{planner.get('uniform_selected_algorithm')!r})")
+up, uf = (planner.get("uniform_planned_step_s"),
+          planner.get("uniform_flat_step_s"))
+identical = planner.get("uniform_program_identical")
+# Uniform-fabric parity: when the planner picks flat it must emit the
+# byte-identical program (parity by construction — wall timing of
+# identical programs on a loaded CPU box is ±20% noise); only a
+# genuinely divergent program falls back to the 2% wall-clock gate.
+if not identical:
+    if not up or not uf or up > uf / 0.98:
+        sys.exit(
+            "premerge planner lane: planner-enabled flush diverged from "
+            "the flat program on the single-class fabric AND regressed "
+            f"beyond the 2% slack (identical={identical!r}, "
+            f"planned={up!r}, flat={uf!r})")
+print(f"premerge planner lane: ok (split schedule "
+      f"{planner['split_selected_algorithm']!r} "
+      f"[{planner.get('split_provenance')!r}], predicted "
+      f"{pp:.6f}s vs flat {pf:.6f}s; uniform program "
+      f"identical={identical!r}, wall ratio "
+      f"{(up / uf) if up and uf else float('nan'):.4f})")
 print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded}, "
       f"fsdp={fsdp}, resident fsdp/mono={r_fsdp / r_mono:.1%})")
 print(f"premerge comms lane: ok (pruned {comms['autotune_pruned']} of "
@@ -313,6 +356,11 @@ try:
         "hvd_link_latency_seconds",
         "hvd_collective_efficiency_ratio",
         "hvd_comms_residual_seconds",
+        # Comms planner: zero-materialized (0 = planner off, absence =
+        # not measuring) plus per-algorithm dispatch counts.
+        "hvd_planner_plans_total",
+        "hvd_planner_replans_total",
+        "hvd_planner_dispatch_total",
         # SDC defense plane: zero-materialized so a clean run still
         # reports the instruments (clean run != not measuring).
         "hvd_integrity_checks_total",
