@@ -1249,6 +1249,98 @@ def main() -> int:
         if planner_lane is not None:
             emit.update(planner=planner_lane)
 
+    # --- section 6c: expert-parallel MoE lane (--smoke included) — the
+    # alltoall sync path (parallel/moe.py) A/B'd against the dense
+    # data-parallel MoE baseline. Both layers run identical routing and
+    # identical per-rank FFN FLOPs (E·capacity token slots through one
+    # D→H→D expert each); the EP side adds the real dispatch/combine
+    # exchanges and in return shards the expert weights 1/E per rank —
+    # a memory win a virtual CPU mesh cannot cash in, so on the smoke
+    # fabric EP ≤ DP by construction and premerge gate 3's floor guards
+    # a pathologically slow wire, not parity. The dispatch-probe A/B
+    # times the quantized (int8) vs fp32 wire in isolation.
+    def run_moe():
+        import statistics as _stats
+
+        from horovod_tpu import attribution
+        from horovod_tpu.parallel import moe as moe_mod
+
+        if n < 2:
+            return {"skipped": "single-device world (no expert set)"}
+        tok_per_rank, d_model, d_ff = (16, 64, 128) if smoke \
+            else (64, 128, 256)
+        cap = 8
+        rng = np.random.RandomState(7)
+        tokens = rng.randn(n * tok_per_rank, d_model).astype(np.float32)
+        gates = rng.randn(d_model, n).astype(np.float32)
+        w1 = rng.randn(n, d_model, d_ff).astype(np.float32)
+        w2 = rng.randn(n, d_ff, d_model).astype(np.float32)
+        args = (tokens, gates, w1, w2)
+        dp_step = moe_mod.make_data_parallel_moe_step(capacity=cap,
+                                                      segments=2)
+        ep_step = moe_mod.make_expert_parallel_moe_step(capacity=cap,
+                                                        segments=2)
+        ep_int8 = moe_mod.make_expert_parallel_moe_step(
+            capacity=cap, segments=2, compression="int8")
+
+        def time_interleaved(fns, probe_args, windows, iters):
+            # Interleaved A/B windows, same rationale as the planner
+            # lane: host-load drift hits every side equally.
+            samples: list[list[float]] = [[] for _ in fns]
+            for fn in fns:
+                jax.block_until_ready(fn(*probe_args))  # compile
+            for _ in range(windows):
+                for fn, acc in zip(fns, samples):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = fn(*probe_args)
+                    jax.block_until_ready(out)
+                    acc.append((time.perf_counter() - t0) / iters)
+            return [_stats.median(sorted(a)) for a in samples]
+
+        windows, iters = (2, 2) if smoke else (5, 10)
+        t_dp, t_ep, t_ep8 = time_interleaved(
+            [dp_step, ep_step, ep_int8], args, windows, iters)
+        toks = n * tok_per_rank
+        # Analytic routed-FFN FLOPs/step (forward): every kept token
+        # does x@w1 + h@w2 = 4·D·H; declared to the attribution plane
+        # so the MoE step exports hvd_mfu_ratio, then restored so the
+        # resnet sections' constant survives the lane.
+        moe_flops = 4.0 * d_model * d_ff * toks
+        prev_flops, _ = attribution.model_flops()
+        hvd.set_model_flops_per_step(moe_flops)
+        try:
+            with hvd.tracing.get_tracer().step_scope("moe_step"):
+                jax.block_until_ready(ep_step(*args))
+        finally:
+            hvd.set_model_flops_per_step(prev_flops)
+        mfu = (moe_flops / (t_ep * peak * n)
+               if peak is not None else None)
+        t_probe32, t_probe8 = time_interleaved(
+            [ep_step.dispatch_probe, ep_int8.dispatch_probe],
+            (tokens, gates), windows, iters)
+        return {
+            "world": n, "tokens_per_step": toks, "capacity": cap,
+            "segments": ep_step.meta["segments"],
+            "algorithm": ep_step.meta["algorithm"],
+            "dispatch_bytes_fp32": ep_step.meta["nbytes"],
+            "dispatch_bytes_int8": ep_int8.meta["nbytes"],
+            "dp_tokens_per_sec": round(toks / t_dp, 1),
+            "ep_tokens_per_sec": round(toks / t_ep, 1),
+            "ep_int8_tokens_per_sec": round(toks / t_ep8, 1),
+            "ep_vs_dp": round(t_dp / t_ep, 4),
+            "mfu": round(mfu, 6) if mfu is not None else None,
+            "dispatch_probe_fp32_s": round(t_probe32, 6),
+            "dispatch_probe_int8_s": round(t_probe8, 6),
+            "dispatch_int8_vs_fp32": round(t_probe32 / t_probe8, 4),
+        }
+
+    if not out_of_time():
+        moe_lane = _with_retry("moe", run_moe, errors,
+                               allow_retry=single_controller)
+        if moe_lane is not None:
+            emit.update(moe=moe_lane)
+
     # --- section 7: attribution lane — the framework-side decomposition
     # of the bench_phases step (compute / exposed_comm / straggler_wait /
     # overhead summing to the step wall time), the measured

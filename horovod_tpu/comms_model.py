@@ -79,9 +79,13 @@ ALGORITHMS = ("flat", "hierarchical", "rs_ag", "fsdp", "rhd", "two_level")
 #: Span-name vocabulary carrying static bucket bytes (ops/fusion.py's
 #: ``annotate_collective`` names and the eager dispatch span args). A
 #: trailing ``.<algorithm>`` names the planner's chosen schedule
-#: (``allreduce.bucket0.1048576B.two_level``); absent = flat.
+#: (``allreduce.bucket0.1048576B.two_level``); absent = flat. The MoE
+#: dispatch/combine probes (``parallel/moe.py``) emit the same grammar
+#: under a dotted op (``moe.dispatch.4224B.two_level``) so the
+#: alltoall wire trains its own per-algorithm fits.
 _BUCKET_NAME_RE = re.compile(
-    r"^(?P<op>allreduce|reducescatter|allgather)\."
+    r"^(?P<op>allreduce|reducescatter|allgather"
+    r"|alltoall|moe\.(?:dispatch|combine))\."
     r"(?:bucket\d+\.)?(?P<bytes>\d+)B"
     r"(?:\.(?P<algo>[a-z0-9_]+))?$")
 
@@ -365,6 +369,8 @@ class CommsModel:
                 if nbytes is None and m is not None:
                     nbytes = float(m.group("bytes"))
                     op = m.group("op")
+                    if op.startswith("moe."):
+                        op = "alltoall"  # the MoE wire IS an alltoall
                 if nbytes is None or op is None:
                     continue
                 name_algo = (m.group("algo") or "flat") \

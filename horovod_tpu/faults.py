@@ -44,6 +44,15 @@ kill workers by behavior flag). This module generalizes that into named
   computed), modeling a bit-flip on the wire — the KV server's
   install-time verification must reject it (422) with the previous good
   replica intact
+- ``moe.dispatch``       — every expert-parallel MoE step's dispatch
+  alltoall (``parallel/moe.py`` step wrappers). **The canonical MoE
+  chaos injector**: ``drop`` loses the dispatched payload (every token
+  takes its passthrough residual — a dead expert exchange, the step
+  survives), ``delay`` stalls the dispatch (an expert-imbalance
+  straggler for the skew gauges), ``corrupt`` flips seeded bits in the
+  token batch feeding the alltoall — quantized or not, the damage
+  crosses ranks, which is what the non-finite tripwire and integrity
+  voting planes must catch
 
 The canonical **control-plane injectors** are these three plus
 :func:`kill_driver` (SIGKILL the driver process — the KV server dies
@@ -129,6 +138,11 @@ COMMS_LINK = "comms.link"
 # the server's install gate must reject it).
 GRAD_CORRUPT = "grad.corrupt"
 PEER_CORRUPT = "peer.corrupt"
+# The expert-parallel MoE dispatch alltoall (the canonical MoE chaos
+# injector — see the module docstring): drop loses the payload
+# (passthrough step), delay stalls it, corrupt flips bits in the token
+# batch feeding the wire.
+MOE_DISPATCH = "moe.dispatch"
 
 _MODES = ("drop", "delay", "raise", "hang", "corrupt")
 _DEFAULT_HANG_S = 3600.0

@@ -832,6 +832,27 @@ PLANNER_DISPATCH = counter(
     "emissions count once per trace; eager ones per dispatch).",
     ("op", "algorithm"))
 
+# Expert-parallel MoE wire (parallel/moe.py): per-step routing health +
+# the alltoall dispatch/combine latency the planner's fits train on.
+MOE_DISPATCH_BYTES = histogram(
+    "hvd_moe_dispatch_bytes",
+    "Per-rank dispatch-alltoall payload bytes per traced expert-parallel "
+    "MoE layer (wire view: post-compression).", (), BYTE_BUCKETS)
+MOE_TOKENS_DROPPED = counter(
+    "hvd_moe_tokens_dropped_total",
+    "Tokens dropped by capacity-factor routing (took the passthrough "
+    "residual instead of their expert).")
+MOE_EXPERT_LOAD = gauge(
+    "hvd_moe_expert_load",
+    "Tokens routed to each expert in the last observed MoE step (this "
+    "rank's routing view) — the imbalance the skew attribution chases.",
+    ("expert",))
+ALLTOALL_LATENCY = histogram(
+    "hvd_alltoall_latency_seconds",
+    "Wall time of alltoall exchanges (eager dispatches and MoE "
+    "dispatch/combine probes), by executed algorithm.",
+    ("algorithm",), LATENCY_BUCKETS_S)
+
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
 # gate can assert the instruments exist and dashboards can tell "never
@@ -867,9 +888,17 @@ def _materialize_checkpoint_cells() -> None:
     # measuring".
     PLANNER_PLANS.labels()
     PLANNER_REPLANS.labels()
-    for op in ("allreduce", "reducescatter", "allgather"):
+    for op in ("allreduce", "reducescatter", "allgather", "alltoall"):
         for algo in ("flat", "rhd", "two_level"):
             PLANNER_DISPATCH.labels(op=op, algorithm=algo)
+    # Expert-parallel MoE zero cells: a job that never ran an MoE layer
+    # (or never dropped a token) still reports the series at 0 — the
+    # premerge scrape gate asserts the instruments exist.
+    MOE_DISPATCH_BYTES.labels()
+    MOE_TOKENS_DROPPED.labels()
+    MOE_EXPERT_LOAD.labels(expert="0")
+    for algo in ("flat", "two_level"):
+        ALLTOALL_LATENCY.labels(algorithm=algo)
     # Integrity defense plane zero cells: a job that never corrupted,
     # never tripped, and never rewound still reports the series at 0 —
     # the premerge scrape gate asserts they exist, and dashboards can

@@ -452,6 +452,11 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=(),
             jax.block_until_ready(out)
             dt = _time.perf_counter() - t_exec
             _metrics.COLLECTIVE_LATENCY.observe(dt, kind=kind)
+            if kind == "alltoall":
+                # The alltoall wire gets its own per-algorithm latency
+                # family (the MoE dispatch/combine probes feed the same
+                # one), so planner A/Bs read straight off the scrape.
+                _metrics.ALLTOALL_LATENCY.observe(dt, algorithm=algorithm)
             try:
                 # Every timed eager dispatch is an alpha-beta sample:
                 # one collective of `nbytes` over this set's worst link
@@ -734,8 +739,13 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
         if splits is not None:
             raise NotImplementedError(
                 "uneven alltoall splits cannot compile inside jit (XLA "
-                "static shapes); pad chunks to equal size (see "
-                "horovod_tpu.ops.fusion.pad_to_multiple) or call the "
+                "static shapes). The jit-compatible path is pad-to-"
+                "capacity: route into fixed per-destination slots with "
+                "horovod_tpu.parallel.moe.route_to_capacity (the "
+                "capacity-factor routing helper — overflow tokens take "
+                "the passthrough residual; see docs/perf.md 'Expert "
+                "parallelism'), pad raw chunks with "
+                "horovod_tpu.ops.fusion.pad_to_multiple, or call the "
                 "eager/host flavor outside the trace"
             )
         return _alltoall_traced(tensor, traced_axis)
@@ -758,7 +768,16 @@ def alltoall(tensor, splits=None, process_set=None, name: str | None = None):
     def traced(x):
         return _alltoall_traced(x, ps.axis_name)
 
-    return _eager_dispatch("alltoall", traced, tensor, ps)
+    def _planned_alltoall(plan):
+        def traced_planned(t):
+            from . import comms_planner
+
+            return comms_planner.apply_alltoall(plan, t, ps.axis_name)
+
+        return traced_planned
+
+    return _eager_dispatch("alltoall", traced, tensor, ps,
+                           plan_spec=("alltoall", _planned_alltoall))
 
 
 def _alltoall_splits_stacked(tensor, splits, ps):
@@ -927,7 +946,8 @@ def run_comms_microprobe(process_set=None, sizes=None,
     over a process set — the jax-side driver of
     ``comms_model.microprobe``.
 
-    Runs eager allreduce / reducescatter / allgather dispatches at each
+    Runs eager allreduce / reducescatter / allgather / alltoall
+    dispatches at each
     payload size (stacked-rank convention, float32); every dispatch's
     measured latency feeds the α–β model automatically through
     ``_eager_dispatch`` (compile time excluded — the first call of each
@@ -968,6 +988,7 @@ def run_comms_microprobe(process_set=None, sizes=None,
         ("reducescatter",
          lambda a: reducescatter(a, op=Sum, process_set=ps)),
         ("allgather", lambda a: allgather(a, process_set=ps)),
+        ("alltoall", lambda a: alltoall(a, process_set=ps)),
     ):
         algorithms: tuple = (
             comms_planner.eligible_algorithms(op_name, n, islands)

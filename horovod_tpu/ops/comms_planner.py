@@ -81,8 +81,15 @@ from typing import Any, NamedTuple, Sequence
 #: The planner's algorithm vocabulary (``algorithm`` label values).
 PLANNER_ALGORITHMS = ("flat", "rhd", "two_level")
 
-#: Ops the planner schedules (the three bucket-flush collectives).
-PLANNER_OPS = ("allreduce", "reducescatter", "allgather")
+#: Ops the planner schedules: the three bucket-flush collectives plus
+#: the MoE dispatch/combine wire (``parallel/moe.py``).
+PLANNER_OPS = ("allreduce", "reducescatter", "allgather", "alltoall")
+
+#: The gradient-wire subset — what a sync_mode's flush can lower to.
+#: The transparent autotuner's algorithm axis intersects eligibility
+#: over THESE only: ``alltoall`` (rhd never eligible) is a per-layer
+#: wire, not a flush the factories might emit under another name.
+_WIRE_OPS = ("allreduce", "reducescatter", "allgather")
 
 
 class BucketPlan(NamedTuple):
@@ -252,12 +259,17 @@ def eligible_algorithms(op: str, world: int, islands,
 
     ``rhd`` needs a power-of-two world (the fold-in step covers the
     allreduce, but the RS/AG halves' ownership contract — rank r keeps
-    row r — has no fold-in analog); ``two_level`` needs a regular ≥2
-    island layout. ``flat`` is always eligible."""
+    row r — has no fold-in analog) and never applies to ``alltoall``
+    (recursive halving reduces; an alltoall only permutes, so the
+    staged form is Bruck's algorithm, which XLA's native lowering
+    already subsumes); ``two_level`` needs a regular ≥2 island layout.
+    ``flat`` is always eligible."""
     out = ["flat"]
     n = int(world)
     pow2 = n >= 2 and (n & (n - 1)) == 0
-    if op == "allreduce":
+    if op == "alltoall":
+        pass  # rhd never eligible for a pure permutation wire
+    elif op == "allreduce":
         if n >= 2:
             out.append("rhd")
     elif pow2:
@@ -294,6 +306,35 @@ def _seed_price(op: str, algorithm: str, nbytes: float, world: int,
     worst = _worst_link_class(islands)
     a_w, b_w = _seed(worst)
     halves = 2.0 if op == "allreduce" else 1.0
+    if op == "alltoall":
+        # A permutation wire, priced differently from the reductions in
+        # both terms. β: every rank ships (n-1)/n of its buffer once (no
+        # reduction halves), and staging CANNOT shrink the cross-island
+        # byte count — two_level's cross leg still carries (G-1)/G of B.
+        # α: flat issues a distinct message per peer ((n-1) launches,
+        # DCN-priced pairs dominating on a split fabric — the MPI
+        # characterization's α-sensitivity result), while two_level
+        # aggregates them into (L-1) ICI + (G-1) DCN launches. So the
+        # seed crossover runs the OPPOSITE way from the reductions:
+        # two_level wins the latency-bound regime, flat the huge
+        # bandwidth-bound payloads. Flat on a split fabric prices as the
+        # "mixed" class (topology.LINK_CLASS_SEEDS): part of each rank's
+        # chunks stay on ICI, so no single DCN link carries the whole
+        # payload the way a ring hop does.
+        if algorithm == "flat":
+            a_f, b_f = _seed("mixed" if worst == "dcn" else worst)
+            return a_f * max(n - 1, 1) + b_f * B * (n - 1) / max(n, 1)
+        if algorithm == "two_level":
+            factors = _regular_factors(islands, n)
+            if factors is None:
+                return None
+            G, L = factors
+            a_i, b_i = _seed("ici")
+            a_d, b_d = _seed("dcn")
+            local = a_i * (L - 1) + b_i * B * (L - 1) / L
+            cross = a_d * (G - 1) + b_d * B * (G - 1) / G
+            return local + cross
+        return None
     if algorithm == "flat":
         return a_w + b_w * B * halves * (n - 1) / max(n, 1)
     if algorithm == "rhd":
@@ -580,7 +621,7 @@ def autotune_candidates(world_size: int | None = None
         return None
     islands = _islands_for(int(n))
     elig = set(PLANNER_ALGORITHMS)
-    for op in PLANNER_OPS:
+    for op in _WIRE_OPS:
         elig &= set(eligible_algorithms(op, int(n), islands))
     ordered = tuple(a for a in PLANNER_ALGORITHMS if a in elig)
     return ("auto",) + ordered if len(ordered) > 1 else None
@@ -848,6 +889,52 @@ def two_level_allgather_row(row, axis_name, world_size: int, islands):
     return full.reshape(n, -1)[jnp.asarray(inv)].reshape(-1)
 
 
+def two_level_alltoall(chunks, axis_name, islands):
+    """ICI×DCN staged alltoall of per-destination ``(world, ...)``
+    chunks: intra-island exchange of the within-island coordinate, then
+    cross-island exchange of the island coordinate, via
+    ``axis_index_groups`` — the message-aggregation form ( (L-1) ICI +
+    (G-1) DCN launches instead of (n-1) mostly-DCN ones). A pure
+    permutation: the result is BITWISE identical to the flat tiled
+    ``lax.all_to_all`` (asserted in tests/test_moe_parallel.py), so
+    unlike the reduction schedules there is no summation-order caveat.
+
+    Writing destination d of island i at within-island position l as
+    (i, l): stage 1 exchanges l among island peers (each rank ends
+    holding, for every island peer p, p's chunks for within-island
+    position = OUR position), stage 2 exchanges i among position peers
+    — after which rank (i, l) holds exactly the chunks every source
+    addressed to it, reordered back to source-rank order by the inverse
+    of the island-major permutation applied up front."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..profiler import annotate_collective
+
+    groups, cross = _two_level_groups(islands)
+    G, L = len(groups), len(groups[0])
+    n = G * L
+    # Destination-rank rows → [l2, i2] island-major view (rank
+    # groups[i][l] is destination (i, l)).
+    perm = [groups[i][l] for l in range(L) for i in range(G)]
+    inv = [0] * n
+    for i in range(G):
+        for l in range(L):
+            inv[groups[i][l]] = i * L + l
+    tail = chunks.shape[1:]
+    x = chunks[jnp.asarray(perm)].reshape(L, G, *tail)
+    with annotate_collective("planner.two_level.a2a_local"):
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True, axis_index_groups=groups)
+    x = jnp.swapaxes(x, 0, 1)  # [l1, i2] → [i2, l1]
+    with annotate_collective("planner.two_level.a2a_cross"):
+        x = lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True, axis_index_groups=cross)
+    # Rows now [i1, l1] = the chunk source rank groups[i1][l1] sent us;
+    # restore source-rank order.
+    return x.reshape(n, *tail)[jnp.asarray(inv)]
+
+
 def rhd_reducescatter_sum(flat, axis_name, world_size: int):
     """Recursive-halving SUM reduce-scatter: ``(world·s,)`` → this
     rank's row r. Power-of-two worlds only (the planner's eligibility
@@ -947,3 +1034,19 @@ def apply_allgather_row(plan: BucketPlan, row, axis_name):
     from jax import lax
 
     return lax.all_gather(row, axis_name, axis=0, tiled=True)
+
+
+def apply_alltoall(plan: BucketPlan, x, axis_name):
+    """Run the plan's alltoall on a rank-local buffer whose dim 0 is
+    ``plan.world · chunk`` (the flat tiled ``lax.all_to_all``
+    contract). Pure permutation — every algorithm returns bitwise the
+    same buffer."""
+    n = int(plan.world)
+    if plan.algorithm == "two_level" and x.shape[0] % n == 0:
+        chunks = x.reshape(n, x.shape[0] // n, *x.shape[1:])
+        out = two_level_alltoall(chunks, axis_name, plan.islands)
+        return out.reshape(x.shape)
+    from jax import lax
+
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)

@@ -581,6 +581,35 @@ def fused_allgather_shards(
     return out
 
 
+def pipeline_interleave(n_segments: int, launch, consume):
+    """Software-pipeline ``n_segments`` launch→consume pairs so segment
+    ``i+1``'s launch is emitted BEFORE segment ``i``'s consume.
+
+    The overlap scheduler's trick, factored out for reuse: inside a
+    trace, program order is dataflow order, so emitting
+    ``launch(1); consume(0); launch(2); consume(1); ...`` gives XLA's
+    latency-hiding scheduler an independent collective to run under
+    every compute segment (the expert-parallel MoE wire overlaps its
+    dispatch alltoalls with expert FFN compute this way —
+    ``parallel/moe.py``; jaxpr-asserted in tests/test_moe_parallel.py).
+    ``launch(i)`` starts segment ``i``'s transfer, ``consume(i,
+    launched_i)`` turns it into the segment result; returns the list of
+    consume results in segment order. Reverse-mode AD transposes both
+    and reverses program order, so the backward jaxpr interleaves the
+    transposed collectives with the transposed compute for free.
+    """
+    k = int(n_segments)
+    if k <= 0:
+        return []
+    launched = [launch(0)]
+    results = []
+    for i in range(1, k):
+        launched.append(launch(i))
+        results.append(consume(i - 1, launched[i - 1]))
+    results.append(consume(k - 1, launched[k - 1]))
+    return results
+
+
 def pad_to_multiple(x, multiple: int, axis: int = 0):
     """Zero-pad `x` along `axis` to a multiple of `multiple`.
 

@@ -262,6 +262,59 @@ def int8_two_level_allreduce_flat(flat, axis_name: str, islands,
     return out
 
 
+def int8_alltoall_rows(rows, axis_name: str, salt=None, groups=None,
+                       extra=None, a2a=None):
+    """Quantized alltoall of per-destination rows — the EQuARX exchange
+    extended from the allreduce/RS-AG halves to the MoE dispatch/combine
+    wire (``parallel/moe.py``, ``HOROVOD_MOE_COMPRESSION=int8``).
+
+    ``rows`` is ``(n, R)`` f32: row ``d`` is the payload this rank
+    addresses to group-member ``d``. Each row is blockwise-quantized
+    (per-block f32 scale, stochastic rounding salted by the
+    caller-threaded step counter — the :func:`_sround` contract), the
+    int8 payload and one f32 side channel ride two all_to_alls, and the
+    received rows dequantize locally. No summation ever happens on or
+    after the wire, so unlike the allreduce there is no overflow hazard
+    — int8 here is purely a 4×→1× payload compression, and the
+    round-trip error is bounded by each source block's own scale.
+
+    ``extra`` — optional ``(n, k)`` f32 carried EXACTLY (concatenated
+    onto the scale rows' side channel): the MoE dispatch uses it for the
+    slot-occupancy mask, which must never quantize (routing correctness
+    is not a tolerance question). ``groups`` scopes both exchanges to
+    ``axis_index_groups``; ``a2a`` overrides the exchange itself (the
+    planner's :func:`~horovod_tpu.ops.comms_planner.two_level_alltoall`
+    staged form — both wires MUST ride the same schedule, so one
+    callable serves both). Non-finite input follows the
+    :func:`_quantize_blocks` tripwire contract: armed, a bad block
+    dequantizes non-finite on the RECEIVING rank, so the post-combine
+    ``isfinite`` check still fires. Returns ``(recv_rows (n, R) f32,
+    recv_extra (n, k) f32 | None)``.
+    """
+    n, R = rows.shape
+    pad = (-R) % BLOCK
+    rp = jnp.pad(rows, ((0, 0), (0, pad))) if pad else rows
+    q, scale = _quantize_blocks(rp.reshape(-1), salt)
+    rows_per_chunk = rp.shape[1] // BLOCK
+    q = q.reshape(n, rows_per_chunk, BLOCK)
+    scale = scale.reshape(n, rows_per_chunk)
+    side = (scale if extra is None
+            else jnp.concatenate([scale, extra.astype(jnp.float32)],
+                                 axis=1))
+    if a2a is None:
+        def a2a(x):
+            return lax.all_to_all(x, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=True,
+                                  axis_index_groups=groups)
+    recv_q = a2a(q).reshape(n, rows_per_chunk, BLOCK)
+    recv_side = a2a(side[:, :, None]).reshape(n, side.shape[1])
+    recv_scale = recv_side[:, :rows_per_chunk]
+    recv_extra = None if extra is None else recv_side[:, rows_per_chunk:]
+    out = (recv_q.astype(jnp.float32)
+           * recv_scale[:, :, None]).reshape(n, -1)[:, :R]
+    return out, recv_extra
+
+
 def int8_fused_reducescatter(
     tensors,
     axis_name: str,

@@ -202,6 +202,71 @@ def _reduce_grads(
     return jax.tree.unflatten(treedef, restored)
 
 
+def _reduce_expert_partitioned(grads, op, axis_name, compression,
+                               prescale_factor, postscale_factor,
+                               threshold_bytes, num_groups, ps,
+                               expert_set, expert_filter, quant_salt=None):
+    """Expert-set-aware gradient reduction (``parallel/moe.py``'s sync
+    half): leaves ``expert_filter`` names are resident on ONE rank per
+    dispatch group, so their gradients allreduce only within that
+    expert's data-parallel replica set
+    (:func:`process_sets.expert_partition`'s ``replica_groups`` — a
+    ``psum`` over ``axis_index_groups``), while every other leaf rides
+    the ordinary fused world allreduce. A world-wide allreduce of an
+    expert leaf would average each expert's gradient with the OTHER
+    experts' (zero) contributions — silently scaling it by 1/E.
+
+    ``expert_filter`` is a predicate over ``jax.tree_util.keystr``
+    leaf paths. Expert leaves always exchange f32 (their replica sets
+    are small — compression's win is on the dense world wire); the
+    dense leaves keep the full compression/bucketing machinery.
+    """
+    from jax import lax
+
+    from . import process_sets
+
+    if isinstance(axis_name, (tuple, list)):
+        raise SyncModeIneligibleError(
+            "expert_filter does not compose with the hierarchical "
+            "two-level axis tuple: the replica-set psum needs ONE named "
+            "axis whose indices the expert partition maps — unset "
+            "HOROVOD_HIERARCHICAL_ALLREDUCE or drop expert_filter")
+    n = _known_size(ps)
+    if n is None:
+        raise SyncModeIneligibleError(
+            "expert_filter needs a known process-set size at trace time "
+            "(init() first)")
+    _, replicas = process_sets.expert_partition(expert_set, n)
+    groups = [list(g) for g in replicas]
+    r = len(groups[0])
+    paths, treedef = jax.tree_util.tree_flatten_with_path(grads)
+    is_expert = [bool(expert_filter(jax.tree_util.keystr(p)))
+                 for p, _ in paths]
+    leaves = [leaf for _, leaf in paths]
+    dense = [leaf for leaf, ex in zip(leaves, is_expert) if not ex]
+    reduced_dense = iter(_reduce_grads(
+        dense, op, axis_name, compression, prescale_factor,
+        postscale_factor, threshold_bytes, num_groups, world_size=n,
+        quant_salt=quant_salt) if dense else [])
+
+    def _expert_reduce(g):
+        # Mirrors the flat wire's scale order: prescale → sum →
+        # Average divisor (the REPLICA set size, not the world) →
+        # postscale.
+        out = (g * jnp.asarray(prescale_factor, g.dtype)
+               if prescale_factor != 1.0 else g)
+        out = lax.psum(out, axis_name, axis_index_groups=groups)
+        if op == collective_ops.Average:
+            out = out / r
+        if postscale_factor != 1.0:
+            out = out * jnp.asarray(postscale_factor, out.dtype)
+        return out
+
+    merged = [(_expert_reduce(leaf) if ex else next(reduced_dense))
+              for leaf, ex in zip(leaves, is_expert)]
+    return jax.tree_util.tree_unflatten(treedef, merged)
+
+
 _VALID_SYNC_MODES = ("allreduce", "sharded", "fsdp")
 
 
@@ -468,6 +533,13 @@ class ReduceSpec(NamedTuple):
     fusion_threshold_bytes: int | None
     backward_passes_per_step: int
     sync_mode: str = "allreduce"
+    # Expert parallelism (parallel/moe.py): expert-sharded leaves
+    # (named by the ``expert_filter`` keystr predicate) allreduce only
+    # within their data-parallel replica set derived from
+    # ``expert_set`` — see _reduce_expert_partitioned. Both None →
+    # byte-identical to the pre-expert wire.
+    expert_set: Any = None
+    expert_filter: Any = None
 
 
 def reduce_spec_of(optimizer) -> ReduceSpec | None:
@@ -726,6 +798,8 @@ def DistributedOptimizer(
     num_groups: int = 0,
     fusion_threshold_bytes: int | None = None,
     sync_mode: str | None = None,
+    expert_set=None,
+    expert_filter=None,
 ):
     """Wrap an optax ``GradientTransformation`` so gradients are
     allreduce-averaged across the process set before the inner update.
@@ -748,6 +822,14 @@ def DistributedOptimizer(
       shard_map with this rank's state row (the step factories handle
       both). Needs an elementwise inner optimizer and op=Average/Sum;
       see docs/perf.md.
+
+    ``expert_set`` + ``expert_filter`` make the reduction
+    expert-parallel-aware (``parallel/moe.py``): leaves the filter
+    matches (a predicate over ``jax.tree_util.keystr`` paths) allreduce
+    only within their expert's data-parallel replica set
+    (:func:`process_sets.expert_partition`); everything else rides the
+    ordinary world wire. Requires sync_mode='allreduce',
+    backward_passes_per_step=1, op=Average/Sum.
     """
     import optax
 
@@ -800,6 +882,35 @@ def DistributedOptimizer(
                 "fusion_threshold_bytes instead (it applies uniformly to "
                 "every segment's buckets)")
 
+    if expert_filter is not None:
+        # Expert-partitioned reduction guard table (docs/perf.md
+        # "Expert parallelism") — every rejection names the fix.
+        if sync_mode != "allreduce":
+            raise SyncModeIneligibleError(
+                f"expert_filter does not compose with sync_mode="
+                f"{sync_mode!r}: the sharded/fsdp ownership maps assume "
+                "every rank holds every leaf, but an expert leaf is "
+                "resident on one rank per dispatch group — use "
+                "sync_mode='allreduce'")
+        if k != 1:
+            raise SyncModeIneligibleError(
+                "expert_filter does not compose with "
+                "backward_passes_per_step > 1: the accumulation "
+                "boundary's single fused flush cannot split per-leaf "
+                "between the world wire and the replica-set psum — "
+                "accumulate outside the optimizer or use "
+                "backward_passes_per_step=1")
+        if op not in (collective_ops.Average, collective_ops.Sum):
+            raise SyncModeIneligibleError(
+                f"expert_filter supports op=Average/Sum, got {op!r} "
+                "(Adasum's whole-vector dot products have no "
+                "replica-subset form — use op=Average)")
+    elif expert_set is not None:
+        raise ValueError(
+            "expert_set without expert_filter: pass expert_filter=<"
+            "predicate over jax.tree_util.keystr leaf paths> naming "
+            "which gradient leaves are expert-sharded")
+
     int8 = getattr(compression, "marker", None) == "int8"
 
     def reduce_fn(grads, salt=None):
@@ -809,6 +920,11 @@ def DistributedOptimizer(
         from .ops.collective_ops import _effective_traced_axis
 
         effective = _effective_traced_axis(ps) or axis_name
+        if expert_filter is not None:
+            return _reduce_expert_partitioned(
+                grads, op, effective, compression, prescale_factor,
+                postscale_factor, fusion_threshold_bytes, num_groups,
+                ps, expert_set, expert_filter, quant_salt=salt)
         return _reduce_grads(
             grads,
             op,
@@ -833,6 +949,8 @@ def DistributedOptimizer(
         fusion_threshold_bytes=fusion_threshold_bytes,
         backward_passes_per_step=k,
         sync_mode=sync_mode,
+        expert_set=expert_set,
+        expert_filter=expert_filter,
     )
 
     if sync_mode == "fsdp":

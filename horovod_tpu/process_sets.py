@@ -152,3 +152,80 @@ def remove_process_set(process_set: ProcessSet) -> bool:
 def get_process_set_ids() -> list[int]:
     with _lock:
         return sorted(_table.keys())
+
+
+def expert_partition(
+    expert_set: "ProcessSet | Sequence[int] | None",
+    world_size: int,
+) -> tuple[list[list[int]], list[list[int]]]:
+    """Partition the world from an expert set's rank pattern.
+
+    The expert-parallel MoE wire (``parallel/moe.py``) shards the E
+    experts one-per-rank across an ``expert_set`` of E ranks and runs
+    data-parallel across the ``world_size / E`` copies of that pattern.
+    This derives both partitions as static replica groups (the
+    ``axis_index_groups`` XLA compiles against):
+
+    - **dispatch groups** — ``world/E`` groups of E ranks each; the
+      dispatch/combine alltoalls run within a group, whose member at
+      position ``j`` hosts expert ``j``. Group 0 is the expert set
+      itself; the rest repeat its pattern (contiguous block → contiguous
+      blocks, strided cosets → shifted cosets).
+    - **replica groups** — the transpose: E groups of ``world/E`` ranks
+      holding the SAME expert, the set an expert's parameters (and their
+      gradients) are replicated/allreduced over
+      (``optimizer.DistributedOptimizer(expert_set=...)``).
+
+    ``expert_set=None`` means every rank is an expert: one dispatch
+    group spanning the world, singleton replica groups. Rank patterns
+    that don't tile the world (E ∤ world, non-contiguous non-strided
+    sets, unaligned blocks) raise ``ValueError`` naming the constraint —
+    membership is static per init() epoch, so this is a config error,
+    not a runtime condition.
+    """
+    world = int(world_size)
+    if expert_set is None:
+        ranks = list(range(world))
+    elif isinstance(expert_set, ProcessSet):
+        ranks = list(expert_set.ranks)
+    else:
+        ranks = sorted(int(r) for r in expert_set)
+    e = len(ranks)
+    if e == 0:
+        raise ValueError("expert set is empty")
+    if len(set(ranks)) != e:
+        raise ValueError(f"duplicate ranks in expert set: {ranks}")
+    if ranks[0] < 0 or ranks[-1] >= world:
+        raise ValueError(
+            f"expert set ranks {ranks} out of range for world size {world}")
+    if world % e != 0:
+        raise ValueError(
+            f"expert set size {e} must divide the world size {world} so the "
+            f"data-parallel replica groups tile evenly")
+    copies = world // e
+    if ranks == list(range(ranks[0], ranks[0] + e)):
+        # Contiguous block: the world tiles into `copies` contiguous
+        # blocks of E, one expert group each.
+        if ranks[0] % e != 0:
+            raise ValueError(
+                f"contiguous expert set {ranks} must start at a multiple of "
+                f"its size {e} to tile the world into aligned blocks")
+        groups = [list(range(g * e, (g + 1) * e)) for g in range(copies)]
+    elif e > 1 and ranks == list(range(ranks[0], world, copies)):
+        # Strided cosets: ranks r0, r0+s, ... with stride s = world/E;
+        # the cosets r0+1, r0+2, ... repeat the pattern.
+        if ranks[0] != 0:
+            raise ValueError(
+                f"strided expert set {ranks} must start at rank 0 so its "
+                f"cosets partition the world")
+        groups = [list(range(c, world, copies)) for c in range(copies)]
+    elif e == world:
+        groups = [list(range(world))]
+    else:
+        raise ValueError(
+            f"expert set {ranks} is neither a contiguous block nor a "
+            f"uniform-stride coset of the {world}-rank world; only those "
+            f"patterns tile into data-parallel replica groups")
+    # Transpose: position j across dispatch groups = expert j's replicas.
+    replicas = [[grp[j] for grp in groups] for j in range(e)]
+    return groups, replicas

@@ -33,6 +33,13 @@ import numpy as np
 LINK_CLASS_SEEDS: dict[str, tuple[float, float]] = {
     "ici": (2.0e-6, 1.0 / 45e9),
     "dcn": (50.0e-6, 1.0 / 2.5e9),
+    # A flat alltoall on a multi-island fabric is the one wire whose
+    # traffic is genuinely part-ICI part-DCN in a single op (every rank
+    # pair exchanges a distinct chunk, so no single slowest link carries
+    # the whole payload the way a ring hop does). Its seed row sits
+    # between the two so the planner's flat-vs-two_level crossover for
+    # ``alltoall`` has somewhere honest to price the flat candidate.
+    "mixed": (26.0e-6, 1.0 / 4.7e9),
     "self": (0.0, 0.0),
 }
 

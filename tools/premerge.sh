@@ -230,6 +230,33 @@ if not identical:
             "the flat program on the single-class fabric AND regressed "
             f"beyond the 2% slack (identical={identical!r}, "
             f"planned={up!r}, flat={uf!r})")
+moe = last.get("moe") or {}
+if not moe or moe.get("skipped"):
+    sys.exit("premerge moe lane: bench record has no 'moe' section "
+             f"(got {moe!r})")
+dp_tps, ep_tps = moe.get("dp_tokens_per_sec"), moe.get("ep_tokens_per_sec")
+if not dp_tps or not ep_tps:
+    sys.exit(
+        "premerge moe lane: tokens/sec missing from the moe record "
+        f"(dp={dp_tps!r}, ep={ep_tps!r})")
+# EP-vs-DP floor: both layers run identical routing and identical
+# per-rank FFN FLOPs; EP adds the real dispatch/combine alltoalls and
+# its payoff (1/E resident expert bytes, asserted in
+# tests/test_moe_parallel.py) is invisible to a virtual CPU mesh — so
+# EP <= DP here by construction and the floor guards a pathologically
+# slow wire (a dispatch that serializes, a quantizer in the hot path
+# when compression is off), not parity. 0.5 = the exchange may cost up
+# to as much as the whole dense step, never more.
+if ep_tps < 0.5 * dp_tps:
+    sys.exit(
+        f"premerge moe lane: expert-parallel tokens/sec regressed to "
+        f"{ep_tps / dp_tps:.1%} of the data-parallel MoE baseline "
+        f"(ep={ep_tps}, dp={dp_tps}, floor 50% — the alltoall wire "
+        f"must not cost more than the dense step it shards)")
+if moe.get("algorithm") not in ("flat", "two_level"):
+    sys.exit(
+        f"premerge moe lane: dispatch wire reports no algorithm "
+        f"(got {moe.get('algorithm')!r})")
 print(f"premerge planner lane: ok (split schedule "
       f"{planner['split_selected_algorithm']!r} "
       f"[{planner.get('split_provenance')!r}], predicted "
@@ -242,6 +269,9 @@ print(f"premerge comms lane: ok (pruned {comms['autotune_pruned']} of "
       f"{len(comms.get('autotune_grid') or [])} candidates, winner "
       f"{comms['autotune_winner_guided']!r} matches exhaustive; fit "
       f"residuals {comms.get('per_mode_rel_residual')})")
+print(f"premerge moe lane: ok (ep/dp tokens-per-sec ratio "
+      f"{ep_tps / dp_tps:.2f}, wire {moe.get('algorithm')!r}, "
+      f"int8-vs-fp32 dispatch {moe.get('dispatch_int8_vs_fp32')!r})")
 EOF
 then
     echo "premerge: perf lane failed" >&2
@@ -376,6 +406,13 @@ try:
         "hvd_overlap_hidden_ratio",
         "hvd_mfu_ratio",
         "hvd_step_regression_score",
+        # Expert-parallel MoE plane: zero-materialized at import so the
+        # scrape always carries them (0 routed bytes = no MoE step ran,
+        # absence = not measuring).
+        "hvd_moe_dispatch_bytes",
+        "hvd_moe_tokens_dropped_total",
+        "hvd_moe_expert_load",
+        "hvd_alltoall_latency_seconds",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
