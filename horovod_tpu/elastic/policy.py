@@ -621,3 +621,249 @@ class PolicyController:
         decision.predicted = dict(decision.predicted)
         decision.predicted["realized"] = realized
         return decision
+
+
+# ---------------------------------------------------------------------------
+# Cross-job arbitration (the multi-tenant scheduler's brain)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ArbiterDecision:
+    """One cross-job capacity transfer: who yields, who heals, and what
+    the capacity model predicts for both."""
+
+    action: str            # "shrink" | "preempt"
+    victim: str            # job yielding capacity
+    recipient: str         # job the freed capacity heals
+    reason: str
+    predicted: dict        # per-job predicted goodput before/after
+    t_decided: float = 0.0
+
+
+class JobArbiter:
+    """Cross-job arbitration for the multi-tenant pod scheduler
+    (``runner/elastic/scheduler.py``): when the shared pool holds no
+    spare that can heal the job furthest under its goodput SLO, decide
+    which OTHER job yields capacity — a one-host **shrink** (the victim
+    stays at or above its own ``min_np``, drained through the existing
+    final-commit contract) or a full **preempt** (the victim job drains
+    entirely and re-queues), in priority order.
+
+    Like :class:`PolicyController`, this is pure deliberation: the
+    scheduler owns the actuators (preempt-notice PUTs, lease rewrites,
+    driver SIGTERM) and reports back via :meth:`record_action`.
+
+    Goodput here is **capacity goodput**: ``granted_np / max_np`` — the
+    deterministic share of the parallelism a job asked for that it
+    actually holds. A job is *under its SLO* when it holds fewer than
+    ``min_np`` hosts (the gang floor — ranked above any ratio miss) or
+    its capacity goodput is below its ``HOROVOD_TARGET_GOODPUT``; a job
+    with no target is satisfied at ``min_np``.
+
+    Thrash control (two starving jobs must not trade hosts forever):
+
+    - **hysteresis** — the recipient must have been under its SLO
+      CONTINUOUSLY for ``HOROVOD_SCHED_HYSTERESIS`` seconds;
+    - **cooldown** — at most one arbitration action per
+      ``HOROVOD_SCHED_COOLDOWN`` seconds;
+    - **transfer pins** — a job that just RECEIVED capacity cannot be a
+      victim for ``HOROVOD_SCHED_PIN_COOLDOWN`` seconds;
+    - **priority monotonicity** — a job that is itself under SLO only
+      yields to a strictly HIGHER-priority recipient, so after the
+      low-priority job shrinks, its own starvation cannot claw the host
+      back from the high-priority job it just healed.
+    """
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.hysteresis_s = get_float("HOROVOD_SCHED_HYSTERESIS", 10.0)
+        self.cooldown_s = get_float("HOROVOD_SCHED_COOLDOWN", 30.0)
+        self.pin_cooldown_s = get_float(
+            "HOROVOD_SCHED_PIN_COOLDOWN", self.cooldown_s)
+        self._lock = threading.Lock()
+        self._jobs: dict[str, dict] = {}
+        self._under_since: dict[str, float] = {}
+        self._pinned_at: dict[str, float] = {}
+        self._last_action_t: float | None = None
+
+    # -- sensor intake -------------------------------------------------------
+
+    def note_job(self, job: str, granted_np: int, min_np: int,
+                 max_np: int, priority: int = 0,
+                 target: float | None = None) -> None:
+        """Fold one observation of a job's granted capacity (the
+        scheduler calls this for every running job on every tick)."""
+        now = self._clock()
+        rec = {
+            "granted": int(granted_np),
+            "min_np": max(int(min_np), 1),
+            "max_np": max(int(max_np), 1),
+            "priority": int(priority),
+            "target": target,
+        }
+        with self._lock:
+            self._jobs[job] = rec
+            if self._deficit(rec) > 0:
+                self._under_since.setdefault(job, now)
+            else:
+                self._under_since.pop(job, None)
+
+    def forget_job(self, job: str) -> None:
+        """The job finished or was preempted off the pool: drop its
+        state (a re-granted job starts a fresh hysteresis clock)."""
+        with self._lock:
+            self._jobs.pop(job, None)
+            self._under_since.pop(job, None)
+            self._pinned_at.pop(job, None)
+
+    @staticmethod
+    def goodput_of(granted_np: int, max_np: int) -> float:
+        """Capacity goodput: the share of its requested parallelism a
+        job actually holds."""
+        return granted_np / max(max_np, 1)
+
+    @staticmethod
+    def _deficit(rec: Mapping[str, Any]) -> float:
+        """How far under its SLO a job is (0 = satisfied). A job below
+        its gang floor (``min_np``) ranks above ANY ratio miss: the
+        floor is the admission contract, the target an aspiration."""
+        granted = rec["granted"]
+        if granted < rec["min_np"]:
+            return 1.0 + (rec["min_np"] - granted) / max(rec["min_np"], 1)
+        target = rec.get("target")
+        if target is None:
+            return 0.0
+        return max(target - JobArbiter.goodput_of(granted,
+                                                  rec["max_np"]), 0.0)
+
+    def job_state(self, job: str) -> dict | None:
+        """The arbiter's live view of one job (for ``GET /pool``):
+        goodput, SLO target, deficit, sustained-under age."""
+        now = self._clock()
+        with self._lock:
+            rec = self._jobs.get(job)
+            if rec is None:
+                return None
+            under_t = self._under_since.get(job)
+            return {
+                "granted_np": rec["granted"],
+                "min_np": rec["min_np"],
+                "max_np": rec["max_np"],
+                "priority": rec["priority"],
+                "target_goodput": rec["target"],
+                "goodput": round(self.goodput_of(rec["granted"],
+                                                 rec["max_np"]), 6),
+                "deficit": round(self._deficit(rec), 6),
+                "under_slo_s": (round(now - under_t, 3)
+                                if under_t is not None else 0.0),
+            }
+
+    # -- deliberation --------------------------------------------------------
+
+    def decide(self, spares_available: int) -> ArbiterDecision | None:
+        """One arbitration pass: if the pool cannot heal the job
+        furthest under its SLO, pick the victim that yields a host.
+        Returns None (hold) otherwise. Fires the ``sched.decide`` fault
+        point."""
+        if faults.fire(faults.SCHED_DECIDE):
+            return None  # injected drop: this pass never happened
+        now = self._clock()
+        with self._lock:
+            if (self._last_action_t is not None
+                    and now - self._last_action_t < self.cooldown_s):
+                return None
+            jobs = {j: dict(r) for j, r in self._jobs.items()}
+            under_since = dict(self._under_since)
+            pinned_at = dict(self._pinned_at)
+        starving = sorted(
+            ((self._deficit(r), r["priority"], j)
+             for j, r in jobs.items() if self._deficit(r) > 0),
+            key=lambda t: (-t[0], -t[1], t[2]))
+        if not starving:
+            return None
+        if spares_available > 0:
+            return None  # the pool can heal: promotion, not arbitration
+        deficit, _prio, recipient = starving[0]
+        rrec = jobs[recipient]
+        if rrec["granted"] >= rrec["max_np"]:
+            return None  # already at full ask: nothing a host would fix
+        under_t = under_since.get(recipient)
+        if under_t is None or now - under_t < self.hysteresis_s:
+            return None  # hysteresis: starvation must be sustained
+        # Victim candidates, in priority order (lowest priority first,
+        # then furthest OVER its SLO). Hosts only ever flow UP the
+        # priority gradient: a victim must sit at strictly lower
+        # priority than the recipient, whether it is over or under its
+        # own SLO. Priorities order jobs into a DAG, so no transfer
+        # cycle can exist — the no-thrash guarantee is structural, not
+        # a property of the timers. (Equal-priority starvation is the
+        # pool's problem: spares and cooldown expiry heal it; the
+        # arbiter never trades hosts between peers.) A freshly-healed
+        # recipient is additionally pinned against being re-victimized
+        # by a still-higher-priority job for one pin window.
+        candidates = []
+        for j, rec in jobs.items():
+            if j == recipient:
+                continue
+            pin_t = pinned_at.get(j)
+            if (pin_t is not None
+                    and now - pin_t < self.pin_cooldown_s):
+                continue
+            if rec["priority"] >= rrec["priority"]:
+                continue
+            surplus = self.goodput_of(rec["granted"], rec["max_np"]) - (
+                rec["target"] if rec["target"] is not None else 0.0)
+            candidates.append((rec["priority"], -surplus, j, rec))
+        for _prio, _nsurplus, victim, vrec in sorted(
+                candidates, key=lambda t: (t[0], t[1], t[2])):
+            before_v = self.goodput_of(vrec["granted"], vrec["max_np"])
+            before_r = self.goodput_of(rrec["granted"], rrec["max_np"])
+            predicted = {
+                "recipient": {
+                    "job": recipient,
+                    "goodput_before": round(before_r, 6),
+                    "goodput_after": round(self.goodput_of(
+                        rrec["granted"] + 1, rrec["max_np"]), 6),
+                    "target_goodput": rrec["target"],
+                    "deficit": round(deficit, 6),
+                },
+                "victim": {
+                    "job": victim,
+                    "goodput_before": round(before_v, 6),
+                    "target_goodput": vrec["target"],
+                },
+                "spares_available": spares_available,
+            }
+            if vrec["granted"] - 1 >= vrec["min_np"]:
+                predicted["victim"]["goodput_after"] = round(
+                    self.goodput_of(vrec["granted"] - 1,
+                                    vrec["max_np"]), 6)
+                return ArbiterDecision(
+                    action="shrink", victim=victim, recipient=recipient,
+                    reason=(f"job {recipient!r} under SLO (deficit "
+                            f"{deficit:.3f}) with no pool spare; "
+                            f"{victim!r} yields one host and stays >= "
+                            f"min_np={vrec['min_np']}"),
+                    predicted=predicted, t_decided=now)
+            if vrec["priority"] < rrec["priority"]:
+                predicted["victim"]["goodput_after"] = 0.0
+                return ArbiterDecision(
+                    action="preempt", victim=victim, recipient=recipient,
+                    reason=(f"job {recipient!r} under SLO (deficit "
+                            f"{deficit:.3f}) with no pool spare; "
+                            f"{victim!r} (priority {vrec['priority']} < "
+                            f"{rrec['priority']}) cannot shrink below "
+                            f"min_np={vrec['min_np']} — full preemption"),
+                    predicted=predicted, t_decided=now)
+        return None
+
+    # -- actuation feedback --------------------------------------------------
+
+    def record_action(self, decision: ArbiterDecision) -> None:
+        """The scheduler executed ``decision``: start the cooldown and
+        pin the recipient against becoming a victim (anti-thrash)."""
+        now = self._clock()
+        with self._lock:
+            self._last_action_t = now
+            self._pinned_at[decision.recipient] = now

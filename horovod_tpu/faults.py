@@ -53,6 +53,16 @@ kill workers by behavior flag). This module generalizes that into named
   token batch feeding the alltoall — quantized or not, the damage
   crosses ranks, which is what the non-finite tripwire and integrity
   voting planes must catch
+- ``sched.decide``       — every cross-job arbitration pass of the
+  multi-tenant scheduler (``elastic/policy.py`` ``JobArbiter``; ``drop``
+  skips the pass, ``raise`` proves a broken arbiter cannot take the
+  scheduler down, ``delay`` defers decisions)
+- ``job.preempt``        — every full-job preemption the scheduler
+  actuates (SIGTERM-drain of the victim job's driver through its final
+  commits)
+- ``pool.assign``        — every pool-to-job host assignment
+  (grant/promote out of the shared pool; ``raise`` holds the host back
+  for a later tick)
 
 The canonical **control-plane injectors** are these three plus
 :func:`kill_driver` (SIGKILL the driver process — the KV server dies
@@ -143,6 +153,18 @@ PEER_CORRUPT = "peer.corrupt"
 # (passthrough step), delay stalls it, corrupt flips bits in the token
 # batch feeding the wire.
 MOE_DISPATCH = "moe.dispatch"
+# Multi-tenant scheduler plane (runner/elastic/scheduler.py): the
+# cross-job arbitration loop, one job's full preemption, and every
+# pool-to-job host assignment — scheduler-level chaos scriptable like
+# every other plane. sched.decide ``drop`` skips an arbitration pass
+# (``raise`` proves a broken arbiter cannot take the scheduler down,
+# ``delay`` defers decisions past the hysteresis window); job.preempt
+# fires on each full-job preemption actuation; pool.assign on each host
+# grant/promote out of the shared pool (``raise`` forces the scheduler
+# to hold the host back and retry the assignment on a later tick).
+SCHED_DECIDE = "sched.decide"
+JOB_PREEMPT = "job.preempt"
+POOL_ASSIGN = "pool.assign"
 
 _MODES = ("drop", "delay", "raise", "hang", "corrupt")
 _DEFAULT_HANG_S = 3600.0

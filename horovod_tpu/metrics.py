@@ -1123,6 +1123,13 @@ class EventJournal:
             "event": name,
             "generation": (default_generation()
                            if generation is None else int(generation)),
+            # Multi-tenant pod: every record is stamped with the job id
+            # from the env contract (HOROVOD_JOB_ID, set per job process
+            # tree by the gang scheduler) — null outside a scheduled job
+            # — so one merged event log from a shared pool replays in
+            # causal order per job. Re-read per record like the
+            # generation, never cached.
+            "job": default_job(),
             "t_wall": time.time(),
             "t_mono": time.monotonic(),
         }
@@ -1154,6 +1161,13 @@ def default_generation() -> int:
         return int(os.environ.get("HOROVOD_WORLD_VERSION", "0") or 0)
     except ValueError:
         return 0
+
+
+def default_job() -> str | None:
+    """The scheduling key this process belongs to (``HOROVOD_JOB_ID``,
+    set per job process tree by the multi-tenant scheduler), or None
+    outside a scheduled job — the journal's ``job`` field."""
+    return os.environ.get("HOROVOD_JOB_ID") or None
 
 
 _journal: EventJournal | None = None

@@ -607,6 +607,15 @@ class ElasticDriver:
 
     def run(self) -> int:
         takeover = self._prepare_takeover()
+        job = os.environ.get("HOROVOD_JOB_ID")
+        if job:
+            # Multi-tenant pod: this driver serves ONE job of a shared
+            # pool (the gang scheduler launched it with a per-job
+            # discovery lease, state dir, and journal); every journal
+            # record it emits is stamped job=<id> by the env contract.
+            self._log.warning(
+                "elastic: driver serving job %r of a multi-tenant pool",
+                job)
         _metrics.event("driver_start",
                        generation=self._server.generation,
                        min_np=self._min_np, max_np=self._max_np,

@@ -213,13 +213,21 @@ class DriverStateStore:
     def publish_endpoint(self, addr: str, port: int,
                          generation: int) -> None:
         """Refresh the shared-storage discovery record orphaned workers
-        re-resolve the rendezvous endpoint from (same epoch fence)."""
-        self._fenced_install(self.endpoint_path, {
+        re-resolve the rendezvous endpoint from (same epoch fence). On a
+        multi-tenant pod the record additionally carries the job id
+        (``HOROVOD_JOB_ID``) — the scheduler resolves each job driver's
+        live KV endpoint from exactly this record; absent outside a
+        scheduled job so the single-job record stays byte-identical."""
+        record = {
             "addr": addr,
             "port": int(port),
             "driver_epoch": self.epoch,
             "generation": int(generation),
-        })
+        }
+        job = os.environ.get("HOROVOD_JOB_ID")
+        if job:
+            record["job"] = job
+        self._fenced_install(self.endpoint_path, record)
 
     # -- takeover loads -------------------------------------------------------
 

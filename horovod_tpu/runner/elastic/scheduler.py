@@ -1,0 +1,976 @@
+"""Multi-tenant pod: gang-scheduling, preemption-arbitrating scheduler.
+
+One scheduler process owns a shared host pool and gang-schedules N jobs
+(:class:`JobSpec` — ``min_np``/``max_np``/``priority``/per-job
+``HOROVOD_TARGET_GOODPUT``) onto **disjoint** host sets, running the
+existing elastic driver once per job as a subprocess. Nothing about the
+single-job stack changes: each job keeps its own rendezvous KV server,
+HMAC secret, driver-state dir (epoch fence), and lifecycle journal —
+the scheduler composes whole drivers, it does not reach inside them.
+
+Actuation is the discovery contract the driver already honors: each
+job's ``--host-discovery-script`` reads a scheduler-owned **lease file**
+(``<job>/hosts.txt``), so growing/shrinking/healing a job is a lease
+rewrite the driver's 1 s discovery poll picks up and turns into a
+generation fence. Shrinks additionally ride the preemption-notice scope
+(``PUT /preempt/<host>`` on the job's KV) so the departing host drains
+through the worker's final-commit path before the lease changes — the
+same two-fence drain→reassign sequence a human operator would run.
+
+The pool tier generalizes the driver's per-job ``HostManager``:
+
+- **blacklist cooldowns are pool-wide** — a host condemned by job A's
+  driver (its ``blacklist`` journal event) carries that evidence into
+  the pool record and is never handed to job B inside the cooldown;
+- **spares are pool-wide** — a surplus host from a shrunk/finished job
+  re-enters the pool as a spare ANY job can promote at its next fence.
+
+Cross-job arbitration lives in :class:`~horovod_tpu.elastic.policy.
+JobArbiter` (same deliberate-only contract as ``PolicyController``):
+when no pool spare can heal the job furthest under its goodput SLO, the
+arbiter picks a victim — a one-host **shrink** (victim stays >= its
+``min_np``) or a full **preempt** (victim drains entirely via SIGTERM
+through final commits and re-queues), in priority order, guarded by
+hysteresis/cooldown/pins so two starving jobs never trade hosts.
+
+Every executed action journals **exactly one** ``sched_decision`` event
+with the predicted AND realized goodput (realized is measured when the
+recipient's republished world actually contains the capacity — the
+``policy_decision`` finalize pattern). Observability: ``GET /metrics``
+(``hvd_pool_*``, ``hvd_jobs_*``, ``hvd_sched_decisions_total`` —
+zero-materialized — plus per-job gauges) and ``GET /pool`` (pool
+membership + per-job world/goodput/SLO state). SIGTERM on the scheduler
+drains every job through final commits.
+
+Inert by construction: nothing imports this module on the single-job
+path, and ``HOROVOD_JOB_ID`` (the env key stamped into each job's
+process tree) is never set outside it.
+
+Stdlib-only and jax-free: the scheduler runs on the pod controller
+before any framework init, like the driver it launches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from typing import Any, Iterable
+from urllib.request import Request, urlopen
+
+from ... import faults
+from ... import metrics as _metrics
+from ...elastic.policy import ArbiterDecision, JobArbiter
+from ...utils.env import get_float
+from ...utils.logging import get_logger
+from ..http.kv_server import AUTH_HEADER, PREEMPT_SCOPE, _auth_payload
+from .. import secret as _secret
+from . import driver_state
+
+#: The env key that stamps a process tree with its scheduling key. Set by
+#: the scheduler on every job driver (workers inherit it through the
+#: driver's env block); NEVER set on the single-job path — every
+#: multi-tenant branch in the stack gates on it.
+ENV_JOB_ID = "HOROVOD_JOB_ID"
+
+#: The `action` vocabulary of hvd_sched_decisions_total (and the
+#: sched_decision journal event) — zero-materialized on every scrape.
+SCHED_ACTIONS = ("grant", "shrink", "preempt", "promote")
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """One gang-scheduled job: the elastic window, the arbitration key,
+    and the per-job SLO the scheduler heals toward."""
+
+    job_id: str
+    command: list[str]                  # the worker command line
+    min_np: int                         # gang floor (whole hosts)
+    max_np: int                         # elastic ceiling
+    priority: int = 0                   # higher wins arbitration
+    target_goodput: float | None = None  # per-job HOROVOD_TARGET_GOODPUT
+    env: dict = dataclasses.field(default_factory=dict)
+    cpu_mode: bool = True
+    elastic_timeout: float = 600.0
+
+    def __post_init__(self):
+        if not self.job_id or "/" in self.job_id:
+            raise ValueError(f"bad job_id {self.job_id!r}")
+        if self.min_np < 1 or self.max_np < self.min_np:
+            raise ValueError(
+                f"job {self.job_id}: need 1 <= min_np <= max_np, got "
+                f"{self.min_np}/{self.max_np}")
+
+
+class HostPool:
+    """The pool tier: every host the scheduler owns, with pool-wide
+    condemnation evidence and cooldowns (generalizing the per-job
+    ``HostManager`` blacklist) and pool-wide spares.
+
+    A condemned record — ``{t, job, reason}`` — is the evidence a job's
+    driver produced when it blacklisted the host; it rides the pool
+    record so the host is never handed to ANOTHER job inside the
+    cooldown (``HOROVOD_SCHED_BLACKLIST_COOLDOWN``, defaulting to the
+    driver's ``HOROVOD_BLACKLIST_COOLDOWN``, 600 s; 0 = permanent).
+    Expired condemnations re-enter the host as a pool spare, mirroring
+    the driver's cooldown-return path.
+    """
+
+    def __init__(self, hosts: Iterable[str], slots: int = 1,
+                 clock=time.monotonic):
+        self._clock = clock
+        self.cooldown_s = get_float(
+            "HOROVOD_SCHED_BLACKLIST_COOLDOWN",
+            get_float("HOROVOD_BLACKLIST_COOLDOWN", 600.0))
+        self._lock = threading.Lock()
+        self._hosts: dict[str, dict] = {}
+        for h in hosts:
+            name, _, s = str(h).partition(":")
+            self._hosts[name] = {
+                "slots": int(s) if s else slots,
+                "job": None,
+                "condemned": None,
+            }
+
+    # -- condemnation (pool-wide blacklist) ---------------------------------
+
+    def condemn(self, host: str, job: str | None, reason: str) -> None:
+        """Record a job driver's blacklist evidence pool-wide: the host
+        leaves its job and cannot be assigned to ANY job inside the
+        cooldown."""
+        with self._lock:
+            rec = self._hosts.get(host)
+            if rec is None:
+                return
+            rec["job"] = None
+            rec["condemned"] = {
+                "t": self._clock(), "job": job, "reason": reason}
+
+    def prune(self) -> list[str]:
+        """Expire condemnations past the cooldown; returns the hosts
+        that just re-entered the pool as spares (for journaling)."""
+        if self.cooldown_s <= 0:
+            return []
+        now = self._clock()
+        returned = []
+        with self._lock:
+            for name, rec in self._hosts.items():
+                c = rec["condemned"]
+                if c is not None and now - c["t"] >= self.cooldown_s:
+                    rec["condemned"] = None
+                    returned.append(name)
+        return returned
+
+    def condemned_record(self, host: str) -> dict | None:
+        with self._lock:
+            rec = self._hosts.get(host)
+            c = rec and rec["condemned"]
+            return dict(c) if c else None
+
+    # -- assignment ----------------------------------------------------------
+
+    def assign(self, host: str, job: str) -> bool:
+        """Hand a free, un-condemned host to a job. Fires the
+        ``pool.assign`` fault point — a drop returns False (the caller
+        holds the host back for a later tick); ``raise`` propagates
+        :class:`~horovod_tpu.faults.InjectedFault` for the caller's
+        containment to prove the scheduler survives it."""
+        if faults.fire(faults.POOL_ASSIGN):
+            return False
+        with self._lock:
+            rec = self._hosts.get(host)
+            if rec is None or rec["job"] is not None or rec["condemned"]:
+                return False
+            rec["job"] = job
+            return True
+
+    def release(self, host: str) -> None:
+        """The host leaves its job WITHOUT evidence against it (shrink
+        surplus, job exit): it re-enters immediately as a pool spare any
+        job can promote."""
+        with self._lock:
+            rec = self._hosts.get(host)
+            if rec is not None:
+                rec["job"] = None
+
+    # -- views ---------------------------------------------------------------
+
+    def spares(self) -> list[str]:
+        """Free, un-condemned hosts, stable order."""
+        with self._lock:
+            return [n for n, r in self._hosts.items()
+                    if r["job"] is None and r["condemned"] is None]
+
+    def assigned_to(self, job: str) -> list[str]:
+        with self._lock:
+            return [n for n, r in self._hosts.items() if r["job"] == job]
+
+    def slots_of(self, host: str) -> int:
+        with self._lock:
+            rec = self._hosts.get(host)
+            return rec["slots"] if rec else 1
+
+    def counts(self) -> dict:
+        with self._lock:
+            hosts = len(self._hosts)
+            blacklisted = sum(
+                1 for r in self._hosts.values() if r["condemned"])
+            spares = sum(1 for r in self._hosts.values()
+                         if r["job"] is None and not r["condemned"])
+        return {"hosts": hosts, "spares": spares,
+                "blacklisted": blacklisted}
+
+    def export(self) -> list[dict]:
+        """Per-host membership for ``GET /pool`` (condemnation ages are
+        relative, like the driver's blacklist export, so the view is
+        meaningful across restarts)."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            for name, rec in sorted(self._hosts.items()):
+                c = rec["condemned"]
+                out.append({
+                    "host": name,
+                    "slots": rec["slots"],
+                    "job": rec["job"],
+                    "condemned": ({
+                        "age_s": round(now - c["t"], 3),
+                        "job": c["job"],
+                        "reason": c["reason"],
+                    } if c else None),
+                })
+        return out
+
+
+class _JobHandle:
+    """Scheduler-internal state for one job: the lease, the driver
+    subprocess, and the journal-tail cursor."""
+
+    def __init__(self, spec: JobSpec, root: str, index: int):
+        self.spec = spec
+        self.index = index
+        self.state = "pending"   # pending|running|preempting|done|failed
+        self.dir = os.path.join(root, spec.job_id)
+        os.makedirs(self.dir, exist_ok=True)
+        self.lease_path = os.path.join(self.dir, "hosts.txt")
+        self.script_path = os.path.join(self.dir, "discover.sh")
+        self.state_dir = os.path.join(self.dir, "state")
+        self.journal_path = os.path.join(self.dir, "events.jsonl")
+        self.log_path = os.path.join(self.dir, "driver.log")
+        self.secret = _secret.make_secret_key()
+        self.lease: list[str] = []
+        self.proc: subprocess.Popen | None = None
+        self.log_fh = None
+        self.journal_offset = 0
+        self.world: dict | None = None   # latest world_published facts
+        self.rc: int | None = None
+        self.not_before = 0.0            # requeue backoff (monotonic)
+        with open(self.script_path, "w", encoding="utf-8") as f:
+            f.write(f"#!/bin/sh\ncat {self.lease_path}\n")
+        os.chmod(self.script_path, 0o755)
+
+    @property
+    def job_id(self) -> str:
+        return self.spec.job_id
+
+    def granted_np(self) -> int:
+        return len(self.lease)
+
+    def goodput(self) -> float:
+        return JobArbiter.goodput_of(len(self.lease), self.spec.max_np)
+
+
+class MultiJobScheduler:
+    """The gang scheduler: owns the pool, runs one elastic driver per
+    job, heals with pool spares, arbitrates with :class:`JobArbiter`,
+    and serves ``GET /metrics`` + ``GET /pool``."""
+
+    def __init__(self, jobs: Iterable[JobSpec], hosts: Iterable[str],
+                 workdir: str, tick: float | None = None,
+                 clock=time.monotonic, http_port: int | None = None):
+        self._clock = clock
+        self._log = get_logger()
+        self._tick_s = (get_float("HOROVOD_SCHED_TICK", 1.0)
+                        if tick is None else tick)
+        self._realize_timeout = get_float(
+            "HOROVOD_SCHED_REALIZE_TIMEOUT", 120.0)
+        self._requeue_backoff = get_float(
+            "HOROVOD_SCHED_REQUEUE_BACKOFF", 5.0)
+        self._root = workdir
+        os.makedirs(workdir, exist_ok=True)
+        self._pool = HostPool(hosts)
+        self._arbiter = JobArbiter(clock=clock)
+        self._lock = threading.RLock()
+        self._jobs: dict[str, _JobHandle] = {}
+        for i, spec in enumerate(jobs):
+            if spec.job_id in self._jobs:
+                raise ValueError(f"duplicate job_id {spec.job_id!r}")
+            self._jobs[spec.job_id] = _JobHandle(spec, workdir, i)
+        self._pending: list[dict] = []   # in-flight actions to realize
+        self._decisions = {a: 0 for a in SCHED_ACTIONS}
+        self._preempted_total = 0
+        self._stop = False
+        self._drain_signaled = False
+        self._httpd: HTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self._http_port = http_port
+        self.port: int | None = None
+
+    # -- HTTP observability --------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """The scheduler's own Prometheus scrape: pool/job gauges and the
+        decision counter, all zero-materialized so dashboards can tell
+        'no decisions yet' from 'not measuring'."""
+        with self._lock:
+            counts = self._pool.counts()
+            running = [h for h in self._jobs.values()
+                       if h.state in ("running", "preempting")]
+            decisions = dict(self._decisions)
+            preempted = self._preempted_total
+            job_np = [({"job": h.job_id}, h.granted_np()) for h in running]
+            job_gp = [({"job": h.job_id}, h.goodput()) for h in running]
+        fams = [
+            _metrics.make_family(
+                "hvd_pool_hosts", "gauge",
+                "Hosts owned by the multi-tenant pool scheduler.",
+                [({}, counts["hosts"])]),
+            _metrics.make_family(
+                "hvd_pool_spares", "gauge",
+                "Pool hosts currently free and assignable to any job.",
+                [({}, counts["spares"])]),
+            _metrics.make_family(
+                "hvd_pool_blacklisted", "gauge",
+                "Pool hosts inside a pool-wide condemnation cooldown "
+                "(evidence carried from the condemning job's driver).",
+                [({}, counts["blacklisted"])]),
+            _metrics.make_family(
+                "hvd_jobs_running", "gauge",
+                "Jobs currently holding a lease on the pool.",
+                [({}, len(running))]),
+            _metrics.make_family(
+                "hvd_jobs_preempted_total", "counter",
+                "Full-job preemptions executed by the scheduler "
+                "(victim drained through final commits and re-queued).",
+                [({}, preempted)]),
+            _metrics.make_family(
+                "hvd_sched_decisions_total", "counter",
+                "Scheduler decisions executed, by action "
+                "(grant|shrink|preempt|promote).",
+                [({"action": a}, decisions[a]) for a in SCHED_ACTIONS]),
+            _metrics.make_family(
+                "hvd_job_np", "gauge",
+                "Hosts currently leased to each running job (the job "
+                "dimension of the pool).", job_np),
+            _metrics.make_family(
+                "hvd_job_goodput_ratio", "gauge",
+                "Capacity goodput of each running job: leased hosts / "
+                "max_np — what the arbiter compares to the job's "
+                "HOROVOD_TARGET_GOODPUT.", job_gp),
+        ]
+        return _metrics.render_families([({}, fams)])
+
+    def pool_state(self) -> dict:
+        """The ``GET /pool`` body: pool membership plus per-job
+        world/goodput/SLO state."""
+        with self._lock:
+            jobs = {}
+            for h in self._jobs.values():
+                arb = self._arbiter.job_state(h.job_id)
+                jobs[h.job_id] = {
+                    "state": h.state,
+                    "priority": h.spec.priority,
+                    "min_np": h.spec.min_np,
+                    "max_np": h.spec.max_np,
+                    "target_goodput": h.spec.target_goodput,
+                    "lease": list(h.lease),
+                    "goodput": round(h.goodput(), 6),
+                    "world": dict(h.world) if h.world else None,
+                    "rc": h.rc,
+                    "arbiter": arb,
+                }
+            return {
+                "hosts": self._pool.export(),
+                "spares": self._pool.spares(),
+                "jobs": jobs,
+                "decisions": dict(self._decisions),
+                "preempted_total": self._preempted_total,
+            }
+
+    def _start_http(self) -> None:
+        sched = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # noqa: D102 — quiet server
+                pass
+
+            def _send(self, code, body, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server contract
+                if self.path == "/metrics":
+                    self._send(200, sched.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
+                elif self.path == "/pool":
+                    self._send(200, json.dumps(
+                        sched.pool_state()).encode(), "application/json")
+                else:
+                    self._send(404, b"not found", "text/plain")
+
+        class Server(socketserver.ThreadingMixIn, HTTPServer):
+            daemon_threads = True
+
+        self._httpd = Server(("0.0.0.0", self._http_port or 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="sched-http",
+            daemon=True)
+        self._http_thread.start()
+
+    # -- lease + driver actuation -------------------------------------------
+
+    def _write_lease(self, job: _JobHandle) -> None:
+        tmp = job.lease_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            for h in job.lease:
+                f.write(f"{h}:{self._pool.slots_of(h)}\n")
+        os.replace(tmp, job.lease_path)
+
+    def _launch_driver(self, job: _JobHandle) -> None:
+        spec = job.spec
+        env = dict(os.environ)
+        env.update(spec.env)
+        env.update({
+            ENV_JOB_ID: spec.job_id,
+            "HOROVOD_SECRET_KEY": job.secret,
+            driver_state.ENV_STATE_DIR: job.state_dir,
+            "HOROVOD_EVENT_LOG": job.journal_path,
+        })
+        if spec.target_goodput is not None:
+            env["HOROVOD_TARGET_GOODPUT"] = str(spec.target_goodput)
+        else:
+            env.pop("HOROVOD_TARGET_GOODPUT", None)
+        # The driver must resolve horovod_tpu the way THIS process did
+        # (checkout runs aren't pip-installed): prepend our import root.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        pp = env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            env["PYTHONPATH"] = (f"{pkg_root}{os.pathsep}{pp}" if pp
+                                 else pkg_root)
+        cmd = [sys.executable, "-m", "horovod_tpu.runner",
+               "--host-discovery-script", job.script_path,
+               "--min-np", str(spec.min_np),
+               "--max-np", str(spec.max_np),
+               "--elastic-timeout", str(spec.elastic_timeout)]
+        if spec.cpu_mode:
+            cmd.append("--cpu-mode")
+        cmd += list(spec.command)
+        job.log_fh = open(job.log_path, "ab")
+        # Its own session: pod-level signals reach job drivers only
+        # through the scheduler's drain path, never as a group side
+        # effect — each driver owns SIGTERM semantics for its workers.
+        job.proc = subprocess.Popen(
+            cmd, env=env, stdout=job.log_fh,
+            stderr=subprocess.STDOUT, start_new_session=True)
+        job.state = "running"
+        job.world = None
+        job.rc = None
+        _metrics.event("sched_job", job=job.job_id, state="launched",
+                       hosts=list(job.lease), pid=job.proc.pid)
+        self._log.warning(
+            "sched: launched job %s on %s (pid %d)", job.job_id,
+            job.lease, job.proc.pid)
+
+    def _signed_preempt_put(self, job: _JobHandle, host: str) -> bool:
+        """``PUT /preempt/<host>`` on the victim job's rendezvous KV,
+        signed with THAT job's secret (the scheduler holds every job's
+        key — it minted them). The driver's next policy tick drains the
+        host through the worker's final commit."""
+        ep = driver_state.read_endpoint(job.state_dir)
+        if ep is None:
+            return False
+        path = f"/{PREEMPT_SCOPE}/{host}"
+        body = json.dumps({"reason": "scheduler shrink",
+                           "by": "multi-job-scheduler"}).encode()
+        req = Request(f"http://{ep['addr']}:{ep['port']}{path}",
+                      data=body, method="PUT")
+        tag = _secret.sign(_auth_payload("PUT", path, body),
+                           key=job.secret.encode())
+        if tag:
+            req.add_header(AUTH_HEADER, tag)
+        try:
+            with urlopen(req, timeout=10.0):
+                return True
+        except OSError:
+            return False
+
+    # -- journal ingestion (the scheduler's sensors) -------------------------
+
+    def _ingest_journals(self) -> None:
+        for job in self._jobs.values():
+            if job.state not in ("running", "preempting"):
+                continue
+            try:
+                with open(job.journal_path, "rb") as f:
+                    f.seek(job.journal_offset)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            # Complete lines only: a concurrent writer may be mid-line.
+            upto = chunk.rfind(b"\n")
+            if upto < 0:
+                continue
+            job.journal_offset += upto + 1
+            for line in chunk[:upto].split(b"\n"):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                self._handle_job_event(job, rec)
+
+    def _handle_job_event(self, job: _JobHandle, rec: dict) -> None:
+        event = rec.get("event")
+        if event == "world_published":
+            job.world = {
+                "np": rec.get("np"),
+                "hosts": rec.get("hosts"),
+                "generation": rec.get("generation"),
+            }
+        elif event == "blacklist":
+            host = rec.get("host")
+            reason = str(rec.get("reason", ""))
+            if not host:
+                return
+            # A blacklist the scheduler itself caused (the shrink's
+            # preempt-drain) is drain-completion, not evidence.
+            for p in self._pending:
+                if (p["action"] == "shrink" and p["stage"] == "drain"
+                        and p["victim"] == job.job_id
+                        and p["host"] == host):
+                    p["stage"] = "reassign"
+                    return
+            if host in job.lease:
+                # Pool-wide condemnation: the evidence (the driver's
+                # blacklist reason) rides the pool record, so no other
+                # job is handed this host inside the cooldown.
+                self._pool.condemn(host, job.job_id, reason)
+                job.lease.remove(host)
+                self._write_lease(job)
+                _metrics.event(
+                    "sched_pool", job=job.job_id, host=host,
+                    change="condemned", reason=reason)
+                self._log.warning(
+                    "sched: pool condemned %s (evidence from job %s: %s)",
+                    host, job.job_id, reason)
+
+    # -- tick phases ---------------------------------------------------------
+
+    def _reap(self) -> None:
+        for job in self._jobs.values():
+            if job.proc is None or job.proc.poll() is None:
+                continue
+            job.rc = job.proc.returncode
+            job.proc = None
+            if job.log_fh is not None:
+                job.log_fh.close()
+                job.log_fh = None
+            for host in list(job.lease):
+                self._pool.release(host)
+            job.lease = []
+            self._write_lease(job)
+            self._arbiter.forget_job(job.job_id)
+            if job.state == "preempting":
+                # The drained victim re-queues; its sched_decision event
+                # realizes now (goodput 0 until re-granted).
+                self._preempted_total += 1
+                for p in self._pending:
+                    if (p["action"] == "preempt"
+                            and p["victim"] == job.job_id
+                            and p["stage"] == "drain"):
+                        p["stage"] = "realized"
+                        p["realized"] = {"victim_rc": job.rc,
+                                         "victim_goodput": 0.0}
+                job.state = "pending"
+                job.not_before = self._clock() + self._requeue_backoff
+                _metrics.event("sched_job", job=job.job_id,
+                               state="requeued", rc=job.rc)
+            else:
+                job.state = "done" if job.rc == 0 else "failed"
+                _metrics.event("sched_job", job=job.job_id,
+                               state="exit", rc=job.rc)
+                self._log.warning("sched: job %s exited rc=%s",
+                                  job.job_id, job.rc)
+
+    def _prune_pool(self) -> None:
+        for host in self._pool.prune():
+            _metrics.event("sched_pool", host=host, change="returned",
+                           reason="condemnation cooldown expired")
+
+    def _grant_pending(self) -> None:
+        now = self._clock()
+        waiting = sorted(
+            (j for j in self._jobs.values()
+             if j.state == "pending" and now >= j.not_before),
+            key=lambda j: (-j.spec.priority, j.index))
+        for job in waiting:
+            spares = self._pool.spares()
+            if len(spares) < job.spec.min_np:
+                continue
+            granted = []
+            for host in spares:
+                if len(granted) >= job.spec.min_np:
+                    break
+                try:
+                    if self._pool.assign(host, job.job_id):
+                        granted.append(host)
+                except faults.InjectedFault as e:
+                    self._log.warning(
+                        "sched: pool.assign fault (%s); holding %s back",
+                        e, host)
+            if len(granted) < job.spec.min_np:
+                for host in granted:     # partial gang: give it back
+                    self._pool.release(host)
+                continue
+            job.lease = granted
+            self._write_lease(job)
+            self._launch_driver(job)
+            self._pending.append({
+                "action": "grant", "job": job.job_id, "victim": None,
+                "host": None, "stage": "adopt",
+                "reason": (f"gang grant of {job.spec.min_np} pool hosts "
+                           f"at priority {job.spec.priority}"),
+                "predicted": {"goodput_after": job.goodput(),
+                              "target_goodput": job.spec.target_goodput},
+                "deadline": now + self._realize_timeout,
+            })
+
+    def _deficit_order(self) -> list[_JobHandle]:
+        """Running jobs by healing urgency (the arbiter's recipient
+        ordering): furthest under SLO first. Computed directly from the
+        spec and the live lease — NOT from the arbiter's observation
+        history, which is empty until the first arbitration pass, while
+        spare promotion must already order correctly on the very tick
+        the gangs are granted."""
+        def key(job: _JobHandle):
+            deficit = JobArbiter._deficit({
+                "granted": job.granted_np(),
+                "min_np": job.spec.min_np,
+                "max_np": job.spec.max_np,
+                "target": job.spec.target_goodput,
+            })
+            return (-deficit, -job.spec.priority, job.index)
+        return sorted((j for j in self._jobs.values()
+                       if j.state == "running"), key=key)
+
+    def _promote_spares(self) -> None:
+        """Pool healing: idle spares flow to running jobs below their
+        ``max_np``, furthest-under-SLO first — a condemned host's
+        replacement joins at the job's next generation fence."""
+        now = self._clock()
+        progress = True
+        while progress:
+            progress = False
+            spares = self._pool.spares()
+            if not spares:
+                return
+            for job in self._deficit_order():
+                if job.granted_np() >= job.spec.max_np:
+                    continue
+                host = spares[0]
+                try:
+                    if not self._pool.assign(host, job.job_id):
+                        continue
+                except faults.InjectedFault as e:
+                    self._log.warning(
+                        "sched: pool.assign fault (%s); holding %s back",
+                        e, host)
+                    continue
+                before = job.goodput()
+                job.lease.append(host)
+                self._write_lease(job)
+                self._pending.append({
+                    "action": "promote", "job": job.job_id,
+                    "victim": None, "host": host, "stage": "adopt",
+                    "reason": f"pool spare {host} promoted into "
+                              f"{job.job_id}",
+                    "predicted": {
+                        "goodput_before": round(before, 6),
+                        "goodput_after": round(job.goodput(), 6),
+                        "target_goodput": job.spec.target_goodput},
+                    "deadline": now + self._realize_timeout,
+                })
+                self._log.warning(
+                    "sched: promoted spare %s into job %s", host,
+                    job.job_id)
+                progress = True
+                break
+
+    def _arbitrate(self) -> None:
+        running = [j for j in self._jobs.values() if j.state == "running"]
+        for job in running:
+            self._arbiter.note_job(
+                job.job_id, job.granted_np(), job.spec.min_np,
+                job.spec.max_np, priority=job.spec.priority,
+                target=job.spec.target_goodput)
+        if len(running) < 2:
+            return
+        if any(p["action"] in ("shrink", "preempt")
+               and p["stage"] != "realized" for p in self._pending):
+            return  # one capacity surgery at a time
+        try:
+            decision = self._arbiter.decide(len(self._pool.spares()))
+        except faults.InjectedFault as e:
+            # sched.decide raise mode: a broken arbiter must never take
+            # the scheduler (and every job under it) down with it.
+            self._log.error("sched: arbiter pass failed (%s); holding", e)
+            return
+        if decision is None:
+            return
+        if decision.action == "shrink":
+            self._actuate_shrink(decision)
+        else:
+            self._actuate_preempt(decision)
+
+    def _actuate_shrink(self, decision: ArbiterDecision) -> None:
+        victim = self._jobs[decision.victim]
+        if not victim.lease:
+            return
+        host = victim.lease[-1]
+        if not self._signed_preempt_put(victim, host):
+            self._log.warning(
+                "sched: shrink of %s deferred — no reachable endpoint "
+                "for its driver yet", victim.job_id)
+            return
+        self._arbiter.record_action(decision)
+        self._pending.append({
+            "action": "shrink", "job": decision.recipient,
+            "victim": decision.victim, "host": host, "stage": "drain",
+            "reason": decision.reason, "predicted": decision.predicted,
+            "deadline": self._clock() + self._realize_timeout,
+        })
+        self._log.warning(
+            "sched: shrinking job %s by %s to heal %s (%s)",
+            decision.victim, host, decision.recipient, decision.reason)
+
+    def _actuate_preempt(self, decision: ArbiterDecision) -> None:
+        victim = self._jobs[decision.victim]
+        if victim.proc is None:
+            return
+        try:
+            if faults.fire(faults.JOB_PREEMPT):
+                return  # injected drop: the preemption never happens
+        except faults.InjectedFault as e:
+            self._log.error("sched: job.preempt fault (%s); holding", e)
+            return
+        self._arbiter.record_action(decision)
+        victim.state = "preempting"
+        # SIGTERM the victim's DRIVER: its forwarder drains every worker
+        # through a final commit, then the driver exits 0 — the job's
+        # state survives for the re-grant.
+        victim.proc.send_signal(signal.SIGTERM)
+        self._pending.append({
+            "action": "preempt", "job": decision.recipient,
+            "victim": decision.victim, "host": None, "stage": "drain",
+            "reason": decision.reason, "predicted": decision.predicted,
+            "deadline": self._clock() + self._realize_timeout,
+        })
+        self._log.warning(
+            "sched: preempting job %s to heal %s (%s)", decision.victim,
+            decision.recipient, decision.reason)
+
+    def _finalize_pending(self) -> None:
+        """Advance in-flight actions toward their realized measurement;
+        each emits EXACTLY ONE ``sched_decision`` journal event, with
+        predicted + realized goodput, when its effect is observed in the
+        recipient's republished world (the ``policy_decision`` finalize
+        contract)."""
+        now = self._clock()
+        done: list[dict] = []
+        for p in self._pending:
+            job = self._jobs.get(p["job"])
+            if p["action"] == "shrink" and p["stage"] == "reassign":
+                victim = self._jobs[p["victim"]]
+                if p["host"] in victim.lease:
+                    victim.lease.remove(p["host"])
+                    self._write_lease(victim)
+                self._pool.release(p["host"])
+                try:
+                    assigned = self._pool.assign(p["host"], p["job"])
+                except faults.InjectedFault:
+                    assigned = False
+                if not assigned:
+                    continue  # held back; retried next tick
+                if job is not None:
+                    job.lease.append(p["host"])
+                    self._write_lease(job)
+                p["stage"] = "adopt"
+            if p["stage"] == "adopt" and job is not None:
+                world = job.world or {}
+                hosts = world.get("hosts") or []
+                adopted = (
+                    p["host"] in hosts if p["host"] is not None
+                    else (world.get("np") or 0) >= job.spec.min_np)
+                if adopted:
+                    realized = {
+                        "goodput": round(job.goodput(), 6),
+                        "np": world.get("np"),
+                        "generation": world.get("generation"),
+                    }
+                    if p["action"] == "shrink":
+                        victim = self._jobs[p["victim"]]
+                        realized["victim_goodput"] = round(
+                            victim.goodput(), 6)
+                    p["realized"] = realized
+                    p["stage"] = "realized"
+            if p["stage"] == "realized":
+                self._emit_decision(p)
+                done.append(p)
+            elif now >= p["deadline"]:
+                # Never realized inside the window: emit honestly with
+                # realized=null rather than pretending or re-emitting.
+                p["realized"] = None
+                self._emit_decision(p)
+                done.append(p)
+        for p in done:
+            self._pending.remove(p)
+
+    def _emit_decision(self, p: dict) -> None:
+        self._decisions[p["action"]] += 1
+        _metrics.event(
+            "sched_decision", action=p["action"], job=p["job"],
+            victim=p["victim"], host=p["host"], reason=p["reason"],
+            predicted=p["predicted"], realized=p.get("realized"))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _request_stop(self, *_args) -> None:
+        self._stop = True
+
+    def _drain_all(self) -> None:
+        if self._drain_signaled:
+            return
+        self._drain_signaled = True
+        running = [j for j in self._jobs.values() if j.proc is not None]
+        _metrics.event("sched_drain", jobs=[j.job_id for j in running])
+        self._log.warning(
+            "sched: SIGTERM — draining %d job(s) through final commits",
+            len(running))
+        for job in running:
+            try:
+                job.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+
+    def tick(self) -> None:
+        """One scheduling pass (public for unit tests)."""
+        with self._lock:
+            self._reap()
+            self._ingest_journals()
+            self._prune_pool()
+            if not self._stop:
+                self._grant_pending()
+                self._promote_spares()
+                self._arbitrate()
+            self._finalize_pending()
+
+    def _all_settled(self) -> bool:
+        return all(j.state in ("done", "failed") for j in
+                   self._jobs.values())
+
+    def _all_reaped(self) -> bool:
+        return all(j.proc is None for j in self._jobs.values())
+
+    def run(self) -> int:
+        """Schedule until every job completes (or SIGTERM drains the
+        pod). Returns 0 iff every job finished rc=0 (a drained pod
+        counts: final commits landed)."""
+        if threading.current_thread() is threading.main_thread():
+            signal.signal(signal.SIGTERM, self._request_stop)
+            signal.signal(signal.SIGINT, self._request_stop)
+        self._start_http()
+        counts = self._pool.counts()
+        _metrics.event(
+            "sched_start", jobs=sorted(self._jobs), port=self.port,
+            pool_hosts=counts["hosts"])
+        self._log.warning(
+            "sched: multi-tenant pod up — %d job(s), %d host(s), "
+            "http :%d", len(self._jobs), counts["hosts"], self.port)
+        try:
+            while True:
+                if self._stop:
+                    self._drain_all()
+                self.tick()
+                if self._stop and self._all_reaped():
+                    break
+                if not self._stop and self._all_settled():
+                    break
+                time.sleep(self._tick_s)
+            rcs = {j.job_id: j.rc for j in self._jobs.values()}
+            _metrics.event("sched_stop", rcs=rcs,
+                           drained=self._drain_signaled)
+            ok = all(rc == 0 for rc in rcs.values())
+            return 0 if ok else 1
+        finally:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+
+
+def _specs_from_config(config: dict) -> list[JobSpec]:
+    return [JobSpec(
+        job_id=str(j["job_id"]),
+        command=list(j["command"]),
+        min_np=int(j["min_np"]),
+        max_np=int(j["max_np"]),
+        priority=int(j.get("priority", 0)),
+        target_goodput=(float(j["target_goodput"])
+                        if j.get("target_goodput") is not None else None),
+        env={str(k): str(v) for k, v in (j.get("env") or {}).items()},
+        cpu_mode=bool(j.get("cpu_mode", True)),
+        elastic_timeout=float(j.get("elastic_timeout", 600.0)),
+    ) for j in config["jobs"]]
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m horovod_tpu.runner.elastic.scheduler pod.json``:
+    the config document carries ``{"hosts": [...], "workdir": ...,
+    "jobs": [{job_id, command, min_np, max_np, priority,
+    target_goodput, env, cpu_mode, elastic_timeout}, ...]}``."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="horovod-scheduler",
+        description="Gang-schedule N elastic jobs onto one host pool.")
+    p.add_argument("config", help="pod config JSON (hosts + jobs)")
+    p.add_argument("--workdir", default=None,
+                   help="override the config's workdir")
+    p.add_argument("--http-port", type=int, default=None)
+    args = p.parse_args(argv)
+    with open(args.config, encoding="utf-8") as f:
+        config = json.load(f)
+    workdir = args.workdir or config.get("workdir") or os.path.join(
+        os.path.dirname(os.path.abspath(args.config)), "pod")
+    sched = MultiJobScheduler(
+        _specs_from_config(config), config["hosts"], workdir,
+        http_port=args.http_port)
+    return sched.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,7 @@ straggler-evidence channel. A cold cluster serves an explicit
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -1021,7 +1022,14 @@ def _render_cluster_metrics(httpd) -> str:
             "Ranks whose peer-replica PUTs are currently fenced by an "
             "integrity-vote quarantine.", [({}, quarantined)]),
     ]
-    groups: list = [({}, driver_families)]
+    # Multi-tenant pod: a driver serving one job of a shared pool
+    # (HOROVOD_JOB_ID set per job process tree by the scheduler) stamps
+    # every family on its scrape with the job dimension, so N per-job
+    # scrape targets merge in PromQL without relabeling. Unset (every
+    # single-job path) the scrape is bit-for-bit the HEAD body.
+    job = os.environ.get("HOROVOD_JOB_ID") or ""
+    job_labels = {"job": job} if job else {}
+    groups: list = [(job_labels, driver_families)]
     steps_samples: list = []
     commit_samples: list = []
     for host, raw in sorted(payloads.items()):
@@ -1031,7 +1039,7 @@ def _render_cluster_metrics(httpd) -> str:
             continue
         if not isinstance(payload, dict):
             continue
-        labels = {"host": host}
+        labels = {"host": host, **job_labels}
         rank = payload.get("rank")
         if rank is not None:
             labels["rank"] = str(rank)

@@ -15,7 +15,14 @@ def free_port() -> int:
 
 
 def is_local(hostname: str) -> bool:
-    return hostname in LOCAL_NAMES or hostname == socket.gethostname()
+    # The whole 127.0.0.0/8 block is the loopback device: any 127.x.y.z
+    # literal names THIS machine (the kernel routes the full /8), which
+    # is what lets the localhost-as-cluster test harness emulate more
+    # distinct "hosts" than the three canonical local names — a shared
+    # multi-job pool needs disjoint per-job host sets plus spares.
+    return (hostname in LOCAL_NAMES
+            or hostname.startswith("127.")
+            or hostname == socket.gethostname())
 
 
 def routable_addr() -> str:

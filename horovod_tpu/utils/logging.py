@@ -8,9 +8,16 @@ when the process runs inside a launched world (``HOROVOD_RANK`` set), the
 generation part appearing only in elastic worlds — so the interleaved
 stdout of a multi-worker job stays attributable per line without grepping
 hostnames, and a line from generation 3 cannot be mistaken for the re-formed
-generation 4's. The prefix re-reads the env per record: an elastic resize
-rewrites ``HOROVOD_RANK``/``HOROVOD_WORLD_VERSION`` in place, and the very
-next log line must carry the new identity.
+generation 4's. On a multi-tenant pod (``HOROVOD_JOB_ID`` set by the
+gang scheduler — ``runner/elastic/scheduler.py``) the prefix leads with
+the job id — ``[job/rank/size g<gen>]`` for workers, ``[job]`` for the
+job's rankless driver process — so two jobs' interleaved logs stay
+attributable per line; an unset job id keeps the exact single-job prefix
+(unprefixed-job: bit-for-bit HEAD). The prefix re-reads the env per
+record: an elastic resize rewrites
+``HOROVOD_RANK``/``HOROVOD_WORLD_VERSION`` in place (and the scheduler
+sets ``HOROVOD_JOB_ID`` per job process tree), and the very next log
+line must carry the new identity.
 """
 
 from __future__ import annotations
@@ -34,13 +41,17 @@ _logger: logging.Logger | None = None
 
 
 def rank_prefix() -> str:
-    """``"[rank/size g<generation>] "`` for launched workers, ``""``
+    """``"[rank/size g<generation>] "`` for launched workers — with the
+    job id prepended (``[job/rank/size g<gen>] ``) when ``HOROVOD_JOB_ID``
+    is set — ``"[job] "`` for a job-tagged rankless process (the per-job
+    elastic driver under the multi-tenant scheduler), and ``""``
     elsewhere (single-process scripts keep clean logs)."""
+    job = os.environ.get("HOROVOD_JOB_ID") or ""
     rank = os.environ.get("HOROVOD_RANK")
     if rank is None:
-        return ""
+        return f"[{job}] " if job else ""
     size = os.environ.get("HOROVOD_SIZE") or "?"
-    prefix = f"[{rank}/{size}"
+    prefix = f"[{job}/{rank}/{size}" if job else f"[{rank}/{size}"
     if (os.environ.get("HOROVOD_ELASTIC") == "1"
             or "HOROVOD_WORLD_VERSION" in os.environ):
         prefix += f" g{os.environ.get('HOROVOD_WORLD_VERSION', '0') or '0'}"

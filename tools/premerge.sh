@@ -82,11 +82,20 @@ echo "== premerge gate 2/4: fault-injection + recovery (chaos lane) =="
 # tripwires skip the poisoned step rank-identically; the loss-spike
 # detector rewinds storage-free with skip-ahead + a storm breaker; and
 # the A/B arm proves every knob unset is bit-for-bit inert.
-if ! timeout -k 10 1800 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
+# test_scheduler.py is the multi-tenant pod's acceptance proof: two
+# real elastic drivers gang-scheduled on one shared host pool —
+# SIGKILL a worker in job A and the pool-wide condemnation + spare
+# promotion heal A at its next generation fence with an exact loss
+# trajectory while job B never re-forms; under SLO pressure the
+# arbiter shrinks the low-priority job one host through the signed
+# preempt-notice drain -> final-commit -> reassign sequence with
+# exactly one sched_decision journal event per executed action
+# (predicted + realized goodput), both jobs rc=0.
+if ! timeout -k 10 2400 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
     tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py \
     tests/test_policy.py tests/test_driver_failover.py \
-    tests/test_integrity.py -q \
+    tests/test_integrity.py tests/test_scheduler.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -567,6 +576,87 @@ finally:
 EOF
 then
     echo "premerge: metrics scrape/timeline lane failed" >&2
+    exit 1
+fi
+
+# Scheduler observability sub-lane: a MultiJobScheduler with two jobs on
+# a shared pool serves GET /metrics (the pool/job gauges and the
+# decision counter must be present and zero-materialized BEFORE any
+# decision executes — 0 means "nothing decided", absence means "not
+# measuring") and GET /pool (the per-host lease/condemnation dump with
+# >=2 job entries carrying the SLO math) over real HTTP.
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import tempfile
+import urllib.request
+
+from horovod_tpu import metrics
+from horovod_tpu.runner.elastic.scheduler import (
+    JobSpec, MultiJobScheduler, SCHED_ACTIONS)
+
+workdir = tempfile.mkdtemp(prefix="premerge-sched-")
+sched = MultiJobScheduler(
+    [JobSpec(job_id="trainA", command=["true"], min_np=2, max_np=4,
+             priority=10, target_goodput=0.8),
+     JobSpec(job_id="trainB", command=["true"], min_np=1, max_np=2,
+             priority=1)],
+    ["h1", "h2", "h3", "h4"], workdir)
+sched._start_http()
+try:
+    base = f"http://127.0.0.1:{sched.port}"
+    with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge scheduler lane: /metrics answered "
+                     f"{r.status}")
+        text = r.read().decode()
+    parsed = metrics.validate_prometheus_text(text)
+    required = ("hvd_pool_hosts", "hvd_pool_spares",
+                "hvd_pool_blacklisted", "hvd_jobs_running",
+                "hvd_jobs_preempted_total", "hvd_sched_decisions_total")
+    missing = [m for m in required
+               if not parsed.get(m, {}).get("samples")]
+    if missing:
+        sys.exit(f"premerge scheduler lane: instruments missing from "
+                 f"the scrape: {missing}")
+    actions = {l.get("action"): v for l, v in
+               parsed["hvd_sched_decisions_total"]["samples"]}
+    if actions != {a: 0.0 for a in SCHED_ACTIONS}:
+        sys.exit(
+            f"premerge scheduler lane: hvd_sched_decisions_total must "
+            f"zero-materialize all of {SCHED_ACTIONS}, got {actions!r}")
+    if parsed["hvd_pool_hosts"]["samples"] != [({}, 4.0)]:
+        sys.exit(f"premerge scheduler lane: hvd_pool_hosts wrong: "
+                 f"{parsed['hvd_pool_hosts']['samples']!r}")
+    with urllib.request.urlopen(f"{base}/pool", timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge scheduler lane: /pool answered "
+                     f"{r.status}")
+        pool = json.loads(r.read().decode())
+    jobs = pool.get("jobs") or {}
+    if len(jobs) < 2:
+        sys.exit(f"premerge scheduler lane: GET /pool carries "
+                 f"{len(jobs)} job entries (need >=2): {sorted(jobs)}")
+    for jid in ("trainA", "trainB"):
+        ent = jobs.get(jid) or {}
+        for field in ("state", "priority", "min_np", "max_np",
+                      "target_goodput", "lease"):
+            if field not in ent:
+                sys.exit(f"premerge scheduler lane: /pool job {jid!r} "
+                         f"missing {field!r}: {ent!r}")
+    if len(pool.get("hosts") or []) != 4:
+        sys.exit(f"premerge scheduler lane: /pool hosts wrong: "
+                 f"{pool.get('hosts')!r}")
+    print(f"premerge scheduler lane: ok (/metrics zero-materialized "
+          f"{len(required)} pool/job instruments over "
+          f"{sorted(SCHED_ACTIONS)}; /pool serves {len(jobs)} jobs on "
+          f"{len(pool['hosts'])} pool hosts)")
+finally:
+    sched._httpd.shutdown()
+    sched._httpd.server_close()
+EOF
+then
+    echo "premerge: scheduler observability lane failed" >&2
     exit 1
 fi
 echo "premerge: all gates passed"
