@@ -1341,6 +1341,77 @@ def main() -> int:
         if moe_lane is not None:
             emit.update(moe=moe_lane)
 
+    # --- section 6c: serving lane — inference latency under concurrent
+    # hot-swap (the training→serving bridge's RCU pointer flip,
+    # horovod_tpu/serving.py). Pure host math, no collectives: an
+    # in-process ModelServer takes a storm of installs on one thread
+    # while this thread hammers reads, measuring request p50/p99 with
+    # the swaps landing mid-stream, the swap-latency distribution, and
+    # — the robustness headline — that not one read observed a torn
+    # model (the params a request sees always match the digest the same
+    # snapshot claims). Runs in --smoke: premerge gate 4 scrapes the
+    # hvd_serve_* instruments this lane exercises.
+    def run_serving():
+        import statistics as _stats
+        import threading as _threading
+
+        from horovod_tpu import serving as _serving
+
+        swaps_target = 30 if smoke else 100
+        server = _serving.ModelServer()
+        swap_ms: list = []
+
+        def _install(k: int) -> bool:
+            payload = np.full(1024, k, np.float32)
+            t0 = time.perf_counter()
+            ok = server.install(payload, generation=0, step=k,
+                                digest=f"model-{k}")
+            if ok:
+                swap_ms.append((time.perf_counter() - t0) * 1e3)
+            return ok
+
+        _install(0)
+        stop = _threading.Event()
+
+        def _swapper():
+            k = 1
+            while not stop.is_set() and k <= swaps_target:
+                _install(k)
+                k += 1
+                time.sleep(0.001)
+            stop.set()
+
+        torn = 0
+        req_ms: list = []
+        swapper = _threading.Thread(target=_swapper, daemon=True)
+        swapper.start()
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            model = server.current()
+            k = int(model.digest.rsplit("-", 1)[1])
+            if not (model.params == k).all() or model.step != k:
+                torn += 1
+            req_ms.append((time.perf_counter() - t0) * 1e3)
+        swapper.join(timeout=30)
+        req_ms.sort()
+        return {
+            "swaps": len(swap_ms),
+            "torn_reads": torn,
+            "requests": len(req_ms),
+            "request_p50_ms": round(_stats.median(req_ms), 6),
+            "request_p99_ms": round(
+                req_ms[min(len(req_ms) - 1,
+                           int(len(req_ms) * 0.99))], 6),
+            "swap_p50_ms": round(_stats.median(swap_ms), 6),
+            "swap_p99_ms": round(max(swap_ms), 6),
+        }
+
+    if not out_of_time():
+        serving_lane = _with_retry("serving", run_serving, errors,
+                                   allow_retry=single_controller)
+        if serving_lane is not None:
+            emit.update(serving=serving_lane)
+
     # --- section 7: attribution lane — the framework-side decomposition
     # of the bench_phases step (compute / exposed_comm / straggler_wait /
     # overhead summing to the step wall time), the measured

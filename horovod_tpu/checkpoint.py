@@ -151,6 +151,52 @@ def rotate_slots(store: MutableMapping, key: str, value,
     store[key] = value
 
 
+def assemble_full_params(payloads: list) -> tuple:
+    """Re-materialize the FULL parameter pytree from a complete replica
+    set's decoded payload dicts (the commit wire format of
+    ``elastic.state.PeerShardedState`` and the serving publisher).
+
+    Returns ``(params, template_params)`` — the monolithic parameters
+    plus the unshard template for the optimizer rows (the
+    ``ShardedParams`` under fsdp, else ``params`` itself). This is the
+    ONE assemble→install parameter path: training-side peer recovery
+    (``_restore_from_peers``) and the serving tier's hot-swap
+    (:mod:`horovod_tpu.serving`) both route through it, so a payload a
+    trainer can recover from is — by construction — a payload a server
+    can serve. Raises ``ValueError`` on any gap (missing rows, no
+    parameter carrier); callers map that onto their own unavailability
+    error. Pure host math; jax is imported lazily and only on the fsdp
+    branch.
+    """
+    if any(p.get("param_layout") == "row" for p in payloads):
+        # fsdp replica set: every record carries its rank's param shard
+        # row — stack them back into the resident layout and gather the
+        # full tensors.
+        from .parallel.param_sharding import (
+            stack_param_rows,
+            unshard_params,
+        )
+
+        bad = [i for i, p in enumerate(payloads)
+               if p.get("param_layout") != "row"
+               or p.get("param_row") is None]
+        if bad:
+            raise ValueError(
+                f"records at positions {bad} carry no param shard row")
+        meta = next((p["param_meta"] for p in payloads
+                     if p.get("param_meta") is not None), None)
+        if meta is None:
+            raise ValueError("no record carries the fsdp shard metadata")
+        sp = stack_param_rows([p["param_row"] for p in payloads], meta)
+        return unshard_params(sp), sp
+    params = next((p["params"] for p in payloads
+                   if p.get("params") is not None), None)
+    if params is None:
+        raise ValueError(
+            "no record in the replica set carries the parameters")
+    return params, params
+
+
 def _read_verified(path: str) -> Any:
     """Load a rank-0 pickle checkpoint, verifying the checksum footer.
 

@@ -304,6 +304,14 @@ class TpuState(State):
         }
         self._saved = integrity.maybe_corrupt_snapshot(self._saved)
         self._integrity_fingerprint(self._commit_count)
+        # Training→serving bridge: republish the committed (host) params
+        # to the KV ``modelstate`` scope for the read-only serving tier.
+        # Inert unless HOROVOD_SERVE_PUBLISH=1 (the hook returns before
+        # touching anything); never raises into the commit.
+        from .. import serving
+
+        serving.maybe_publish_model(
+            self._saved["params"], step=self._commit_count)
         self.check_host_updates()
 
     def restore(self) -> None:
@@ -587,6 +595,17 @@ class PeerShardedState(TpuState):
         })
         self._replicator.replicate(payload, step=self._commit_seq,
                                    has_params=(r == 0))
+        # Training→serving bridge: mirror the already-pickled commit
+        # record to the ``modelstate`` scope (same wire format, same
+        # fences — the serving tier assembles exactly what recovery
+        # would). Inert unless HOROVOD_SERVE_PUBLISH=1; never raises
+        # into the commit.
+        from .. import serving
+
+        serving.maybe_publish_record(
+            payload, step=self._commit_seq, rank=r, world_size=n,
+            has_params=(r == 0),
+            generation_fn=self._replicator.generation)
         self.check_host_updates()
 
     def restore(self) -> None:
@@ -702,44 +721,19 @@ class PeerShardedState(TpuState):
         t0 = _time.perf_counter()
         records = self._replicator.assemble()
         payloads = [pickle.loads(rec.payload) for rec in records]
-        if any(p.get("param_layout") == "row" for p in payloads):
-            # fsdp replica set: every record carries its rank's param
-            # shard row — stack them back into the resident layout and
-            # re-materialize the full parameters (pure host math, the
-            # same unshard the optimizer rows take below).
-            from ..parallel.param_sharding import (
-                stack_param_rows,
-                unshard_params,
-            )
+        # The shared assemble→install parameter path (also the serving
+        # tier's hot-swap path — see checkpoint.assemble_full_params).
+        # Under fsdp the returned template is the ShardedParams: it
+        # carries the full shapes as static metadata, so the opt-state
+        # unshard below avoids allocating the full monolithic inner
+        # state on the recovery path.
+        from .. import checkpoint as _checkpoint
 
-            bad = [r.rank for r, p in zip(records, payloads)
-                   if p.get("param_layout") != "row"
-                   or p.get("param_row") is None]
-            if bad:
-                raise peercheck.ReplicaUnavailableError(
-                    f"records of ranks {bad} carry no param shard row")
-            meta = next(p["param_meta"] for p in payloads
-                        if p.get("param_meta") is not None)
-            try:
-                sp = stack_param_rows(
-                    [p["param_row"] for p in payloads], meta)
-            except ValueError as e:
-                raise peercheck.ReplicaUnavailableError(str(e)) from e
-            params = unshard_params(sp)
-            # Template for the opt-state unshard below: the
-            # ShardedParams carries the full shapes as static metadata,
-            # so unshard_opt_state's eval_shape branch avoids allocating
-            # the full monolithic inner state on the recovery path.
-            template_params = sp
-        else:
-            params = next(
-                (p["params"] for p in payloads
-                 if p.get("params") is not None),
-                None)
-            template_params = params
-        if params is None:
-            raise peercheck.ReplicaUnavailableError(
-                "no record in the replica set carries the parameters")
+        try:
+            params, template_params = _checkpoint.assemble_full_params(
+                payloads)
+        except ValueError as e:
+            raise peercheck.ReplicaUnavailableError(str(e)) from e
         if len(records) == 1 and payloads[0]["layout"] != "row":
             full = payloads[0]["row"]  # degenerate: the full tree as-is
         else:

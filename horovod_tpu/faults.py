@@ -63,6 +63,22 @@ kill workers by behavior flag). This module generalizes that into named
 - ``pool.assign``        — every pool-to-job host assignment
   (grant/promote out of the shared pool; ``raise`` holds the host back
   for a later tick)
+- ``model.publish``      — every training-side model publication to the
+  serving tier's ``modelstate`` KV scope (``horovod_tpu/serving.py``,
+  fired on each elastic commit when ``HOROVOD_SERVE_PUBLISH=1``):
+  ``drop`` loses the publication (training continues, the serving tier
+  keeps serving last-good and its staleness gauge climbs), ``delay``
+  stalls the commit-path PUT, ``corrupt`` flips seeded bits in the
+  ENCODED wire record — the server's install-time verification must 422
+  it with the previous good model intact (the ``peer.corrupt`` twin)
+- ``serve.fetch``        — every serving-subscriber poll of the
+  ``modelstate`` scope (``drop``/``raise`` fail the fetch so the
+  bounded retry + ``retry_budget_exhausted`` observability is provable;
+  ``delay`` stalls it past the staleness SLO)
+- ``serve.swap``         — every hot-swap install attempt on the
+  serving tier's RCU pointer (``drop`` skips the swap — last-good keeps
+  serving, the next poll retries; ``delay`` widens the swap window the
+  concurrency tests hammer)
 
 The canonical **control-plane injectors** are these three plus
 :func:`kill_driver` (SIGKILL the driver process — the KV server dies
@@ -165,6 +181,13 @@ MOE_DISPATCH = "moe.dispatch"
 SCHED_DECIDE = "sched.decide"
 JOB_PREEMPT = "job.preempt"
 POOL_ASSIGN = "pool.assign"
+# Training-to-serving bridge (horovod_tpu/serving.py): the commit-path
+# model publication, the serving subscriber's scope poll, and the
+# RCU hot-swap install — the canonical serving chaos injectors
+# (drop/delay/corrupt), consistent with peer.replicate/peer.corrupt.
+MODEL_PUBLISH = "model.publish"
+SERVE_FETCH = "serve.fetch"
+SERVE_SWAP = "serve.swap"
 
 _MODES = ("drop", "delay", "raise", "hang", "corrupt")
 _DEFAULT_HANG_S = 3600.0

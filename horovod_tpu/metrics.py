@@ -852,6 +852,31 @@ ALLTOALL_LATENCY = histogram(
     "Wall time of alltoall exchanges (eager dispatches and MoE "
     "dispatch/combine probes), by executed algorithm.",
     ("algorithm",), LATENCY_BUCKETS_S)
+# Training-to-serving bridge (horovod_tpu/serving.py): the read-only
+# serving tier's hot-swap/staleness instruments. Age is the bounded-
+# staleness SLO signal (seconds since the served model's install);
+# rejected publishes carry the reason the fence/verifier gave.
+SERVE_MODEL_AGE = gauge(
+    "hvd_serve_model_age_seconds",
+    "Seconds since the currently served model was installed (the "
+    "bounded-staleness SLO signal; crosses HOROVOD_SERVE_MAX_STALENESS "
+    "-> serve_degraded journaled, last-good keeps serving).")
+SERVE_SWAPS = counter(
+    "hvd_serve_swaps_total",
+    "Model hot-swaps installed by the serving tier's RCU pointer flip.")
+SERVE_REJECTED = counter(
+    "hvd_serve_rejected_publishes_total",
+    "Model publications/installs the serving bridge rejected, by reason "
+    "(fenced|corrupt|rollback|storm|dwell).", ("reason",))
+SERVE_REQUESTS = counter(
+    "hvd_serve_requests_total",
+    "Inference requests answered by the serving tier (every request "
+    "served from exactly one complete model snapshot).")
+SERVE_SWAP_SECONDS = histogram(
+    "hvd_serve_swap_seconds",
+    "Wall time of one serving hot-swap (assemble + verify + RCU "
+    "pointer flip; the request path never blocks on it).",
+    (), LATENCY_BUCKETS_S)
 
 # Materialize the zero cells (the goodput pattern): a job that never
 # checkpointed or replicated still reports the series at 0, so the scrape
@@ -899,6 +924,16 @@ def _materialize_checkpoint_cells() -> None:
     MOE_EXPERT_LOAD.labels(expert="0")
     for algo in ("flat", "two_level"):
         ALLTOALL_LATENCY.labels(algorithm=algo)
+    # Serving-bridge zero cells: a job that never published (knob unset)
+    # or a serving tier that never swapped still reports the series at 0
+    # — the premerge scrape gate asserts the instruments exist, and
+    # dashboards can tell "no swaps yet" from "not measuring".
+    SERVE_MODEL_AGE.labels()
+    SERVE_SWAPS.labels()
+    SERVE_REQUESTS.labels()
+    SERVE_SWAP_SECONDS.labels()
+    for reason in ("fenced", "corrupt", "rollback", "storm", "dwell"):
+        SERVE_REJECTED.labels(reason=reason)
     # Integrity defense plane zero cells: a job that never corrupted,
     # never tripped, and never rewound still reports the series at 0 —
     # the premerge scrape gate asserts they exist, and dashboards can
@@ -1117,8 +1152,11 @@ class EventJournal:
             # journal keeps appending rather than dying over rotation.
             self._fh = open(self.path, "a", encoding="utf-8")
 
-    def event(self, name: str, generation: int | None = None,
+    def event(self, name: str, /, generation: int | None = None,
               **fields: Any) -> None:
+        # ``name`` is positional-only so ``fields`` may itself carry a
+        # ``name`` key (e.g. retry_budget_exhausted labels the retried
+        # operation that way) without a keyword collision.
         record = {
             "event": name,
             "generation": (default_generation()
@@ -1205,7 +1243,8 @@ def journal() -> EventJournal | None:
         return _journal
 
 
-def event(name: str, generation: int | None = None, **fields: Any) -> None:
+def event(name: str, /, generation: int | None = None,
+          **fields: Any) -> None:
     """Record one lifecycle event (no-op when ``HOROVOD_EVENT_LOG`` is
     unset). Never raises: observability must not take down training."""
     try:

@@ -58,6 +58,12 @@ from .utils.logging import get_logger
 #: KV scope replica records publish to (``PUT /peerstate/<rank>``).
 PEERSTATE_SCOPE = "peerstate"
 
+#: KV scope the training→serving bridge publishes commit records to
+#: (``PUT /modelstate/<rank>``, same wire format and fences as
+#: ``peerstate`` — see :mod:`horovod_tpu.serving`). A separate scope so
+#: the serving tier's retention/consumption never races recovery's.
+MODELSTATE_SCOPE = "modelstate"
+
 #: Suffix of the retained-previous slot (both pool- and server-side).
 PREV_SUFFIX = ".prev"
 
@@ -194,6 +200,23 @@ def verify_wire(blob: bytes) -> str | None:
         return str(e)
     except Exception as e:  # noqa: BLE001 — any failure is a rejection
         return f"replica record unreadable: {e}"
+
+
+def replica_set_digest(records) -> str:
+    """One hex digest identifying a complete replica set's BYTES: the
+    sha256 over each member's ``rank:payload_digest`` line, rank order.
+    The serving tier stamps every hot-swapped model with it and the KV
+    server's ``GET /model`` health view recomputes it from the stored
+    records — equality proves the served weights are byte-exact against
+    the training-side commit they claim to be."""
+    import hashlib
+
+    from .checkpoint import payload_digest
+
+    h = hashlib.sha256()
+    for rec in sorted(records, key=lambda r: r.rank):
+        h.update(f"{rec.rank}:{payload_digest(rec.payload)}\n".encode())
+    return h.hexdigest()
 
 
 class ReplicaPool:
@@ -496,56 +519,94 @@ class PeerReplicator:
         otherwise (the ladder's cue to fall through to durable)."""
         if current_generation is None:
             current_generation = self.generation()
-        quarantine = self.quarantined()
-        groups: dict[tuple[int, int], dict[int, ReplicaRecord]] = {}
-        for record in self.fetch_all():
-            if record.generation > current_generation:
-                continue  # not our lineage: a fenced-off future/foreign gen
-            entry = quarantine.get(str(record.rank))
-            if entry is not None and _condemned(record, entry):
-                # The integrity vote named this rank's replica state
-                # divergent at (generation, step): every record it
-                # committed from that point on is suspect — including
-                # the copies already pulled into THIS rank's local pool
-                # before the vote landed (self-consistent checksums;
-                # eviction on the KV cannot reach them). Dropping them
-                # here makes assembly fall back to the last commit the
-                # vote did not condemn.
-                self._log.error(
+        return assemble_records(self.fetch_all(), current_generation,
+                                quarantine=self.quarantined(),
+                                log=self._log)
+
+
+def assemble_records(records, current_generation: int,
+                     quarantine: Mapping | None = None,
+                     log=None) -> list[ReplicaRecord]:
+    """The pure assembly math, shared by the recovery rung
+    (:meth:`PeerReplicator.assemble`) and the serving tier
+    (``horovod_tpu/serving.py`` — the read-only subscriber reuses the
+    same pool/filter semantics): find the newest ``(generation, step)``
+    group with one record per rank of an agreed world, the generation an
+    ancestor of (≤) ``current_generation``, and NO member inside an
+    integrity-quarantine entry's condemned range.
+
+    A group whose commit identity any in-world rank's condemned range
+    covers is skipped OUTRIGHT, never "completed" from other ranks'
+    records or ``.prev`` slots — assembling around the tombstone would
+    install a wave the vote proved was corrupted mid-flight. Raises
+    :class:`ReplicaUnavailableError` naming every rejected group."""
+    quarantine = quarantine or {}
+    groups: dict[tuple[int, int], dict[int, ReplicaRecord]] = {}
+    dropped: dict[tuple[int, int], set[int]] = {}
+    for record in records:
+        if record.generation > current_generation:
+            continue  # not our lineage: a fenced-off future/foreign gen
+        entry = quarantine.get(str(record.rank))
+        if entry is not None and _condemned(record, entry):
+            # The integrity vote named this rank's replica state
+            # divergent at (generation, step): every record it
+            # committed from that point on is suspect — including
+            # the copies already pulled into a LOCAL pool before the
+            # vote landed (self-consistent checksums; eviction on the
+            # KV cannot reach them). Remembering the condemned
+            # (group, rank) — instead of silently dropping the record —
+            # lets the completeness pass below refuse to complete the
+            # group from .prev slots.
+            if log is not None:
+                log.error(
                     "peercheck: dropping replica of rank %d at (gen %d, "
                     "step %d) — integrity-quarantined since (gen %s, "
                     "step %s)", record.rank, record.generation,
                     record.step, entry.get("generation"),
                     entry.get("step"))
-                continue
-            slot = groups.setdefault(record.group(), {})
-            held = slot.get(record.rank)
-            if held is None or len(record.payload) >= len(held.payload):
-                slot[record.rank] = record
-        if not groups:
-            raise ReplicaUnavailableError(
-                "no replica records visible (pool empty, peerstate scope "
-                "empty or unreachable)")
-        reasons: list[str] = []
-        for group_key in sorted(groups, reverse=True):
-            generation, step = group_key
-            members = groups[group_key]
-            sizes = {r.world_size for r in members.values()}
-            if len(sizes) != 1:
-                reasons.append(
-                    f"(gen {generation}, step {step}): inconsistent world "
-                    f"sizes {sorted(sizes)}")
-                continue
-            world = sizes.pop()
-            missing = sorted(set(range(world)) - set(members))
-            if missing:
-                reasons.append(
-                    f"(gen {generation}, step {step}): missing ranks "
-                    f"{missing} of {world}")
-                continue
-            return [members[r] for r in range(world)]
+            dropped.setdefault(record.group(), set()).add(record.rank)
+            continue
+        slot = groups.setdefault(record.group(), {})
+        held = slot.get(record.rank)
+        if held is None or len(record.payload) >= len(held.payload):
+            slot[record.rank] = record
+    if not groups and not dropped:
         raise ReplicaUnavailableError(
-            "no complete replica set: " + "; ".join(reasons))
+            "no replica records visible (pool empty, peerstate scope "
+            "empty or unreachable)")
+    reasons: list[str] = []
+    for group_key in sorted(set(groups) | set(dropped), reverse=True):
+        generation, step = group_key
+        members = groups.get(group_key, {})
+        sizes = {r.world_size for r in members.values()}
+        if len(sizes) > 1:
+            reasons.append(
+                f"(gen {generation}, step {step}): inconsistent world "
+                f"sizes {sorted(sizes)}")
+            continue
+        world = sizes.pop() if sizes else 0
+        condemned_here = sorted(
+            r for r in dropped.get(group_key, ())
+            if world == 0 or r < world)
+        if condemned_here:
+            # The vote condemned an in-world member of THIS commit wave:
+            # the whole group is suspect, even if .prev slots of other
+            # ranks could formally complete it — refuse, fall back to an
+            # older clean group (or raise).
+            reasons.append(
+                f"(gen {generation}, step {step}): ranks "
+                f"{condemned_here} integrity-quarantined (condemned "
+                "range covers this commit)")
+            continue
+        missing = sorted(set(range(world)) - set(members))
+        if missing:
+            reasons.append(
+                f"(gen {generation}, step {step}): missing ranks "
+                f"{missing} of {world}")
+            continue
+        return [members[r] for r in range(world)]
+    raise ReplicaUnavailableError(
+        "no complete replica set: " + "; ".join(reasons))
 
 
 def _condemned(record: ReplicaRecord, entry: Mapping) -> bool:
@@ -555,16 +616,22 @@ def _condemned(record: ReplicaRecord, entry: Mapping) -> bool:
     generation the vote fired in. A later generation's records are a
     DIFFERENT owner of the reused rank id (the re-formed world) and pass
     — matching the KV fence, which lifts on the first
-    strictly-newer-generation write."""
+    strictly-newer-generation write.
+
+    Fails CLOSED on a malformed entry: a quarantine record exists for
+    this rank but its range is unreadable — treating the replica as
+    clean would assemble around the tombstone, so the whole rank's
+    history is suspect until a readable entry (or a newer generation)
+    says otherwise."""
     try:
         fence_gen = int(entry.get("generation", -1))
         start_gen = int(entry.get("from_generation", fence_gen))
         start_step = int(entry.get("from_step", entry.get("step", 0)))
-        return (record.generation <= fence_gen
-                and (record.generation, record.step)
-                >= (start_gen, start_step))
     except (TypeError, ValueError):
-        return False
+        return True
+    return (record.generation <= fence_gen
+            and (record.generation, record.step)
+            >= (start_gen, start_step))
 
 
 _active: PeerReplicator | None = None

@@ -160,6 +160,14 @@ POLICY_ACTIONS = ("drain", "promote", "preempt")
 # recovery rung of generation g+1 assembles (horovod_tpu/peercheck.py).
 PEERSTATE_SCOPE = _peercheck.PEERSTATE_SCOPE
 
+# Training→serving bridge scope: trainers (HOROVOD_SERVE_PUBLISH=1)
+# mirror each commit's replica record to ``PUT /modelstate/<rank>`` —
+# same wire format, same install-time verification, same
+# generation/epoch/quarantine fences as peerstate, but a scope of its
+# own so serving-side consumption never contends with recovery. The
+# read-only health/age view is the auth-exempt ``GET /model``.
+MODELSTATE_SCOPE = _peercheck.MODELSTATE_SCOPE
+
 # Payload bound for /trace PUTs: the worker caps spans/steps at the
 # source; this is the server-side backstop against a misbehaving client.
 _TRACE_MAX_BYTES = 1 << 20
@@ -281,6 +289,12 @@ class _KVHandler(BaseHTTPRequestHandler):
             # per rank, piggybacked on heartbeats) plus the live vote —
             # the SDC defense plane's observability window.
             return self._serve_json(_render_integrity, "application/json")
+        if self.path == "/model":
+            # Same exemption: the training→serving bridge's health/age
+            # view (newest assemblable modelstate commit, publish
+            # counters, staleness) — load balancers and serving probes
+            # can't HMAC either.
+            return self._serve_json(_render_model, "application/json")
         if not self._authenticate():
             return
         store = self.server.store  # type: ignore[attr-defined]
@@ -440,7 +454,7 @@ class _KVHandler(BaseHTTPRequestHandler):
         if key is None:
             return self._reply(400, b"missing key")
         length = int(self.headers.get("Content-Length", 0))
-        if (scope == PEERSTATE_SCOPE
+        if (scope in (PEERSTATE_SCOPE, MODELSTATE_SCOPE)
                 and length > _peercheck.max_record_bytes()):
             return self._drain_and_413(length, b"replica record too large")
         if scope == TRACE_SCOPE and length > _TRACE_MAX_BYTES:
@@ -448,20 +462,31 @@ class _KVHandler(BaseHTTPRequestHandler):
         body = self.rfile.read(length)
         if not self._authenticate(body):
             return
-        if scope == PEERSTATE_SCOPE:
+        if scope in (PEERSTATE_SCOPE, MODELSTATE_SCOPE):
             # Install-time integrity gate: a half-received body (SIGKILL
             # mid-PUT, cut connection) or a corrupt record is rejected
             # BEFORE it can touch the pool — the previous good replica
-            # (and its .prev) stay authoritative.
+            # (and its .prev) stay authoritative. The modelstate scope
+            # rides the identical gate: a torn publish must never become
+            # a servable record.
             why = _peercheck.verify_wire(body)
             if why is not None:
+                if scope == MODELSTATE_SCOPE:
+                    with self.server.lock:  # type: ignore[attr-defined]
+                        self.server.model_rejected += 1  # type: ignore[attr-defined]
                 return self._reply(422, why.encode())
         with self.server.lock:  # type: ignore[attr-defined]
             rejected = self._fence_check_locked()
-            if rejected is None and scope == PEERSTATE_SCOPE:
+            if rejected is None and scope in (PEERSTATE_SCOPE,
+                                              MODELSTATE_SCOPE):
                 rejected = self._integrity_quarantine_locked(key)
+            if rejected is not None and scope == MODELSTATE_SCOPE:
+                self.server.model_rejected += 1  # type: ignore[attr-defined]
             if rejected is None:
-                if scope == PEERSTATE_SCOPE:
+                if scope == MODELSTATE_SCOPE:
+                    self.server.model_publishes += 1  # type: ignore[attr-defined]
+                    self.server.model_last_t = time.time()  # type: ignore[attr-defined]
+                if scope in (PEERSTATE_SCOPE, MODELSTATE_SCOPE):
                     # Rotate, don't overwrite: <rank> + <rank>.prev, via
                     # the same helper as the durable .prev file — the
                     # previous good commit survives until this one is
@@ -933,6 +958,56 @@ def _render_integrity(httpd) -> dict:
     return out
 
 
+def _render_model(httpd) -> dict:
+    """``GET /model``: the training→serving bridge's health/age view —
+    the newest complete, checksum-valid, unquarantined ``modelstate``
+    commit the stored records can assemble right now, plus publish
+    counters and the model age. A cold scope serves an explicit
+    ``no_model`` body, an unassemblable one serves the reason — never a
+    500: this is what load balancers and readiness probes poll."""
+    with httpd.lock:
+        generation = httpd.version
+        publishes = getattr(httpd, "model_publishes", 0)
+        rejected = getattr(httpd, "model_rejected", 0)
+        last_t = getattr(httpd, "model_last_t", None)
+        blobs = list(httpd.store.get(MODELSTATE_SCOPE, {}).values())
+        quarantine = dict(getattr(httpd, "integrity_quarantine", {}))
+    records = []
+    for blob in blobs:
+        try:
+            records.append(_peercheck.decode_record(blob, verify=True))
+        except Exception:  # noqa: BLE001 — judged at assembly, not here
+            continue
+    out = {
+        "status": "no_model",
+        "generation": generation,
+        "publishes": publishes,
+        "rejected": rejected,
+        "age_seconds": (None if last_t is None
+                        else max(0.0, time.time() - last_t)),
+        "model": None,
+    }
+    if not records:
+        return out
+    try:
+        members = _peercheck.assemble_records(
+            records, generation, quarantine=quarantine)
+    except _peercheck.ReplicaUnavailableError as e:
+        out["status"] = "unassemblable"
+        out["reason"] = str(e)
+        return out
+    out["status"] = "ok"
+    out["model"] = {
+        "generation": members[0].generation,
+        "step": members[0].step,
+        "world_size": members[0].world_size,
+        "ranks": [r.rank for r in members],
+        "bytes": sum(len(r.payload) for r in members),
+        "digest": _peercheck.replica_set_digest(members),
+    }
+    return out
+
+
 def _render_cluster_metrics(httpd) -> str:
     """The driver's cluster-wide scrape: driver-plane gauges built from
     live server state, then every worker snapshot found piggybacked on a
@@ -1127,6 +1202,12 @@ class RendezvousServer:
         self._httpd.driver_lost = {}  # type: ignore[attr-defined]
         self._httpd.integrity_quarantine = {}  # type: ignore[attr-defined]
         self._httpd.integrity_divergence = {}  # type: ignore[attr-defined]
+        # Training→serving bridge counters (the GET /model health view):
+        # accepted / fence-or-verify-rejected modelstate publishes and
+        # the wall time of the last accepted one (model age).
+        self._httpd.model_publishes = 0  # type: ignore[attr-defined]
+        self._httpd.model_rejected = 0  # type: ignore[attr-defined]
+        self._httpd.model_last_t = None  # type: ignore[attr-defined]
         # Inertness latch + vote cache for the live-vote fence: until a
         # heartbeat actually carries an integrity fingerprint, peerstate
         # PUTs must not pay a JSON parse of every heartbeat body; once
@@ -1596,6 +1677,14 @@ class KVClient:
         peer-replica assembly consults so a condemned rank's records are
         dropped from its LOCAL pool too, not just evicted from the KV."""
         with self._request("GET", "/integrity") as r:
+            return json.loads(r.read().decode())
+
+    def model_view(self) -> dict:
+        """``GET /model`` (auth-exempt): the training→serving bridge's
+        health/age view — the newest assemblable ``modelstate`` commit,
+        publish counters, and the model age (what serving readiness
+        probes and the premerge HTTP gate poll)."""
+        with self._request("GET", "/model") as r:
             return json.loads(r.read().decode())
 
     def keys(self, scope: str) -> list[str]:

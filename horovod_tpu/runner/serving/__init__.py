@@ -1,0 +1,10 @@
+"""The serving tier's process surface: a stdlib HTTP inference server
+wired to the training→serving bridge (:mod:`horovod_tpu.serving`).
+
+``python -m horovod_tpu.runner.serving`` starts a subscriber polling the
+rendezvous KV's ``modelstate`` scope and an HTTP front that serves
+health (``GET /model``) and inference (``POST /infer``) off the
+RCU-swapped model — see :mod:`.server`.
+"""
+
+from .server import InferenceServer, serve  # noqa: F401

@@ -1,0 +1,27 @@
+"""CLI: ``python -m horovod_tpu.runner.serving [--host H] [--port P]``.
+
+Reads the rendezvous endpoint from the launcher env contract
+(HOROVOD_RENDEZVOUS_ADDR / HOROVOD_RENDEZVOUS_PORT) and serves until
+interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from .server import serve
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(
+        prog="horovod_tpu.runner.serving",
+        description="Read-only serving tier: subscribe to the KV "
+                    "modelstate scope and hot-swap inference weights.")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8500)
+    args = parser.parse_args()
+    serve(host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

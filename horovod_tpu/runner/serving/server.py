@@ -1,0 +1,124 @@
+"""The serving fleet's HTTP front: health + inference off the
+RCU-swapped model.
+
+Stdlib only (ThreadingHTTPServer — the same serving substrate as the
+rendezvous KV), no framework init on the request path. The request
+handler reads the model pointer ONCE (:meth:`ModelServer.current`) and
+uses that snapshot for the whole request: a concurrent hot-swap is
+invisible to in-flight requests, and a request can never observe two
+models (the swap-atomicity contract tests/test_serving.py hammers).
+
+Routes:
+
+- ``GET /model`` — health/identity/age JSON (``ModelServer.health``);
+  200 with ``status: no_model`` before the first install — readiness
+  probes poll this, they must never see a connection error.
+- ``POST /infer`` — run ``infer_fn(model, body)`` on the snapshot.
+  With no model yet: 503 (the ONLY 5xx this server emits — once a model
+  has been served, degradation serves last-good, never an error).
+
+``infer_fn`` is injectable: the default echoes the model identity
+(generation/step/digest), which is exactly what the chaos tests need to
+prove which model served a request; real deployments pass a jax apply.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+from ... import metrics as _metrics
+from ... import serving as _serving
+
+
+def _default_infer(model: _serving.ServedModel, body: bytes) -> dict:
+    """Identity probe: which complete model served this request."""
+    return {"generation": model.generation, "step": model.step,
+            "digest": model.digest}
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # noqa: D102 — quiet by default
+        pass
+
+    def _reply(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802
+        if self.path != "/model":
+            return self._reply(404, {"error": "unknown route"})
+        self._reply(200, self.server.model_server.health())  # type: ignore[attr-defined]
+
+    def do_POST(self):  # noqa: N802
+        if self.path != "/infer":
+            return self._reply(404, {"error": "unknown route"})
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        # THE read: one reference fetch, then this request lives on that
+        # snapshot no matter how many swaps land meanwhile.
+        model = self.server.model_server.current()  # type: ignore[attr-defined]
+        try:
+            _metrics.SERVE_REQUESTS.inc()
+        except Exception:  # noqa: BLE001
+            pass
+        if model is None:
+            return self._reply(503, {"error": "no model installed yet"})
+        try:
+            out = self.server.infer_fn(model, body)  # type: ignore[attr-defined]
+        except Exception as e:  # noqa: BLE001 — one bad request ≠ dark fleet
+            return self._reply(400, {"error": str(e)})
+        self._reply(200, out)
+
+
+class InferenceServer:
+    """The serving process: subscriber thread + HTTP front."""
+
+    def __init__(self, model_server: _serving.ModelServer | None = None,
+                 infer_fn: Callable | None = None,
+                 host: str = "0.0.0.0", port: int = 0):
+        self.model_server = model_server or _serving.ModelServer()
+        self.subscriber = _serving.ModelSubscriber(self.model_server)
+        self._httpd = ThreadingHTTPServer((host, port), _ServeHandler)
+        self._httpd.model_server = self.model_server  # type: ignore[attr-defined]
+        self._httpd.infer_fn = infer_fn or _default_infer  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        self.subscriber.start()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-serve-http",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.subscriber.stop()
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd.server_close()
+
+
+def serve(host: str = "0.0.0.0", port: int = 8500) -> None:
+    """Blocking entry point (``python -m horovod_tpu.runner.serving``)."""
+    server = InferenceServer(host=host, port=port)
+    server.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()

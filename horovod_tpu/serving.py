@@ -1,0 +1,615 @@
+"""Training→serving bridge: chaos-proven sub-second model hot-swap.
+
+ROADMAP item 5 ("serve heavy traffic from millions of users"): the peer
+replication plane (PR 7) already streams every rank's verified,
+generation-fenced shard to the KV on each commit — but its only consumer
+was recovery. This module adds the serving side of that wire:
+
+1. **Publisher** (:func:`maybe_publish_model` / :func:`maybe_publish_record`
+   — the hooks ``elastic/state.py`` calls at the end of every commit):
+   mirror the commit's replica record to the KV ``modelstate`` scope
+   (``PUT /modelstate/<rank>``, same wire format + sha256 + generation/
+   driver-epoch fences as ``peerstate``). **Inert unless
+   HOROVOD_SERVE_PUBLISH=1** — unset, the hooks return before touching
+   anything, and a publish failure NEVER raises into the commit.
+2. **Subscriber** (:class:`ModelSubscriber`): a read-only poll loop that
+   pulls the scope into a local :class:`~horovod_tpu.peercheck.ReplicaPool`
+   (same ``.prev`` rotation, so a half-landed commit wave completes from
+   retained slots), filters integrity-condemned replicas, assembles the
+   newest complete checksum-valid same-generation-lineage set via the
+   SHARED math (``peercheck.assemble_records`` +
+   ``checkpoint.assemble_full_params`` — byte-identical to what recovery
+   would install), and hands the result to the server.
+3. **RCU hot-swap** (:class:`ModelServer`): inference requests read ONE
+   volatile reference (:meth:`ModelServer.current`) — no lock, no
+   copy — while :meth:`ModelServer.install` flips the pointer under the
+   writer lock. In-flight requests finish on the model they started
+   with; new requests see the new one; a reader never observes a
+   half-built model because the :class:`ServedModel` is fully
+   constructed before the flip.
+
+Robustness contract (the reason this module exists):
+
+- **Never roll backward**: installs are (generation, step)-monotone; a
+  zombie trainer's stale publish is fenced twice — at the KV (409) and
+  again at install (``rejected{rollback}`` + ``publish_fenced``).
+- **Never serve torn bytes**: every record re-verifies its sha256 at
+  every hop (KV install gate, pool install, assembly), and the swapped
+  set's :func:`~horovod_tpu.peercheck.replica_set_digest` proves the
+  served weights byte-exact against the training commit.
+- **Never go dark**: when training stops publishing (abort, resize,
+  death) the server keeps serving last-good and says so honestly —
+  ``hvd_serve_model_age_seconds`` rises, and past
+  ``HOROVOD_SERVE_MAX_STALENESS`` a ``serve_degraded`` journal event
+  latches (once per degradation, re-armed by the next install).
+- **Never thrash**: a flapping trainer meets the min-dwell
+  (``HOROVOD_SERVE_MIN_DWELL``) and the swap storm-breaker
+  (``HOROVOD_SERVE_STORM_SWAPS`` per ``HOROVOD_SERVE_STORM_WINDOW``).
+
+Chaos injection points: ``model.publish`` (commit-path publication),
+``serve.fetch`` (subscriber poll), ``serve.swap`` (the install) — see
+:mod:`horovod_tpu.faults`. The HTTP surface (stdlib inference server,
+``GET /model`` on the KV) lives in ``runner/serving/`` and
+``runner/http/kv_server.py``.
+
+Module import is **stdlib-only** (jax enters lazily through
+``checkpoint.assemble_full_params`` on the fsdp branch) so a serving
+host needs no framework init to run the subscriber.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, Callable, Mapping
+
+from . import faults
+from . import metrics as _metrics
+from . import peercheck
+from .peercheck import MODELSTATE_SCOPE  # noqa: F401 — canonical re-export
+from .utils.env import get_float, get_int
+from .utils.logging import get_logger
+from .utils.retry import call_with_retries
+
+
+def publish_enabled() -> bool:
+    """The bridge's master switch. Unset/0, every publish hook is a
+    no-op before any client, import, or allocation — the bit-for-bit
+    inertness contract the A/B test in tests/test_serving.py proves."""
+    return os.environ.get("HOROVOD_SERVE_PUBLISH", "") == "1"
+
+
+# ---------------------------------------------------------------------------
+# Publisher — the training-side commit hook
+# ---------------------------------------------------------------------------
+
+class ModelPublisher:
+    """Ships commit records to the KV ``modelstate`` scope.
+
+    A dedicated short-timeout client (retries=1 — the publish rides the
+    commit path and must never inherit the fat KV retry budget), fenced
+    with the caller's generation view. Best-effort by contract: any
+    failure degrades serving freshness (the subscriber keeps last-good),
+    it never takes down training.
+    """
+
+    def __init__(self, client=None,
+                 generation_fn: Callable[[], int] | None = None):
+        self._client = client
+        self._generation_fn = generation_fn or peercheck._env_generation
+        self._log = get_logger()
+
+    def client(self):
+        if self._client is None:
+            addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+            port = os.environ.get("HOROVOD_RENDEZVOUS_PORT", "")
+            if not addr or not port:
+                return None
+            from .runner.http.kv_server import KVClient
+
+            self._client = KVClient(
+                addr, int(port),
+                timeout=get_float("HOROVOD_SERVE_PUBLISH_TIMEOUT", 5.0),
+                retries=1, generation_fn=self._generation_fn)
+        return self._client
+
+    def publish(self, payload: bytes, step: int, rank: int,
+                world_size: int, has_params: bool) -> bool:
+        """Encode + ship one commit record. Returns True when it landed.
+        Never raises (the commit path calls this)."""
+        from urllib.error import HTTPError
+
+        record = peercheck.ReplicaRecord(
+            rank=rank, step=step, generation=int(self._generation_fn()),
+            world_size=world_size, payload=payload, has_params=has_params)
+        blob = peercheck.encode_record(record)
+        # SDC/chaos injection, one hit per publish: ``corrupt`` flips
+        # bits in the ENCODED blob (digest already stamped — the KV's
+        # install gate must 422 it with last-good left authoritative);
+        # every other mode keeps its ``fire`` semantics.
+        spec = (faults.active().get(faults.MODEL_PUBLISH)
+                if faults.armed(faults.MODEL_PUBLISH) else None)
+        if spec is not None and spec.mode == "corrupt":
+            blob = faults.corrupt_payload(faults.MODEL_PUBLISH, blob)
+        try:
+            if spec is not None and spec.mode != "corrupt" and \
+                    faults.fire(faults.MODEL_PUBLISH):
+                raise faults.InjectedFault(
+                    f"model publish dropped: rank {rank} step {step}")
+            client = self.client()
+            if client is None:
+                return False
+            client.put(MODELSTATE_SCOPE, str(rank), blob)
+        except HTTPError as e:
+            reason = "fenced" if e.code == 409 else "corrupt"
+            try:
+                _metrics.SERVE_REJECTED.labels(reason=reason).inc()
+                _metrics.event(
+                    "publish_fenced" if reason == "fenced"
+                    else "model_published",
+                    generation=record.generation, rank=rank, step=step,
+                    shipped=False, http_status=e.code)
+            except Exception:  # noqa: BLE001
+                pass
+            self._log.warning(
+                "serving: publish of step %d rejected by the KV "
+                "(HTTP %d): %s", step, e.code, e)
+            return False
+        except Exception as e:  # noqa: BLE001 — publish is best-effort
+            self._log.warning(
+                "serving: publish of step %d failed (%s); the serving "
+                "tier keeps last-good until the next commit", step, e)
+            return False
+        try:
+            _metrics.event(
+                "model_published", generation=record.generation,
+                rank=rank, step=step, bytes=len(blob), shipped=True,
+                world_size=world_size)
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+
+_publisher: ModelPublisher | None = None
+_publisher_lock = threading.Lock()
+
+
+def _get_publisher(generation_fn=None) -> ModelPublisher:
+    global _publisher
+    with _publisher_lock:
+        if _publisher is None:
+            _publisher = ModelPublisher(generation_fn=generation_fn)
+        return _publisher
+
+
+def maybe_publish_record(payload: bytes, step: int, rank: int,
+                         world_size: int, has_params: bool,
+                         generation_fn=None) -> bool:
+    """The ``PeerShardedState.commit`` hook: mirror the already-pickled
+    commit record (one shard row per rank, the exact bytes recovery
+    would assemble) to the modelstate scope. Inert unless
+    HOROVOD_SERVE_PUBLISH=1; never raises."""
+    if not publish_enabled():
+        return False
+    try:
+        return _get_publisher(generation_fn).publish(
+            payload, step=step, rank=rank, world_size=world_size,
+            has_params=has_params)
+    except Exception:  # noqa: BLE001 — the commit path must not feel this
+        return False
+
+
+def maybe_publish_model(params_host, step: int) -> bool:
+    """The monolithic (``TpuState.commit``) hook: publish the full host
+    params as a single-record commit (rank 0, world 1 — the degenerate
+    replica set). Only rank 0 publishes (every rank holds the same full
+    copy under allreduce). Inert unless HOROVOD_SERVE_PUBLISH=1; never
+    raises."""
+    if not publish_enabled():
+        return False
+    try:
+        if int(os.environ.get("HOROVOD_RANK", "0") or 0) != 0:
+            return False
+        payload = pickle.dumps({
+            "params": params_host,
+            "param_row": None,
+            "param_layout": "full",
+            "param_meta": None,
+            "row": None,
+            "layout": "none",
+            "extras": {},
+        })
+        return _get_publisher().publish(
+            payload, step=step, rank=0, world_size=1, has_params=True)
+    except Exception:  # noqa: BLE001 — the commit path must not feel this
+        return False
+
+
+# ---------------------------------------------------------------------------
+# The served model + RCU swap
+# ---------------------------------------------------------------------------
+
+class ServedModel:
+    """One immutable, fully-assembled model the request path reads via a
+    single reference — never mutated after construction (the RCU
+    contract: readers holding it keep a consistent world forever)."""
+
+    __slots__ = ("params", "generation", "step", "digest", "world_size",
+                 "bytes", "installed_t", "installed_wall")
+
+    def __init__(self, params, generation: int, step: int, digest: str,
+                 world_size: int, nbytes: int, installed_t: float,
+                 installed_wall: float):
+        self.params = params
+        self.generation = int(generation)
+        self.step = int(step)
+        self.digest = digest
+        self.world_size = int(world_size)
+        self.bytes = int(nbytes)
+        self.installed_t = installed_t
+        self.installed_wall = installed_wall
+
+    def identity(self) -> tuple[int, int]:
+        return (self.generation, self.step)
+
+    def summary(self) -> dict:
+        return {"generation": self.generation, "step": self.step,
+                "digest": self.digest, "world_size": self.world_size,
+                "bytes": self.bytes}
+
+
+class ModelServer:
+    """The serving tier's model holder: lock-free reads, fenced
+    RCU-style installs, honest staleness.
+
+    ``clock`` is injectable (monotonic seconds) so the dwell/storm/
+    staleness machinery is testable without sleeping.
+    """
+
+    def __init__(self, clock: Callable[[], float] | None = None):
+        self._clock = clock or time.monotonic
+        self._swap_lock = threading.Lock()
+        self._model: ServedModel | None = None  # the RCU pointer
+        self._swap_times: list[float] = []  # storm-breaker window
+        self._degraded = False  # serve_degraded latch
+        self._log = get_logger()
+
+    # -- the request path (zero locks) --------------------------------------
+
+    def current(self) -> ServedModel | None:
+        """The request path: ONE attribute read. CPython guarantees the
+        reference assignment in :meth:`install` is atomic, so a reader
+        sees either the old complete model or the new complete model —
+        never a mixture (the 100-swap hammer in tests/test_serving.py
+        asserts exactly this)."""
+        return self._model
+
+    # -- knobs ---------------------------------------------------------------
+
+    @staticmethod
+    def min_dwell() -> float:
+        """Seconds a model must serve before the next swap (0 = off)."""
+        return get_float("HOROVOD_SERVE_MIN_DWELL", 0.0)
+
+    @staticmethod
+    def storm_swaps() -> int:
+        """Swaps allowed per storm window before the breaker trips
+        (0 = off)."""
+        return get_int("HOROVOD_SERVE_STORM_SWAPS", 0)
+
+    @staticmethod
+    def storm_window() -> float:
+        return get_float("HOROVOD_SERVE_STORM_WINDOW", 10.0)
+
+    @staticmethod
+    def max_staleness() -> float:
+        """The bounded-staleness SLO: model age (seconds since install)
+        past which the tier declares itself degraded — while STILL
+        serving last-good (degrade, never 500). 0 disables."""
+        return get_float("HOROVOD_SERVE_MAX_STALENESS", 0.0)
+
+    # -- the install path ----------------------------------------------------
+
+    def _reject(self, reason: str, detail: str, **fields) -> bool:
+        try:
+            _metrics.SERVE_REJECTED.labels(reason=reason).inc()
+            if reason == "rollback":
+                _metrics.event("publish_fenced", reason=reason, **fields)
+        except Exception:  # noqa: BLE001
+            pass
+        self._log.warning("serving: install rejected (%s): %s",
+                          reason, detail)
+        return False
+
+    def install(self, params, generation: int, step: int, digest: str,
+                world_size: int = 1, nbytes: int = 0) -> bool:
+        """Atomically swap the served model. Returns True when the new
+        model is now being served. Fences, in order:
+
+        - **rollback**: (generation, step) below the served identity —
+          a zombie trainer can never roll the fleet backward (same
+          identity is a silent no-op: the subscriber re-assembling an
+          unchanged commit is steady state, not an error);
+        - **dwell**: the served model is younger than the min-dwell;
+        - **storm**: the breaker tripped for this window.
+        """
+        t0 = time.perf_counter()
+        if faults.fire(faults.SERVE_SWAP):
+            return self._reject(
+                "storm", f"swap dropped by fault injection at step {step}")
+        with self._swap_lock:
+            now = self._clock()
+            old = self._model
+            if old is not None:
+                if (generation, step) < old.identity():
+                    return self._reject(
+                        "rollback",
+                        f"({generation}, {step}) would roll back the "
+                        f"served model {old.identity()}",
+                        generation=generation, step=step,
+                        served_generation=old.generation,
+                        served_step=old.step)
+                if (generation, step) == old.identity():
+                    return False  # steady state: same commit re-assembled
+                dwell = self.min_dwell()
+                if dwell > 0 and now - old.installed_t < dwell:
+                    return self._reject(
+                        "dwell",
+                        f"served model is {now - old.installed_t:.3f}s "
+                        f"old < min dwell {dwell}s")
+            limit = self.storm_swaps()
+            if limit > 0:
+                window = self.storm_window()
+                self._swap_times = [t for t in self._swap_times
+                                    if now - t < window]
+                if len(self._swap_times) >= limit:
+                    return self._reject(
+                        "storm",
+                        f"{len(self._swap_times)} swaps in the last "
+                        f"{window}s (limit {limit})")
+                self._swap_times.append(now)
+            model = ServedModel(
+                params, generation=generation, step=step, digest=digest,
+                world_size=world_size, nbytes=nbytes, installed_t=now,
+                installed_wall=time.time())
+            self._model = model  # the RCU flip: one atomic reference set
+            self._degraded = False  # fresh model: re-arm the SLO latch
+        dt = time.perf_counter() - t0
+        try:
+            _metrics.SERVE_SWAPS.inc()
+            _metrics.SERVE_SWAP_SECONDS.observe(dt)
+            _metrics.SERVE_MODEL_AGE.set(0.0)
+            _metrics.event(
+                "model_swapped", generation=generation, step=step,
+                digest=digest, world_size=world_size, bytes=nbytes,
+                swap_seconds=dt)
+        except Exception:  # noqa: BLE001
+            pass
+        return True
+
+    # -- staleness SLO -------------------------------------------------------
+
+    def age_seconds(self) -> float | None:
+        model = self._model
+        if model is None:
+            return None
+        return max(0.0, self._clock() - model.installed_t)
+
+    def tick_staleness(self) -> bool:
+        """Refresh the age gauge and latch ``serve_degraded`` once per
+        degradation episode (re-armed by the next install). Returns the
+        current degraded verdict. Called by the subscriber on every poll
+        — including failed ones, which is exactly when it matters."""
+        age = self.age_seconds()
+        if age is None:
+            return False
+        try:
+            _metrics.SERVE_MODEL_AGE.set(age)
+        except Exception:  # noqa: BLE001
+            pass
+        slo = self.max_staleness()
+        if slo <= 0 or age <= slo:
+            return False
+        if not self._degraded:
+            self._degraded = True
+            model = self._model
+            try:
+                _metrics.event(
+                    "serve_degraded", age_seconds=age, max_staleness=slo,
+                    generation=model.generation, step=model.step)
+            except Exception:  # noqa: BLE001
+                pass
+            self._log.warning(
+                "serving: model age %.1fs exceeds the staleness SLO "
+                "%.1fs; serving last-good (generation %d, step %d)",
+                age, slo, model.generation, model.step)
+        return True
+
+    def health(self) -> dict:
+        """The ``GET /model`` body of the inference server: status +
+        identity + age — never raises, never 500s."""
+        model = self._model
+        age = self.age_seconds()
+        degraded = self.tick_staleness()
+        out = {
+            "status": ("no_model" if model is None
+                       else "degraded" if degraded else "ok"),
+            "age_seconds": age,
+            "model": None if model is None else model.summary(),
+        }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Subscriber — KV scope → assembled model → install
+# ---------------------------------------------------------------------------
+
+class ModelSubscriber:
+    """Pulls the ``modelstate`` scope, assembles, installs.
+
+    The pull side mirrors ``PeerReplicator.fetch_all``: every record
+    lands in a local :class:`~horovod_tpu.peercheck.ReplicaPool` first
+    (verify-then-rotate, ``.prev`` retained), so a commit wave the
+    trainer half-landed before dying completes from the retained slots —
+    the subscriber can assemble a model the KV alone no longer holds
+    whole. Integrity-condemned replicas are filtered with the SAME
+    condemned-range math as recovery (``peercheck.assemble_records``).
+    """
+
+    def __init__(self, server: ModelServer, client=None,
+                 scope: str | None = None):
+        self.server = server
+        self._client = client
+        self.scope = scope or os.environ.get(
+            "HOROVOD_SERVE_SCOPE", MODELSTATE_SCOPE)
+        self.pool = peercheck.ReplicaPool()
+        self._quarantine: Mapping[str, Mapping] = {}
+        self._log = get_logger()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def client(self):
+        if self._client is None:
+            addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+            port = os.environ.get("HOROVOD_RENDEZVOUS_PORT", "")
+            if not addr or not port:
+                return None
+            from .runner.http.kv_server import KVClient
+
+            self._client = KVClient(
+                addr, int(port),
+                timeout=get_float("HOROVOD_SERVE_FETCH_TIMEOUT", 5.0),
+                retries=1)
+        return self._client
+
+    @staticmethod
+    def poll_seconds() -> float:
+        return get_float("HOROVOD_SERVE_POLL_SECONDS", 0.5)
+
+    # -- one poll ------------------------------------------------------------
+
+    def _fetch_records(self) -> list:
+        """KV scope → verified records (pool-installed current slots +
+        every retained slot), with bounded retry on the scope listing —
+        an exhausted budget journals ``retry_budget_exhausted`` and
+        degrades to whatever the pool already holds."""
+        client = self.client()
+        if client is None:
+            return list(self.pool.records())
+        if faults.fire(faults.SERVE_FETCH):
+            raise faults.InjectedFault("serve fetch dropped")
+        keys = call_with_retries(
+            lambda: client.keys(self.scope),
+            attempts=get_int("HOROVOD_SERVE_FETCH_RETRIES", 3),
+            base_delay=0.05, name="serve.fetch")
+        prevs: list = []
+        for key in keys:
+            try:
+                blob = client.get(self.scope, key)
+                if blob is None:
+                    continue
+                if key.endswith(peercheck.PREV_SUFFIX):
+                    # The KV's retained slots complete a half-landed
+                    # wave for a FRESH subscriber too — read, verify,
+                    # but never pool-install (that would rotate the
+                    # pool's own current slots away).
+                    prevs.append(peercheck.decode_record(blob, verify=True))
+                else:
+                    self.pool.install(blob)
+            except peercheck.ReplicaCorruptError as e:
+                self._log.error(
+                    "serving: record %r failed verification: %s", key, e)
+            except Exception as e:  # noqa: BLE001 — per-key best-effort
+                self._log.debug(
+                    "serving: record %r fetch failed: %s", key, e)
+        return list(self.pool.records()) + prevs
+
+    def _refresh_quarantine(self, client) -> Mapping[str, Mapping]:
+        """Best-effort integrity view, caching the last good answer —
+        an unreachable server must not un-condemn anything."""
+        if client is None:
+            return self._quarantine
+        try:
+            view = client.integrity_view()
+            quarantine = view.get("quarantined")
+            if isinstance(quarantine, Mapping):
+                self._quarantine = quarantine
+        except Exception:  # noqa: BLE001 — keep the cached view
+            pass
+        return self._quarantine
+
+    def poll_once(self) -> bool:
+        """One subscribe→assemble→install cycle. Returns True when a NEW
+        model was installed. Any failure leaves the served model alone
+        (serve last-good) and still ticks the staleness SLO."""
+        installed = False
+        try:
+            records = self._fetch_records()
+            client = self._client  # whatever _fetch_records resolved
+            quarantine = self._refresh_quarantine(client)
+            generation = None
+            if client is not None:
+                try:
+                    generation = int(client.world_version())
+                except Exception:  # noqa: BLE001
+                    generation = None
+            if generation is None:
+                generation = max(
+                    (r.generation for r in records), default=0)
+            members = peercheck.assemble_records(
+                records, generation, quarantine=quarantine,
+                log=self._log)
+            current = self.server.current()
+            if (current is not None
+                    and (members[0].generation, members[0].step)
+                    <= current.identity()):
+                return False  # nothing newer: steady state, not a swap
+            from . import checkpoint as _checkpoint
+
+            payloads = [pickle.loads(r.payload) for r in members]
+            params, _template = _checkpoint.assemble_full_params(payloads)
+            installed = self.server.install(
+                params,
+                generation=members[0].generation,
+                step=members[0].step,
+                digest=peercheck.replica_set_digest(members),
+                world_size=members[0].world_size,
+                nbytes=sum(len(r.payload) for r in members))
+        except peercheck.ReplicaUnavailableError as e:
+            self._log.debug("serving: no assemblable model yet: %s", e)
+        except Exception as e:  # noqa: BLE001 — the loop must survive
+            self._log.warning("serving: poll failed (%s); serving "
+                              "last-good", e)
+        finally:
+            self.server.tick_staleness()
+        return installed
+
+    # -- the loop ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="hvd-serve-subscriber", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.poll_once()
+            self._stop.wait(self.poll_seconds())
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
+def reset_for_testing() -> None:
+    """Drop the cached publisher singleton (tests re-point the KV)."""
+    global _publisher
+    with _publisher_lock:
+        _publisher = None

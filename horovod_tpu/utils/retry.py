@@ -2,9 +2,16 @@
 
 The one retry policy the control plane shares: rendezvous KV requests
 (``runner/http/kv_server.py — KVClient``), durable checkpoint writes
-(``checkpoint.py``), and anything else that talks to a service that can
+(``checkpoint.py``), the serving subscriber's scope polls
+(``serving.py``), and anything else that talks to a service that can
 blip. Bounded by construction — the unbounded-silent-retry loops this
 replaces are exactly what let a dead driver hang a worker forever.
+
+Exhaustion is observable: when the attempt budget (or ``deadline_s``)
+runs out, a ``retry_budget_exhausted`` record lands in the lifecycle
+journal before the final exception propagates — a subscriber loop that
+silently gives up is precisely the dark failure the serving tier's
+staleness SLO must be able to explain.
 """
 
 from __future__ import annotations
@@ -14,6 +21,32 @@ import time
 from typing import Callable, Iterable, TypeVar
 
 T = TypeVar("T")
+
+
+def backoff_delay(attempt: int, base_delay: float, max_delay: float,
+                  jitter: float) -> float:
+    """The delay before attempt ``attempt + 1`` (attempts are 1-based):
+    ``min(max_delay, base_delay * 2**(attempt-1))`` scaled by a uniform
+    ``1 ± jitter`` factor, floored at 0. The cap applies BEFORE jitter,
+    so the worst-case sleep is ``max_delay * (1 + jitter)`` — a bounded,
+    testable envelope (see tests/test_faults.py's property tests)."""
+    delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+    return max(0.0, delay * (1.0 + random.uniform(-jitter, jitter)))
+
+
+def _note_exhausted(name: str | None, attempts: int,
+                    error: BaseException, deadline: bool) -> None:
+    """Journal one ``retry_budget_exhausted`` event (best-effort — the
+    observability must never mask the exception about to propagate)."""
+    try:
+        from .. import metrics
+
+        metrics.event(
+            "retry_budget_exhausted", name=name or "",
+            attempts=attempts, deadline=deadline,
+            error=str(error)[:200])
+    except Exception:  # noqa: BLE001 — journaling never blocks the raise
+        pass
 
 
 def call_with_retries(
@@ -27,6 +60,7 @@ def call_with_retries(
     give_up_on: tuple[type[BaseException], ...] = (),
     deadline_s: float | None = None,
     on_retry: Callable[[int, BaseException], None] | None = None,
+    name: str | None = None,
 ) -> T:
     """Call ``fn`` up to ``attempts`` times.
 
@@ -35,6 +69,9 @@ def call_with_retries(
     of workers hammering a recovering driver). ``give_up_on`` exceptions
     propagate immediately (e.g. an HTTP 404 is an answer, not a blip);
     ``deadline_s`` bounds total wall time regardless of attempts left.
+    ``name`` labels the ``retry_budget_exhausted`` journal record emitted
+    when the budget runs out (give-up answers emit nothing: they are
+    answers, not exhaustion).
     """
     if attempts < 1:
         raise ValueError("attempts must be >= 1")
@@ -46,9 +83,11 @@ def call_with_retries(
             raise
         except retry_on as e:
             if attempt >= attempts:
+                _note_exhausted(name, attempt, e, deadline=False)
                 raise
             if deadline_s is not None and \
                     time.monotonic() - start >= deadline_s:
+                _note_exhausted(name, attempt, e, deadline=True)
                 raise
             try:
                 from .. import metrics
@@ -58,9 +97,8 @@ def call_with_retries(
                 pass
             if on_retry is not None:
                 on_retry(attempt, e)
-            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
-            delay *= 1.0 + random.uniform(-jitter, jitter)
-            time.sleep(max(0.0, delay))
+            time.sleep(backoff_delay(attempt, base_delay, max_delay,
+                                     jitter))
     raise AssertionError("unreachable")
 
 
@@ -85,5 +123,4 @@ def iter_backoff(
 ) -> Iterable[float]:
     """The bare delay schedule (for loops that retry inline)."""
     for attempt in range(1, attempts):
-        delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
-        yield max(0.0, delay * (1.0 + random.uniform(-jitter, jitter)))
+        yield backoff_delay(attempt, base_delay, max_delay, jitter)

@@ -33,7 +33,12 @@ from horovod_tpu.runner.http.kv_server import (
     KVClient,
     RendezvousServer,
 )
-from horovod_tpu.utils.retry import call_with_retries, iter_backoff, retrying
+from horovod_tpu.utils.retry import (
+    backoff_delay,
+    call_with_retries,
+    iter_backoff,
+    retrying,
+)
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -195,6 +200,104 @@ class TestRetryHelper:
     def test_backoff_schedule_is_bounded(self):
         delays = list(iter_backoff(6, base_delay=0.1, max_delay=0.4, jitter=0))
         assert delays == [0.1, 0.2, 0.4, 0.4, 0.4]
+
+
+class TestBackoffProperties:
+    """Property tests of the backoff envelope: every delay the policy
+    can emit lives inside a bounded, computable window — the fleet can
+    never sleep longer than ``max_delay * (1 + jitter)``."""
+
+    def test_jitter_stays_inside_the_envelope(self):
+        for attempt in (1, 2, 3, 7, 20):
+            for jitter in (0.0, 0.25, 0.5, 1.0):
+                nominal = min(2.0, 0.1 * (2 ** (attempt - 1)))
+                lo = max(0.0, nominal * (1.0 - jitter))
+                hi = nominal * (1.0 + jitter)
+                for _ in range(200):
+                    d = backoff_delay(attempt, base_delay=0.1,
+                                      max_delay=2.0, jitter=jitter)
+                    assert lo <= d <= hi, (attempt, jitter, d)
+
+    def test_cap_applies_before_jitter(self):
+        """Even at absurd attempt counts the worst case is exactly
+        ``max_delay * (1 + jitter)`` — the cap bounds the base, jitter
+        scales the capped value, never the exponential."""
+        worst = 0.5 * (1.0 + 0.5)
+        for _ in range(500):
+            d = backoff_delay(50, base_delay=0.1, max_delay=0.5,
+                              jitter=0.5)
+            assert d <= worst + 1e-9
+            assert d >= 0.5 * (1.0 - 0.5) - 1e-9
+
+    def test_never_negative(self):
+        for _ in range(500):
+            assert backoff_delay(1, base_delay=0.001, max_delay=5.0,
+                                 jitter=1.0) >= 0.0
+
+    def test_growth_is_monotone_below_the_cap(self):
+        series = [backoff_delay(a, base_delay=0.1, max_delay=100.0,
+                                jitter=0.0) for a in range(1, 8)]
+        assert series == sorted(series)
+        assert series[0] == pytest.approx(0.1)
+        assert series[-1] == pytest.approx(0.1 * 2 ** 6)
+
+
+class TestRetryBudgetJournal:
+    """Exhaustion is observable: the ``retry_budget_exhausted`` record
+    lands in the lifecycle journal just before the final raise — and
+    ONLY on exhaustion, never on a give-up answer."""
+
+    def _events(self, path):
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+
+    def test_attempt_budget_exhaustion_journaled(self, monkeypatch,
+                                                 tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        with pytest.raises(OSError):
+            call_with_retries(lambda: (_ for _ in ()).throw(OSError("x")),
+                              attempts=3, base_delay=0.001,
+                              name="unit.op")
+        events = [e for e in self._events(str(log))
+                  if e["event"] == "retry_budget_exhausted"]
+        assert len(events) == 1
+        assert events[0]["name"] == "unit.op"
+        assert events[0]["attempts"] == 3
+        assert events[0]["deadline"] is False
+        assert "x" in events[0]["error"]
+
+    def test_deadline_exhaustion_journaled(self, monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise OSError("blip")
+
+        with pytest.raises(OSError):
+            call_with_retries(flaky, attempts=100, base_delay=0.0,
+                              deadline_s=0.0, name="unit.deadline")
+        assert len(calls) == 1  # the deadline cut 99 attempts short
+        events = [e for e in self._events(str(log))
+                  if e["event"] == "retry_budget_exhausted"]
+        assert len(events) == 1
+        assert events[0]["deadline"] is True
+        assert events[0]["name"] == "unit.deadline"
+
+    def test_give_up_answers_emit_nothing(self, monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        with pytest.raises(KeyError):
+            call_with_retries(
+                lambda: (_ for _ in ()).throw(KeyError("an answer")),
+                attempts=5, base_delay=0.001, give_up_on=(KeyError,),
+                name="unit.answer")
+        assert [e for e in self._events(str(log))
+                if e["event"] == "retry_budget_exhausted"] == []
 
 
 # -- KV client retries against a real rendezvous server ----------------------

