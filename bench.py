@@ -145,7 +145,7 @@ class _Emitter:
 
 def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
                 overlap_spec=None, sharded_spec=None, fsdp_spec=None,
-                world_size=None):
+                world_size=None, mesh2d_shape=None):
     """sync_grads: None when `optimizer` already syncs (DistributedOptimizer);
     for the raw baseline it is the hand-written pmean a correct hand-rolled
     DP step must do, so both sides do equivalent communication work.
@@ -166,7 +166,13 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
     full tensors are allgathered just in time in the forward, gradients
     reduce-scatter inside backprop at the gather boundaries, and the
     shard-local update writes back to the resident rows with no
-    trailing allgather."""
+    trailing allgather.
+
+    mesh2d_shape: a (batch, model) pair switches the fsdp wire to the
+    2-D mesh — ``mesh`` must then be the named (batch, model) mesh,
+    rows ride P(("model", "batch")), the batch rides P(("batch",
+    "model")) (flat rank order), and the gather takes the two-leg
+    rank-factorized form (``gather_params_2d``)."""
     import jax
     import optax
     from jax.sharding import PartitionSpec as P
@@ -175,7 +181,10 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
         x, y = batch
 
         if fsdp_spec is not None:
-            from horovod_tpu.parallel.param_sharding import gather_params
+            from horovod_tpu.parallel.param_sharding import (
+                gather_params,
+                gather_params_2d,
+            )
 
             meta = params.meta
             shards = jax.tree.unflatten(
@@ -183,8 +192,13 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
             local_state = jax.tree.map(lambda a: a[0], opt_state)
 
             def loss_of_shards(sh):
-                full = gather_params(sh, meta, fsdp_spec, axis_name,
-                                     int(world_size))
+                if mesh2d_shape is not None:
+                    full = gather_params_2d(
+                        sh, meta, fsdp_spec,
+                        int(mesh2d_shape[0]), int(mesh2d_shape[1]))
+                else:
+                    full = gather_params(sh, meta, fsdp_spec, axis_name,
+                                         int(world_size))
                 logits, updated = model.apply(
                     {"params": full, "batch_stats": batch_stats},
                     x, train=True, mutable=["batch_stats"])
@@ -235,13 +249,20 @@ def _build_step(model, optimizer, mesh, axis_name, loss_fn, sync_grads=None,
         return new_params, new_stats, new_opt, loss
 
     sharded_state = sharded_spec is not None or fsdp_spec is not None
-    opt_spec = P(axis_name) if sharded_state else P()
-    param_spec = P(axis_name) if fsdp_spec is not None else P()
+    if mesh2d_shape is not None:
+        from horovod_tpu.parallel.mesh import MESH2D_AXES, MESH2D_ROW_AXES
+
+        opt_spec = param_spec = P(MESH2D_ROW_AXES)
+        batch_spec = P(MESH2D_AXES)
+    else:
+        opt_spec = P(axis_name) if sharded_state else P()
+        param_spec = P(axis_name) if fsdp_spec is not None else P()
+        batch_spec = P(axis_name)
     return jax.jit(
         jax.shard_map(
             spmd_step,
             mesh=mesh,
-            in_specs=(param_spec, P(), opt_spec, P(axis_name)),
+            in_specs=(param_spec, P(), opt_spec, batch_spec),
             out_specs=(param_spec, P(), opt_spec, P()),
             check_vma=False,
         ),
@@ -855,6 +876,59 @@ def main() -> int:
                 record["fsdp_prefetch_overlap_ratio"] = round(ratio, 4)
             record["param_gather_probe_ms"] = round(t_gather * 1e3, 3)
             emit.update(**record)
+
+    # --- section 4c3: the 2-D (batch, model) fsdp wire, machinery-forced
+    # — the SAME rank-factorized resident row layout (byte parity with
+    # the 1-D rows is exact by the ceil identity, so the gate asserts
+    # <=), but the parameter gather splits into two legs: the bucketed
+    # batch-axis gather moves ~1/model of the 1-D gather bytes
+    # (hvd_param_gather_bytes{axis="batch"}) and the model-axis
+    # all_gather rides the short-hop contiguous-rank links.
+    def run_fsdp_2d():
+        from horovod_tpu.parallel import param_sharding
+        from horovod_tpu.parallel.mesh import mesh_2d
+
+        b2, m2 = n // 2, 2
+        with _forced_wire():
+            fsdp_opt = hvd.DistributedOptimizer(
+                optax.sgd(0.1, momentum=0.9),
+                compression=(hvd.Compression.bf16 if on_tpu
+                             else hvd.Compression.none),
+                sync_mode="fsdp",
+            )
+            spec = hvd.reduce_spec_of(fsdp_opt)
+            mesh2 = mesh_2d(b2, m2)
+            step = _build_step(model, fsdp_opt, mesh2, None, loss_fn,
+                               fsdp_spec=spec, world_size=n,
+                               mesh2d_shape=(b2, m2))
+            sp = hvd.shard_params(params, n)
+            stacked = fsdp_opt.init(params)
+            resident = {
+                "params": param_sharding.resident_param_bytes(sp),
+                "opt_state": _tree_bytes(stacked) // max(1, n),
+            }
+            state = (
+                hvd.data_parallel.shard_state(sp, mesh=mesh2),
+                hvd.data_parallel.replicate(batch_stats, mesh=mesh2),
+                hvd.data_parallel.shard_state(stacked, mesh=mesh2),
+            )
+            batch2 = hvd.data_parallel.shard_batch((x, y), mesh=mesh2)
+            timed = _time_steps(step, state, batch2, **timing)
+            return timed, resident
+
+    if raw is not None and n >= 4 and n % 2 == 0 and not out_of_time():
+        fsdp_2d = _with_retry("resnet_fsdp_2d", run_fsdp_2d, errors,
+                              allow_retry=single_controller)
+        if fsdp_2d is not None:
+            (t_2d, _), resident_2d = fsdp_2d
+            resident_by_mode = dict(
+                emit.record.get("resident_bytes_per_rank") or {})
+            resident_by_mode["fsdp_2d"] = (
+                resident_2d["params"] + resident_2d["opt_state"])
+            emit.update(
+                vs_baseline_machinery_fsdp_2d=round(raw[0] / t_2d, 4),
+                resident_bytes_per_rank=resident_by_mode,
+            )
 
     # --- section 4d: per-phase step-time breakdown — forward_backward /
     # collective / optimizer_update medians (the attribution plane's

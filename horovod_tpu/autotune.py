@@ -83,8 +83,8 @@ class BayesianTuner:
 # step per candidate threshold, times a few steps, and pins the winner.
 
 _tuned: dict = {"threshold": None, "segments": None, "sync_mode": None,
-                "algorithm": None, "aborted": False, "history": [],
-                "pruned": []}
+                "algorithm": None, "mesh_shape": None, "aborted": False,
+                "history": [], "pruned": []}
 
 
 def model_guided_enabled() -> bool:
@@ -208,6 +208,36 @@ def set_tuned_sync_mode(sync_mode: str | None) -> None:
     _tuned["sync_mode"] = sync_mode
 
 
+def tuned_mesh_shape() -> tuple[int, int] | None:
+    """The pinned 2-D ``(batch, model)`` mesh shape (None = untuned;
+    the ``HOROVOD_MESH_SHAPE`` env and explicit ``mesh=`` factory
+    arguments rule). Consulted by
+    ``parallel.mesh.resolve_mesh_shape`` at step-factory CONSTRUCTION —
+    like the sync_mode axis, the shape fixes the state layout's device
+    placement, so a pin only affects steps built after it lands."""
+    return _tuned["mesh_shape"]
+
+
+def set_tuned_mesh_shape(mesh_shape: tuple[int, int] | None) -> None:
+    """Pin (or clear, with None) the 2-D training-mesh shape. Loses to
+    ``HOROVOD_MESH_SHAPE`` and explicit ``mesh=`` factory arguments in
+    ``parallel.mesh.resolve_mesh_shape``."""
+    if mesh_shape is None:
+        _tuned["mesh_shape"] = None
+        return
+    try:
+        b, m = (int(v) for v in mesh_shape)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh_shape must be a (batch, model) pair of ints, got "
+            f"{mesh_shape!r}") from None
+    if m < 1 or (b < 1 and b != -1):
+        raise ValueError(
+            f"mesh_shape axes must be positive (batch may be -1 to "
+            f"infer), got {mesh_shape!r}")
+    _tuned["mesh_shape"] = (b, m)
+
+
 def autotune_state() -> dict:
     """Introspection (parity: the native ``hvdrt_autotune_state``): the
     live threshold, whether a tuned decision is pinned, and the measured
@@ -220,6 +250,7 @@ def autotune_state() -> dict:
         "overlap_segments": _tuned["segments"],
         "sync_mode": _tuned["sync_mode"],
         "algorithm": _tuned["algorithm"],
+        "mesh_shape": _tuned["mesh_shape"],
         "samples": len(_tuned["history"]),
         "history": list(_tuned["history"]),
         "pruned": list(_tuned["pruned"]),
@@ -644,9 +675,10 @@ def maybe_autotune_step(jitted, segment_candidates=None,
 
 
 def tune_step_sync_mode(
-    build_step: Callable[[str], Callable[[], Any]],
+    build_step: Callable[..., Callable[[], Any]],
     sync_modes: Sequence[str] = ("allreduce", "sharded", "fsdp"),
     iters: int = 3,
+    mesh_shapes: Sequence[tuple[int, int] | None] | None = None,
 ) -> str:
     """Explicit warmup tuning of the gradient sync mode.
 
@@ -680,6 +712,16 @@ def tune_step_sync_mode(
     The fastest eligible mode is pinned via :func:`set_tuned_sync_mode`
     (so optimizers built afterwards with ``sync_mode=None`` inherit it)
     and returned.
+
+    ``mesh_shapes`` joins the 2-D training-mesh shape into the grid: the
+    sweep then measures the cross product ``sync_modes × mesh_shapes``
+    (a ``None`` shape = the flat 1-D wire) and ``build_step`` is called
+    with TWO arguments, ``build_step(mode, shape)``. The winning pair is
+    pinned via :func:`set_tuned_sync_mode` AND
+    :func:`set_tuned_mesh_shape`; abort semantics pin the rank-identical
+    first eligible (mode, shape) pair on both axes. Without
+    ``mesh_shapes`` the signature and pins are exactly the historical
+    single-axis ones.
     """
     import time as _time
 
@@ -688,18 +730,28 @@ def tune_step_sync_mode(
     from .exceptions import SyncModeIneligibleError
 
     log = get_logger()
-    results: list[tuple[str, float]] = []
-    skipped: set[str] = set()
+    joint = mesh_shapes is not None
+    shapes: Sequence[tuple[int, int] | None] = (
+        tuple(mesh_shapes) if joint else (None,))
+    grid = [(mode, shape) for mode in sync_modes for shape in shapes]
+    results: list[tuple[tuple[str, tuple[int, int] | None], float]] = []
+    skipped: set[tuple[str, tuple[int, int] | None]] = set()
+
+    def _label(mode, shape):
+        if not joint:
+            return repr(mode)
+        return f"{mode!r} x {shape[0]}x{shape[1]}" if shape else f"{mode!r} x flat"
+
     try:
-        for mode in sync_modes:
+        for mode, shape in grid:
             try:
-                run = build_step(mode)
+                run = build_step(mode, shape) if joint else build_step(mode)
                 out = run()  # compile + settle
             except SyncModeIneligibleError as e:
                 log.warning(
-                    "autotune sync_mode: %r ineligible for this job "
-                    "(%s); skipped", mode, e)
-                skipped.add(mode)
+                    "autotune sync_mode: %s ineligible for this job "
+                    "(%s); skipped", _label(mode, shape), e)
+                skipped.add((mode, shape))
                 continue
             jax.block_until_ready(out)
             t0 = _time.perf_counter()
@@ -707,29 +759,35 @@ def tune_step_sync_mode(
                 out = run()
             jax.block_until_ready(out)
             seconds = (_time.perf_counter() - t0) / max(1, iters)
-            results.append((mode, seconds))
+            results.append(((mode, shape), seconds))
             _record_trial("sync_mode", seconds)
-            log.info("autotune sync_mode: %s -> %.6fs/step", mode, seconds)
+            log.info("autotune sync_mode: %s -> %.6fs/step",
+                     _label(mode, shape), seconds)
     except Exception:
         # Pin the first candidate NOT already proven ineligible — a
         # skipped mode would crash every later sync_mode=None
         # construction on its own guard. Skipping is a deterministic
         # function of the job's static config, so this choice stays
         # rank-identical.
-        fallback = next((m for m in sync_modes if m not in skipped),
-                        sync_modes[0])
-        set_tuned_sync_mode(fallback)
+        fb_mode, fb_shape = next(
+            (c for c in grid if c not in skipped), grid[0])
+        set_tuned_sync_mode(fb_mode)
+        if joint:
+            set_tuned_mesh_shape(fb_shape)
         log.warning(
             "autotune sync_mode: aborted mid-sweep; pinned the "
-            "rank-identical first eligible candidate %r", fallback)
+            "rank-identical first eligible candidate %s",
+            _label(fb_mode, fb_shape))
         raise
     if not results:
         raise ValueError(
-            f"autotune sync_mode: every candidate in {tuple(sync_modes)} "
+            f"autotune sync_mode: every candidate in {tuple(grid)} "
             "was ineligible for this job (see the skip warnings above)")
-    best = min(results, key=lambda p: p[1])[0]
+    (best, best_shape) = min(results, key=lambda p: p[1])[0]
     set_tuned_sync_mode(best)
-    log.info("autotune sync_mode: pinned %r", best)
+    if joint:
+        set_tuned_mesh_shape(best_shape)
+    log.info("autotune sync_mode: pinned %s", _label(best, best_shape))
     return best
 
 

@@ -427,9 +427,13 @@ def load_state_and_broadcast(path: str, optimizer, root_rank: int = 0,
     ``optimizer`` was built with ``sync_mode='sharded'`` — the optimizer
     state is re-sharded for the CURRENT world (ownership is a pure
     function of the world size and parameter shapes, so a checkpoint
-    written at N ranks restores cleanly at M). Returns the state dict
-    (``params`` / ``opt_state`` / extras) or None when no checkpoint is
-    readable."""
+    written at N ranks restores cleanly at M). A ``mesh_shape`` extra
+    (saved by a 2-D mesh job) is likewise re-fitted to the current
+    world: the model axis is kept when it still divides, else the shape
+    collapses to the flat ``(n, 1)`` — the on-disk layout itself is
+    mesh-shape independent either way (gather-on-save). Returns the
+    state dict (``params`` / ``opt_state`` / extras) or None when no
+    checkpoint is readable."""
     from .optimizer import reduce_spec_of, reshard_opt_state
     from .parallel.param_sharding import shard_params
 
@@ -450,7 +454,32 @@ def load_state_and_broadcast(path: str, optimizer, root_rank: int = 0,
             # (gather-on-save); re-shard into the resident rows for the
             # CURRENT world — cross-mode and cross-size resume both ways.
             obj["params"] = shard_params(obj["params"], n)
+    if obj.get("mesh_shape") is not None:
+        from . import basics
+
+        n = world_size
+        if n is None:
+            n = (spec.process_set.size() if spec is not None
+                 else basics.size())
+        obj = dict(obj)
+        obj["mesh_shape"] = _refit_mesh_shape(obj["mesh_shape"], n)
     return obj
+
+
+def _refit_mesh_shape(shape, n: int) -> tuple[int, int]:
+    """Re-fit a checkpointed (batch, model) shape to ``n`` ranks: keep
+    the model axis only when the batch axis shrinks cleanly (model
+    divides ``n`` and the old batch count is a multiple of the new
+    one — nested data-parallel groups), else collapse flat (with a
+    warning). Mirrors ``TpuState._revalidate_mesh_shape``."""
+    b, m = (int(v) for v in shape)
+    if m >= 1 and n % m == 0 and b % (n // m) == 0:
+        return (n // m, m)
+    get_logger().warning(
+        "checkpoint mesh_shape %dx%d cannot be refactored with nested "
+        "batch groups onto %d rank(s); resuming on the flat "
+        "(%d, 1) mesh", b, m, n, n)
+    return (n, 1)
 
 
 def load_and_broadcast(path: str, root_rank: int = 0) -> Any:

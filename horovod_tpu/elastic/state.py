@@ -194,10 +194,26 @@ class TpuState(State):
     """
 
     def __init__(self, params=None, opt_state=None, sharded_optimizer=None,
-                 **extras):
+                 mesh_shape=None, **extras):
         super().__init__()
         self.params = params
         self.opt_state = opt_state
+        if mesh_shape is not None:
+            try:
+                b, m = (int(v) for v in mesh_shape)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"mesh_shape must be a (batch, model) pair of "
+                    f"positive ints, got {mesh_shape!r}") from None
+            if b < 1 or m < 1:
+                raise ValueError(
+                    f"mesh_shape must be a (batch, model) pair of "
+                    f"positive ints, got {mesh_shape!r}")
+            mesh_shape = (b, m)
+            # First-class extra: rides commit/restore snapshots and the
+            # sync() broadcast like any user extra, then gets
+            # re-validated against the NEW world (see sync()).
+            extras = {"mesh_shape": mesh_shape, **extras}
         self._sharded_spec = None
         if sharded_optimizer is not None:
             from ..optimizer import ReduceSpec, reduce_spec_of
@@ -374,8 +390,43 @@ class TpuState(State):
         extras = broadcast_object({k: getattr(self, k) for k in self._extras})
         for k, v in extras.items():
             setattr(self, k, v)
+        self._revalidate_mesh_shape()
         self._sync_commit_counter()
         self.commit()
+
+    def _revalidate_mesh_shape(self) -> None:
+        """Re-fit the tracked 2-D ``(batch, model)`` mesh shape to the
+        NEW world after a resize: keep the model axis only when the
+        batch axis shrinks CLEANLY — the model axis still divides the
+        new world AND the old batch group count is a multiple of the new
+        one (8x2 -> 16 ranks -> 8 ranks gives 4x2, nested halving).
+        A non-nested refactor (4x2 -> 6 ranks would be 3x2, and 4 % 3
+        != 0) scrambles the batch-axis group structure that bucket
+        thresholds, peer rung assignment, and autotune pins are keyed
+        to, so it collapses to the flat ``(n, 1)`` mesh with a warning.
+        Runs after the extras broadcast, so every rank recomputes from
+        rank-0's value and the same world size — rank-identical by
+        construction. Shard ownership was already re-derived from the
+        new world either way (the rank-factorized row layout is
+        mesh-shape independent); this only steers the step factories
+        built after the reset."""
+        shape = getattr(self, "mesh_shape", None)
+        if shape is None or "mesh_shape" not in self._extras:
+            return
+        n = self._sync_world_size()
+        b, m = (int(v) for v in shape)
+        if m >= 1 and n % m == 0 and b % (n // m) == 0:
+            self.mesh_shape = (n // m, m)
+            return
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "elastic resize to %d rank(s): the %dx%d mesh cannot be "
+            "refactored with nested batch groups (model axis must "
+            "divide %d and the old batch count %d must be a multiple "
+            "of the new one); mesh_shape collapses to the flat "
+            "(%d, 1) mesh", n, b, m, n, b, n)
+        self.mesh_shape = (n, 1)
 
     def _sync_commit_counter(self) -> None:
         """Re-align the commit counter across the re-formed world (the

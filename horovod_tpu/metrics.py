@@ -686,8 +686,10 @@ PEER_POOL_REPLICAS = gauge(
 PARAM_GATHER_BYTES = histogram(
     "hvd_param_gather_bytes",
     "Wire bytes per traced fsdp parameter-gather program segment "
-    "(post-compression view; one observation per TRACE, not per step).",
-    (), BYTE_BUCKETS)
+    "(post-compression view; one observation per TRACE, not per step), "
+    "by mesh axis: 'batch' is the bucketed data-axis leg (the flat 1-D "
+    "wire records here too), 'model' the intra-layer ICI leg of the 2-D "
+    "mesh.", ("axis",), BYTE_BUCKETS)
 PARAM_GATHER_SECONDS = histogram(
     "hvd_param_gather_seconds",
     "Wall time of a standalone fsdp parameter-gather program (the bench "
@@ -702,6 +704,11 @@ FSDP_PREFETCH_OVERLAP = gauge(
     "Fraction of the fsdp parameter-gather time hidden under compute "
     "(gather time hidden / total gather time), derived from the bench "
     "phase probes and tracing spans.")
+MESH_AXIS_SIZE = gauge(
+    "hvd_mesh_axis_size",
+    "Axis sizes of the 2-D (batch, model) training mesh the step "
+    "factories compiled against (0 = flat 1-D wire, no mesh axis in "
+    "play — the HOROVOD_MESH_SHAPE-unset default).", ("axis",))
 # Self-healing policy plane (driver-side; the rendezvous server mirrors
 # these into the /metrics scrape so they exist even before a decision —
 # see runner/http/kv_server.py).
@@ -889,7 +896,9 @@ def _materialize_checkpoint_cells() -> None:
     PEER_REPLICATION_BYTES.labels()
     PEER_REPLICATION_SECONDS.labels()
     PEER_POOL_REPLICAS.labels()
-    PARAM_GATHER_BYTES.labels()
+    for axis in ("batch", "model"):
+        PARAM_GATHER_BYTES.labels(axis=axis)
+        MESH_AXIS_SIZE.labels(axis=axis)
     PARAM_GATHER_SECONDS.labels()
     FSDP_PREFETCH_OVERLAP.labels()
     for mode in ("sharded", "fsdp"):
@@ -994,11 +1003,14 @@ def fsdp_summary() -> dict:
             sample["value"])
     gb = PARAM_GATHER_BYTES.dump()["samples"]
     gs = PARAM_GATHER_SECONDS.dump()["samples"]
+    by_axis = {s["labels"].get("axis", ""): s for s in gb}
     return {
         "resident_bytes": resident,
         "param_gather": {
-            "traces": gb[0]["count"] if gb else 0,
-            "bytes_total": round(gb[0]["sum"]) if gb else 0,
+            "traces": sum(s["count"] for s in gb),
+            "bytes_total": round(sum(s["sum"] for s in gb)),
+            "bytes_by_axis": {a: round(s["sum"])
+                              for a, s in sorted(by_axis.items())},
             "probe_seconds_total": round(gs[0]["sum"], 4) if gs else 0.0,
         },
         "prefetch_overlap_ratio": FSDP_PREFETCH_OVERLAP.labels().get(),

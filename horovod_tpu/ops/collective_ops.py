@@ -118,9 +118,15 @@ def _effective_traced_axis(ps):
         return ps.axis_name
     if ps.process_set_id == 0:
         from ..parallel.hierarchical import HIERARCHICAL_AXES
+        from ..parallel.mesh import MESH2D_AXES
 
         if _in_axis_scope(HIERARCHICAL_AXES):
             return HIERARCHICAL_AXES
+        # The 2-D (batch, model) training mesh: a global-set collective
+        # traced inside it reduces over the axis tuple — batch rides the
+        # two-level cross leg, model the short-hop local leg.
+        if _in_axis_scope(MESH2D_AXES):
+            return MESH2D_AXES
     return None
 
 

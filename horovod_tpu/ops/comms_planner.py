@@ -700,6 +700,89 @@ def describe_plans(topology) -> list[str]:
             choices.append(f"{kib}KiB->{algo}({provenance})")
         if choices:
             lines.append(f"  {op}@{link}: " + " ".join(choices))
+    lines.extend(describe_axis_plans(topology))
+    return lines
+
+
+def _mesh_shape_for(topology) -> tuple[int, int] | None:
+    """The configured 2-D (batch, model) shape resolved against THIS
+    topology's world, or None (unset/invalid)."""
+    try:
+        from ..parallel.mesh import resolve_mesh_shape
+
+        shape = resolve_mesh_shape()
+    except Exception:  # noqa: BLE001 — introspection must never raise
+        return None
+    if shape is None:
+        return None
+    b, m = shape
+    n = topology.size
+    if b == -1:
+        if m < 1 or n % m != 0:
+            return None
+        b = n // m
+    return (b, m) if b * m == n else None
+
+
+def axis_link_class(topology, axis: str, batch: int, model: int) -> str:
+    """The worst link class a collective over ONE 2-D mesh axis rides:
+    ``model``-axis hops are contiguous flat ranks (stride 1 within a row
+    of ``model``), ``batch``-axis hops stride ``model`` — the placement
+    contract of ``parallel.mesh.mesh_2d``. This is what lets the planner
+    price the two fsdp gather legs separately: on a split fabric the
+    model leg stays inside an ICI island while the batch leg crosses."""
+    n = topology.size
+    stride = 1 if axis == "model" else model
+    order = {"self": 0, "ici": 1, "mixed": 2, "dcn": 3}
+    worst = "self"
+    for r in range(n):
+        q = r + stride
+        if q >= n or (stride == 1 and q // model != r // model):
+            continue
+        cls = topology.link_class(r, q)
+        if order.get(cls, 3) > order.get(worst, 0):
+            worst = cls
+    return worst if worst != "self" else "ici"
+
+
+def price_axis_gather(axis: str, nbytes: int, batch: int, model: int,
+                      topology=None) -> float:
+    """Seed-priced seconds of an allgather leg over one 2-D mesh axis —
+    the flat-ring formula over that axis's size and ITS link class (not
+    the whole-world worst class the 1-D plan prices with). The pricing
+    argument for the (batch, model) split in one number: the batch leg
+    moves ~1/model of the 1-D gather bytes, and the model leg's bytes
+    ride the short-hop class."""
+    if topology is None:
+        from .. import basics
+
+        topology = basics._state.topology
+    k = int(batch) if axis == "batch" else int(model)
+    if k < 2:
+        return 0.0
+    a, b = _seed(axis_link_class(topology, axis, batch, model))
+    return a + b * float(nbytes) * (k - 1) / k
+
+
+def describe_axis_plans(topology) -> list[str]:
+    """Per-mesh-axis gather pricing lines for ``Topology.describe()`` —
+    empty when no 2-D mesh shape is configured. Rank-local and
+    side-effect free, like :func:`describe_plans`."""
+    shape = _mesh_shape_for(topology)
+    if shape is None:
+        return []
+    b, m = shape
+    lines = []
+    for axis, k in (("batch", b), ("model", m)):
+        if k < 2:
+            lines.append(f"  gather@{axis}: size 1 (no wire)")
+            continue
+        cls = axis_link_class(topology, axis, b, m)
+        prices = " ".join(
+            f"{nb // 1024}KiB->"
+            f"{price_axis_gather(axis, nb, b, m, topology):.2e}s"
+            for nb in _DESCRIBE_PAYLOADS)
+        lines.append(f"  gather@{axis}({k} rank(s), {cls}): {prices}")
     return lines
 
 

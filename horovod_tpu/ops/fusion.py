@@ -438,6 +438,32 @@ def shard_ownership(leaves: Sequence[Any], world_size: int) -> list[int]:
     return [max(1, -(-int(leaf.size) // n)) for leaf in leaves]
 
 
+def shard_ownership_2d(leaves: Sequence[Any], batch: int, model: int,
+                       ) -> list[tuple[int, int]]:
+    """Per-leaf ``(model_share, shard)`` sizes for the 2-D
+    ``(batch, model)`` mesh — :func:`shard_ownership` computed per mesh
+    axis.
+
+    The flat leaf zero-padded to ``batch*model*shard`` splits first over
+    ``model`` into contiguous blocks of ``model_share = batch * shard``
+    elements (model coordinate m owns block m — the model-axis gather's
+    unit), then each block over ``batch`` into rows of ``shard``
+    elements (batch coordinate b owns row b — the batch-axis
+    reduce-scatter's unit). Device ``(b, m)`` therefore resident-holds
+    flat slice ``(m*batch + b) * shard : +shard`` — and because
+    ``ceil(ceil(s/model)/batch) == ceil(s/(model*batch))``, ``shard`` is
+    IDENTICAL to the flat :func:`shard_ownership` over
+    ``world = batch*model``: the resident row layout (and with it every
+    checkpoint, resize hop, and peer replica) is shared between the 1-D
+    and 2-D wires, only the gather/reduce schedule differs. Same
+    stability contract: a pure function of shapes and axis sizes.
+    """
+    b = max(1, int(batch))
+    m = max(1, int(model))
+    shards = shard_ownership(leaves, b * m)
+    return [(b * s, s) for s in shards]
+
+
 def _pack_shard_rows(leaves, shard_sizes, world_size):
     """Pack same-dtype leaves into one ``(world_size, R)`` block whose row
     ``r`` is the concatenation of rank r's per-leaf owned slices — the

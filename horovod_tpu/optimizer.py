@@ -334,13 +334,22 @@ def _reducescatter_grads(
     owned 1-D shards (sizes per ``ops.fusion.shard_ownership``).
     """
     if isinstance(axis_name, (tuple, list)):
-        raise ValueError(
-            "sync_mode='sharded' does not compose with the hierarchical "
-            "(cross, local) mesh; use the flat axis — for ICI x DCN "
-            "hierarchy on the flat axis, set HOROVOD_COMMS_PLANNER and "
-            "the planner's two_level schedule gives the RS/AG halves "
-            "the same intra-island/cross-island composition per bucket "
-            "(ops/comms_planner.py)")
+        from .parallel.mesh import MESH2D_AXES
+
+        # The 2-D (batch, model) training mesh IS supported: reducing
+        # over the axis tuple enumerates scatter chunks batch-major,
+        # which is exactly flat rank order, so the (world, shard) row
+        # layout is byte-identical to the 1-D wire. The hierarchical
+        # (cross, local) allreduce mesh stays rejected.
+        if tuple(axis_name) != MESH2D_AXES:
+            raise ValueError(
+                "sync_mode='sharded' does not compose with the "
+                "hierarchical (cross, local) mesh; use the flat axis — "
+                "for ICI x DCN hierarchy on the flat axis, set "
+                "HOROVOD_COMMS_PLANNER and the planner's two_level "
+                "schedule gives the RS/AG halves the same "
+                "intra-island/cross-island composition per bucket "
+                "(ops/comms_planner.py)")
     if world_size is None:
         raise ValueError(
             "sync_mode='sharded' needs a known process-set size at trace "

@@ -252,7 +252,14 @@ def _fsdp_spec_of(optimizer):
 
 def _check_flat_axis(axis_name, what: str, sync_mode: str = "sharded"):
     from ..exceptions import SyncModeIneligibleError
+    from .mesh import MESH2D_AXES
 
+    if (isinstance(axis_name, (tuple, list))
+            and tuple(axis_name) == MESH2D_AXES):
+        # The (batch, model) tuple is a flat-rank factorization, not the
+        # hierarchical (cross, local) composition — ZeRO-1 reduces over
+        # it in flat order (batch major), so the ownership map is intact.
+        return
     if not isinstance(axis_name, str):
         raise SyncModeIneligibleError(
             f"sync_mode='{sync_mode}' does not compose with the "
@@ -285,17 +292,65 @@ def shard_state(tree, mesh=None, axis_name: str | None = None):
     """Place a stacked sharded optimizer state (leading world axis, from
     ``hvd.init_sharded_state`` / a sharded optimizer's ``init``) on the
     mesh, sharded along that axis — so each rank holds only its 1/n of
-    the state. The sharded counterpart of :func:`replicate`."""
+    the state. The sharded counterpart of :func:`replicate`.
+
+    On a 2-D ``(batch, model)`` mesh the leading world axis splits over
+    BOTH mesh axes; the default placement is the fsdp row order
+    (``("model", "batch")`` — row ``m*batch + b`` on device ``(b, m)``,
+    per ``ops.fusion.shard_ownership_2d``). Pass
+    ``axis_name=("batch", "model")`` for the ZeRO-1 flat-order layout."""
     from jax.sharding import NamedSharding
 
     from .. import basics
+    from .mesh import MESH2D_ROW_AXES, is_mesh_2d
 
     if mesh is None:
         mesh = basics.global_mesh()
     if axis_name is None:
-        axis_name = basics.global_axis_name()
+        axis_name = (MESH2D_ROW_AXES if is_mesh_2d(mesh)
+                     else basics.global_axis_name())
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree.map(partial(jax.device_put, device=sharding), tree)
+
+
+def _record_mesh_axes(sizes: dict) -> None:
+    try:
+        from .. import metrics
+
+        for axis, v in sizes.items():
+            metrics.MESH_AXIS_SIZE.set(int(v), axis=axis)
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
+
+
+def _resolve_mesh_2d(mesh, hierarchical):
+    """The 2-D ``(batch, model)`` mesh this factory call compiles
+    against, or None for the flat 1-D wire. Precedence: an explicit 2-D
+    ``mesh=`` argument > ``HOROVOD_MESH_SHAPE`` > an autotune mesh-shape
+    pin. With none of the three (the default) this returns None and the
+    factory takes the pre-mesh code path byte for byte — the knob-unset
+    inertness contract."""
+    from .mesh import is_mesh_2d, mesh_2d, resolve_mesh_shape
+
+    if mesh is not None:
+        return mesh if is_mesh_2d(mesh) else None
+    shape = resolve_mesh_shape()
+    if shape is None:
+        return None
+    hier = hierarchical
+    if hier is None:
+        from .. import basics
+
+        cfg = basics._state.config
+        hier = bool(cfg and cfg.hierarchical_allreduce)
+    if hier:
+        raise ValueError(
+            "HOROVOD_MESH_SHAPE does not compose with the hierarchical "
+            "(cross, local) allreduce: the 2-D (batch, model) mesh "
+            "already places each collective leg on its link class "
+            "(model on ICI, batch across). Unset one of the two knobs "
+            "(docs/perf.md, '2-D mesh' guard table)")
+    return mesh_2d(*shape)
 
 
 def make_train_step(
@@ -342,10 +397,13 @@ def make_train_step(
       allgather of the updated parameter shards issued off the gradient
       critical path.
     """
-    import optax
-
     spec = _sharded_spec_of(optimizer)
     fsdp_spec = _fsdp_spec_of(optimizer)
+    mesh2d = _resolve_mesh_2d(mesh, hierarchical)
+    if mesh2d is not None:
+        return _make_mesh2d_train_step(
+            loss_fn, optimizer, spec, fsdp_spec, mesh2d, donate,
+            loss_is_averaged, deferred_param_gather)
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
     from ..exceptions import SyncModeIneligibleError
 
@@ -370,6 +428,18 @@ def make_train_step(
         return _make_sharded_train_step(
             loss_fn, spec, mesh, axis_name, donate, loss_is_averaged,
             deferred_param_gather)
+    return _make_allreduce_train_step(
+        loss_fn, optimizer, mesh, axis_name, donate, loss_is_averaged)
+
+
+def _make_allreduce_train_step(loss_fn, optimizer, mesh, axis_name,
+                               donate, loss_is_averaged):
+    """The monolithic (allreduce-mode) program — replicated params and
+    opt_state, batch sharded over ``axis_name`` (a flat axis, the
+    hierarchical (cross, local) tuple, or the 2-D (batch, model) tuple:
+    the optimizer's allreduce resolves the bound axis form at trace
+    time and takes the matching two-level composition for tuples)."""
+    import optax
 
     def spmd_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
@@ -587,6 +657,139 @@ def _make_fsdp_train_step(loss_fn, spec, mesh, axis_name, donate,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P(axis_name), P()),
+        check_vma=False,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return _StallWatchedStep(
+        maybe_autotune_step(
+            jax.jit(sharded, donate_argnums=donate_argnums),
+            algorithm_candidates=_planner_autotune_candidates()),
+        name_prefix)
+
+
+def _make_mesh2d_train_step(loss_fn, optimizer, spec, fsdp_spec, mesh2d,
+                            donate, loss_is_averaged,
+                            deferred_param_gather):
+    """Dispatch a factory call onto the 2-D ``(batch, model)`` mesh:
+    fsdp takes the two-leg wire (:func:`_make_fsdp_train_step_2d`),
+    ZeRO-1 reduces over the flat-rank axis tuple, and the monolithic
+    mode takes the two-level allreduce composition (model leg on ICI,
+    batch leg across). Guard table: expert_set x model and the deferred
+    parameter gather are unsupported compositions."""
+    from ..exceptions import SyncModeIneligibleError
+    from ..optimizer import reduce_spec_of
+    from .mesh import MESH2D_AXES, mesh_axis_sizes
+
+    sizes = mesh_axis_sizes(mesh2d)
+    _record_mesh_axes(sizes)
+    any_spec = fsdp_spec or spec or reduce_spec_of(optimizer)
+    if any_spec is not None and getattr(any_spec, "expert_set", None):
+        raise SyncModeIneligibleError(
+            "expert_set x model is an unsupported mesh composition: the "
+            "expert alltoall already owns the intra-host links the "
+            "model axis would claim, and the expert-partitioned "
+            "reduction is defined over the flat world. Run MoE jobs "
+            "without HOROVOD_MESH_SHAPE (docs/perf.md, '2-D mesh' "
+            "guard table)")
+    if deferred_param_gather:
+        raise SyncModeIneligibleError(
+            "deferred_param_gather x model is an unsupported mesh "
+            "composition: the deferred allgather program is built over "
+            "the flat axis (docs/perf.md, '2-D mesh' guard table)")
+    if fsdp_spec is not None:
+        return _make_fsdp_train_step_2d(
+            loss_fn, fsdp_spec, mesh2d, donate, loss_is_averaged)
+    if spec is not None:
+        return _make_sharded_train_step(
+            loss_fn, spec, mesh2d, MESH2D_AXES, donate, loss_is_averaged,
+            False)
+    return _make_allreduce_train_step(
+        loss_fn, optimizer, mesh2d, MESH2D_AXES, donate, loss_is_averaged)
+
+
+def _make_fsdp_train_step_2d(loss_fn, spec, mesh2d, donate,
+                             loss_is_averaged, num_segments=None,
+                             name_prefix: str = "train_step"):
+    """The sync_mode='fsdp' program on the 2-D ``(batch, model)`` mesh.
+
+    The resident layout is byte-identical to the flat wire — the same
+    :class:`param_sharding.ShardedParams` stacked ``(world, shard)``
+    rows, ``world = batch*model`` (``ops.fusion.shard_ownership_2d``) —
+    but the rows place over BOTH mesh axes in model-major order
+    (``P(("model", "batch"))``: row ``m*batch + b`` on device
+    ``(b, m)``), and each per-segment collective splits into two legs:
+    the batch leg rides the existing bucketed RS/AG machinery over the
+    long hops, the model leg is a plain ICI all_gather/psum_scatter XLA
+    schedules on the shortest links (:func:`param_sharding
+    .gather_params_2d`). The batch slice shards over both axes in flat
+    rank order, so the loss trajectory matches the 1-D fsdp run to
+    reduction-order noise while 1/model of the gather bytes leave the
+    slow links.
+    """
+    import optax
+
+    from ..autotune import maybe_autotune_step
+    from ..optimizer import _SaltState, _known_size
+    from .mesh import MESH2D_AXES, MESH2D_ROW_AXES, mesh_axis_sizes
+    from .param_sharding import ShardedParams, gather_params_2d
+
+    int8 = getattr(spec.compression, "marker", None) == "int8"
+    sizes = mesh_axis_sizes(mesh2d)
+    b, m = sizes["batch"], sizes["model"]
+    n = _known_size(spec.process_set)
+    if n is None:
+        raise ValueError(
+            "sync_mode='fsdp' needs a known process-set size at step-build "
+            "time (init() first)")
+    if n != b * m:
+        raise ValueError(
+            f"mesh {b}x{m} does not cover the process set of {n} rank(s)")
+
+    def spmd_step(sharded_params, opt_state, batch):
+        if not isinstance(sharded_params, ShardedParams):
+            from ..exceptions import SyncModeIneligibleError
+
+            raise SyncModeIneligibleError(
+                "the fsdp train step takes resident ShardedParams (build "
+                "with hvd.shard_params(params) and place with "
+                f"shard_state), got {type(sharded_params).__name__}")
+        meta = sharded_params.meta
+        # Inside the shard_map each device sees its own (1, s) row of
+        # every leaf — row m*batch + b under the model-major placement.
+        shards = jax.tree.unflatten(
+            meta.treedef, [a[0] for a in sharded_params.rows])
+        local_state = jax.tree.map(lambda a: a[0], opt_state)
+        if int8:
+            inner_local, salt = local_state.inner_state, local_state.counter
+        else:
+            inner_local, salt = local_state, None
+
+        def loss_of(sh):
+            full = gather_params_2d(sh, meta, spec, b, m, salt=salt,
+                                    num_segments=num_segments)
+            return loss_fn(full, batch)
+
+        # Gradients arrive ALREADY reduce-scattered to the shard domain:
+        # each segment boundary's backward emitted its model-leg
+        # psum_scatter and batch-leg reducescatter inside backprop and
+        # its cotangent IS the owned (s,) slice.
+        loss, grad_shards = jax.value_and_grad(loss_of)(shards)
+        updates, new_inner = spec.inner.update(grad_shards, inner_local,
+                                               shards)
+        new_shards = optax.apply_updates(shards, updates)
+        new_local = _SaltState(new_inner, salt + 1) if int8 else new_inner
+        new_rows = ShardedParams(
+            [a[None] for a in jax.tree.leaves(new_shards)], meta)
+        new_state = jax.tree.map(lambda a: a[None], new_local)
+        if loss_is_averaged:
+            loss = jax.lax.pmean(loss, MESH2D_AXES)
+        return new_rows, new_state, loss
+
+    sharded = jax.shard_map(
+        spmd_step,
+        mesh=mesh2d,
+        in_specs=(P(MESH2D_ROW_AXES), P(MESH2D_ROW_AXES), P(MESH2D_AXES)),
+        out_specs=(P(MESH2D_ROW_AXES), P(MESH2D_ROW_AXES), P()),
         check_vma=False,
     )
     donate_argnums = (0, 1) if donate else ()
@@ -819,6 +1022,25 @@ def make_overlapped_train_step(
             "communication to overlap — use make_train_step")
     int8 = getattr(spec.compression, "marker", None) == "int8"
     sharded_mode = getattr(spec, "sync_mode", "allreduce") == "sharded"
+    mesh2d = _resolve_mesh_2d(mesh, hierarchical)
+    if mesh2d is not None:
+        if getattr(spec, "sync_mode", "allreduce") != "fsdp":
+            from ..exceptions import SyncModeIneligibleError
+
+            raise SyncModeIneligibleError(
+                "the overlap scheduler on a 2-D (batch, model) mesh is "
+                "only defined for sync_mode='fsdp' (whose gather "
+                "boundaries ARE the overlap machinery); allreduce/"
+                "sharded overlapped steps run on the flat axis — unset "
+                "HOROVOD_MESH_SHAPE or use make_train_step "
+                "(docs/perf.md, '2-D mesh' guard table)")
+        from .mesh import mesh_axis_sizes
+
+        _record_mesh_axes(mesh_axis_sizes(mesh2d))
+        return _make_fsdp_train_step_2d(
+            loss_fn, spec, mesh2d, donate, loss_is_averaged,
+            num_segments=num_segments,
+            name_prefix="overlapped_train_step")
     mesh, axis_name = _resolve_mesh_axis(mesh, axis_name, hierarchical)
     if getattr(spec, "sync_mode", "allreduce") == "fsdp":
         # fsdp's gather boundaries ARE the overlap machinery: each
@@ -909,15 +1131,21 @@ def make_overlapped_train_step(
 
 
 def shard_batch(batch, mesh=None, axis_name: str | None = None):
-    """Place a host batch on the mesh, sharded along the leading axis."""
+    """Place a host batch on the mesh, sharded along the leading axis.
+
+    On a 2-D ``(batch, model)`` mesh the leading dim splits over BOTH
+    axes in flat rank order (``("batch", "model")`` — rank ``b*model+m``
+    gets the same rows it would on the flat 1-D mesh)."""
     from jax.sharding import NamedSharding
 
     from .. import basics
+    from .mesh import MESH2D_AXES, is_mesh_2d
 
     if mesh is None:
         mesh = basics.global_mesh()
     if axis_name is None:
-        axis_name = basics.global_axis_name()
+        axis_name = (MESH2D_AXES if is_mesh_2d(mesh)
+                     else basics.global_axis_name())
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree.map(partial(jax.device_put, device=sharding), batch)
 

@@ -15,18 +15,56 @@ sit on adjacent ICI links:
 
 Axis order in the mesh tuple = topology-major order: tp innermost (most
 bandwidth-hungry, shortest ICI hops), then sp, ep, pp, dp outermost
-(allreduce tolerates the longest hops / DCN).
+(allreduce tolerates the longest hops / DCN). ``build_mesh`` reshapes the
+canonical ICI-ordered device list row-major into that axis order and
+asserts the constructed :class:`jax.sharding.Mesh` preserves it — flat
+rank ``r`` of the topology occupies mesh position
+``np.unravel_index(r, shape)``, so contiguous innermost-axis groups are
+ICI-contiguous by construction.
+
+The 2-D training mesh (:func:`mesh_2d`) is the ``(batch, model)``
+factorization the step factories compile sync modes against:
+``batch`` = dp (outermost, long hops / DCN), ``model`` = tp (innermost,
+short ICI hops). ``HOROVOD_MESH_SHAPE="BxM"`` selects it without code
+changes; unset leaves every factory on the flat 1-D axis bit for bit.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
+import os
 from typing import Any, Sequence
 
 import numpy as np
 
 AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")  # outermost -> innermost
+
+#: Axis names of the 2-D training mesh, outermost first: ``batch`` is the
+#: data axis (gradient sync, long hops), ``model`` the intra-layer axis
+#: (parameter gathers, short ICI hops). The tuple is also the axis
+#: argument collectives take to reduce over the WHOLE 2-D world in flat
+#: rank order ("batch" major, matching the canonical device list).
+MESH2D_AXES = ("batch", "model")
+
+#: Leading-axis placement of resident fsdp stacked rows on the 2-D mesh:
+#: row ``k = m*batch + b`` lands on device ``(b, m)`` ("model" major), so
+#: the batch-axis gather at fixed m reassembles a CONTIGUOUS model block
+#: and the model-axis gather concatenates blocks in flat order — see
+#: ``ops.fusion.shard_ownership_2d``.
+MESH2D_ROW_AXES = ("model", "batch")
+
+
+def _nearest_factorizations(n_devices: int, axis: str, requested: int,
+                            ) -> str:
+    """Render the valid sizes for ``axis`` nearest to ``requested`` —
+    the actionable half of a does-not-divide rejection."""
+    divisors = [d for d in range(1, n_devices + 1) if n_devices % d == 0]
+    divisors.sort(key=lambda d: (abs(d - requested), d))
+    parts = []
+    for d in divisors[:2]:
+        parts.append(f"{axis}={d} (mesh {n_devices // d}x{d})")
+    return " or ".join(parts)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,6 +77,16 @@ class MeshSpec:
 
     def resolve(self, n_devices: int) -> dict[str, int]:
         sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        # Reject a fixed axis that cannot divide the device count up
+        # front, with the nearest valid factorization spelled out —
+        # "tp=3 does not divide 8" is actionable; "mesh does not cover"
+        # after inference is not.
+        for a, v in sizes.items():
+            if v > 0 and n_devices % v != 0:
+                raise ValueError(
+                    f"mesh axis {a}={v} does not divide {n_devices} "
+                    f"device(s); nearest valid: "
+                    f"{_nearest_factorizations(n_devices, a, v)}")
         fixed = math.prod(v for v in sizes.values() if v > 0)
         inferred = [a for a, v in sizes.items() if v <= 0]
         if len(inferred) > 1:
@@ -57,6 +105,31 @@ class MeshSpec:
         return sizes
 
 
+def _default_devices():
+    from ..topology import sorted_devices
+
+    from .. import basics
+
+    if basics.is_initialized():
+        return basics._state.topology.devices
+    return sorted_devices()
+
+
+def _assert_topology_major(mesh, devices) -> None:
+    """The constructed Mesh must enumerate devices in topology-major
+    order: flat rank r at mesh position unravel_index(r, shape). A
+    row-major reshape guarantees it; this assertion keeps the guarantee
+    load-bearing (the docstring said it for four PRs while nothing
+    checked)."""
+    got = list(np.asarray(mesh.devices).reshape(-1))
+    want = list(devices)
+    if got != want:
+        raise AssertionError(
+            "mesh device order does not match topology-major placement: "
+            f"mesh enumerates {[getattr(d, 'id', d) for d in got]} but the "
+            f"canonical ICI order is {[getattr(d, 'id', d) for d in want]}")
+
+
 def build_mesh(
     spec: MeshSpec | None = None,
     devices: Sequence[Any] | None = None,
@@ -65,12 +138,12 @@ def build_mesh(
     """Build a named mesh over the canonical ICI-ordered device list.
 
     ``build_mesh(dp=4, tp=2)`` or ``build_mesh(MeshSpec(dp=-1, tp=2))``.
-    Devices default to the initialized world's topology order, so contiguous
-    tp groups are ICI-contiguous.
+    Devices default to the initialized world's topology order; the
+    row-major reshape places flat rank r at mesh position
+    ``unravel_index(r, shape)``, so contiguous tp (innermost) groups are
+    ICI-contiguous — asserted, not assumed.
     """
     from jax.sharding import Mesh
-
-    from ..topology import sorted_devices
 
     if spec is None:
         spec = MeshSpec(**{a: axis_sizes.get(a, -1 if a == "dp" else 1) for a in AXIS_ORDER})
@@ -78,13 +151,83 @@ def build_mesh(
         raise ValueError("pass either a MeshSpec or axis sizes, not both")
 
     if devices is None:
-        from .. import basics
-
-        if basics.is_initialized():
-            devices = basics._state.topology.devices
-        else:
-            devices = sorted_devices()
+        devices = _default_devices()
     sizes = spec.resolve(len(devices))
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     array = np.array(devices).reshape(shape)
-    return Mesh(array, AXIS_ORDER)
+    mesh = Mesh(array, AXIS_ORDER)
+    _assert_topology_major(mesh, list(devices))
+    return mesh
+
+
+# ---------------------------------------------------------------------------
+# The 2-D (batch, model) training mesh
+# ---------------------------------------------------------------------------
+
+
+def mesh_2d(batch: int = -1, model: int = 1,
+            devices: Sequence[Any] | None = None):
+    """The ``(batch, model)`` training mesh over the canonical device
+    list: ``model`` innermost (contiguous flat ranks — the shortest ICI
+    hops carry the intra-layer parameter collectives), ``batch``
+    outermost (gradient sync tolerates the long hops). ``batch=-1``
+    infers from the device count. Flat rank ``r`` sits at mesh position
+    ``(r // model, r % model)``."""
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = _default_devices()
+    # dp/tp carry the divide-and-nearest-factorization checks; the 2-D
+    # mesh is exactly the (dp, tp) plane of the canonical axis order.
+    sizes = MeshSpec(dp=batch, tp=model).resolve(len(devices))
+    b, m = sizes["dp"], sizes["tp"]
+    mesh = Mesh(np.array(devices).reshape(b, m), MESH2D_AXES)
+    _assert_topology_major(mesh, list(devices))
+    return mesh
+
+
+def is_mesh_2d(mesh) -> bool:
+    """True when ``mesh`` is a named 2-D ``(batch, model)`` mesh."""
+    return (mesh is not None
+            and tuple(getattr(mesh, "axis_names", ())) == MESH2D_AXES)
+
+
+def mesh_axis_sizes(mesh) -> dict[str, int]:
+    """``{"batch": B, "model": M}`` of a 2-D training mesh."""
+    if not is_mesh_2d(mesh):
+        raise ValueError(f"not a (batch, model) mesh: {mesh!r}")
+    return dict(zip(MESH2D_AXES, np.asarray(mesh.devices).shape))
+
+
+def parse_mesh_shape(value: str) -> tuple[int, int]:
+    """Parse a ``"BxM"`` mesh-shape string (e.g. ``"4x2"``) into
+    ``(batch, model)``. ``-1`` for batch means infer."""
+    parts = str(value).strip().lower().replace("×", "x").split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"HOROVOD_MESH_SHAPE must look like 'BxM' (e.g. '4x2'), "
+            f"got {value!r}")
+    try:
+        b, m = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"HOROVOD_MESH_SHAPE must be two integers 'BxM', got "
+            f"{value!r}") from None
+    if m < 1 or (b < 1 and b != -1):
+        raise ValueError(
+            f"HOROVOD_MESH_SHAPE axes must be positive (batch may be -1 "
+            f"to infer), got {value!r}")
+    return b, m
+
+
+def resolve_mesh_shape() -> tuple[int, int] | None:
+    """The requested 2-D mesh shape: ``HOROVOD_MESH_SHAPE`` first, then
+    an autotune pin (:func:`horovod_tpu.autotune.tuned_mesh_shape`).
+    None — the default — leaves every factory on the flat 1-D axis,
+    bit for bit."""
+    raw = os.environ.get("HOROVOD_MESH_SHAPE", "").strip()
+    if raw:
+        return parse_mesh_shape(raw)
+    from ..autotune import tuned_mesh_shape
+
+    return tuned_mesh_shape()

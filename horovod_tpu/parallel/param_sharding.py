@@ -265,10 +265,13 @@ def _wire_itemsize(compression, dtype) -> int:
         return jnp.dtype(dtype).itemsize
 
 
-def _record_gather(templates, compression) -> None:
+def _record_gather(templates, compression, axis: str = "batch") -> None:
     """Trace-time metrics record of one parameter-gather program segment
     (static wire bytes — the per-trace shape, not a per-step rate, same
-    contract as the grad-sync flush counters). Never raises."""
+    contract as the grad-sync flush counters), labeled by the mesh axis
+    the collective runs over: the flat 1-D wire and the 2-D batch leg
+    record under ``axis="batch"``, the 2-D intra-layer leg under
+    ``axis="model"``. Never raises."""
     try:
         from .. import metrics
 
@@ -276,7 +279,7 @@ def _record_gather(templates, compression) -> None:
             int(np.prod(t.shape) if t.shape else 1)
             * _wire_itemsize(compression, t.dtype)
             for t in templates)
-        metrics.PARAM_GATHER_BYTES.observe(nbytes)
+        metrics.PARAM_GATHER_BYTES.observe(nbytes, axis=axis)
     except Exception:  # noqa: BLE001 — instrumentation is best-effort
         pass
 
@@ -415,6 +418,181 @@ def gather_params(shards_tree, meta: _Meta, spec, axis_name,
             [shard_leaves[i] for i in idx],
             [templates[i] for i in idx],
             si, spec, axis_name, world_size, salt)
+        for i, g in zip(idx, gathered):
+            full[i] = g
+    return jax.tree.unflatten(meta.treedef, full)
+
+
+# ---------------------------------------------------------------------------
+# The 2-D (batch, model) wire: two-leg gathers / reduce-scatters
+# ---------------------------------------------------------------------------
+
+
+def _gather_boundary_2d(shard_leaves, templates, seg_index, spec,
+                        batch: int, model: int, salt):
+    """The :func:`_gather_boundary` of the 2-D ``(batch, model)`` mesh:
+    same shards-in / full-tensors-out custom-vjp contract, with each
+    collective split into two legs placed on the links that suit it.
+
+    Forward — resident ``(shard,)`` rows to full tensors in two hops:
+
+    1. **batch leg** (long hops / DCN): the existing bucketed
+       ``_gather_param_shards`` machinery allgathers this rank's shard
+       over the ``batch`` axis into its model coordinate's contiguous
+       ``(batch*shard,)`` block — 1/model of the segment's bytes on the
+       slow links, vs the full segment on the flat 1-D wire.
+    2. **model leg** (short ICI hops): one plain ``lax.all_gather`` per
+       leaf over the ``model`` axis concatenates the blocks into the
+       full flat view — the intra-layer collective XLA schedules on the
+       fastest links of the mesh.
+
+    Backward reverses the legs: the full-shaped cotangents
+    ``psum_scatter`` over ``model`` down to the block domain, then the
+    block cotangents ride the SAME bucketed ``_reducescatter_grads``
+    wire as the flat mode over the ``batch`` axis (compression, scaling,
+    flush accounting — ``flush_label="fsdp"``), landing in the resident
+    ``(shard,)`` domain. ``op=Average`` divides by ``batch`` inside the
+    batch leg, so the model leg contributes its own ``1/model`` — the
+    composition equals the flat wire's ``1/(batch*model)``.
+
+    The two-hop split of :func:`ops.fusion.shard_ownership_2d` keeps the
+    resident row layout byte-identical to the flat wire, so the gathered
+    full tensors are bit-equal to the 1-D gather; only the gradient
+    reduction association differs (two-leg vs flat), which is
+    reduction-order noise.
+    """
+    from jax import lax
+
+    from ..optimizer import _gather_param_shards, _reducescatter_grads
+    from ..ops import collective_ops
+    from ..ops.fusion import shard_ownership_2d
+    from ..profiler import annotate_collective
+
+    b, m = int(batch), int(model)
+    templates = list(templates)
+    ownership = shard_ownership_2d(templates, b, m)
+    batch_axis, model_axis = "batch", "model"
+    block_templates = [
+        jax.ShapeDtypeStruct((share,), t.dtype)
+        for (share, _s), t in zip(ownership, templates)
+    ]
+
+    def gather(ls, s):
+        _record_gather(block_templates, spec.compression, axis="batch")
+        with annotate_collective(
+                f"fsdp.param_gather.batch.seg{seg_index}"):
+            blocks = _gather_param_shards(
+                list(ls), block_templates, spec.compression, batch_axis,
+                b, spec.fusion_threshold_bytes, 0, quant_salt=s)
+        _record_gather(templates, None, axis="model")
+        full = []
+        with annotate_collective(
+                f"fsdp.param_gather.model.seg{seg_index}"):
+            for blk, t in zip(blocks, templates):
+                flat = lax.all_gather(jnp.ravel(blk), model_axis,
+                                      tiled=True)
+                size = int(np.prod(t.shape)) if t.shape else 1
+                full.append(flat[:size].reshape(t.shape).astype(t.dtype))
+        return full
+
+    def reduce_cts(cts, s):
+        blocks = []
+        with annotate_collective(
+                f"fsdp.grad_reducescatter.model.seg{seg_index}"):
+            for ct, (share, shard) in zip(cts, ownership):
+                flat = jnp.ravel(jnp.asarray(ct))
+                flat = jnp.pad(flat, (0, m * share - int(flat.size)))
+                blk = lax.psum_scatter(flat, model_axis, tiled=True)
+                if spec.op is collective_ops.Average:
+                    # The batch leg divides by `batch`; this leg owes
+                    # the remaining 1/model of the flat wire's 1/world.
+                    blk = blk / m
+                blocks.append(blk)
+        with annotate_collective(
+                f"fsdp.grad_reducescatter.batch.seg{seg_index}"):
+            shards = _reducescatter_grads(
+                blocks,
+                spec.op,
+                batch_axis,
+                spec.compression,
+                spec.prescale_factor,
+                spec.postscale_factor,
+                spec.fusion_threshold_bytes,
+                0,
+                world_size=b,
+                quant_salt=s,
+                issue_reversed=True,
+                flush_label="fsdp",
+            )
+        return [jnp.asarray(sh).astype(jnp.asarray(orig).dtype)
+                for sh, orig in zip(shards, shard_leaves)]
+
+    if salt is None:
+
+        @jax.custom_vjp
+        def boundary(ls):
+            return gather(ls, None)
+
+        def fwd(ls):
+            return gather(ls, None), None
+
+        def bwd(_, cts):
+            return (reduce_cts(cts, None),)
+
+        boundary.defvjp(fwd, bwd)
+        return boundary(list(shard_leaves))
+
+    @jax.custom_vjp
+    def boundary_salted(ls, s):
+        return gather(ls, s)
+
+    def fwd_salted(ls, s):
+        return gather(ls, s), s
+
+    def bwd_salted(s, cts):
+        return (reduce_cts(cts, s),
+                np.zeros(np.shape(s), jax.dtypes.float0))
+
+    boundary_salted.defvjp(fwd_salted, bwd_salted)
+    return boundary_salted(list(shard_leaves), salt)
+
+
+def gather_params_2d(shards_tree, meta: _Meta, spec, batch: int,
+                     model: int, salt=None,
+                     num_segments: int | None = None):
+    """:func:`gather_params` on the 2-D ``(batch, model)`` mesh — the
+    same per-segment just-in-time schedule, each segment's boundary
+    split into the batch-leg (bucketed machinery) and model-leg (plain
+    ICI all_gather) collectives of :func:`_gather_boundary_2d`. The
+    resident row layout is identical to the flat wire
+    (:func:`ops.fusion.shard_ownership_2d`), so a ShardedParams built by
+    :func:`shard_params` for ``world = batch*model`` feeds either."""
+    from ..ops.fusion import fsdp_segments, segment_leaves
+
+    shard_leaves = jax.tree.leaves(shards_tree)
+    templates = [jax.ShapeDtypeStruct(s, d)
+                 for s, d in zip(meta.shapes, meta.dtypes)]
+    if len(shard_leaves) != len(templates):
+        raise ValueError(
+            f"gather_params_2d: {len(shard_leaves)} shard leaves vs "
+            f"{len(templates)} templates — the shards tree must be the "
+            "ShardedParams row view of the same parameter pytree")
+    if int(batch) * int(model) != int(meta.world_size):
+        raise ValueError(
+            f"gather_params_2d: mesh {batch}x{model} does not factor the "
+            f"sharded world of {meta.world_size} rows")
+    if not reshard_after_forward():
+        k = 1
+    elif num_segments is not None:
+        k = max(1, int(num_segments))
+    else:
+        k = fsdp_segments()
+    full: list[Any] = [None] * len(templates)
+    for si, idx in enumerate(segment_leaves(templates, k)):
+        gathered = _gather_boundary_2d(
+            [shard_leaves[i] for i in idx],
+            [templates[i] for i in idx],
+            si, spec, batch, model, salt)
         for i, g in zip(idx, gathered):
             full[i] = g
     return jax.tree.unflatten(meta.treedef, full)

@@ -103,19 +103,40 @@ class ReplicaUnavailableError(RuntimeError):
     the peer rung must fall through to the durable rung."""
 
 
+def mesh_coords_of(rank: int, mesh_shape) -> tuple[int, int] | None:
+    """The 2-D ``(batch, model)`` mesh coordinates of a flat rank —
+    ``(r // model, r % model)``, the placement contract of
+    ``parallel.mesh.mesh_2d`` — or None when no (valid) shape is
+    given. Provenance only: replica identity stays keyed by flat rank
+    (the row layout is mesh-shape independent), the coords let an
+    operator read WHICH axis a missing record sat on."""
+    if mesh_shape is None:
+        return None
+    try:
+        b, m = (int(v) for v in mesh_shape)
+    except (TypeError, ValueError):
+        return None
+    if b < 1 or m < 1 or not 0 <= int(rank) < b * m:
+        return None
+    return (int(rank) // m, int(rank) % m)
+
+
 class ReplicaRecord:
     """One rank's shard snapshot at one commit, plus its provenance."""
 
     __slots__ = ("rank", "step", "generation", "world_size", "has_params",
-                 "payload")
+                 "mesh_coords", "payload")
 
     def __init__(self, rank: int, step: int, generation: int,
-                 world_size: int, payload: bytes, has_params: bool = False):
+                 world_size: int, payload: bytes, has_params: bool = False,
+                 mesh_coords=None):
         self.rank = int(rank)
         self.step = int(step)
         self.generation = int(generation)
         self.world_size = int(world_size)
         self.has_params = bool(has_params)
+        self.mesh_coords = (None if mesh_coords is None
+                            else tuple(int(v) for v in mesh_coords))
         self.payload = payload
 
     def group(self) -> tuple[int, int]:
@@ -123,10 +144,13 @@ class ReplicaRecord:
         return (self.generation, self.step)
 
     def summary(self) -> dict:
-        return {"rank": self.rank, "step": self.step,
-                "generation": self.generation,
-                "world_size": self.world_size,
-                "bytes": len(self.payload)}
+        out = {"rank": self.rank, "step": self.step,
+               "generation": self.generation,
+               "world_size": self.world_size,
+               "bytes": len(self.payload)}
+        if self.mesh_coords is not None:
+            out["mesh_coords"] = list(self.mesh_coords)
+        return out
 
 
 def encode_record(record: ReplicaRecord) -> bytes:
@@ -143,6 +167,10 @@ def encode_record(record: ReplicaRecord) -> bytes:
         "generation": record.generation,
         "world_size": record.world_size,
         "has_params": record.has_params,
+        # Omitted entirely when None: records from flat-mesh jobs stay
+        # byte-identical to the pre-mesh wire form.
+        **({"mesh_coords": list(record.mesh_coords)}
+           if record.mesh_coords is not None else {}),
         "sha256": payload_digest(record.payload),
         "bytes": len(record.payload),
     }, sort_keys=True).encode()
@@ -172,6 +200,7 @@ def decode_record(blob: bytes, verify: bool = True) -> ReplicaRecord:
             generation=header["generation"],
             world_size=header["world_size"], payload=payload,
             has_params=header.get("has_params", False),
+            mesh_coords=header.get("mesh_coords"),
         )
     except (KeyError, TypeError, ValueError) as e:
         raise ReplicaCorruptError(f"replica header incomplete: {e}") from e
@@ -340,6 +369,27 @@ class PeerReplicator:
     def generation(self) -> int:
         return int(self._generation_fn())
 
+    def _mesh_shape(self) -> tuple[int, int] | None:
+        """The configured 2-D mesh shape fitted to THIS replicator's
+        world, for record provenance. Best-effort: any failure (no
+        config, non-dividing axis) degrades to None — coords are
+        diagnostic, never load-bearing."""
+        try:
+            from .parallel.mesh import resolve_mesh_shape
+
+            shape = resolve_mesh_shape()
+            if shape is None:
+                return None
+            b, m = shape
+            n = self.world_size()
+            if b == -1:
+                if m < 1 or n % m != 0:
+                    return None
+                b = n // m
+            return (b, m) if b * m == n else None
+        except Exception:  # noqa: BLE001
+            return None
+
     def repoint(self) -> None:
         """Drop the cached KV client so the next replicate/fetch builds
         a fresh one from the launcher env — called by the worker's
@@ -376,7 +426,8 @@ class PeerReplicator:
         record = ReplicaRecord(
             rank=self.rank, step=step, generation=self.generation(),
             world_size=self.world_size(), payload=payload,
-            has_params=has_params)
+            has_params=has_params,
+            mesh_coords=mesh_coords_of(self.rank, self._mesh_shape()))
         blob = encode_record(record)
         # SDC injection point: peer.corrupt flips bits in the ENCODED
         # wire blob (header digest already computed) — a bit-flip on the

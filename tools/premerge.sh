@@ -183,6 +183,31 @@ if r_fsdp >= 0.40 * r_mono:
         f"{r_fsdp / r_mono:.1%} of monolithic (must be < 40%: the "
         f"params-sharded-at-rest contract; fsdp={r_fsdp}, "
         f"monolithic={r_mono})")
+# 2-D mesh lane: fsdp on the emulated 4x2 (batch, model) split must hold
+# within 2% of 1-D fsdp (the two-leg gather must not cost wall clock on
+# the machinery-forced wire) and its resident bytes must not exceed the
+# 1-D rows (the rank-factorized layout is byte-identical by the ceil
+# identity — any growth means the layout regressed).
+fsdp_2d = last.get("vs_baseline_machinery_fsdp_2d")
+if fsdp_2d is None:
+    sys.exit(
+        "premerge perf lane: vs_baseline_machinery_fsdp_2d missing from "
+        "bench record (the 2-D mesh lane did not run)")
+if fsdp_2d < fsdp * 0.98:
+    sys.exit(
+        f"premerge perf lane: fsdp on the 2-D (batch, model) mesh "
+        f"regressed {(1 - fsdp_2d / fsdp) * 100:.1f}% below 1-D fsdp "
+        f"(fsdp_2d={fsdp_2d}, fsdp={fsdp}, allowed slack 2%)")
+r_2d = resident.get("fsdp_2d")
+if r_2d is None:
+    sys.exit(
+        "premerge perf lane: resident_bytes_per_rank has no fsdp_2d "
+        f"entry (got {resident!r})")
+if r_2d > r_fsdp:
+    sys.exit(
+        f"premerge perf lane: 2-D fsdp resident bytes exceed the 1-D "
+        f"rows (fsdp_2d={r_2d}, fsdp={r_fsdp}; the rank-factorized "
+        f"layout must be byte-identical)")
 comms = last.get("comms") or {}
 if not comms:
     sys.exit("premerge comms lane: bench record has no 'comms' section")
@@ -388,6 +413,9 @@ try:
         "hvd_param_gather_seconds",
         "hvd_resident_state_bytes",
         "hvd_fsdp_prefetch_overlap_ratio",
+        # 2-D (batch, model) mesh plane: zero-materialized per axis (0 =
+        # flat 1-D wire, absence = not measuring).
+        "hvd_mesh_axis_size",
         "hvd_policy_decisions_total",
         "hvd_policy_spare_hosts",
         "hvd_driver_epoch",
@@ -439,6 +467,17 @@ try:
         sys.exit(
             f"premerge metrics lane: core instruments missing samples "
             f"from the scrape: {missing}")
+    # The 2-D mesh instruments must carry BOTH per-axis cells — a scrape
+    # with the family present but an axis cell missing reads as "flat
+    # wire" when it might mean "not measuring that axis".
+    for fam in ("hvd_mesh_axis_size", "hvd_param_gather_bytes"):
+        axes = {labels.get("axis")
+                for labels, _ in parsed[fam]["samples"]}
+        if not {"batch", "model"} <= axes:
+            sys.exit(
+                f"premerge metrics lane: {fam} is missing per-axis "
+                f"cells (got axes {sorted(a for a in axes if a)!r}, "
+                f"need both 'batch' and 'model')")
     dispatches = sum(
         v for labels, v in parsed["hvd_collective_latency_seconds"]["samples"]
         if labels.get("le") == "+Inf")
