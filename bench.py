@@ -283,6 +283,22 @@ def _tree_bytes(tree) -> int:
         for l in jax.tree.leaves(tree)))
 
 
+def _peak_rss_bytes() -> int | None:
+    """Host-side peak resident set size (VmHWM from /proc/self/status):
+    the high-water mark of everything this process ever held in host
+    RAM — on the CPU-mesh bench the analog of the device HBM peak, and
+    the sanity bound the per-rank resident predictions must sit under.
+    None off Linux (no procfs)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) * 1024  # kB -> bytes
+    except OSError:
+        pass
+    return None
+
+
 def _measure_fetch_overhead(loss) -> float:
     """Round-trip cost of fetching an already-computed scalar (the tunnel
     RTT on remote backends). Each timed repeat ends in exactly one such
@@ -929,6 +945,55 @@ def main() -> int:
                 vs_baseline_machinery_fsdp_2d=round(raw[0] / t_2d, 4),
                 resident_bytes_per_rank=resident_by_mode,
             )
+
+    # --- section 4c4: memory observatory — the analytic footprint model
+    # (horovod_tpu/memory.predict_footprint) priced against the measured
+    # resident bytes the mode lanes above reported, one row per sync
+    # mode that actually ran. drift_ratio is |predicted - measured| /
+    # measured — the premerge memory gate asserts the fsdp row stays
+    # under 5%. host_peak_rss_bytes (VmHWM) is the host-side high-water
+    # mark: on the CPU mesh every "device" buffer is host RAM, so the
+    # per-rank predictions must sit comfortably under it.
+    def run_memory():
+        from horovod_tpu import memory as _memory
+
+        measured = dict(emit.record.get("resident_bytes_per_rank") or {})
+        lanes = {
+            "monolithic": ("allreduce", None),
+            "sharded": ("sharded", None),
+            "fsdp": ("fsdp", None),
+            "fsdp_2d": ("fsdp", (n // 2, 2)),
+        }
+        rows = {}
+        for mode, got in measured.items():
+            sync_mode, shape = lanes.get(mode, (None, None))
+            if sync_mode is None:
+                continue
+            fp = _memory.footprint_of(dist_opt, params, world_size=n,
+                                      sync_mode=sync_mode,
+                                      mesh_shape=shape)
+            want = int(fp["resident_total"])
+            rows[mode] = {
+                "predicted_resident_bytes": want,
+                "measured_resident_bytes": int(got),
+                "drift_ratio": (round(abs(want - got) / got, 6)
+                                if got else None),
+                "predicted_peak_bytes": int(fp["peak_total"]),
+            }
+        out = {"predicted_vs_measured": rows}
+        hwm = _peak_rss_bytes()
+        if hwm is not None:
+            out["host_peak_rss_bytes"] = hwm
+        summary = _memory.summary()
+        out["resident_bytes"] = summary.get("resident") or {}
+        out["watermark_bytes"] = summary.get("watermarks") or {}
+        return out
+
+    if raw is not None:
+        memory_lane = _with_retry("memory", run_memory, errors,
+                                  allow_retry=single_controller)
+        if memory_lane is not None:
+            emit.update(memory=memory_lane)
 
     # --- section 4d: per-phase step-time breakdown — forward_backward /
     # collective / optimizer_update medians (the attribution plane's
@@ -1590,6 +1655,24 @@ def main() -> int:
                   file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — observability only
             print(f"# bench: comms snapshot failed: {exc}",
+                  file=sys.stderr)
+    # HOROVOD_MEMORY_SNAPSHOT=/path: dump this run's memory-observatory
+    # payload (the same wire format a worker piggybacks on heartbeats)
+    # so the premerge gate can publish it to a live KV server as two
+    # ranks and fetch the cluster-merged GET /memory back over HTTP.
+    memory_path = os.environ.get("HOROVOD_MEMORY_SNAPSHOT", "")
+    if memory_path:
+        try:
+            import json as _json
+
+            from horovod_tpu import memory as _memory
+
+            with open(memory_path, "w") as f:
+                _json.dump(_memory.get_observatory().payload(), f)
+            print(f"# bench: memory snapshot written to {memory_path}",
+                  file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — observability only
+            print(f"# bench: memory snapshot failed: {exc}",
                   file=sys.stderr)
     emit.update(bench_wall_time_s=round(time.perf_counter() - t_start, 1))
     return 0 if dist is not None else 1

@@ -105,6 +105,7 @@ from . import attribution  # noqa: F401
 from .attribution import set_model_flops_per_step  # noqa: F401
 from . import autotune  # noqa: F401
 from . import comms_model  # noqa: F401
+from . import memory  # noqa: F401
 from .ops import comms_planner  # noqa: F401
 from . import faults  # noqa: F401
 from . import metrics  # noqa: F401

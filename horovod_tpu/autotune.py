@@ -413,7 +413,9 @@ class AutotuneStep:
         if self._prune_checked:
             return
         self._prune_checked = True
-        if not model_guided_enabled():
+        from . import memory as _memory
+
+        if not model_guided_enabled() and not _memory.memory_guard_enabled():
             return
         # LOCAL pricing may fail safe (kept_idx=None = no pruning): rank
         # 0's verdict is what everyone adopts, so a rank-local pricing
@@ -427,19 +429,38 @@ class AutotuneStep:
 
             model = comms_model.get_model()
             leaf_sizes = model.leaf_sizes()
-            if model.ready() and leaf_sizes and len(self._cands) > 1:
+            kept_list = list(self._cands[1:])
+            did_filter = False
+            if (model_guided_enabled() and model.ready() and leaf_sizes
+                    and len(self._cands) > 1):
                 from .ops.collective_ops import _link_class_of
                 from .process_sets import global_process_set
 
                 link_class = _link_class_of(global_process_set)
                 verdict = comms_model.prune_candidates(
-                    self._cands[1:], leaf_sizes, link_class)
+                    kept_list, leaf_sizes, link_class)
+                kept_list = verdict["kept"]
+                did_filter = True
+            if _memory.memory_guard_enabled() and len(self._cands) > 1:
+                # Second stage: the memory guard drops candidates whose
+                # predicted per-rank peak exceeds device capacity —
+                # pure pricing from the noted layout + env, so every
+                # rank agrees, but rank 0's list is still what is
+                # adopted (same broadcast discipline as the cost stage).
+                mem_verdict = _memory.filter_candidates(kept_list)
+                if mem_verdict["pruned"]:
+                    get_logger().info(
+                        "autotune: memory guard rejected %d candidate(s) "
+                        "over HBM capacity: %s",
+                        len(mem_verdict["pruned"]), mem_verdict["pruned"])
+                    kept_list = mem_verdict["kept"]
+                    did_filter = True
+            if did_filter:
                 # kept is an order-preserving subsequence of the tail:
                 # recover indices with a two-pointer walk (id()/set
                 # matching would misbehave on duplicate grid values).
                 kept_idx = []
                 ki = 0
-                kept_list = verdict["kept"]
                 for i, c in enumerate(self._cands[1:]):
                     if ki < len(kept_list) and kept_list[ki] == c:
                         kept_idx.append(i)
@@ -745,6 +766,15 @@ def tune_step_sync_mode(
     try:
         for mode, shape in grid:
             try:
+                # The memory guard prices the candidate BEFORE building
+                # it: a mode predicted to blow HBM raises
+                # MemoryBudgetExceededError (a SyncModeIneligibleError,
+                # so it rides the same rank-identical skip as the guard
+                # tables — pricing is a pure function of the noted
+                # layout + env). Inert with the knob unset.
+                from . import memory as _memory
+
+                _memory.check_candidate(mode, mesh_shape=shape)
                 run = build_step(mode, shape) if joint else build_step(mode)
                 out = run()  # compile + settle
             except SyncModeIneligibleError as e:

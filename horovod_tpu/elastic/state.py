@@ -232,7 +232,41 @@ class TpuState(State):
             setattr(self, k, v)
         self._extras = list(extras.keys())
         self._saved: dict[str, Any] | None = None
+        self._note_memory()
         self.commit()
+
+    def _note_memory(self) -> None:
+        """Register the live training state with the memory observatory:
+        exact per-rank resident bytes for params and opt_state (a
+        stacked world-axis layout divides by its leading axis — each
+        rank materializes one row), plus the named top leaves the OOM
+        forensics record names. Never raises."""
+        try:
+            from .. import memory
+            from ..parallel.param_sharding import ShardedParams
+
+            world = self._state_world_size() or 1
+            if self.params is not None:
+                if isinstance(self.params, ShardedParams):
+                    n = self.params.world_size
+                    leaves = memory.named_leaf_bytes(
+                        self.params.shards_tree())
+                    top = [(name, b // max(1, n)) for name, b in leaves]
+                    memory.note_resident(
+                        "params", sum(b for _, b in top),
+                        top_leaves=top[:memory.top_n()])
+                else:
+                    top = memory.named_leaf_bytes(self.params)
+                    memory.note_resident(
+                        "params", sum(b for _, b in top),
+                        top_leaves=top[:memory.top_n()])
+            if self.opt_state is not None:
+                nbytes = memory.tree_nbytes(self.opt_state)
+                if self._sharded_spec is not None:
+                    nbytes //= max(1, world)
+                memory.note_resident("opt_state", nbytes)
+        except Exception:  # noqa: BLE001 — instrumentation only
+            pass
 
     def _state_world_size(self) -> int | None:
         """Leading world-axis length of the stacked sharded state (every

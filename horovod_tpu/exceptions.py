@@ -78,6 +78,20 @@ class SyncModeIneligibleError(ValueError):
     """
 
 
+class MemoryBudgetExceededError(SyncModeIneligibleError):
+    """The autotune memory guard rejected a candidate configuration.
+
+    Raised by ``memory.check_candidate`` when a (sync_mode, segments,
+    mesh-shape) candidate's predicted per-rank footprint
+    (``memory.predict_footprint`` over the noted parameter layout)
+    exceeds the device HBM capacity. Subclasses
+    :class:`SyncModeIneligibleError` so ``autotune.tune_step_sync_mode``
+    SKIPS the candidate rank-identically (the prediction is a pure
+    function of the layout and env, identical on every rank) instead of
+    aborting the sweep.
+    """
+
+
 class HostsUpdatedInterrupt(HorovodTpuError):
     """Raised when the elastic driver reports a host-set change.
 

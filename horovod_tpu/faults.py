@@ -79,6 +79,12 @@ kill workers by behavior flag). This module generalizes that into named
   serving tier's RCU pointer (``drop`` skips the swap — last-good keeps
   serving, the next poll retries; ``delay`` widens the swap window the
   concurrency tests hammer)
+- ``memory.pressure``    — every stall-watched factory step entry
+  (``parallel/data_parallel.py``): ``drop`` raises a synthetic
+  ``RESOURCE_EXHAUSTED`` at the step boundary — the deterministic
+  device-OOM injector behind the memory observatory's forensics tests
+  (the boundary catches it, dumps the ``oom`` flight record naming the
+  top resident leaves, and re-raises)
 
 The canonical **control-plane injectors** are these three plus
 :func:`kill_driver` (SIGKILL the driver process — the KV server dies
@@ -188,6 +194,12 @@ POOL_ASSIGN = "pool.assign"
 MODEL_PUBLISH = "model.publish"
 SERVE_FETCH = "serve.fetch"
 SERVE_SWAP = "serve.swap"
+# The memory observatory's OOM injector (parallel/data_parallel.py, the
+# factory step boundary): ``drop`` raises a synthetic RESOURCE_EXHAUSTED
+# at the step boundary — the deterministic device-OOM the forensics
+# tests ride (the boundary's catch dumps the memory flight record and
+# re-raises); ``delay`` stalls the step entry like worker.step.
+MEMORY_PRESSURE = "memory.pressure"
 
 _MODES = ("drop", "delay", "raise", "hang", "corrupt")
 _DEFAULT_HANG_S = 3600.0

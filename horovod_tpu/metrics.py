@@ -699,6 +699,29 @@ RESIDENT_BYTES = gauge(
     "hvd_resident_state_bytes",
     "Per-rank resident bytes of sharded training state at rest, by kind "
     "(params|opt_state) and sync_mode.", ("kind", "sync_mode"))
+HBM_BYTES = gauge(
+    "hvd_hbm_bytes",
+    "Per-rank resident device-memory bytes by kind (params|opt_state|"
+    "grads|peer_pool|executables|serving|other) — the memory "
+    "observatory's live accounting (horovod_tpu/memory.py): exact "
+    "nbytes noted by the call sites that materialize each kind, plus "
+    "polled suppliers (replica pool, executable cache).", ("kind",))
+HBM_WATERMARK = gauge(
+    "hvd_hbm_watermark_bytes",
+    "Peak resident bytes observed at span exits of each step phase "
+    "(step|forward_backward|collective|optimizer_update|other) — the "
+    "memory observatory's per-phase high-water marks, folded in by the "
+    "tracing plane.", ("phase",))
+HBM_HEADROOM = gauge(
+    "hvd_hbm_headroom_ratio",
+    "1 - resident_total/capacity, clamped to [0,1]. Capacity comes from "
+    "HOROVOD_HBM_BYTES_PER_DEVICE or the backend's memory_stats "
+    "bytes_limit; 0 = no capacity source known (never a guess).")
+HBM_RESIDUAL = gauge(
+    "hvd_hbm_model_residual_bytes",
+    "Predicted minus measured resident bytes over the model kinds "
+    "(params+opt_state) — the footprint model's drift alarm "
+    "(memory.predict_footprint vs the live accounting).")
 FSDP_PREFETCH_OVERLAP = gauge(
     "hvd_fsdp_prefetch_overlap_ratio",
     "Fraction of the fsdp parameter-gather time hidden under compute "
@@ -962,6 +985,18 @@ def _materialize_checkpoint_cells() -> None:
     EXPOSED_COMM.labels()
     OVERLAP_HIDDEN.labels()
     MFU_RATIO.labels()
+    # Memory-observatory zero cells: a job that never measured (or has
+    # no capacity source) still reports the series at 0, so the
+    # premerge scrape gate can assert the instruments exist and
+    # dashboards can tell "nothing resident yet" from "not measuring".
+    for kind in ("params", "opt_state", "grads", "peer_pool",
+                 "executables", "serving", "other"):
+        HBM_BYTES.labels(kind=kind)
+    for phase in ("step", "forward_backward", "collective",
+                  "optimizer_update", "other"):
+        HBM_WATERMARK.labels(phase=phase)
+    HBM_HEADROOM.labels()
+    HBM_RESIDUAL.labels()
 
 
 _materialize_checkpoint_cells()

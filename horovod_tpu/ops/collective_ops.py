@@ -53,8 +53,15 @@ def cache_stats(reset: bool = False) -> dict:
     Parity: the reference's response-cache hit statistics
     (``response_cache.cc``) surfaced through the timeline. Returns::
 
-        {"executable_cache": {"hits", "misses", "size", "capacity"},
+        {"executable_cache": {"hits", "misses", "size", "capacity",
+                              "bytes"},
          "eager_dispatch": {kind: count, ...}}
+
+    ``bytes`` is the cache's noted memory cost — the sum of each resident
+    entry's serialized-program size, recorded by the dispatch path on the
+    compile miss (entries whose size could not be measured contribute 0,
+    so it is a lower bound). The same total feeds the memory
+    observatory's ``hvd_hbm_bytes{kind="executables"}`` gauge.
 
     Also surfaced in ``hvd.profiler.summary()`` and emitted once per run
     by ``bench.py``.
@@ -71,6 +78,7 @@ def cache_stats(reset: bool = False) -> dict:
             "misses": cache.misses,
             "size": len(cache),
             "capacity": cache.capacity,
+            "bytes": cache.nbytes(),
         },
         "eager_dispatch": dict(_dispatch_counts),
     }
@@ -425,6 +433,15 @@ def _eager_dispatch(kind: str, traced_fn, x, process_set, extra_key=(),
     if missed:
         _metrics.COLLECTIVE_COMPILE.observe(build_info["compile_s"],
                                             kind=kind)
+        try:
+            # Note the entry's memory cost once, on the miss that paid
+            # the compile: the lowered program text is a serialization
+            # proxy for the executable's size (exact device code size is
+            # not exposed portably). Feeds cache_stats()["bytes"] and
+            # hvd_hbm_bytes{kind="executables"}.
+            cache.note_bytes(key, len(compiled.lower(x).as_text()))
+        except Exception:  # noqa: BLE001 — the ledger is best-effort
+            pass
     sharding = NamedSharding(mesh, P(axis))
     x = jax.device_put(x, sharding)
     # Eager ops are synchronous (reference parity: hvd.allreduce blocks;

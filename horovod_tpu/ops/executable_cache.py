@@ -12,6 +12,14 @@ bucket-size quantization in the fusion pass).
 
 An LRU bound (``HOROVOD_CACHE_CAPACITY``) protects against signature churn
 from dynamic shapes, just as the reference's capacity bound does.
+
+The cache also keeps a per-entry serialized-cost ledger
+(:meth:`ExecutableCache.note_bytes` / :meth:`ExecutableCache.nbytes`):
+the dispatch path notes each compiled program's serialized size on the
+miss, so ``hvd.cache_stats()`` can report the cache's memory cost in
+bytes and the memory observatory can expose it as
+``hvd_hbm_bytes{kind="executables"}`` — previously the cache's size was
+visible only as an entry COUNT.
 """
 
 from __future__ import annotations
@@ -33,6 +41,10 @@ class ExecutableCache:
         # each count a miss — the first caller builds, the rest wait on
         # its event and read the landed entry (single-flight).
         self._building: dict[Hashable, threading.Event] = {}
+        # Serialized executable cost per entry (noted best-effort by the
+        # dispatch path on each miss); evicted/cleared entries drop
+        # their ledger rows with them.
+        self._bytes: dict[Hashable, int] = {}
         self.hits = 0
         self.misses = 0
 
@@ -65,14 +77,36 @@ class ExecutableCache:
             self._entries[key] = value
             self._entries.move_to_end(key)
             while len(self._entries) > self.capacity:
-                self._entries.popitem(last=False)
+                evicted, _ = self._entries.popitem(last=False)
+                self._bytes.pop(evicted, None)
             self._building.pop(key, None)
         done.set()
         return value
 
+    def note_bytes(self, key: Hashable, nbytes: int) -> None:
+        """Record one entry's serialized executable cost (dispatch notes
+        it on the miss). Unknown keys (already evicted) are ignored."""
+        try:
+            nbytes = int(nbytes)
+        except (TypeError, ValueError):
+            return
+        if nbytes < 0:
+            return
+        with self._lock:
+            if key in self._entries:
+                self._bytes[key] = nbytes
+
+    def nbytes(self) -> int:
+        """Total noted serialized bytes of the resident entries — a
+        lower bound on the cache's memory cost (entries whose dispatch
+        could not serialize a cost report 0)."""
+        with self._lock:
+            return sum(self._bytes.values())
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()
+            self._bytes.clear()
             self.hits = 0
             self.misses = 0
 
@@ -102,4 +136,17 @@ def global_cache() -> ExecutableCache:
         else:
             capacity = get_int("HOROVOD_CACHE_CAPACITY", 1024)
         _global_cache = ExecutableCache(capacity)
+    try:
+        # The memory observatory polls the cache's serialized cost
+        # live (hvd_hbm_bytes{kind="executables"}) — entries land
+        # from any dispatch path, outside local noting call sites.
+        # Registered on every lookup (an idempotent dict write) so a
+        # fresh observatory — reset_for_testing — re-acquires it.
+        from .. import memory
+
+        cache = _global_cache
+        memory.get_observatory().register_supplier(
+            "executables", cache.nbytes)
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
     return _global_cache

@@ -249,6 +249,19 @@ def _note_leaf_sizes(tensors) -> None:
         ])
     except Exception:  # noqa: BLE001 — instrumentation is best-effort
         pass
+    try:
+        # The memory observatory keeps the element-accurate twin (it
+        # shards ELEMENT counts, not bytes — ceil(10/8)*4 != ceil(40/8)):
+        # the layout the autotune memory guard prices candidate
+        # (sync_mode, segments, mesh) footprints against.
+        from .. import memory
+
+        memory.get_observatory().note_layout([
+            (int(t.size), jnp.dtype(t.dtype).itemsize, str(t.dtype))
+            for t in tensors
+        ])
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
 
 
 def _reduce_bucket(flat, op, axis_name, prescale_factor, postscale_factor):

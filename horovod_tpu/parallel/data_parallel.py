@@ -127,31 +127,57 @@ class _StallWatchedStep:
         # exactly like the stall watch: the pipeline drain would bias
         # the tuner's samples.
         self._trace_calls += 1
-        with tracer.step_scope(self._prefix) as rec:
-            sample = tracing.sample_every()
-            sample_due = (not tuning and sample > 0
-                          and self._trace_calls % sample == 0)
-            if watch_due:
-                import jax
+        try:
+            from .. import faults
 
-                from ..stall import watch
-
-                # The announcement precedes the DISPATCH: on backends
-                # that execute synchronously (CPU) a diverged peer hangs
-                # this rank inside the jitted call itself, before any
-                # post-hoc fetch could announce.
-                with watch(name=f"{self._prefix}.{n}", cross_rank=cross):
-                    out = self._fn(*args, **kwargs)
-                    out = jax.block_until_ready(out)
-                rec.synced = True
-            else:
-                out = self._fn(*args, **kwargs)
-                if sample_due:
+            if faults.fire(faults.MEMORY_PRESSURE):
+                # drop = synthetic device OOM at the step boundary: the
+                # deterministic injector behind the memory observatory's
+                # forensics tests (caught and dumped just below, exactly
+                # like a real RESOURCE_EXHAUSTED out of the jitted call).
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: injected memory pressure "
+                    "(fault point memory.pressure)")
+            with tracer.step_scope(self._prefix) as rec:
+                sample = tracing.sample_every()
+                sample_due = (not tuning and sample > 0
+                              and self._trace_calls % sample == 0)
+                if watch_due:
                     import jax
 
-                    out = jax.block_until_ready(out)
+                    from ..stall import watch
+
+                    # The announcement precedes the DISPATCH: on backends
+                    # that execute synchronously (CPU) a diverged peer hangs
+                    # this rank inside the jitted call itself, before any
+                    # post-hoc fetch could announce.
+                    with watch(name=f"{self._prefix}.{n}",
+                               cross_rank=cross):
+                        out = self._fn(*args, **kwargs)
+                        out = jax.block_until_ready(out)
                     rec.synced = True
-            rec.ship = sample_due and rec.synced
+                else:
+                    out = self._fn(*args, **kwargs)
+                    if sample_due:
+                        import jax
+
+                        out = jax.block_until_ready(out)
+                        rec.synced = True
+                rec.ship = sample_due and rec.synced
+        except Exception as exc:
+            # The factory step boundary is the OOM forensics consumer:
+            # a RESOURCE_EXHAUSTED surfacing here dumps a memory flight
+            # record naming the top resident leaves and the
+            # predicted-vs-measured delta, then re-raises untouched
+            # (recovery policy belongs to the elastic loop, not here).
+            try:
+                from .. import memory
+
+                if memory.is_oom_error(exc):
+                    memory.dump_oom_record(exc, step=self._prefix)
+            except Exception:  # noqa: BLE001 — forensics must not
+                pass  # mask the original failure
+            raise
         return out
 
     @property

@@ -147,6 +147,37 @@ def _record_resident(kind: str, sync_mode: str, nbytes: int) -> None:
         metrics.RESIDENT_BYTES.set(nbytes, kind=kind, sync_mode=sync_mode)
     except Exception:  # noqa: BLE001 — instrumentation is best-effort
         pass
+    try:
+        # The memory observatory's live accounting rides the same call
+        # sites: every (re)materialization of sharded state updates the
+        # hvd_hbm_bytes{kind} cell with its exact per-rank nbytes.
+        from .. import memory
+
+        memory.note_resident(kind, nbytes)
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
+
+
+def _note_param_leaves(params, sizes, world_size: int) -> None:
+    """Feed the memory observatory's forensics table: the per-rank
+    resident bytes of every named parameter leaf (ownership-map rows,
+    not full leaves — the bytes that actually sit in HBM). Never
+    raises."""
+    try:
+        from .. import memory
+
+        flat, _ = jax.tree_util.tree_flatten_with_path(params)
+        per_leaf = [
+            (jax.tree_util.keystr(path) or "<root>",
+             int(s) * np.dtype(leaf.dtype).itemsize)
+            for (path, leaf), s in zip(flat, sizes)
+        ]
+        per_leaf.sort(key=lambda kv: kv[1], reverse=True)
+        memory.note_resident(
+            "params", sum(b for _, b in per_leaf),
+            top_leaves=per_leaf[:memory.top_n()])
+    except Exception:  # noqa: BLE001 — instrumentation is best-effort
+        pass
 
 
 def shard_params(params, world_size: int | None = None) -> ShardedParams:
@@ -190,6 +221,7 @@ def shard_params(params, world_size: int | None = None) -> ShardedParams:
     )
     sp = ShardedParams(rows, meta)
     _record_resident("params", "fsdp", _resident_bytes(rows, n))
+    _note_param_leaves(params, sizes, n)
     return sp
 
 

@@ -258,6 +258,22 @@ class ReplicaPool:
     def __init__(self):
         self._lock = threading.Lock()
         self._slots: dict[str, ReplicaRecord] = {}
+        try:
+            # The memory observatory polls the pool's host-memory bytes
+            # live (hvd_hbm_bytes{kind="peer_pool"}): replicas arrive
+            # from peers outside any local noting call site.
+            from . import memory
+
+            memory.get_observatory().register_supplier(
+                "peer_pool", self.nbytes)
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+
+    def nbytes(self) -> int:
+        """Total encoded payload bytes resident in the pool (both
+        slots)."""
+        with self._lock:
+            return sum(len(r.payload) for r in self._slots.values())
 
     def install(self, blob_or_record) -> ReplicaRecord:
         """Verify + rotate one record in. Raises

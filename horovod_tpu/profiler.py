@@ -83,11 +83,15 @@ def summary() -> dict:
     when ``hvd.set_model_flops_per_step`` declared the model's FLOPs,
     the predicted-vs-observed exposed-comm residual, and the local
     regression sentinel's state — see docs/observability.md "Step-time
-    attribution"). ``bench.py`` emits this once per run so every
-    benchmark record carries the cache/goodput behavior that produced
-    it.
+    attribution"), and the HBM memory observatory (``"memory"``:
+    per-kind resident bytes, the per-phase watermarks, the footprint
+    model's predicted-vs-measured residual, headroom, and the top
+    resident leaves — reset via ``memory.reset_for_testing()``).
+    ``bench.py`` emits this once per run so every benchmark record
+    carries the cache/goodput behavior that produced it.
     """
-    from . import attribution, comms_model, integrity, metrics, tracing
+    from . import (attribution, comms_model, integrity, memory, metrics,
+                   tracing)
     from .ops.collective_ops import cache_stats
 
     return {
@@ -100,6 +104,7 @@ def summary() -> dict:
         "comms": comms_model.summary(),
         "integrity": integrity.summary(),
         "attribution": attribution.summary(),
+        "memory": memory.summary(),
         **cache_stats(),
     }
 

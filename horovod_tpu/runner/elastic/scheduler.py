@@ -649,6 +649,7 @@ class MultiJobScheduler:
                 continue
             job.lease = granted
             self._write_lease(job)
+            self._admission_memory_check(job)
             self._launch_driver(job)
             self._pending.append({
                 "action": "grant", "job": job.job_id, "victim": None,
@@ -659,6 +660,36 @@ class MultiJobScheduler:
                               "target_goodput": job.spec.target_goodput},
                 "deadline": now + self._realize_timeout,
             })
+
+    def _admission_memory_check(self, job: "_JobHandle") -> None:
+        """Advisory HBM admission check at grant time: compare the
+        job's declared per-rank footprint (``HOROVOD_HBM_PREDICTED_BYTES``
+        in its env block — e.g. a prior run's ``predict_footprint``)
+        against the pool's advertised per-device HBM
+        (``HOROVOD_SCHED_HOST_HBM_BYTES``, falling back to the job's own
+        ``HOROVOD_HBM_BYTES_PER_DEVICE``). A predicted overrun journals
+        ONE ``admission_memory_risk`` event naming the deficit — the
+        grant itself is NEVER changed (with both knobs unset this is a
+        no-op, and scheduling decisions stay bit-for-bit identical)."""
+        try:
+            from ... import memory as _memory
+
+            predicted = job.spec.env.get("HOROVOD_HBM_PREDICTED_BYTES")
+            capacity = (os.environ.get("HOROVOD_SCHED_HOST_HBM_BYTES")
+                        or job.spec.env.get("HOROVOD_HBM_BYTES_PER_DEVICE"))
+            risk = _memory.admission_check(
+                int(predicted) if predicted else None,
+                int(capacity) if capacity else None)
+            if risk is not None:
+                self._log.warning(
+                    "sched: job %s predicts %d bytes/rank against %d "
+                    "bytes of host HBM (deficit %d); granting anyway "
+                    "(advisory)", job.job_id, risk["predicted_bytes"],
+                    risk["capacity_bytes"], risk["deficit_bytes"])
+                _metrics.event("admission_memory_risk", job=job.job_id,
+                               **risk)
+        except Exception:  # noqa: BLE001 — advisory only, never blocks
+            pass
 
     def _deficit_order(self) -> list[_JobHandle]:
         """Running jobs by healing urgency (the arbiter's recipient

@@ -548,6 +548,18 @@ class ElasticWorkerContext:
                     body["comms"] = _comms_model.get_model().payload()
             except Exception:  # noqa: BLE001 — observability only
                 pass
+            try:
+                # Memory observatory: per-kind resident bytes and the
+                # phase watermarks ride the same beat (bounded: a few
+                # ints), so the driver's GET /memory serves a
+                # cluster-merged per-rank breakdown. Same parked-spare
+                # rule as the comms payload.
+                if not self.parked:
+                    from ... import memory as _memory
+
+                    body["memory"] = _memory.get_observatory().payload()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
         try:
             # Integrity defense plane: the latest state fingerprint
             # rides the beat (tiny — one digest + a few summaries) so

@@ -87,6 +87,7 @@ from urllib.request import Request, urlopen
 
 from ... import attribution as _attribution
 from ... import comms_model as _comms_model
+from ... import memory as _memory
 from ... import faults
 from ... import integrity as _integrity
 from ... import metrics as _metrics
@@ -284,6 +285,11 @@ class _KVHandler(BaseHTTPRequestHandler):
             # Same exemption as /metrics: read-only operational
             # telemetry (the cluster-merged alpha-beta link cost model).
             return self._serve_json(_render_comms, "application/json")
+        if self.path == "/memory":
+            # Same exemption: the cluster-merged HBM breakdown (per-rank
+            # resident bytes by kind, phase watermarks, headroom, model
+            # drift) — read-only operational telemetry like /comms.
+            return self._serve_json(_render_memory, "application/json")
         if self.path == "/integrity":
             # Same exemption: the collected integrity fingerprints (one
             # per rank, piggybacked on heartbeats) plus the live vote —
@@ -873,6 +879,40 @@ def _render_comms(httpd) -> dict:
     smoke) serves an explicit ``insufficient_samples`` body — never a
     500 (``comms_model.merge_payloads`` owns that contract)."""
     merged = _comms_model.merge_payloads(_comms_payloads(httpd))
+    with httpd.lock:
+        merged["generation"] = httpd.version
+    return merged
+
+
+def _memory_payloads(httpd) -> dict[str, dict]:
+    """Per-rank memory-observatory payloads, as piggybacked on heartbeat
+    PUTs (the ``"memory"`` key of each heartbeat body), keyed by host.
+    Malformed heartbeats are skipped — same tolerance as the comms
+    piggyback."""
+    with httpd.lock:
+        raw = dict(httpd.store.get(HEARTBEAT_SCOPE, {}))
+    out: dict[str, dict] = {}
+    for host, body in raw.items():
+        try:
+            hb = json.loads(body)
+        except (ValueError, UnicodeDecodeError):
+            continue
+        if not isinstance(hb, dict):
+            continue
+        mem = hb.get("memory")
+        if isinstance(mem, dict):
+            out[host] = mem
+    return out
+
+
+def _render_memory(httpd) -> dict:
+    """``GET /memory``: the cluster-merged HBM breakdown. A world where
+    nothing measured yet (cold start, parked spares) serves an explicit
+    ``insufficient_samples`` body — never a 500
+    (``memory.merge_payloads`` owns that contract). Generation-fenced
+    like ``/comms``: the body carries the world generation so readers
+    can discard cross-generation merges."""
+    merged = _memory.merge_payloads(_memory_payloads(httpd))
     with httpd.lock:
         merged["generation"] = httpd.version
     return merged
@@ -1480,6 +1520,13 @@ class RendezvousServer:
         straggler-evidence channel the elastic driver feeds
         ``elastic/policy.py``."""
         return _render_comms(self._httpd)
+
+    def memory_summary(self) -> dict:
+        """The cluster-merged HBM breakdown (what ``GET /memory``
+        serves), rendered in-process — per-rank resident bytes by kind,
+        phase watermark maxes, the minimum headroom ratio, and the
+        worst model drift."""
+        return _render_memory(self._httpd)
 
     def trace_payload(self, host: str) -> dict | None:
         """The last trace payload a host shipped, parsed, or None."""

@@ -378,6 +378,19 @@ class ModelServer:
             self._degraded = False  # fresh model: re-arm the SLO latch
         dt = time.perf_counter() - t0
         try:
+            # The installed model is resident HBM on this host for as
+            # long as it serves — and during the swap window BOTH the
+            # old and new trees are live (RCU: readers may still hold
+            # the old reference). Note the RESIDENT side here; the
+            # transient double-buffer is what predict_footprint's
+            # serve_staging term prices.
+            from . import memory as _serve_memory
+
+            _serve_memory.note_resident(
+                "serving", nbytes or _serve_memory.tree_nbytes(params))
+        except Exception:  # noqa: BLE001 — observability only
+            pass
+        try:
             _metrics.SERVE_SWAPS.inc()
             _metrics.SERVE_SWAP_SECONDS.observe(dt)
             _metrics.SERVE_MODEL_AGE.set(0.0)

@@ -523,6 +523,16 @@ class span:
                 self._act.__exit__(*exc)
             except Exception:  # noqa: BLE001
                 pass
+        # Memory-observatory watermark hook: every span close folds the
+        # current resident total into its phase's high-water mark
+        # (memory.note_phase never raises and is cheap — cached cells
+        # plus two guarded supplier polls).
+        try:
+            from . import memory
+
+            memory.note_phase(self.name, self.cat)
+        except Exception:  # noqa: BLE001 — tracing must not fail
+            pass
         return False
 
 
@@ -684,6 +694,17 @@ def dump_flight_record(reason: str, generation: int | None = None,
             asum = attribution.flight_summary(snap)
             if asum is not None:
                 snap["attribution"] = asum
+        except Exception:  # noqa: BLE001 — the dump must still land
+            pass
+        # Memory snapshot rides EVERY dump: per-kind resident bytes,
+        # the phase watermarks, and the footprint model's drift — the
+        # first questions when the wedge or abort was memory-shaped.
+        try:
+            from . import memory
+
+            msum = memory.flight_summary()
+            if msum is not None:
+                snap["memory"] = msum
         except Exception:  # noqa: BLE001 — the dump must still land
             pass
         metrics.FLIGHT_DUMPS.inc(reason=reason)

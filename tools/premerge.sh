@@ -108,7 +108,8 @@ blog="$(mktemp "${TMPDIR:-/tmp}/_bench.XXXXXX.log")"
 msnap="$(mktemp "${TMPDIR:-/tmp}/_metrics.XXXXXX.json")"
 tsnap="$(mktemp "${TMPDIR:-/tmp}/_trace.XXXXXX.json")"
 csnap="$(mktemp "${TMPDIR:-/tmp}/_comms.XXXXXX.json")"
-trap 'rm -f "$t1log" "$blog" "$msnap" "$tsnap" "$csnap"' EXIT
+memsnap="$(mktemp "${TMPDIR:-/tmp}/_memory.XXXXXX.json")"
+trap 'rm -f "$t1log" "$blog" "$msnap" "$tsnap" "$csnap" "$memsnap"' EXIT
 # Scrape/timeline artifacts survive the run for build archiving.
 ARTIFACTS="${PREMERGE_ARTIFACTS:-${TMPDIR:-/tmp}/premerge-artifacts}"
 mkdir -p "$ARTIFACTS"
@@ -122,6 +123,7 @@ if ! JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     HOROVOD_METRICS_SNAPSHOT="$msnap" \
     HOROVOD_TRACE_SNAPSHOT="$tsnap" \
     HOROVOD_COMMS_SNAPSHOT="$csnap" \
+    HOROVOD_MEMORY_SNAPSHOT="$memsnap" \
     python bench.py --smoke | tee "$blog"; then
     echo "premerge: bench smoke failed" >&2
     exit 1
@@ -208,6 +210,24 @@ if r_2d > r_fsdp:
         f"premerge perf lane: 2-D fsdp resident bytes exceed the 1-D "
         f"rows (fsdp_2d={r_2d}, fsdp={r_fsdp}; the rank-factorized "
         f"layout must be byte-identical)")
+# Memory lane: the analytic footprint model must price the fsdp lane's
+# measured resident bytes within 5% (on the CPU mesh the shapes are
+# fully static, so the honest number is exact — the 5% slack only
+# absorbs a future lane changing its optimizer); a silent drift here
+# means predict_footprint no longer mirrors shard_ownership.
+memory = last.get("memory") or {}
+mem_rows = memory.get("predicted_vs_measured") or {}
+mem_fsdp = mem_rows.get("fsdp") or {}
+if not mem_fsdp:
+    sys.exit("premerge memory lane: bench record has no memory "
+             f"predicted_vs_measured fsdp row (got {memory!r})")
+drift = mem_fsdp.get("drift_ratio")
+if drift is None or drift > 0.05:
+    sys.exit(
+        f"premerge memory lane: footprint model drifted {drift!r} from "
+        f"the measured fsdp resident bytes (predicted="
+        f"{mem_fsdp.get('predicted_resident_bytes')!r}, measured="
+        f"{mem_fsdp.get('measured_resident_bytes')!r}, allowed 5%)")
 comms = last.get("comms") or {}
 if not comms:
     sys.exit("premerge comms lane: bench record has no 'comms' section")
@@ -300,6 +320,9 @@ print(f"premerge planner lane: ok (split schedule "
       f"{(up / uf) if up and uf else float('nan'):.4f})")
 print(f"premerge perf lane: ok (monolithic={mono}, sharded={sharded}, "
       f"fsdp={fsdp}, resident fsdp/mono={r_fsdp / r_mono:.1%})")
+print(f"premerge memory lane: ok (fsdp predicted "
+      f"{mem_fsdp['predicted_resident_bytes']} vs measured "
+      f"{mem_fsdp['measured_resident_bytes']} bytes, drift {drift})")
 print(f"premerge comms lane: ok (pruned {comms['autotune_pruned']} of "
       f"{len(comms.get('autotune_grid') or [])} candidates, winner "
       f"{comms['autotune_winner_guided']!r} matches exhaustive; fit "
@@ -327,7 +350,7 @@ echo "== premerge gate 4/4: /metrics scrape + /timeline + /criticalpath + /comms
 # any line flunks the strict Prometheus-text validator, or the core
 # instrument set (collective dispatch histograms, heartbeat gauge,
 # goodput counters) is absent.
-if ! JAX_PLATFORMS=cpu python - "$msnap" "$tsnap" "$ARTIFACTS" "$csnap" <<'EOF'
+if ! JAX_PLATFORMS=cpu python - "$msnap" "$tsnap" "$ARTIFACTS" "$csnap" "$memsnap" <<'EOF'
 import copy
 import json
 import os
@@ -354,6 +377,11 @@ with open(sys.argv[4]) as f:
 if not isinstance(comms, dict) or comms.get("status") != "ok":
     sys.exit("premerge comms lane: bench wrote no fitted comms payload "
              f"(status={comms.get('status') if isinstance(comms, dict) else comms!r})")
+with open(sys.argv[5]) as f:
+    mempayload = json.load(f)
+if not isinstance(mempayload, dict) or mempayload.get("status") != "ok":
+    sys.exit("premerge memory lane: bench wrote no measured memory payload "
+             f"(status={mempayload.get('status') if isinstance(mempayload, dict) else mempayload!r})")
 server = RendezvousServer(host="127.0.0.1")
 server.start()
 server.set_cluster_info(world_np=2)
@@ -371,13 +399,15 @@ try:
     client.put("heartbeat", socket.gethostname(), json.dumps(
         {"rank": 0, "steps": 1, "commits": 0, "metrics": snap,
          "integrity": irecs[0],
-         "comms": dict(comms, rank="0", host="bench-r0")}).encode())
+         "comms": dict(comms, rank="0", host="bench-r0"),
+         "memory": dict(mempayload, rank=0, host="bench-r0")}).encode())
     # A second rank's comms payload (relabeled) so GET /comms proves the
     # cluster merge over the real heartbeat plumbing with >=2 ranks.
     client.put("heartbeat", "bench-r1", json.dumps(
         {"rank": 1, "steps": 1, "commits": 0,
          "integrity": irecs[1],
-         "comms": dict(comms, rank="1", host="bench-r1")}).encode())
+         "comms": dict(comms, rank="1", host="bench-r1"),
+         "memory": dict(mempayload, rank=1, host="bench-r1")}).encode())
     # Publish the bench trace as rank 0, plus a relabeled copy as rank 1
     # whose wall clocks are shifted +5s with the matching measured
     # offset (-5s): after correction both ranks must land on one
@@ -460,6 +490,13 @@ try:
         "hvd_serve_rejected_publishes_total",
         "hvd_serve_requests_total",
         "hvd_serve_swap_seconds",
+        # HBM memory observatory: all four zero-materialized, and the
+        # bench's mode lanes note real resident bytes into the kind
+        # gauge (0 = nothing resident, absence = not measuring).
+        "hvd_hbm_bytes",
+        "hvd_hbm_watermark_bytes",
+        "hvd_hbm_headroom_ratio",
+        "hvd_hbm_model_residual_bytes",
     )
     missing = [m for m in required
                if not parsed.get(m, {}).get("samples")]
@@ -572,6 +609,30 @@ try:
             f"/comms merge, got {sorted(crank_payloads)}")
     if not cmerged.get("cluster"):
         sys.exit("premerge comms lane: /comms cluster aggregate is empty")
+    # Cluster-merged memory observatory over HTTP: >=2 rank payloads
+    # with measured resident breakdowns, summed per kind in the cluster
+    # aggregate (the same heartbeat piggyback plumbing as /comms).
+    murl = f"http://127.0.0.1:{server.port}/memory"
+    with urllib.request.urlopen(murl, timeout=10) as r:
+        if r.status != 200:
+            sys.exit(f"premerge memory lane: {murl} answered {r.status}")
+        mbody = r.read()
+    mmerged = json.loads(mbody)
+    if mmerged.get("status") != "ok":
+        sys.exit(
+            f"premerge memory lane: /memory status "
+            f"{mmerged.get('status')!r} (expected 'ok')")
+    mrank_payloads = mmerged.get("ranks") or {}
+    if len(mrank_payloads) < 2:
+        sys.exit(
+            f"premerge memory lane: expected >=2 rank payloads in the "
+            f"/memory merge, got {sorted(mrank_payloads)}")
+    mcluster = mmerged.get("cluster") or {}
+    if not mcluster.get("resident_bytes"):
+        sys.exit("premerge memory lane: /memory cluster aggregate has "
+                 f"no resident byte breakdown (got {mcluster!r})")
+    with open(os.path.join(artifacts, "memory.json"), "wb") as f:
+        f.write(mbody)
     # Integrity voting plane over HTTP: both piggybacked fingerprints
     # collected, and the newest complete group votes clean (bitwise
     # agreement is the steady state the plane certifies).
@@ -659,6 +720,9 @@ try:
     print(f"premerge comms lane: ok (/comms merged "
           f"{len(crank_payloads)} rank payloads, "
           f"{len(cmerged['cluster'])} cluster fit keys)")
+    print(f"premerge memory lane: ok (/memory merged "
+          f"{len(mrank_payloads)} rank payloads, cluster resident "
+          f"{mcluster.get('resident_total')!r} bytes)")
     print(f"premerge integrity lane: ok (/integrity collected "
           f"{len(irank_recs)} rank digests, clean "
           f"{ivote['voters']}-voter verdict)")
